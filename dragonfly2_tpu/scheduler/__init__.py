@@ -1,0 +1,7 @@
+"""Scheduler service layer (reference counterpart: scheduler/).
+
+Subpackages: ``evaluator`` (parent scoring — rule-based + ML), ``resource``
+(cluster state: hosts/tasks/peers, FSMs, peer DAG), ``scheduling`` (candidate
+selection core), ``networktopology`` (probe store), ``storage`` (dataset
+sink).
+"""

@@ -1,0 +1,30 @@
+"""Parent-peer evaluation (reference: scheduler/scheduling/evaluator/).
+
+Three algorithms, matching the reference's factory
+(evaluator.go:36-57 — ``default`` | ``ml`` | ``plugin``):
+
+- :class:`~dragonfly2_tpu.scheduler.evaluator.base.BaseEvaluator` — the
+  rule-based score math, behavior-identical to evaluator_base.go:32-247.
+  Doubles as the training-label generator for the ML path.
+- ``MLEvaluator`` (in :mod:`dragonfly2_tpu.inference.scorer`) — the TPU-backed
+  scorer that fills the reference's ``MLAlgorithm`` TODO (evaluator.go:48).
+- plugin loading via entry points (reference used Go .so plugins).
+"""
+
+from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+from dragonfly2_tpu.scheduler.evaluator.scoring import (
+    FEATURE_DIM,
+    FEATURE_NAMES,
+    idc_match,
+    location_matches,
+    rule_scores,
+)
+
+__all__ = [
+    "BaseEvaluator",
+    "FEATURE_DIM",
+    "FEATURE_NAMES",
+    "idc_match",
+    "location_matches",
+    "rule_scores",
+]

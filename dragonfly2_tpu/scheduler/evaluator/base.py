@@ -1,0 +1,139 @@
+"""Object-level rule-based evaluator.
+
+Reference counterpart: scheduler/scheduling/evaluator/evaluator_base.go.
+Operates on duck-typed peer objects (anything satisfying
+:class:`PeerLike`/:class:`HostLike` — the concrete resource model binds
+later) and delegates the arithmetic to the shared numeric core in
+:mod:`.scoring` so the control plane, the label generator, and the TPU
+scorer can never drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+import numpy as np
+
+from dragonfly2_tpu.scheduler.evaluator import scoring
+
+# Peer FSM state names (reference: scheduler/resource/peer.go:53-81).
+PEER_STATE_PENDING = "Pending"
+PEER_STATE_RECEIVED_EMPTY = "ReceivedEmpty"
+PEER_STATE_RECEIVED_TINY = "ReceivedTiny"
+PEER_STATE_RECEIVED_SMALL = "ReceivedSmall"
+PEER_STATE_RECEIVED_NORMAL = "ReceivedNormal"
+PEER_STATE_RUNNING = "Running"
+PEER_STATE_BACK_TO_SOURCE = "BackToSource"
+PEER_STATE_SUCCEEDED = "Succeeded"
+PEER_STATE_FAILED = "Failed"
+PEER_STATE_LEAVE = "Leave"
+
+# IsBadNode thresholds (evaluator_base.go:60-71).
+NORMAL_DISTRIBUTION_LEN = 30
+MIN_AVAILABLE_COST_LEN = 2
+
+# States in which a peer cannot serve as a parent (evaluator_base.go:211-218).
+_BAD_STATES = frozenset(
+    {
+        PEER_STATE_FAILED,
+        PEER_STATE_LEAVE,
+        PEER_STATE_PENDING,
+        PEER_STATE_RECEIVED_EMPTY,
+        PEER_STATE_RECEIVED_TINY,
+        PEER_STATE_RECEIVED_SMALL,
+        PEER_STATE_RECEIVED_NORMAL,
+    }
+)
+
+
+class HostLike(Protocol):
+    type: object  # HostType
+    upload_count: int
+    upload_failed_count: int
+    concurrent_upload_limit: int
+    idc: str
+    location: str
+
+    def free_upload_count(self) -> int: ...
+
+
+class PeerLike(Protocol):
+    id: str
+    host: HostLike
+
+    def state(self) -> str: ...
+    def finished_piece_count(self) -> int: ...
+    def piece_costs(self) -> Sequence[float]: ...
+
+
+def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> np.ndarray:
+    """Extract the canonical feature vector for one (parent, child) pair."""
+    host = parent.host
+    is_seed = getattr(host.type, "is_seed", bool(host.type))
+    state = parent.state()
+    return scoring.pack_features(
+        parent_finished_pieces=parent.finished_piece_count(),
+        child_finished_pieces=child.finished_piece_count(),
+        total_pieces=total_piece_count,
+        upload_count=host.upload_count,
+        upload_failed_count=host.upload_failed_count,
+        free_upload_count=host.free_upload_count(),
+        concurrent_upload_limit=host.concurrent_upload_limit,
+        is_seed=bool(is_seed),
+        seed_ready=state in (PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING),
+        parent_idc=host.idc,
+        child_idc=child.host.idc,
+        parent_location=host.location,
+        child_location=child.host.location,
+    )
+
+
+class BaseEvaluator:
+    """The ``default`` algorithm (evaluator.go:44-46)."""
+
+    def evaluate(self, parent: PeerLike, child: PeerLike, total_piece_count: int) -> float:
+        features = pair_features(parent, child, total_piece_count)
+        return float(scoring.rule_scores(features))
+
+    def evaluate_parents(
+        self, parents: Sequence[PeerLike], child: PeerLike, total_piece_count: int
+    ) -> list[PeerLike]:
+        """Sort candidate parents best-first (evaluator_base.go:80-90).
+
+        Scores the whole candidate set as one batched feature matrix —
+        O(n) feature extraction + one vectorized evaluation, instead of the
+        reference's O(n log n) re-evaluation inside a sort comparator.
+        """
+        if not parents:
+            return []
+        features = np.stack([pair_features(p, child, total_piece_count) for p in parents])
+        scores = scoring.rule_scores(features)
+        # Stable descending sort keeps the reference's tie behavior
+        # (sort.Slice with strict '>' keeps equal-score input order).
+        order = np.argsort(-scores, kind="stable")
+        return [parents[i] for i in order]
+
+    def is_bad_node(self, peer: PeerLike) -> bool:
+        """Statistical bad-node detection (evaluator_base.go:211-247).
+
+        A peer is bad if its FSM is in a non-serving state, or its latest
+        piece cost is an outlier: >20x the mean of prior costs when the
+        sample is small (<30), or outside mean+3*sigma once the sample is
+        large enough to assume normality.
+        """
+        if peer.state() in _BAD_STATES:
+            return True
+
+        costs = np.asarray(peer.piece_costs(), dtype=np.float64)
+        if len(costs) < MIN_AVAILABLE_COST_LEN:
+            return False
+
+        last = costs[-1]
+        prior = costs[:-1]
+        mean = prior.mean()
+        if len(costs) < NORMAL_DISTRIBUTION_LEN:
+            return bool(last > mean * 20)
+
+        # Population standard deviation, matching the reference's
+        # stats.StandardDeviation.
+        return bool(last > mean + 3 * prior.std())

@@ -1,0 +1,68 @@
+"""Dataset record schemas + columnar IO.
+
+Reference counterpart: scheduler/storage/types.go:1-320. These records are
+the training-data contract between the scheduler (producer), the trainer
+(consumer), and the inference scorer (feature layout): ``Download`` rows
+train the MLP bandwidth predictor; ``NetworkTopology`` rows train the
+GraphSAGE topology model.
+
+Design notes (TPU-first):
+- The reference serialises nested records to CSV with *fixed-arity* list
+  flattening (``csv[]:"20"`` / ``"10"`` / ``"5"`` tags). We keep exactly that
+  fixed arity — not for CSV nostalgia, but because fixed arity is what gives
+  every flattened row a static width, which is what XLA needs for batched
+  feature tensors. The flattener in :mod:`.records` is the single source of
+  truth for column order.
+- Bulk IO is columnar (parquet via pyarrow); CSV remains supported for
+  interop with reference-format datasets.
+"""
+
+from dragonfly2_tpu.schema.records import (
+    MAX_DEST_HOSTS,
+    MAX_PARENTS,
+    MAX_PIECES_PER_PARENT,
+    CPU,
+    CPUTimes,
+    Build,
+    DestHost,
+    Disk,
+    Download,
+    DownloadError,
+    Host,
+    Memory,
+    Network,
+    NetworkTopology,
+    Parent,
+    Piece,
+    Probes,
+    SrcHost,
+    Task,
+    column_spec,
+    flatten_record,
+    unflatten_record,
+)
+
+__all__ = [
+    "MAX_DEST_HOSTS",
+    "MAX_PARENTS",
+    "MAX_PIECES_PER_PARENT",
+    "CPU",
+    "CPUTimes",
+    "Build",
+    "DestHost",
+    "Disk",
+    "Download",
+    "DownloadError",
+    "Host",
+    "Memory",
+    "Network",
+    "NetworkTopology",
+    "Parent",
+    "Piece",
+    "Probes",
+    "SrcHost",
+    "Task",
+    "column_spec",
+    "flatten_record",
+    "unflatten_record",
+]

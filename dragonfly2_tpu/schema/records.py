@@ -1,0 +1,371 @@
+"""Training-record schemas with deterministic fixed-arity flattening.
+
+Reference counterpart: scheduler/storage/types.go (Download at :189-225,
+NetworkTopology at :284-320, Host telemetry sub-structs from
+scheduler/resource/host.go:200-340). Field names and arities match the
+reference so datasets are semantically interchangeable; the flattened column
+order defined here is the canonical feature layout for the ML pipeline.
+
+Flattening rules:
+- nested records flatten to dot-joined column names (``host.cpu.percent``)
+- fixed-arity lists flatten each slot with a numeric path segment
+  (``parents.3.host.network.idc``); absent slots are zero/empty-padded and a
+  companion ``<list>.len`` column records true arity, so padding is
+  distinguishable from real zeros downstream (used to build masks on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, List, Tuple, Type, get_args, get_origin
+
+# Fixed arities, identical to the reference's csv[] tags
+# (scheduler/storage/types.go:214 parents "20", :173 pieces "10",
+#  :316 destHosts "5").
+MAX_PARENTS = 20
+MAX_PIECES_PER_PARENT = 10
+MAX_DEST_HOSTS = 5
+
+
+def _arity(f: dataclasses.Field) -> int:
+    return f.metadata["arity"]
+
+
+def list_field(arity: int):
+    """A fixed-arity list field (flattened to ``arity`` column groups)."""
+    return field(default_factory=list, metadata={"arity": arity})
+
+
+# --------------------------------------------------------------------------
+# Host telemetry (reference: scheduler/resource/host.go:200-340)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class CPUTimes:
+    user: float = 0.0
+    system: float = 0.0
+    idle: float = 0.0
+    nice: float = 0.0
+    iowait: float = 0.0
+    irq: float = 0.0
+    softirq: float = 0.0
+    steal: float = 0.0
+    guest: float = 0.0
+    guest_nice: float = 0.0
+
+
+@dataclass
+class CPU:
+    logical_count: int = 0
+    physical_count: int = 0
+    percent: float = 0.0
+    process_percent: float = 0.0
+    times: CPUTimes = field(default_factory=CPUTimes)
+
+
+@dataclass
+class Memory:
+    total: int = 0
+    available: int = 0
+    used: int = 0
+    used_percent: float = 0.0
+    process_used_percent: float = 0.0
+    free: int = 0
+
+
+@dataclass
+class Network:
+    tcp_connection_count: int = 0
+    upload_tcp_connection_count: int = 0
+    location: str = ""  # multi-element affinity path, '|'-separated
+    idc: str = ""
+
+
+@dataclass
+class Disk:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    used_percent: float = 0.0
+    inodes_total: int = 0
+    inodes_used: int = 0
+    inodes_free: int = 0
+    inodes_used_percent: float = 0.0
+
+
+@dataclass
+class Build:
+    git_version: str = ""
+    git_commit: str = ""
+    platform: str = ""
+
+
+@dataclass
+class Host:
+    """Full host snapshot attached to download records
+    (reference: scheduler/storage/types.go:57-127)."""
+
+    id: str = ""
+    type: str = "normal"
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    download_port: int = 0
+    os: str = ""
+    platform: str = ""
+    platform_family: str = ""
+    platform_version: str = ""
+    kernel_version: str = ""
+    concurrent_upload_limit: int = 0
+    concurrent_upload_count: int = 0
+    upload_count: int = 0
+    upload_failed_count: int = 0
+    cpu: CPU = field(default_factory=CPU)
+    memory: Memory = field(default_factory=Memory)
+    network: Network = field(default_factory=Network)
+    disk: Disk = field(default_factory=Disk)
+    build: Build = field(default_factory=Build)
+    scheduler_cluster_id: int = 0
+    created_at: int = 0  # nanoseconds
+    updated_at: int = 0
+
+
+# --------------------------------------------------------------------------
+# Download records → MLP training data
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Task:
+    """(reference: scheduler/storage/types.go:26-56)"""
+
+    id: str = ""
+    url: str = ""
+    type: str = ""
+    content_length: int = 0
+    total_piece_count: int = 0
+    back_to_source_limit: int = 0
+    back_to_source_peer_count: int = 0
+    state: str = ""
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclass
+class Piece:
+    """One piece downloaded from a parent (types.go:129-141)."""
+
+    length: int = 0
+    cost: int = 0  # nanoseconds
+    created_at: int = 0
+
+
+@dataclass
+class Parent:
+    """One candidate/used parent of a download (types.go:143-175)."""
+
+    id: str = ""
+    tag: str = ""
+    application: str = ""
+    state: str = ""
+    cost: int = 0
+    upload_piece_count: int = 0
+    finished_piece_count: int = 0
+    host: Host = field(default_factory=Host)
+    pieces: List[Piece] = list_field(MAX_PIECES_PER_PARENT)
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclass
+class DownloadError:
+    """(types.go:177-187)"""
+
+    code: str = ""
+    message: str = ""
+
+
+@dataclass
+class Download:
+    """One peer download outcome — an MLP training example
+    (types.go:189-225). The label (achieved bandwidth) derives from
+    ``cost`` and the task content length; features come from host telemetry
+    and parent interaction statistics."""
+
+    id: str = ""
+    tag: str = ""
+    application: str = ""
+    state: str = ""
+    error: DownloadError = field(default_factory=DownloadError)
+    cost: int = 0
+    finished_piece_count: int = 0
+    task: Task = field(default_factory=Task)
+    host: Host = field(default_factory=Host)
+    parents: List[Parent] = list_field(MAX_PARENTS)
+    created_at: int = 0
+    updated_at: int = 0
+
+
+# --------------------------------------------------------------------------
+# Network-topology records → GNN training data
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Probes:
+    """Aggregated probe statistics for one (src, dest) edge
+    (types.go:227-239)."""
+
+    average_rtt: int = 0  # nanoseconds, EWMA with alpha=0.1
+    created_at: int = 0
+    updated_at: int = 0
+
+
+@dataclass
+class SrcHost:
+    """(types.go:241-263)"""
+
+    id: str = ""
+    type: str = "normal"
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    network: Network = field(default_factory=Network)
+
+
+@dataclass
+class DestHost:
+    """(types.go:265-290)"""
+
+    id: str = ""
+    type: str = "normal"
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    network: Network = field(default_factory=Network)
+    probes: Probes = field(default_factory=Probes)
+
+
+@dataclass
+class NetworkTopology:
+    """One probe-graph star: a source host and ≤5 probed destinations —
+    a GNN training example (types.go:292-320)."""
+
+    id: str = ""
+    host: SrcHost = field(default_factory=SrcHost)
+    dest_hosts: List[DestHost] = list_field(MAX_DEST_HOSTS)
+    created_at: int = 0
+
+
+# --------------------------------------------------------------------------
+# Flattening — single source of truth for column order
+# --------------------------------------------------------------------------
+
+_LEAF_TYPES = (int, float, str, bool)
+
+
+def _elem_type(f: dataclasses.Field) -> type:
+    args = get_args(f.type) if not isinstance(f.type, str) else None
+    if args:
+        return args[0]
+    # Annotations may be strings under `from __future__ import annotations`;
+    # resolve List[X] by name against this module's globals.
+    t = f.type if isinstance(f.type, str) else str(f.type)
+    inner = t[t.index("[") + 1 : t.rindex("]")]
+    return globals()[inner]
+
+
+def _resolved_type(f: dataclasses.Field) -> Any:
+    if isinstance(f.type, str):
+        resolved = globals().get(f.type)
+        if resolved is not None:
+            return resolved
+        return {"int": int, "float": float, "str": str, "bool": bool}[f.type]
+    return f.type
+
+
+def column_spec(record_type: Type) -> List[Tuple[str, type]]:
+    """Ordered ``(column_name, leaf_type)`` pairs for a record type.
+
+    Deterministic: follows dataclass field order depth-first. Fixed-arity
+    lists contribute ``arity`` repeated groups plus one ``<name>.len``
+    int column (the mask source).
+    """
+    out: List[Tuple[str, type]] = []
+
+    def walk(t: Type, prefix: str) -> None:
+        for f in fields(t):
+            name = f"{prefix}{f.name}"
+            if "arity" in f.metadata:
+                elem = _elem_type(f)
+                out.append((f"{name}.len", int))
+                for i in range(_arity(f)):
+                    walk(elem, f"{name}.{i}.")
+                continue
+            ft = _resolved_type(f)
+            if is_dataclass(ft):
+                walk(ft, f"{name}.")
+            elif ft in _LEAF_TYPES:
+                out.append((name, ft))
+            else:  # pragma: no cover - schema definition error
+                raise TypeError(f"unsupported field type {ft!r} at {name}")
+
+    walk(record_type, "")
+    return out
+
+
+def flatten_record(record: Any) -> dict:
+    """Flatten a record instance into ``{column: leaf_value}`` following
+    :func:`column_spec` order. List slots beyond the true length are padded
+    with type defaults."""
+    out: dict = {}
+
+    def walk(obj: Any, t: Type, prefix: str) -> None:
+        for f in fields(t):
+            name = f"{prefix}{f.name}"
+            value = getattr(obj, f.name) if obj is not None else None
+            if "arity" in f.metadata:
+                elem = _elem_type(f)
+                items = list(value or [])
+                arity = _arity(f)
+                if len(items) > arity:
+                    raise ValueError(
+                        f"{name} has {len(items)} items, exceeds fixed arity {arity}"
+                    )
+                out[f"{name}.len"] = len(items)
+                for i in range(arity):
+                    walk(items[i] if i < len(items) else None, elem, f"{name}.{i}.")
+                continue
+            ft = _resolved_type(f)
+            if is_dataclass(ft):
+                walk(value, ft, f"{name}.")
+            else:
+                out[name] = value if value is not None else ft()
+
+    walk(record, type(record), "")
+    return out
+
+
+def unflatten_record(record_type: Type, row: dict) -> Any:
+    """Inverse of :func:`flatten_record`; list slots past ``<name>.len`` are
+    dropped."""
+
+    def build(t: Type, prefix: str) -> Any:
+        kwargs = {}
+        for f in fields(t):
+            name = f"{prefix}{f.name}"
+            if "arity" in f.metadata:
+                elem = _elem_type(f)
+                n = int(row[f"{name}.len"])
+                kwargs[f.name] = [build(elem, f"{name}.{i}.") for i in range(n)]
+                continue
+            ft = _resolved_type(f)
+            if is_dataclass(ft):
+                kwargs[f.name] = build(ft, f"{name}.")
+            else:
+                kwargs[f.name] = ft(row[name])
+        return t(**kwargs)
+
+    return build(record_type, "")

@@ -1,0 +1,1 @@
+"""Shared utility layer (reference counterpart: pkg/ and internal/)."""

@@ -1,0 +1,270 @@
+"""Golden tests for the rule-based evaluator score math.
+
+Modeled on the reference's exhaustive evaluator_base_test.go:1-1046 — the
+sub-score cases here encode the same arithmetic; any drift breaks training
+labels and ML/rule parity.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler.evaluator import (
+    BaseEvaluator,
+    idc_match,
+    location_matches,
+    rule_scores,
+)
+from dragonfly2_tpu.scheduler.evaluator.base import (
+    PEER_STATE_BACK_TO_SOURCE,
+    PEER_STATE_FAILED,
+    PEER_STATE_PENDING,
+    PEER_STATE_RECEIVED_NORMAL,
+    PEER_STATE_RUNNING,
+    PEER_STATE_SUCCEEDED,
+    pair_features,
+)
+from dragonfly2_tpu.scheduler.evaluator.scoring import pack_features
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+
+@dataclass
+class FakeHost:
+    type: HostType = HostType.NORMAL
+    upload_count: int = 0
+    upload_failed_count: int = 0
+    concurrent_upload_limit: int = 50
+    concurrent_upload_count: int = 0
+    idc: str = ""
+    location: str = ""
+
+    def free_upload_count(self) -> int:
+        return self.concurrent_upload_limit - self.concurrent_upload_count
+
+
+@dataclass
+class FakePeer:
+    id: str = "peer"
+    host: FakeHost = field(default_factory=FakeHost)
+    _state: str = PEER_STATE_RUNNING
+    _finished: int = 0
+    _costs: List[float] = field(default_factory=list)
+
+    def state(self) -> str:
+        return self._state
+
+    def finished_piece_count(self) -> int:
+        return self._finished
+
+    def piece_costs(self) -> List[float]:
+        return self._costs
+
+
+def score_of(**kwargs) -> float:
+    return float(rule_scores(pack_features(**kwargs)))
+
+
+def base_kwargs(**overrides):
+    kw = dict(
+        parent_finished_pieces=0,
+        child_finished_pieces=0,
+        total_pieces=0,
+        upload_count=0,
+        upload_failed_count=0,
+        free_upload_count=0,
+        concurrent_upload_limit=0,
+        is_seed=False,
+        seed_ready=False,
+    )
+    kw.update(overrides)
+    return kw
+
+
+class TestSubScores:
+    """Each case isolates one weighted term (all others zeroed)."""
+
+    def test_piece_score_normalized(self):
+        # piece=64/256 → 0.2*0.25; host_type normal adds 0.15*0.5 unless
+        # seed; zero the rest.
+        s = score_of(**base_kwargs(parent_finished_pieces=64, total_pieces=256,
+                                   is_seed=True, seed_ready=False))
+        # upload both zero → upload term = 0.2*1.0 (never-scheduled max).
+        assert s == pytest.approx(0.2 * 0.25 + 0.2 * 1.0)
+
+    def test_piece_score_difference_when_total_unknown(self):
+        s = score_of(**base_kwargs(parent_finished_pieces=10, child_finished_pieces=4,
+                                   upload_count=1, upload_failed_count=1,
+                                   is_seed=True))
+        # piece = 10-4 = 6 (unbounded by design); upload = 0/1 = 0.
+        assert s == pytest.approx(0.2 * 6.0)
+
+    def test_upload_success(self):
+        kw = base_kwargs(is_seed=True)  # host-type term = 0
+        assert score_of(**{**kw, "upload_count": 100, "upload_failed_count": 10}) == (
+            pytest.approx(0.2 * 0.9)
+        )
+        # More failures than uploads → 0.
+        assert score_of(**{**kw, "upload_count": 5, "upload_failed_count": 6}) == 0.0
+        # Never scheduled → max.
+        assert score_of(**kw) == pytest.approx(0.2 * 1.0)
+
+    def test_free_upload(self):
+        kw = base_kwargs(is_seed=True, upload_count=1, upload_failed_count=1)
+        assert score_of(**{**kw, "free_upload_count": 30,
+                           "concurrent_upload_limit": 50}) == pytest.approx(0.15 * 0.6)
+        assert score_of(**{**kw, "free_upload_count": 0,
+                           "concurrent_upload_limit": 50}) == 0.0
+        assert score_of(**{**kw, "free_upload_count": 10,
+                           "concurrent_upload_limit": 0}) == 0.0
+
+    def test_host_type(self):
+        kw = base_kwargs(upload_count=1, upload_failed_count=1)
+        # Normal host → 0.5 regardless of state.
+        assert score_of(**{**kw, "is_seed": False}) == pytest.approx(0.15 * 0.5)
+        # Seed host with peer past registration → max.
+        assert score_of(**{**kw, "is_seed": True, "seed_ready": True}) == (
+            pytest.approx(0.15 * 1.0)
+        )
+        # Seed host still registering → 0.
+        assert score_of(**{**kw, "is_seed": True, "seed_ready": False}) == 0.0
+
+    def test_idc_affinity(self):
+        assert idc_match("idc-a", "idc-a") == 1.0
+        assert idc_match("IDC-A", "idc-a") == 1.0  # case-insensitive
+        assert idc_match("idc-a", "idc-b") == 0.0
+        assert idc_match("", "idc-a") == 0.0
+        assert idc_match("idc-a", "") == 0.0
+
+    def test_location_affinity(self):
+        assert location_matches("", "cn|hz") == 0.0
+        assert location_matches("cn|hz", "cn|hz") == 5.0  # exact match → max
+        assert location_matches("CN|HZ", "cn|hz") == 5.0
+        assert location_matches("cn|hz", "cn|sh") == 1.0
+        assert location_matches("cn|hz|a|b", "cn|hz|c|d") == 2.0
+        # Prefix break stops counting even if later elements match.
+        assert location_matches("a|x|c", "a|y|c") == 1.0
+        # Cap at 5 elements.
+        assert location_matches("a|b|c|d|e|f|g", "a|b|c|d|e|f|z") == 5.0
+        assert location_matches("a|b|c|d|e", "a|b|c|d|e|f") == 5.0
+
+    def test_full_weighted_sum(self):
+        s = score_of(
+            parent_finished_pieces=128, child_finished_pieces=0, total_pieces=256,
+            upload_count=200, upload_failed_count=20,
+            free_upload_count=25, concurrent_upload_limit=50,
+            is_seed=False, seed_ready=False,
+            parent_idc="idc-a", child_idc="idc-a",
+            parent_location="cn|hz|az1", child_location="cn|hz|az2",
+        )
+        expected = (
+            0.2 * 0.5 + 0.2 * 0.9 + 0.15 * 0.5 + 0.15 * 0.5 + 0.15 * 1.0
+            + 0.15 * (2 / 5)
+        )
+        assert s == pytest.approx(expected)
+
+
+class TestVectorized:
+    def test_batch_matches_scalar(self):
+        rng = np.random.default_rng(0)
+        n = 512
+        feats = np.stack(
+            [
+                pack_features(
+                    parent_finished_pieces=float(rng.integers(0, 300)),
+                    child_finished_pieces=float(rng.integers(0, 300)),
+                    total_pieces=float(rng.integers(0, 2) * rng.integers(1, 300)),
+                    upload_count=float(rng.integers(0, 100)),
+                    upload_failed_count=float(rng.integers(0, 100)),
+                    free_upload_count=float(rng.integers(0, 50)),
+                    concurrent_upload_limit=float(rng.integers(0, 2) * 50),
+                    is_seed=bool(rng.integers(0, 2)),
+                    seed_ready=bool(rng.integers(0, 2)),
+                    parent_idc=rng.choice(["", "a", "b"]),
+                    child_idc=rng.choice(["", "a", "b"]),
+                    parent_location=rng.choice(["", "cn|hz", "cn|sh|az1"]),
+                    child_location=rng.choice(["", "cn|hz", "cn|sh|az2"]),
+                )
+                for _ in range(n)
+            ]
+        )
+        batch = rule_scores(feats)
+        scalar = np.array([float(rule_scores(feats[i])) for i in range(n)])
+        np.testing.assert_allclose(batch, scalar, rtol=1e-6)
+
+    def test_jax_matches_numpy(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        feats = rng.uniform(0, 100, size=(64, 11)).astype(np.float32)
+        feats[:, 7:10] = rng.integers(0, 2, size=(64, 3))  # flags
+        feats[:, 10] = rng.integers(0, 6, size=64)  # location matches
+        np.testing.assert_allclose(
+            np.asarray(rule_scores(jnp.asarray(feats), xp=jnp)),
+            rule_scores(feats),
+            rtol=1e-5,
+        )
+
+
+class TestEvaluateParents:
+    def test_sorts_best_first(self):
+        child = FakePeer(id="child")
+        weak = FakePeer(id="weak", _finished=1,
+                        host=FakeHost(upload_count=10, upload_failed_count=9))
+        strong = FakePeer(id="strong", _finished=200,
+                          host=FakeHost(upload_count=10, upload_failed_count=0))
+        ev = BaseEvaluator()
+        ranked = ev.evaluate_parents([weak, strong], child, total_piece_count=256)
+        assert [p.id for p in ranked] == ["strong", "weak"]
+
+    def test_stable_on_ties(self):
+        child = FakePeer(id="child")
+        a = FakePeer(id="a")
+        b = FakePeer(id="b")
+        ev = BaseEvaluator()
+        assert [p.id for p in ev.evaluate_parents([a, b], child, 0)] == ["a", "b"]
+        assert [p.id for p in ev.evaluate_parents([b, a], child, 0)] == ["b", "a"]
+
+    def test_empty(self):
+        assert BaseEvaluator().evaluate_parents([], FakePeer(), 0) == []
+
+
+class TestIsBadNode:
+    def test_bad_states(self):
+        ev = BaseEvaluator()
+        for state in (PEER_STATE_FAILED, PEER_STATE_PENDING, PEER_STATE_RECEIVED_NORMAL):
+            assert ev.is_bad_node(FakePeer(_state=state))
+        for state in (PEER_STATE_RUNNING, PEER_STATE_SUCCEEDED, PEER_STATE_BACK_TO_SOURCE):
+            assert not ev.is_bad_node(FakePeer(_state=state))
+
+    def test_not_enough_costs(self):
+        assert not BaseEvaluator().is_bad_node(FakePeer(_costs=[100.0]))
+
+    def test_small_sample_20x_rule(self):
+        ev = BaseEvaluator()
+        # mean of prior = 100; last 2001 > 2000 → bad.
+        assert ev.is_bad_node(FakePeer(_costs=[100.0] * 10 + [2001.0]))
+        assert not ev.is_bad_node(FakePeer(_costs=[100.0] * 10 + [1999.0]))
+
+    def test_normal_distribution_3_sigma(self):
+        rng = np.random.default_rng(2)
+        prior = rng.normal(1000, 50, size=40).tolist()
+        mean, std = np.mean(prior), np.std(prior)
+        ev = BaseEvaluator()
+        assert ev.is_bad_node(FakePeer(_costs=prior + [mean + 3 * std + 1]))
+        assert not ev.is_bad_node(FakePeer(_costs=prior + [mean + 3 * std - 1]))
+
+
+class TestPairFeatures:
+    def test_extraction(self):
+        parent = FakePeer(
+            id="p", _state=PEER_STATE_RUNNING, _finished=7,
+            host=FakeHost(type=HostType.SUPER_SEED, upload_count=5,
+                          upload_failed_count=2, concurrent_upload_limit=100,
+                          concurrent_upload_count=40, idc="x", location="cn|hz"),
+        )
+        child = FakePeer(id="c", _finished=3,
+                         host=FakeHost(idc="x", location="cn|sh"))
+        f = pair_features(parent, child, total_piece_count=64)
+        assert f.tolist() == [7, 3, 64, 5, 2, 60, 100, 1, 1, 1, 1]

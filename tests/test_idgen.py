@@ -1,0 +1,82 @@
+"""Tests for deterministic ID generation (reference: pkg/idgen/task_id_test.go)."""
+
+import re
+
+from dragonfly2_tpu.utils import idgen
+from dragonfly2_tpu.utils.digest import sha256_from_strings
+
+
+class TestTaskIDV1:
+    def test_deterministic(self):
+        a = idgen.task_id_v1("https://example.com/file.bin")
+        b = idgen.task_id_v1("https://example.com/file.bin")
+        assert a == b
+        assert re.fullmatch(r"[0-9a-f]{64}", a)
+
+    def test_url_only_matches_plain_sha256(self):
+        url = "https://example.com/data"
+        assert idgen.task_id_v1(url) == sha256_from_strings(url)
+
+    def test_meta_fields_change_id(self):
+        url = "https://example.com/data"
+        base = idgen.task_id_v1(url)
+        assert idgen.task_id_v1(url, tag="t") != base
+        assert idgen.task_id_v1(url, application="app") != base
+        assert idgen.task_id_v1(url, digest="sha256:" + "0" * 64) != base
+        assert idgen.task_id_v1(url, url_range="0-99") != base
+
+    def test_empty_fields_omitted(self):
+        # Empty meta fields must hash identically to absent ones
+        # (the reference appends conditionally).
+        url = "https://example.com/data"
+        assert idgen.task_id_v1(url, tag="", application="") == idgen.task_id_v1(url)
+
+    def test_filters_strip_query_params(self):
+        signed = "https://example.com/data?sig=abc&expires=123"
+        signed2 = "https://example.com/data?sig=xyz&expires=999"
+        f = "sig&expires"
+        assert idgen.task_id_v1(signed, filters=f) == idgen.task_id_v1(signed2, filters=f)
+        assert idgen.task_id_v1(signed) != idgen.task_id_v1(signed2)
+
+    def test_filtered_query_sorted_like_go(self):
+        # Go's url.Values.Encode() sorts keys; task IDs must agree across
+        # implementations regardless of original param order.
+        a = idgen.task_id_v1("https://e.com/f?b=2&a=1&sig=x", filters="sig")
+        b = idgen.task_id_v1("https://e.com/f?a=1&b=2&sig=y", filters="sig")
+        assert a == b
+        assert idgen.filter_query("https://e.com/f?b=2&a=1", ["z"]) == "https://e.com/f?a=1&b=2"
+
+    def test_parent_task_id_ignores_range(self):
+        url = "https://example.com/data"
+        ranged = idgen.task_id_v1(url, url_range="0-99")
+        parent = idgen.parent_task_id_v1(url, url_range="0-99")
+        assert parent == idgen.task_id_v1(url)
+        assert parent != ranged
+
+
+class TestTaskIDV2:
+    def test_hashes_all_fields(self):
+        url = "https://example.com/data"
+        base = idgen.task_id_v2(url)
+        assert idgen.task_id_v2(url, piece_length=4194304) != base
+        assert idgen.task_id_v2(url, tag="t") != base
+        # All-empty fields still hash (unlike v1's conditional appends).
+        assert base == sha256_from_strings(url, "", "", "", "0")
+
+
+class TestOtherIDs:
+    def test_host_ids(self):
+        assert idgen.host_id_v1("node-1", 8002) == "node-1-8002"
+        assert idgen.host_id_v2("10.0.0.1", "node-1") == sha256_from_strings(
+            "10.0.0.1", "node-1"
+        )
+
+    def test_peer_ids_unique(self):
+        assert idgen.peer_id_v1("10.0.0.1") != idgen.peer_id_v1("10.0.0.1")
+        assert idgen.seed_peer_id_v1("10.0.0.1").endswith("_Seed")
+
+    def test_model_ids(self):
+        gnn = idgen.gnn_model_id_v1("10.0.0.1", "sched-1")
+        mlp = idgen.mlp_model_id_v1("10.0.0.1", "sched-1")
+        assert gnn != mlp
+        assert gnn == sha256_from_strings("10.0.0.1", "sched-1", "GNN")

@@ -1,0 +1,165 @@
+"""Schema round-trip tests (reference model: trainer/storage/storage_test.go
+and scheduler/storage/storage_test.go — dataset files must survive
+write→read with full fidelity)."""
+
+import pytest
+
+from dragonfly2_tpu.schema import (
+    MAX_DEST_HOSTS,
+    MAX_PARENTS,
+    DestHost,
+    Download,
+    DownloadError,
+    Host,
+    Network,
+    NetworkTopology,
+    Parent,
+    Piece,
+    Probes,
+    SrcHost,
+    Task,
+    column_spec,
+    flatten_record,
+    unflatten_record,
+)
+from dragonfly2_tpu.schema import io as schema_io
+
+
+def make_download(n_parents: int = 2) -> Download:
+    return Download(
+        id="peer-1",
+        tag="tag",
+        application="app",
+        state="Succeeded",
+        error=DownloadError(code="", message=""),
+        cost=123456789,
+        finished_piece_count=32,
+        task=Task(id="task-1", url="https://example.com/f", content_length=1 << 30,
+                  total_piece_count=256, state="Succeeded", created_at=1, updated_at=2),
+        host=Host(id="host-1", type="normal", hostname="h1", ip="10.0.0.1",
+                  network=Network(idc="idc-a", location="cn|hz")),
+        parents=[
+            Parent(
+                id=f"parent-{i}",
+                state="Running",
+                finished_piece_count=100 + i,
+                host=Host(id=f"host-p{i}", type="super",
+                          network=Network(idc="idc-a", location="cn|sh")),
+                pieces=[Piece(length=4096, cost=1000 + j, created_at=j) for j in range(3)],
+            )
+            for i in range(n_parents)
+        ],
+        created_at=10,
+        updated_at=20,
+    )
+
+
+def make_topology(n_dest: int = 3) -> NetworkTopology:
+    return NetworkTopology(
+        id="nt-1",
+        host=SrcHost(id="src-1", hostname="s1", ip="10.0.0.1",
+                     network=Network(idc="idc-a", location="cn|hz")),
+        dest_hosts=[
+            DestHost(id=f"dst-{i}", hostname=f"d{i}", ip=f"10.0.1.{i}",
+                     network=Network(idc="idc-b"),
+                     probes=Probes(average_rtt=1_000_000 + i, created_at=1, updated_at=2))
+            for i in range(n_dest)
+        ],
+        created_at=42,
+    )
+
+
+class TestFlatten:
+    def test_download_roundtrip(self):
+        d = make_download()
+        row = flatten_record(d)
+        assert row["parents.len"] == 2
+        assert row["parents.0.pieces.len"] == 3
+        assert row["parents.1.id"] == "parent-1"
+        assert row["parents.5.id"] == ""  # padded slot
+        back = unflatten_record(Download, row)
+        assert back == d
+
+    def test_topology_roundtrip(self):
+        t = make_topology()
+        back = unflatten_record(NetworkTopology, flatten_record(t))
+        assert back == t
+
+    def test_column_spec_static_width(self):
+        spec = column_spec(Download)
+        names = [n for n, _ in spec]
+        assert len(names) == len(set(names))  # no collisions
+        # Every flattened row has exactly the schema's width — the static
+        # shape the TPU feature pipeline depends on.
+        assert set(flatten_record(make_download(0))) == set(names)
+        assert set(flatten_record(make_download(MAX_PARENTS))) == set(names)
+
+    def test_arity_overflow_rejected(self):
+        d = make_download()
+        d.parents = [Parent() for _ in range(MAX_PARENTS + 1)]
+        with pytest.raises(ValueError, match="fixed arity"):
+            flatten_record(d)
+
+    def test_topology_spec_matches_reference_arity(self):
+        names = [n for n, _ in column_spec(NetworkTopology)]
+        assert f"dest_hosts.{MAX_DEST_HOSTS - 1}.probes.average_rtt" in names
+        assert f"dest_hosts.{MAX_DEST_HOSTS}.id" not in names
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, tmp_path):
+        records = [make_download(i % 4) for i in range(10)]
+        path = str(tmp_path / "download.parquet")
+        schema_io.write_parquet(Download, records, path)
+        assert schema_io.read_parquet_records(Download, path) == records
+
+    def test_parquet_column_pruning(self, tmp_path):
+        path = str(tmp_path / "nt.parquet")
+        schema_io.write_parquet(NetworkTopology, [make_topology()], path)
+        table = schema_io.read_parquet(path, columns=["dest_hosts.0.probes.average_rtt"])
+        assert table.num_columns == 1
+        assert table.column(0).to_pylist() == [1_000_000]
+
+    def test_csv_roundtrip(self, tmp_path):
+        records = [make_topology(i % (MAX_DEST_HOSTS + 1)) for i in range(7)]
+        path = str(tmp_path / "networktopology.csv")
+        with schema_io.CsvRecordWriter(NetworkTopology, path) as w:
+            for r in records:
+                w.write(r)
+        assert list(schema_io.read_csv_records(NetworkTopology, path)) == records
+
+    def test_csv_append_no_duplicate_header(self, tmp_path):
+        path = str(tmp_path / "download.csv")
+        with schema_io.CsvRecordWriter(Download, path) as w:
+            w.write(make_download())
+        with schema_io.CsvRecordWriter(Download, path) as w:
+            w.write(make_download())
+        assert len(list(schema_io.read_csv_records(Download, path))) == 2
+
+    def test_headerless_csv_roundtrip(self, tmp_path):
+        # Reference-format files have no header row
+        # (gocsv.MarshalWithoutHeaders, scheduler/storage/storage.go:393).
+        path = str(tmp_path / "ref.csv")
+        records = [make_download(1), make_download(3)]
+        with schema_io.CsvRecordWriter(Download, path, write_header=False) as w:
+            for r in records:
+                w.write(r)
+        with open(path) as f:
+            assert f.readline().split(",")[0] != "id"  # really headerless
+        assert list(schema_io.read_csv_records(Download, path)) == records
+
+    def test_empty_csv_yields_nothing(self, tmp_path):
+        path = tmp_path / "empty.csv"
+        path.write_text("")
+        assert list(schema_io.read_csv_records(Download, str(path))) == []
+
+    def test_csv_to_parquet(self, tmp_path):
+        csv_path = str(tmp_path / "d.csv")
+        pq_path = str(tmp_path / "d.parquet")
+        records = [make_download(2) for _ in range(5)]
+        with schema_io.CsvRecordWriter(Download, csv_path) as w:
+            for r in records:
+                w.write(r)
+        n = schema_io.csv_to_parquet(Download, csv_path, pq_path, batch_size=2)
+        assert n == 5
+        assert schema_io.read_parquet_records(Download, pq_path) == records
