@@ -1,0 +1,65 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Headline metric this round: scheduler parent-selection p50 latency through
+the TPU-backed ML scorer (BASELINE.md target: <1 ms p50, no GPU). The
+``extras`` field carries secondary numbers (MLP training throughput).
+
+``vs_baseline`` is target_ms / measured_ms — >1.0 means the 1 ms north-star
+target is beaten (the reference publishes no numbers of its own;
+BASELINE.md documents that the targets are self-established).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+TARGET_P50_MS = 1.0
+
+
+def main() -> None:
+    import numpy as np
+
+    from dragonfly2_tpu.data import SyntheticCluster
+    from dragonfly2_tpu.inference import ParentScorer
+    from dragonfly2_tpu.parallel import data_parallel_mesh
+    from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+
+    mesh = data_parallel_mesh()
+    cluster = SyntheticCluster(n_hosts=256, seed=0)
+    X, y = cluster.pair_example_columns(500_000)
+    result = train_mlp(
+        X, y, MLPTrainConfig(epochs=4, batch_size=16384), mesh
+    )
+
+    scorer = ParentScorer(
+        result.model, result.params, result.normalizer, result.target_norm
+    )
+    # 16-candidate batches: the scheduler's filterParentLimit is 15
+    # (reference constants.go:33-37).
+    latency = scorer.benchmark(batch=16, iters=500)
+
+    print(
+        json.dumps(
+            {
+                "metric": "parent_select_p50_latency",
+                "value": round(latency["p50_ms"], 4),
+                "unit": "ms",
+                "vs_baseline": round(TARGET_P50_MS / latency["p50_ms"], 3),
+                "extras": {
+                    "parent_select_p95_ms": round(latency["p95_ms"], 4),
+                    "parent_select_p99_ms": round(latency["p99_ms"], 4),
+                    "mlp_train_samples_per_sec_per_chip": int(
+                        result.samples_per_sec / mesh.n_data
+                    ),
+                    "mlp_eval_mae_mbps": round(result.mae, 3),
+                    "mlp_final_loss": round(result.history[-1], 4),
+                    "n_devices": mesh.n_data,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

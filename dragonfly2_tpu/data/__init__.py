@@ -1,0 +1,26 @@
+"""Input pipeline: dataset generation, feature extraction, batching.
+
+This is the host-side half of the ML loop (reference left the consumer of
+scheduler/storage datasets unimplemented — trainer/training/training.go:82-98).
+Everything here produces *static-shape* numpy arrays ready for pjit: padded
+fixed arities come from the schema, fixed batch sizes from the pipeline.
+"""
+
+from dragonfly2_tpu.data.features import (
+    PAIR_LABEL_SCALE,
+    Graph,
+    graph_from_table,
+    pair_examples_from_table,
+)
+from dragonfly2_tpu.data.pipeline import ArrayDataset, shard_batch
+from dragonfly2_tpu.data.synthetic import SyntheticCluster
+
+__all__ = [
+    "ArrayDataset",
+    "Graph",
+    "PAIR_LABEL_SCALE",
+    "SyntheticCluster",
+    "graph_from_table",
+    "pair_examples_from_table",
+    "shard_batch",
+]

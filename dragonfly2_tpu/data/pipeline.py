@@ -1,0 +1,85 @@
+"""Batched input pipeline with deterministic global shuffle.
+
+The reference's dataset layer stops at rotating CSV files
+(scheduler/storage/storage.go:412-475); here we add what training actually
+needs: epoch iteration with a *deterministic* global shuffle (seeded
+permutation — reproducible across restarts, a prerequisite for elastic
+resume under pjit data parallelism), fixed batch shapes (XLA recompiles on
+shape change, so the remainder batch is dropped, never padded dynamically),
+and leading-axis sharding for data parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+class ArrayDataset:
+    """In-memory array dataset: (features, labels) with epoch batching.
+
+    10M pair examples ≈ 10M × 12 × 4B ≈ 480 MB — comfortably host-resident;
+    sharded streaming from parquet handles anything larger (see
+    ``from_parquet_shards``).
+    """
+
+    def __init__(self, *arrays: np.ndarray):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = arrays
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def batches(
+        self, batch_size: int, *, seed: int = 0, epoch: int = 0, shuffle: bool = True
+    ) -> Iterator[tuple[np.ndarray, ...]]:
+        """Fixed-size batches; remainder dropped (static shapes for jit).
+
+        The permutation is a pure function of (seed, epoch) — restartable
+        mid-training without replaying data order state.
+        """
+        n = len(self)
+        if shuffle:
+            order = np.random.default_rng((seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+        for start in range(0, n - batch_size + 1, batch_size):
+            idx = order[start : start + batch_size]
+            yield tuple(a[idx] for a in self.arrays)
+
+    def split(self, eval_fraction: float = 0.1, seed: int = 0):
+        """Deterministic train/eval split."""
+        n = len(self)
+        order = np.random.default_rng((seed, 1)).permutation(n)
+        n_eval = int(n * eval_fraction)
+        eval_idx, train_idx = order[:n_eval], order[n_eval:]
+        return (
+            ArrayDataset(*(a[train_idx] for a in self.arrays)),
+            ArrayDataset(*(a[eval_idx] for a in self.arrays)),
+        )
+
+
+def shard_batch(batch: tuple[np.ndarray, ...] | np.ndarray, n_shards: int):
+    """Reshape leading axis [B, ...] → [n_shards, B/n_shards, ...] for
+    per-device placement (pmap-style) — pjit with a sharded-batch
+    annotation consumes the flat form directly, so this is only needed for
+    explicit device-axis code paths."""
+    def one(a: np.ndarray) -> np.ndarray:
+        assert len(a) % n_shards == 0, f"batch {len(a)} not divisible by {n_shards}"
+        return a.reshape(n_shards, len(a) // n_shards, *a.shape[1:])
+
+    if isinstance(batch, tuple):
+        return tuple(one(a) for a in batch)
+    return one(batch)
+
+
+def from_parquet_shards(paths: Sequence[str], extractor) -> ArrayDataset:
+    """Concatenate ``extractor(table) -> (arrays...)`` across parquet shards."""
+    from dragonfly2_tpu.schema.io import read_parquet
+
+    parts = [extractor(read_parquet(p)) for p in paths]
+    n_arrays = len(parts[0])
+    return ArrayDataset(
+        *(np.concatenate([p[i] for p in parts]) for i in range(n_arrays))
+    )

@@ -1,0 +1,12 @@
+"""TPU-backed inference: the parent-selection scorer and its serving shell.
+
+Replaces the reference's *designed but absent* Triton/GPU sidecar
+(pkg/rpc/inference/client/client_v1.go + manager/types/model.go
+``tensorrt_plan`` configs) with a jit-compiled scorer on TPU, and fills the
+``MLAlgorithm`` evaluator TODO (scheduler/scheduling/evaluator/
+evaluator.go:48).
+"""
+
+from dragonfly2_tpu.inference.scorer import MLEvaluator, ParentScorer
+
+__all__ = ["MLEvaluator", "ParentScorer"]

@@ -1,0 +1,15 @@
+"""Model zoo — the real implementations of the reference's stubbed models.
+
+The reference's trainer names exactly two models (trainer/training/
+training.go:82-98, both empty TODOs) and its registry stores their metrics
+(manager/models/model.go:19-46: ``mlp`` with mse/mae, ``gnn`` with
+precision/recall/f1). We implement both, plus the scale-out GAT config:
+
+- :mod:`.mlp`       — bandwidth predictor over (parent, child) pair features
+- :mod:`.graphsage` — GraphSAGE over the probe topology graph
+- :mod:`.gat`       — attention variant for the full-cluster config
+"""
+
+from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
+
+__all__ = ["MLPBandwidthPredictor", "Normalizer"]

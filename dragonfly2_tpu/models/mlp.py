@@ -1,0 +1,85 @@
+"""MLP bandwidth predictor (BASELINE config #1).
+
+Fills the reference's ``trainMLP`` stub (trainer/training/training.go:92-98)
+with a real model: given a (parent, child) feature vector in the canonical
+evaluator layout (scoring.FEATURE_NAMES), predict the bandwidth the child
+would achieve downloading from that parent. Registry metrics: mse/mae
+(manager/models/model.go mlp schema).
+
+TPU notes: compute in bfloat16 (MXU-native), params in float32; the
+network is deliberately wide-and-shallow — a [B, F]×[F, H] matmul chain
+batches onto the MXU, and at inference the whole forward fits in one fused
+kernel, which is what makes the <1 ms p50 parent-select target reachable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Normalizer:
+    """Per-feature affine normalization, fitted host-side on the train set.
+
+    Stored beside params in the checkpoint (models must normalize at
+    serving time with *training* statistics, not request statistics).
+    """
+
+    mean: np.ndarray
+    std: np.ndarray
+
+    @staticmethod
+    def fit(x: np.ndarray) -> "Normalizer":
+        return Normalizer(
+            mean=x.mean(axis=0).astype(np.float32),
+            std=(x.std(axis=0) + 1e-6).astype(np.float32),
+        )
+
+    @staticmethod
+    def identity(dim: int) -> "Normalizer":
+        return Normalizer(np.zeros(dim, np.float32), np.ones(dim, np.float32))
+
+    def __call__(self, x):
+        return (x - self.mean) / self.std
+
+
+class MLPBandwidthPredictor(nn.Module):
+    """Predicts log1p(bandwidth MB/s) for normalized pair features.
+
+    The log target tames the heavy-tailed bandwidth distribution
+    (same-rack 10GbE vs cross-region WAN spans ~3 orders of magnitude);
+    mse/mae registry metrics are computed back on the raw MB/s scale.
+    """
+
+    hidden: Sequence[int] = (128, 128, 64)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.Dense(width, dtype=self.dtype, param_dtype=jnp.float32)(x)
+            x = nn.gelu(x)
+        x = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        return x[..., 0].astype(jnp.float32)
+
+
+def predict_bandwidth(
+    model: MLPBandwidthPredictor,
+    params,
+    normalizer: Normalizer,
+    target_norm: Normalizer,
+    x,
+):
+    """Raw-scale bandwidth prediction (MB/s).
+
+    The model emits standardized log-bandwidth; this denormalizes with the
+    training-time target statistics.
+    """
+    out = model.apply(params, normalizer(x))
+    return jnp.expm1(out * target_norm.std[0] + target_norm.mean[0])

@@ -69,8 +69,13 @@ class PeerLike(Protocol):
 def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> np.ndarray:
     """Extract the canonical feature vector for one (parent, child) pair."""
     host = parent.host
-    is_seed = getattr(host.type, "is_seed", bool(host.type))
+    is_seed = bool(getattr(host.type, "is_seed", bool(host.type)))
     state = parent.state()
+    # seed_ready is defined as "is a seed AND past registration" in the
+    # canonical feature layout — training data (data/features.py,
+    # data/synthetic.py) uses the same conjunction, and the rule score only
+    # reads it when is_seed is set. Keep the three sites in lockstep or the
+    # model serves feature combinations it never trained on.
     return scoring.pack_features(
         parent_finished_pieces=parent.finished_piece_count(),
         child_finished_pieces=child.finished_piece_count(),
@@ -79,8 +84,8 @@ def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> 
         upload_failed_count=host.upload_failed_count,
         free_upload_count=host.free_upload_count(),
         concurrent_upload_limit=host.concurrent_upload_limit,
-        is_seed=bool(is_seed),
-        seed_ready=state in (PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING),
+        is_seed=is_seed,
+        seed_ready=is_seed and state in (PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING),
         parent_idc=host.idc,
         child_idc=child.host.idc,
         parent_location=host.location,

@@ -1,0 +1,11 @@
+"""Training loops — the real implementation of the reference's trainer stub
+(trainer/training/training.go:33-98: load → preprocess → train → upload).
+
+Loops are pjit-compiled over a data-parallel mesh: batches shard over the
+``data`` axis, parameters replicate, and XLA inserts the gradient allreduce
+over ICI. The same code runs single-chip (mesh of 1) and on a v5e-8 slice.
+"""
+
+from dragonfly2_tpu.train.mlp_trainer import MLPTrainConfig, MLPTrainResult, train_mlp
+
+__all__ = ["MLPTrainConfig", "MLPTrainResult", "train_mlp"]

@@ -1,0 +1,75 @@
+"""Model checkpointing and export (orbax).
+
+The reference has *no* training checkpoints (training was a stub; SURVEY.md
+§5 checkpoint/resume). We add real ones: an orbax-saved pytree (params +
+normalizer) plus a JSON metadata sidecar carrying the registry fields the
+manager stores per model version (manager/models/model.go:19-46 — type,
+evaluation metrics; idgen model IDs from pkg/idgen/model_id.go:32-38).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dragonfly2_tpu.models.mlp import Normalizer
+
+METADATA_FILE = "metadata.json"
+TREE_DIR = "tree"
+
+
+@dataclass
+class ModelMetadata:
+    """Registry-facing model description."""
+
+    model_id: str
+    model_type: str  # "mlp" | "gnn" (manager/models/model.go ModelType*)
+    version: int = 1
+    # mlp: {"mse": .., "mae": ..}; gnn: {"precision": .., "recall": .., "f1": ..}
+    evaluation: dict = field(default_factory=dict)
+    config: dict = field(default_factory=dict)
+    feature_schema: list = field(default_factory=list)
+
+
+def save_model(path: str, tree: Any, metadata: ModelMetadata) -> None:
+    """Save ``tree`` (params/normalizer arrays) + metadata under ``path``."""
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, TREE_DIR), tree, force=True)
+    with open(os.path.join(path, METADATA_FILE), "w") as f:
+        json.dump(asdict(metadata), f, indent=2)
+
+
+def load_model(path: str) -> tuple[Any, ModelMetadata]:
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(os.path.join(path, TREE_DIR))
+    with open(os.path.join(path, METADATA_FILE)) as f:
+        metadata = ModelMetadata(**json.load(f))
+    return tree, metadata
+
+
+def mlp_tree(params: Any, normalizer: Normalizer, target_norm: Normalizer) -> dict:
+    return {
+        "params": params,
+        "norm_mean": np.asarray(normalizer.mean),
+        "norm_std": np.asarray(normalizer.std),
+        "target_mean": np.asarray(target_norm.mean),
+        "target_std": np.asarray(target_norm.std),
+    }
+
+
+def mlp_from_tree(tree: dict) -> tuple[Any, Normalizer, Normalizer]:
+    return (
+        tree["params"],
+        Normalizer(mean=np.asarray(tree["norm_mean"]), std=np.asarray(tree["norm_std"])),
+        Normalizer(
+            mean=np.asarray(tree["target_mean"]), std=np.asarray(tree["target_std"])
+        ),
+    )

@@ -1,17 +1,23 @@
 """Test harness configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic
-(pjit/shard_map over a Mesh) is exercised without TPU hardware — the
-documented JAX pattern for testing SPMD code. Must run before jax imports.
+(pjit/shard_map over a Mesh) is exercised without TPU hardware.
+
+Note: this machine's sitecustomize force-registers the axon TPU backend and
+overrides JAX_PLATFORMS, so the env var is NOT enough — we must set the
+platform through jax.config before the first backend initialization.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # Make the repo root importable regardless of pytest invocation directory.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,119 @@
+"""Scorer + MLEvaluator tests (latency asserted loosely here — the real
+p50 target is measured by bench.py on TPU; this host is 1-core CPU)."""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.data import SyntheticCluster
+from dragonfly2_tpu.inference import MLEvaluator, ParentScorer
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+
+@pytest.fixture(scope="module")
+def trained():
+    X, y = SyntheticCluster(n_hosts=64, seed=0).pair_example_columns(20000)
+    return train_mlp(X, y, MLPTrainConfig(hidden=(32, 32), epochs=3, batch_size=1024))
+
+
+@pytest.fixture(scope="module")
+def scorer(trained):
+    return ParentScorer(
+        trained.model, trained.params, trained.normalizer, trained.target_norm,
+        max_batch=64,
+    )
+
+
+class TestParentScorer:
+    def test_score_shapes_and_padding(self, scorer):
+        rng = np.random.default_rng(0)
+        for n in (1, 7, 8, 15, 16, 33, 64):
+            feats = rng.uniform(0, 50, (n, FEATURE_DIM)).astype(np.float32)
+            s = scorer.score(feats)
+            assert s.shape == (n,)
+        assert scorer.score(np.zeros((0, FEATURE_DIM), np.float32)).shape == (0,)
+
+    def test_padding_does_not_change_scores(self, scorer):
+        rng = np.random.default_rng(1)
+        feats = rng.uniform(0, 50, (5, FEATURE_DIM)).astype(np.float32)
+        s5 = scorer.score(feats)
+        # Same rows inside a bigger batch (different bucket) → same scores.
+        feats16 = np.concatenate([feats, rng.uniform(0, 50, (11, FEATURE_DIM)).astype(np.float32)])
+        s16 = scorer.score(feats16)[:5]
+        np.testing.assert_allclose(s5, s16, rtol=1e-5)
+
+    def test_over_max_batch_rejected(self, scorer):
+        with pytest.raises(ValueError, match="max_batch"):
+            scorer.score(np.zeros((65, FEATURE_DIM), np.float32))
+
+    def test_ranking_tracks_true_bandwidth(self, trained, scorer):
+        X, y = SyntheticCluster(n_hosts=64, seed=9).pair_example_columns(64)
+        s = scorer.score(X)
+        top = y[np.argsort(s)[-16:]].mean()
+        bottom = y[np.argsort(s)[:16]].mean()
+        assert top > bottom
+
+    def test_benchmark_returns_percentiles(self, scorer):
+        b = scorer.benchmark(batch=16, iters=20)
+        assert 0 < b["p50_ms"] <= b["p95_ms"] <= b["p99_ms"]
+
+
+@dataclass
+class FakeHost:
+    type: HostType = HostType.NORMAL
+    upload_count: int = 0
+    upload_failed_count: int = 0
+    concurrent_upload_limit: int = 50
+    concurrent_upload_count: int = 0
+    idc: str = ""
+    location: str = ""
+
+    def free_upload_count(self) -> int:
+        return self.concurrent_upload_limit - self.concurrent_upload_count
+
+
+@dataclass
+class FakePeer:
+    id: str = "peer"
+    host: FakeHost = field(default_factory=FakeHost)
+    _state: str = "Running"
+    _finished: int = 0
+
+    def state(self) -> str:
+        return self._state
+
+    def finished_piece_count(self) -> int:
+        return self._finished
+
+    def piece_costs(self):
+        return []
+
+
+class TestMLEvaluator:
+    def test_fallback_without_model(self):
+        ev = MLEvaluator(scorer=None)
+        assert not ev.has_model
+        child = FakePeer("c")
+        a, b = FakePeer("a", _finished=100), FakePeer("b")
+        ranked = ev.evaluate_parents([b, a], child, 256)
+        base = BaseEvaluator().evaluate_parents([b, a], child, 256)
+        assert [p.id for p in ranked] == [p.id for p in base]
+
+    def test_ml_ranking(self, scorer):
+        ev = MLEvaluator(scorer)
+        assert ev.has_model
+        child = FakePeer("c", FakeHost(idc="a", location="r0|z0|k0"))
+        good = FakePeer("good", FakeHost(idc="a", location="r0|z0|k0",
+                                         upload_count=100, upload_failed_count=1),
+                        _finished=60)
+        bad = FakePeer("bad", FakeHost(idc="b", location="r9|z9|k9",
+                                       upload_count=100, upload_failed_count=70))
+        ranked = ev.evaluate_parents([bad, good], child, 64)
+        assert ranked[0].id == "good"
+
+    def test_empty(self, scorer):
+        assert MLEvaluator(scorer).evaluate_parents([], FakePeer(), 0) == []

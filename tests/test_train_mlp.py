@@ -1,0 +1,120 @@
+"""MLP training-loop tests on the virtual 8-device CPU mesh.
+
+Kept intentionally small: the host has 1 physical core and XLA CPU
+collectives deadlock under heavy per-device workloads (see conftest note);
+correctness — not throughput — is what these tests establish. Throughput is
+bench.py's job on real TPU.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.data import SyntheticCluster
+from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer, predict_bandwidth
+from dragonfly2_tpu.parallel import data_parallel_mesh
+from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+from dragonfly2_tpu.train import checkpoint as ckpt
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return SyntheticCluster(n_hosts=64, seed=0).pair_example_columns(20000)
+
+
+SMALL = MLPTrainConfig(hidden=(32, 32), epochs=3, batch_size=1024, learning_rate=3e-3)
+
+
+@pytest.fixture(scope="module")
+def result(dataset):
+    X, y = dataset
+    return train_mlp(X, y, SMALL, data_parallel_mesh())
+
+
+class TestTrainMLP:
+    def test_loss_decreases(self, result):
+        assert result.history[-1] < result.history[0] * 0.7
+
+    def test_beats_predict_mean_baseline(self, result):
+        # Loss is on the standardized log target, so predict-mean scores
+        # exactly 1.0; the model must do meaningfully better.
+        assert result.history[-1] < 0.8
+
+    def test_metrics_finite(self, result):
+        assert np.isfinite(result.mse) and np.isfinite(result.mae)
+        assert result.samples_per_sec > 0
+
+    def test_data_parallel_matches_single_device(self, dataset):
+        """The DP gradient allreduce must be numerically equivalent to
+        single-device training (same seed, same batches) — the core SPMD
+        correctness property."""
+        X, y = dataset
+        mesh8 = data_parallel_mesh()
+        mesh1 = data_parallel_mesh(devices=jax.devices()[:1])
+        cfg = MLPTrainConfig(hidden=(32,), epochs=1, batch_size=1024)
+        r8 = train_mlp(X, y, cfg, mesh8)
+        r1 = train_mlp(X, y, cfg, mesh1)
+        np.testing.assert_allclose(r8.history, r1.history, rtol=2e-3)
+
+    def test_predictions_track_labels(self, dataset, result):
+        X, y = dataset
+        pred = np.asarray(
+            predict_bandwidth(
+                result.model, result.params, result.normalizer,
+                result.target_norm, X[:2000],
+            )
+        )
+        # Rank correlation: predicted fast pairs should actually be fast.
+        order_pred = np.argsort(pred)
+        top = y[order_pred[-200:]].mean()
+        bottom = y[order_pred[:200]].mean()
+        assert top > 3 * bottom
+
+
+class TestTrainerEdgeCases:
+    def test_batch_larger_than_dataset_shrinks(self, dataset):
+        X, y = dataset
+        r = train_mlp(X[:600], y[:600],
+                      MLPTrainConfig(hidden=(8,), epochs=1, batch_size=8192))
+        assert len(r.history) == 1 and np.isfinite(r.history[0])
+
+    def test_too_small_for_mesh_raises(self, dataset):
+        X, y = dataset
+        with pytest.raises(ValueError, match="smaller than the data-parallel"):
+            train_mlp(X[:6], y[:6], MLPTrainConfig(hidden=(8,), epochs=1))
+
+    def test_no_eval_split_gives_nan_metrics(self, dataset):
+        X, y = dataset
+        r = train_mlp(X[:2000], y[:2000],
+                      MLPTrainConfig(hidden=(8,), epochs=1, batch_size=512,
+                                     eval_fraction=0.0))
+        assert np.isnan(r.mse) and np.isnan(r.mae)
+        assert np.isfinite(r.history[0])
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path, result):
+        path = str(tmp_path / "model")
+        meta = ckpt.ModelMetadata(
+            model_id="m1",
+            model_type="mlp",
+            evaluation={"mse": result.mse, "mae": result.mae},
+            config={"hidden": list(result.config.hidden)},
+        )
+        ckpt.save_model(
+            path,
+            ckpt.mlp_tree(result.params, result.normalizer, result.target_norm),
+            meta,
+        )
+        tree, meta2 = ckpt.load_model(path)
+        params, norm, tnorm = ckpt.mlp_from_tree(tree)
+        assert meta2.model_type == "mlp"
+        assert meta2.evaluation["mae"] == pytest.approx(result.mae)
+        np.testing.assert_array_equal(norm.mean, result.normalizer.mean)
+
+        x = np.random.default_rng(0).uniform(0, 10, (64, 11)).astype(np.float32)
+        a = predict_bandwidth(result.model, result.params, result.normalizer,
+                              result.target_norm, x)
+        b = predict_bandwidth(MLPBandwidthPredictor(hidden=(32, 32)), params, norm,
+                              tnorm, x)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
