@@ -49,24 +49,13 @@ def _location_element(values, i: int) -> list[str]:
 
 
 def _location_matches_vec(dst, src) -> np.ndarray:
-    """Vectorized scoring.location_matches over string arrays."""
-    out = np.zeros(len(dst), dtype=np.float32)
-    for k, (d, s) in enumerate(zip(dst, src)):
-        if not d or not s:
-            continue
-        dl, sl = d.lower(), s.lower()
-        if dl == sl:
-            out[k] = 5.0
-            continue
-        de, se = dl.split("|"), sl.split("|")
-        n = min(len(de), len(se), 5)
-        c = 0
-        for i in range(n):
-            if de[i] != se[i]:
-                break
-            c += 1
-        out[k] = c
-    return out
+    """scoring.location_matches applied pairwise over string arrays —
+    single source of truth for the affinity rule."""
+    from dragonfly2_tpu.scheduler.evaluator.scoring import location_matches
+
+    return np.array(
+        [location_matches(d, s) for d, s in zip(dst, src)], dtype=np.float32
+    )
 
 
 def pair_examples_from_table(table: pa.Table) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,156 @@
+"""Fixed-fanout neighbor sampling for GraphSAGE minibatches.
+
+SURVEY.md §7 hard part: "GraphSAGE neighbor sampling is dynamic; XLA wants
+static shapes → padded fixed-fanout sampling with masking, done on host in
+the input pipeline." This module is that host half: it turns the probe
+graph into CSR adjacency and emits constant-shape index/mask/RTT arrays; the
+device half (models/graphsage.py) is pure gathers + masked means + matmuls.
+
+Sampling is vectorized numpy (no per-node Python): a batch of M nodes gets
+its f neighbors via one random-offset gather into the CSR arrays. Nodes
+with degree < f are padded (mask 0); nodes with degree ≥ f get sampling
+with replacement — the mean aggregator is unbiased either way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from dragonfly2_tpu.data.features import Graph
+
+
+@dataclass
+class CSRGraph:
+    """Compressed adjacency (outgoing probe edges) + per-edge RTT."""
+
+    indptr: np.ndarray     # [n_nodes + 1] int64
+    indices: np.ndarray    # [n_edges] int32 — neighbor node ids
+    edge_rtt: np.ndarray   # [n_edges] float32 — log1p(rtt_ms)
+    node_features: np.ndarray  # [n_nodes, F] float32
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.indptr) - 1
+
+    @staticmethod
+    def from_graph(g: Graph) -> "CSRGraph":
+        order = np.argsort(g.edge_src, kind="stable")
+        src = g.edge_src[order]
+        counts = np.bincount(src, minlength=g.n_nodes)
+        indptr = np.zeros(g.n_nodes + 1, np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        return CSRGraph(
+            indptr=indptr,
+            indices=g.edge_dst[order].astype(np.int32),
+            edge_rtt=np.log1p(g.edge_rtt_ns[order] / 1e6).astype(np.float32),
+            node_features=g.node_features,
+        )
+
+    def sample_neighbors(
+        self, nodes: np.ndarray, fanout: int, rng: np.random.Generator
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Sample ``fanout`` neighbors for each node in the flat array.
+
+        Returns (nbr_idx, rtt, mask), each ``nodes.shape + (fanout,)``;
+        padded slots have index 0 and mask 0.
+        """
+        flat = nodes.reshape(-1)
+        deg = (self.indptr[flat + 1] - self.indptr[flat]).astype(np.int64)
+        offs = rng.integers(0, 1 << 31, size=(len(flat), fanout))
+        safe_deg = np.maximum(deg, 1)[:, None]
+        pos = self.indptr[flat][:, None] + offs % safe_deg
+        # Zero-degree nodes produce pos == indptr[node], which for trailing
+        # nodes equals n_edges (out of bounds). Their mask is 0, so any
+        # in-bounds position works — clamp.
+        pos = np.minimum(pos, max(len(self.indices) - 1, 0))
+        nbr = self.indices[pos] if len(self.indices) else np.zeros_like(pos, np.int32)
+        rtt = self.edge_rtt[pos] if len(self.indices) else np.zeros_like(pos, np.float32)
+        mask = (deg > 0)[:, None] * np.ones((1, fanout), np.float32)
+        shape = nodes.shape + (fanout,)
+        return (
+            np.where(mask > 0, nbr, 0).astype(np.int32).reshape(shape),
+            (rtt * mask).astype(np.float32).reshape(shape),
+            mask.astype(np.float32).reshape(shape),
+        )
+
+
+@dataclass
+class EdgeBatch:
+    """One static-shape GraphSAGE minibatch over B target edges.
+
+    Every array's shape is a pure function of (B, fanouts, F) — XLA
+    compiles the training step exactly once. Node features are gathered
+    host-side (F is ~9 floats; shipping features instead of indices keeps
+    the device graph pure dense math with no sharded-gather ambiguity and
+    no replicated node table in HBM).
+    """
+
+    center_feat: np.ndarray  # [B, 2, F] float32 — (src, dst) features
+    nbr1_feat: np.ndarray    # [B, 2, f1, F] float32
+    nbr1_rtt: np.ndarray     # [B, 2, f1] float32
+    nbr1_mask: np.ndarray    # [B, 2, f1] float32
+    nbr2_feat: np.ndarray    # [B, 2, f1, f2, F] float32
+    nbr2_rtt: np.ndarray     # [B, 2, f1, f2] float32
+    nbr2_mask: np.ndarray    # [B, 2, f1, f2] float32
+    labels: np.ndarray       # [B] float32
+
+    def astuple(self) -> tuple:
+        return (
+            self.center_feat, self.nbr1_feat, self.nbr1_rtt, self.nbr1_mask,
+            self.nbr2_feat, self.nbr2_rtt, self.nbr2_mask, self.labels,
+        )
+
+
+class EdgeBatchSampler:
+    """Samples 2-hop neighborhoods around target-edge endpoints.
+
+    The prediction task (mirrors what the reference's evaluator needs from
+    the topology model): given endpoints' sampled neighborhoods, classify
+    whether this src→dst path is fast (probe RTT under threshold) — the
+    learned replacement for raw-probe lookup when no direct probe exists.
+    """
+
+    def __init__(
+        self,
+        csr: CSRGraph,
+        edge_src: np.ndarray,
+        edge_dst: np.ndarray,
+        labels: np.ndarray,
+        fanouts: tuple[int, int] = (10, 5),
+    ):
+        self.csr = csr
+        self.edge_src = edge_src
+        self.edge_dst = edge_dst
+        self.labels = labels.astype(np.float32)
+        self.fanouts = fanouts
+
+    @property
+    def n_edges(self) -> int:
+        return len(self.edge_src)
+
+    def sample(self, edge_ids: np.ndarray, rng: np.random.Generator) -> EdgeBatch:
+        f1, f2 = self.fanouts
+        centers = np.stack(
+            [self.edge_src[edge_ids], self.edge_dst[edge_ids]], axis=1
+        ).astype(np.int32)
+        nbr1, rtt1, mask1 = self.csr.sample_neighbors(centers, f1, rng)
+        nbr2, rtt2, mask2 = self.csr.sample_neighbors(nbr1, f2, rng)
+        # Mask out 2-hop samples hanging off padded 1-hop slots.
+        mask2 = mask2 * mask1[..., None]
+        nf = self.csr.node_features
+        return EdgeBatch(
+            center_feat=nf[centers],
+            nbr1_feat=nf[nbr1], nbr1_rtt=rtt1, nbr1_mask=mask1,
+            nbr2_feat=nf[nbr2], nbr2_rtt=rtt2 * mask2, nbr2_mask=mask2,
+            labels=self.labels[edge_ids],
+        )
+
+    def epoch_batches(self, batch_size: int, *, seed: int = 0, epoch: int = 0):
+        """Deterministic-shuffle epoch of static-size batches (remainder
+        dropped, matching the pipeline-wide static-shape rule)."""
+        rng = np.random.default_rng((seed, epoch))
+        order = rng.permutation(self.n_edges)
+        for start in range(0, self.n_edges - batch_size + 1, batch_size):
+            yield self.sample(order[start : start + batch_size], rng)

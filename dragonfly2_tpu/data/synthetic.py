@@ -161,7 +161,10 @@ class SyntheticCluster:
                 h.is_seed[parent].astype(float),
                 (h.is_seed[parent] & (self.rng.random(n) < 0.9)).astype(float),
                 (h.idc[parent] == h.idc[child]).astype(float),
-                np.select([prox == 0, prox == 1, prox == 2], [3.0, 2.0, 1.0], 0.0),
+                # Must match scoring.location_matches on real strings:
+                # identical "r|z|k" paths (same rack) score 5 (exact-match
+                # rule), same zone matches 2 leading elements, same region 1.
+                np.select([prox == 0, prox == 1, prox == 2], [5.0, 2.0, 1.0], 0.0),
             ],
             axis=1,
         ).astype(np.float32)
@@ -182,6 +185,20 @@ class SyntheticCluster:
         mask = dst == src
         dst[mask] = (dst[mask] + 1) % len(self.hosts)
         return {"src": src, "dst": dst, "rtt_ns": self.rtt_ns(src, dst)}
+
+    def probe_graph(self, n_edges: int):
+        """Bench-scale Graph built directly from columnar probe edges
+        (bypasses the record path; same semantics as graph_from_table)."""
+        from dragonfly2_tpu.data.features import Graph
+
+        cols = self.probe_edge_columns(n_edges)
+        return Graph(
+            node_ids=np.array([f"host-{i}" for i in range(len(self.hosts))]),
+            node_features=self.node_feature_matrix(),
+            edge_src=cols["src"].astype(np.int32),
+            edge_dst=cols["dst"].astype(np.int32),
+            edge_rtt_ns=cols["rtt_ns"],
+        )
 
     def node_feature_matrix(self) -> np.ndarray:
         """Observable per-host features [n_hosts, 8]: type flag, upload

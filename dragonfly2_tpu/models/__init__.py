@@ -10,6 +10,7 @@ precision/recall/f1). We implement both, plus the scale-out GAT config:
 - :mod:`.gat`       — attention variant for the full-cluster config
 """
 
+from dragonfly2_tpu.models.graphsage import GraphSAGE
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 
-__all__ = ["MLPBandwidthPredictor", "Normalizer"]
+__all__ = ["GraphSAGE", "MLPBandwidthPredictor", "Normalizer"]

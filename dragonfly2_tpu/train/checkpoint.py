@@ -55,6 +55,16 @@ def load_model(path: str) -> tuple[Any, ModelMetadata]:
     return tree, metadata
 
 
+def gnn_tree(params: Any, node_features: np.ndarray) -> dict:
+    """GNN checkpoint: params + the node-feature matrix snapshot the model
+    was trained against (serving must featurize hosts identically)."""
+    return {"params": params, "node_features": np.asarray(node_features)}
+
+
+def gnn_from_tree(tree: dict) -> tuple[Any, np.ndarray]:
+    return tree["params"], np.asarray(tree["node_features"])
+
+
 def mlp_tree(params: Any, normalizer: Normalizer, target_norm: Normalizer) -> dict:
     return {
         "params": params,

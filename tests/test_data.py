@@ -30,7 +30,7 @@ class TestSynthetic:
         # Same-rack pairs must be systematically faster than cross-region:
         # otherwise there is no signal for the models to learn.
         X, y = cluster.pair_example_columns(20000)
-        near = y[X[:, 10] == 3.0]  # location_matches == 3 → same rack
+        near = y[X[:, 10] == 5.0]  # location_matches == 5 → same rack (exact match)
         far = y[X[:, 10] == 0.0]
         assert near.mean() > 2 * far.mean()
 
