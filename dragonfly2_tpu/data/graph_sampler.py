@@ -7,9 +7,11 @@ graph into CSR adjacency and emits constant-shape index/mask/RTT arrays; the
 device half (models/graphsage.py) is pure gathers + masked means + matmuls.
 
 Sampling is vectorized numpy (no per-node Python): a batch of M nodes gets
-its f neighbors via one random-offset gather into the CSR arrays. Nodes
-with degree < f are padded (mask 0); nodes with degree ≥ f get sampling
-with replacement — the mean aggregator is unbiased either way.
+its f neighbors via one random-offset gather into the CSR arrays, sampling
+WITH replacement for every node that has at least one out-edge (so a
+degree-2 node with fanout 10 contributes 10 valid replacement-sampled
+slots — the masked-mean aggregator is unbiased under replacement). Only
+zero-degree nodes get padded slots (mask 0).
 """
 
 from __future__ import annotations

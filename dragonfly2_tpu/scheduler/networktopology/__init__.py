@@ -1,0 +1,9 @@
+"""Probe store + topology snapshotting (reference: scheduler/networktopology/)."""
+
+from dragonfly2_tpu.scheduler.networktopology.store import (
+    NetworkTopologyConfig,
+    NetworkTopologyStore,
+    Probe,
+)
+
+__all__ = ["NetworkTopologyConfig", "NetworkTopologyStore", "Probe"]

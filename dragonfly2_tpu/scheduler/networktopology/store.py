@@ -1,0 +1,224 @@
+"""Network-topology probe store.
+
+Reference counterpart: scheduler/networktopology/{network_topology,probes}.go.
+The reference keeps this state in Redis (adjacency hashes, probe lists,
+probed-count keys); ours is an in-process store behind the same interface —
+the scheduler is the only writer, and the snapshot/export path (not shared
+mutable state) is what feeds training. Semantics preserved:
+
+- per-(src,dst) probe queue of length 5 (DefaultProbeQueueLength,
+  config/constants.go:183), oldest evicted;
+- moving-average RTT recomputed over the queue on every enqueue with the
+  reference's exact recurrence (probes.go:143-165): seeded with the first
+  probe, then avg = 0.1*avg + 0.9*rtt — latest sample dominates;
+- probed-count incremented per enqueue; FindProbedHosts samples 50 random
+  candidate hosts and returns the 5 least-probed
+  (network_topology.go:166-223);
+- periodic Snapshot joins the store against the host manager and writes one
+  NetworkTopology record per source host (network_topology.go:276-387).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.schema import records as schema
+from dragonfly2_tpu.schema.records import MAX_DEST_HOSTS
+
+DEFAULT_PROBE_QUEUE_LENGTH = 5
+DEFAULT_PROBE_COUNT = 5
+FIND_PROBED_CANDIDATE_HOSTS_LIMIT = 50
+MOVING_AVERAGE_WEIGHT = 0.1
+DEFAULT_COLLECT_INTERVAL = 2 * 60 * 60.0  # 2h
+
+
+@dataclass
+class NetworkTopologyConfig:
+    enable: bool = True
+    collect_interval: float = DEFAULT_COLLECT_INTERVAL
+    probe_queue_length: int = DEFAULT_PROBE_QUEUE_LENGTH
+    probe_count: int = DEFAULT_PROBE_COUNT
+
+
+@dataclass
+class Probe:
+    host_id: str  # probed destination host
+    rtt: float    # seconds
+    created_at: float = field(default_factory=time.time)
+
+
+class _Edge:
+    """Probe queue + aggregates for one (src, dst) pair."""
+
+    def __init__(self, queue_length: int):
+        self.queue: deque[Probe] = deque(maxlen=queue_length)
+        self.average_rtt: float = 0.0
+        self.created_at = time.time()
+        self.updated_at = time.time()
+
+    def enqueue(self, probe: Probe) -> None:
+        self.queue.append(probe)  # deque(maxlen) evicts the oldest
+        # Reference recurrence (probes.go:143-165): recompute over the
+        # queue, newest-dominant EWMA.
+        avg = 0.0
+        for i, p in enumerate(self.queue):
+            if i == 0:
+                avg = p.rtt
+            else:
+                avg = avg * MOVING_AVERAGE_WEIGHT + p.rtt * (1 - MOVING_AVERAGE_WEIGHT)
+        self.average_rtt = avg
+        self.updated_at = probe.created_at
+
+
+class NetworkTopologyStore:
+    def __init__(self, config: NetworkTopologyConfig | None = None,
+                 resource=None, storage=None):
+        self.config = config or NetworkTopologyConfig()
+        self.resource = resource
+        self.storage = storage
+        self._edges: Dict[tuple[str, str], _Edge] = {}
+        self._probed_count: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- adjacency ------------------------------------------------------------
+
+    def has(self, src_host_id: str, dest_host_id: str) -> bool:
+        return (src_host_id, dest_host_id) in self._edges
+
+    def store(self, src_host_id: str, dest_host_id: str) -> None:
+        """Ensure the edge exists (reference: Store — creates the adjacency
+        hash if absent)."""
+        with self._lock:
+            self._edges.setdefault(
+                (src_host_id, dest_host_id), _Edge(self.config.probe_queue_length)
+            )
+
+    def enqueue_probe(self, src_host_id: str, probe: Probe) -> None:
+        with self._lock:
+            key = (src_host_id, probe.host_id)
+            edge = self._edges.setdefault(key, _Edge(self.config.probe_queue_length))
+            edge.enqueue(probe)
+            self._probed_count[probe.host_id] = (
+                self._probed_count.get(probe.host_id, 0) + 1
+            )
+
+    def probes(self, src_host_id: str, dest_host_id: str) -> List[Probe]:
+        edge = self._edges.get((src_host_id, dest_host_id))
+        return list(edge.queue) if edge else []
+
+    def average_rtt(self, src_host_id: str, dest_host_id: str) -> Optional[float]:
+        edge = self._edges.get((src_host_id, dest_host_id))
+        return edge.average_rtt if edge else None
+
+    def probed_count(self, host_id: str) -> int:
+        return self._probed_count.get(host_id, 0)
+
+    # -- probe-target selection ----------------------------------------------
+
+    def find_probed_hosts(self, host_id: str) -> List:
+        """Least-probed N of a 50-host random sample, excluding self."""
+        hosts = self.resource.host_manager.load_random_hosts(
+            FIND_PROBED_CANDIDATE_HOSTS_LIMIT, blocklist={host_id}
+        )
+        if not hosts:
+            return []
+        if len(hosts) <= self.config.probe_count:
+            return hosts
+        hosts.sort(key=lambda h: self._probed_count.get(h.id, 0))
+        return hosts[: self.config.probe_count]
+
+    # -- host lifecycle -------------------------------------------------------
+
+    def delete_host(self, host_id: str) -> None:
+        """Drop all edges touching the host and its probed count
+        (reference: DeleteHost — the LeaveHost cascade)."""
+        with self._lock:
+            self._edges = {
+                k: v for k, v in self._edges.items()
+                if k[0] != host_id and k[1] != host_id
+            }
+            self._probed_count.pop(host_id, None)
+
+    # -- snapshot → dataset ---------------------------------------------------
+
+    def snapshot(self) -> int:
+        """Write one NetworkTopology record per source host with up to
+        MAX_DEST_HOSTS most-recently-updated destinations. Returns the
+        number of records written."""
+        with self._lock:
+            by_src: Dict[str, List[tuple[str, _Edge]]] = {}
+            for (src, dst), edge in self._edges.items():
+                by_src.setdefault(src, []).append((dst, edge))
+
+        written = 0
+        for src_id, dests in by_src.items():
+            src_host = self.resource.host_manager.load(src_id)
+            if src_host is None:
+                continue
+            dests.sort(key=lambda it: it[1].updated_at, reverse=True)
+            dest_records = []
+            for dst_id, edge in dests[:MAX_DEST_HOSTS]:
+                dst_host = self.resource.host_manager.load(dst_id)
+                if dst_host is None:
+                    continue
+                dest_records.append(
+                    schema.DestHost(
+                        id=dst_id,
+                        type=dst_host.type.type_name,
+                        hostname=dst_host.hostname,
+                        ip=dst_host.ip,
+                        port=dst_host.port,
+                        network=dst_host.network,
+                        probes=schema.Probes(
+                            average_rtt=int(edge.average_rtt * 1e9),
+                            created_at=int(edge.created_at * 1e9),
+                            updated_at=int(edge.updated_at * 1e9),
+                        ),
+                    )
+                )
+            if not dest_records:
+                continue
+            self.storage.create_network_topology(
+                schema.NetworkTopology(
+                    id=str(uuid.uuid4()),
+                    host=schema.SrcHost(
+                        id=src_id,
+                        type=src_host.type.type_name,
+                        hostname=src_host.hostname,
+                        ip=src_host.ip,
+                        port=src_host.port,
+                        network=src_host.network,
+                    ),
+                    dest_hosts=dest_records,
+                    created_at=int(time.time() * 1e9),
+                )
+            )
+            written += 1
+        return written
+
+    # -- background collection ------------------------------------------------
+
+    def serve(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="networktopology",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.collect_interval):
+            self.snapshot()

@@ -1,0 +1,47 @@
+"""In-memory cluster resource model (reference: scheduler/resource/).
+
+Hosts, tasks, and peers with lifecycle FSMs, the per-task peer DAG, and
+TTL-GC'd managers. This is the state the scheduling core reads and mutates
+on every announce/piece event, and the state snapshotted into the ML
+training datasets.
+"""
+
+from dragonfly2_tpu.scheduler.resource.host import (
+    DEFAULT_PEER_CONCURRENT_UPLOAD_LIMIT,
+    DEFAULT_SEED_PEER_CONCURRENT_UPLOAD_LIMIT,
+    Host,
+)
+from dragonfly2_tpu.scheduler.resource.managers import (
+    HostManager,
+    PeerManager,
+    TaskManager,
+)
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerEvent, PeerState
+from dragonfly2_tpu.scheduler.resource.resource import Resource
+from dragonfly2_tpu.scheduler.resource.task import (
+    Piece,
+    SizeScope,
+    Task,
+    TaskEvent,
+    TaskState,
+    TaskType,
+)
+
+__all__ = [
+    "DEFAULT_PEER_CONCURRENT_UPLOAD_LIMIT",
+    "DEFAULT_SEED_PEER_CONCURRENT_UPLOAD_LIMIT",
+    "Host",
+    "HostManager",
+    "Peer",
+    "PeerEvent",
+    "PeerManager",
+    "PeerState",
+    "Piece",
+    "Resource",
+    "SizeScope",
+    "Task",
+    "TaskEvent",
+    "TaskManager",
+    "TaskState",
+    "TaskType",
+]

@@ -1,0 +1,184 @@
+"""Host/Task/Peer managers with TTL garbage collection.
+
+Reference counterparts: scheduler/resource/{host,task,peer}_manager.go —
+each is a concurrent map plus a pkg/gc-registered reclaim pass. TTLs match
+the reference's semantics: hosts go when their last announce is stale and
+they have no peers; tasks go when peerless and stale; peers go when their
+state is terminal (or stale) — leaving cascades DAG cleanup.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerState
+from dragonfly2_tpu.scheduler.resource.task import Task, TaskEvent
+from dragonfly2_tpu.utils.gc import GC
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_HOST_TTL = 6 * 60.0          # reference: host gc interval semantics
+DEFAULT_TASK_TTL = 30 * 60.0
+DEFAULT_PEER_TTL = 24 * 60 * 60.0
+DEFAULT_GC_INTERVAL = 60.0
+
+
+class HostManager:
+    GC_TASK_ID = "host"
+
+    def __init__(self, ttl: float = DEFAULT_HOST_TTL,
+                 gc: GC | None = None, interval: float = DEFAULT_GC_INTERVAL):
+        self._hosts: Dict[str, Host] = {}
+        self._lock = threading.RLock()
+        self.ttl = ttl
+        if gc is not None:
+            gc.add(self.GC_TASK_ID, interval, self.run_gc)
+
+    def load(self, host_id: str) -> Optional[Host]:
+        return self._hosts.get(host_id)
+
+    def store(self, host: Host) -> None:
+        with self._lock:
+            self._hosts[host.id] = host
+
+    def load_or_store(self, host: Host) -> Host:
+        with self._lock:
+            return self._hosts.setdefault(host.id, host)
+
+    def delete(self, host_id: str) -> None:
+        with self._lock:
+            self._hosts.pop(host_id, None)
+
+    def __iter__(self) -> Iterator[Host]:
+        return iter(list(self._hosts.values()))
+
+    def __len__(self) -> int:
+        return len(self._hosts)
+
+    def load_random_hosts(self, n: int, blocklist: set[str] | None = None) -> list[Host]:
+        """Up to n random hosts excluding the blocklist (reference:
+        host_manager LoadRandomHosts — the probe-target pre-sample)."""
+        import random
+
+        block = blocklist or set()
+        ids = [h for h in self._hosts if h not in block]
+        random.shuffle(ids)
+        return [self._hosts[i] for i in ids[:n] if i in self._hosts]
+
+    def run_gc(self) -> None:
+        now = time.time()
+        for host in list(self):
+            if host.peer_count == 0 and now - host.updated_at > self.ttl:
+                logger.info("gc reclaiming idle host %s", host.id)
+                self.delete(host.id)
+            elif host.peer_count > 0 and now - host.updated_at > self.ttl:
+                # Stale but still owning peers: mark peers left so the peer
+                # GC can cascade (reference: host_manager RunGC leave path).
+                host.leave_peers()
+
+
+class TaskManager:
+    GC_TASK_ID = "task"
+
+    def __init__(self, ttl: float = DEFAULT_TASK_TTL,
+                 gc: GC | None = None, interval: float = DEFAULT_GC_INTERVAL):
+        self._tasks: Dict[str, Task] = {}
+        self._lock = threading.RLock()
+        self.ttl = ttl
+        if gc is not None:
+            gc.add(self.GC_TASK_ID, interval, self.run_gc)
+
+    def load(self, task_id: str) -> Optional[Task]:
+        return self._tasks.get(task_id)
+
+    def store(self, task: Task) -> None:
+        with self._lock:
+            self._tasks[task.id] = task
+
+    def load_or_store(self, task: Task) -> Task:
+        with self._lock:
+            return self._tasks.setdefault(task.id, task)
+
+    def delete(self, task_id: str) -> None:
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def __iter__(self) -> Iterator[Task]:
+        return iter(list(self._tasks.values()))
+
+    def __len__(self) -> int:
+        return len(self._tasks)
+
+    def run_gc(self) -> None:
+        now = time.time()
+        for task in list(self):
+            if task.peer_count() == 0 and now - task.updated_at > self.ttl:
+                logger.info("gc reclaiming peerless task %s", task.id)
+                if task.fsm.can(TaskEvent.LEAVE):
+                    task.fsm.fire(TaskEvent.LEAVE)
+                self.delete(task.id)
+
+
+class PeerManager:
+    GC_TASK_ID = "peer"
+
+    def __init__(self, ttl: float = DEFAULT_PEER_TTL,
+                 gc: GC | None = None, interval: float = DEFAULT_GC_INTERVAL):
+        self._peers: Dict[str, Peer] = {}
+        self._lock = threading.RLock()
+        self.ttl = ttl
+        if gc is not None:
+            gc.add(self.GC_TASK_ID, interval, self.run_gc)
+
+    def load(self, peer_id: str) -> Optional[Peer]:
+        return self._peers.get(peer_id)
+
+    def store(self, peer: Peer) -> None:
+        with self._lock:
+            self._peers[peer.id] = peer
+            peer.task.store_peer(peer)
+            peer.host.store_peer(peer)
+
+    def load_or_store(self, peer: Peer) -> Peer:
+        with self._lock:
+            existing = self._peers.get(peer.id)
+            if existing is not None:
+                return existing
+            self.store(peer)
+            return peer
+
+    def delete(self, peer_id: str) -> None:
+        """Remove the peer everywhere: manager map, task DAG (with upload
+        slot release), host registry."""
+        with self._lock:
+            peer = self._peers.pop(peer_id, None)
+        if peer is None:
+            return
+        task = peer.task
+        if peer_id in task.dag:
+            task.delete_peer_in_edges(peer_id)
+            task.delete_peer_out_edges(peer)
+            task.delete_peer(peer_id)
+        peer.host.delete_peer(peer_id)
+
+    def __iter__(self) -> Iterator[Peer]:
+        return iter(list(self._peers.values()))
+
+    def __len__(self) -> int:
+        return len(self._peers)
+
+    def run_gc(self) -> None:
+        now = time.time()
+        for peer in list(self):
+            state = peer.fsm.current
+            if state == PeerState.LEAVE:
+                logger.info("gc reclaiming left peer %s", peer.id)
+                self.delete(peer.id)
+            elif now - peer.updated_at > self.ttl:
+                # Stale peers are led through Leave so children reschedule
+                # before the vertex disappears.
+                peer.leave()

@@ -1,0 +1,151 @@
+"""Peer — one download of one task by one host.
+
+Reference counterpart: scheduler/resource/peer.go. Tracks finished pieces
+(bitset), per-piece costs (bad-node statistics input), the lifecycle FSM,
+blocked parents, and back-to-source intent. Satisfies the evaluator's
+PeerLike protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.task import Piece, Task
+from dragonfly2_tpu.utils.fsm import FSM
+
+
+class PeerState:
+    PENDING = "Pending"
+    RECEIVED_EMPTY = "ReceivedEmpty"
+    RECEIVED_TINY = "ReceivedTiny"
+    RECEIVED_SMALL = "ReceivedSmall"
+    RECEIVED_NORMAL = "ReceivedNormal"
+    RUNNING = "Running"
+    BACK_TO_SOURCE = "BackToSource"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    LEAVE = "Leave"
+
+
+class PeerEvent:
+    REGISTER_EMPTY = "RegisterEmpty"
+    REGISTER_TINY = "RegisterTiny"
+    REGISTER_SMALL = "RegisterSmall"
+    REGISTER_NORMAL = "RegisterNormal"
+    DOWNLOAD = "Download"
+    DOWNLOAD_BACK_TO_SOURCE = "DownloadBackToSource"
+    DOWNLOAD_SUCCEEDED = "DownloadSucceeded"
+    DOWNLOAD_FAILED = "DownloadFailed"
+    LEAVE = "Leave"
+
+
+_RECEIVED = [
+    PeerState.RECEIVED_EMPTY,
+    PeerState.RECEIVED_TINY,
+    PeerState.RECEIVED_SMALL,
+    PeerState.RECEIVED_NORMAL,
+]
+
+# Transition table mirrors peer.go:230-251 (incl. the out-of-order
+# success path: results may arrive before piece reports).
+_PEER_EVENTS = {
+    PeerEvent.REGISTER_EMPTY: ([PeerState.PENDING], PeerState.RECEIVED_EMPTY),
+    PeerEvent.REGISTER_TINY: ([PeerState.PENDING], PeerState.RECEIVED_TINY),
+    PeerEvent.REGISTER_SMALL: ([PeerState.PENDING], PeerState.RECEIVED_SMALL),
+    PeerEvent.REGISTER_NORMAL: ([PeerState.PENDING], PeerState.RECEIVED_NORMAL),
+    PeerEvent.DOWNLOAD: (_RECEIVED, PeerState.RUNNING),
+    PeerEvent.DOWNLOAD_BACK_TO_SOURCE: (
+        _RECEIVED + [PeerState.RUNNING],
+        PeerState.BACK_TO_SOURCE,
+    ),
+    PeerEvent.DOWNLOAD_SUCCEEDED: (
+        _RECEIVED + [PeerState.RUNNING, PeerState.BACK_TO_SOURCE],
+        PeerState.SUCCEEDED,
+    ),
+    PeerEvent.DOWNLOAD_FAILED: (
+        [PeerState.PENDING] + _RECEIVED
+        + [PeerState.RUNNING, PeerState.BACK_TO_SOURCE, PeerState.SUCCEEDED],
+        PeerState.FAILED,
+    ),
+    PeerEvent.LEAVE: (
+        [PeerState.PENDING] + _RECEIVED
+        + [PeerState.RUNNING, PeerState.BACK_TO_SOURCE, PeerState.FAILED,
+           PeerState.SUCCEEDED],
+        PeerState.LEAVE,
+    ),
+}
+
+
+class Peer:
+    def __init__(self, id: str, task: Task, host: Host, *,
+                 tag: str = "", application: str = "", priority: int = 0,
+                 range_header: str = ""):
+        self.id = id
+        self.task = task
+        self.host = host
+        self.tag = tag
+        self.application = application
+        self.priority = priority
+        self.range_header = range_header
+        self.finished_pieces: set[int] = set()
+        self.pieces: Dict[int, Piece] = {}
+        self._piece_costs: List[float] = []
+        self.cost: float = 0.0
+        self.block_parents: set[str] = set()
+        self.need_back_to_source = False
+        self.schedule_count = 0
+        self.piece_updated_at = time.time()
+        self.created_at = time.time()
+        self.updated_at = time.time()
+        self._lock = threading.RLock()
+        self.fsm = FSM(PeerState.PENDING, _PEER_EVENTS,
+                       on_transition=lambda *_: self.touch())
+
+    def touch(self) -> None:
+        self.updated_at = time.time()
+
+    # -- evaluator PeerLike protocol ------------------------------------------
+
+    def state(self) -> str:
+        return self.fsm.current
+
+    def finished_piece_count(self) -> int:
+        return len(self.finished_pieces)
+
+    def piece_costs(self) -> List[float]:
+        return list(self._piece_costs)
+
+    # -- piece bookkeeping ----------------------------------------------------
+
+    def append_piece_cost(self, cost: float) -> None:
+        with self._lock:
+            self._piece_costs.append(cost)
+
+    def store_piece(self, piece: Piece) -> None:
+        with self._lock:
+            self.pieces[piece.number] = piece
+            self.finished_pieces.add(piece.number)
+            self.append_piece_cost(piece.cost)
+            self.piece_updated_at = time.time()
+
+    def load_piece(self, number: int) -> Optional[Piece]:
+        return self.pieces.get(number)
+
+    # -- lifecycle helpers ----------------------------------------------------
+
+    def leave(self) -> None:
+        if self.fsm.can(PeerEvent.LEAVE):
+            self.fsm.fire(PeerEvent.LEAVE)
+
+    def parents(self):
+        return self.task.peer_parents(self.id)
+
+    def children(self):
+        return self.task.peer_children(self.id)
+
+    def main_parent(self):
+        ps = self.parents()
+        return ps[0] if ps else None

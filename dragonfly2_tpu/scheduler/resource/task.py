@@ -1,0 +1,235 @@
+"""Task — one piece of content being distributed, plus its peer DAG.
+
+Reference counterpart: scheduler/resource/task.go. The task owns the piece
+metadata map, the back-to-source budget, the FSM, and the DAG of its
+peers (edges parent→child along piece flow).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.utils import dag as dag_mod
+from dragonfly2_tpu.utils.fsm import FSM
+
+EMPTY_FILE_SIZE = 0
+TINY_FILE_SIZE = 128  # bytes — fits inline in the register response
+
+
+class TaskState:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    LEAVE = "Leave"
+
+
+class TaskEvent:
+    DOWNLOAD = "Download"
+    DOWNLOAD_SUCCEEDED = "DownloadSucceeded"
+    DOWNLOAD_FAILED = "DownloadFailed"
+    LEAVE = "Leave"
+
+
+# Transition table mirrors task.go:197-202.
+_TASK_EVENTS = {
+    TaskEvent.DOWNLOAD: (
+        [TaskState.PENDING, TaskState.SUCCEEDED, TaskState.FAILED, TaskState.LEAVE],
+        TaskState.RUNNING,
+    ),
+    TaskEvent.DOWNLOAD_SUCCEEDED: (
+        [TaskState.LEAVE, TaskState.RUNNING, TaskState.FAILED],
+        TaskState.SUCCEEDED,
+    ),
+    TaskEvent.DOWNLOAD_FAILED: ([TaskState.RUNNING], TaskState.FAILED),
+    TaskEvent.LEAVE: (
+        [TaskState.PENDING, TaskState.RUNNING, TaskState.SUCCEEDED, TaskState.FAILED],
+        TaskState.LEAVE,
+    ),
+}
+
+
+class TaskType(enum.Enum):
+    # reference: commonv2.TaskType — DFDAEMON tasks may back-to-source;
+    # DFCACHE are cache-only; DFSTORE object-storage backed.
+    DFDAEMON = "dfdaemon"
+    DFCACHE = "dfcache"
+    DFSTORE = "dfstore"
+
+
+class SizeScope(enum.Enum):
+    """Register fast-path class (task.go:442-464 SizeScope)."""
+
+    NORMAL = "normal"
+    SMALL = "small"     # single piece: parent returned inline
+    TINY = "tiny"       # ≤128 B: bytes returned inline
+    EMPTY = "empty"     # zero-length
+    UNKNOW = "unknow"   # content length not yet known
+
+
+@dataclass
+class Piece:
+    """Piece metadata (reference: scheduler/resource/task.go Piece)."""
+
+    number: int
+    parent_id: str = ""
+    offset: int = 0
+    length: int = 0
+    digest: str = ""
+    traffic_type: str = ""
+    cost: float = 0.0  # seconds
+    created_at: float = field(default_factory=time.time)
+
+
+class Task:
+    def __init__(
+        self,
+        id: str,
+        url: str = "",
+        *,
+        tag: str = "",
+        application: str = "",
+        type: TaskType = TaskType.DFDAEMON,
+        digest: str = "",
+        filtered_query_params: Optional[List[str]] = None,
+        request_header: Optional[Dict[str, str]] = None,
+        piece_length: int = 0,
+        back_to_source_limit: int = 3,
+    ):
+        self.id = id
+        self.url = url
+        self.tag = tag
+        self.application = application
+        self.type = type
+        self.digest = digest
+        self.filtered_query_params = filtered_query_params or []
+        self.request_header = request_header or {}
+        self.piece_length = piece_length
+        self.content_length = -1
+        self.total_piece_count = 0
+        self.direct_piece = b""  # tiny-task inline payload
+        self.back_to_source_limit = back_to_source_limit
+        self.back_to_source_peers: set[str] = set()
+        self.peer_failed_count = 0
+        self.pieces: Dict[int, Piece] = {}
+        self.dag: dag_mod.DAG = dag_mod.DAG()
+        self.created_at = time.time()
+        self.updated_at = time.time()
+        self._lock = threading.RLock()
+        self.fsm = FSM(TaskState.PENDING, _TASK_EVENTS,
+                       on_transition=lambda *_: self.touch())
+
+    def touch(self) -> None:
+        self.updated_at = time.time()
+
+    # -- piece registry -------------------------------------------------------
+
+    def store_piece(self, piece: Piece) -> None:
+        with self._lock:
+            self.pieces[piece.number] = piece
+
+    def load_piece(self, number: int) -> Optional[Piece]:
+        return self.pieces.get(number)
+
+    def delete_piece(self, number: int) -> None:
+        with self._lock:
+            self.pieces.pop(number, None)
+
+    # -- peer DAG -------------------------------------------------------------
+
+    def store_peer(self, peer) -> None:
+        if peer.id not in self.dag:
+            self.dag.add_vertex(peer.id, peer)
+
+    def load_peer(self, peer_id: str):
+        try:
+            return self.dag.vertex(peer_id).value
+        except dag_mod.VertexNotFoundError:
+            return None
+
+    def delete_peer(self, peer_id: str) -> None:
+        self.dag.delete_vertex(peer_id)
+
+    def peer_count(self) -> int:
+        return len(self.dag)
+
+    def peers(self):
+        return list(self.dag.values())
+
+    def can_add_peer_edge(self, parent_id: str, child_id: str) -> bool:
+        return self.dag.can_add_edge(parent_id, child_id)
+
+    def add_peer_edge(self, parent, child) -> None:
+        """parent serves pieces to child; counts an upload slot on the
+        parent's host (task.go AddPeerEdge)."""
+        self.dag.add_edge(parent.id, child.id)
+        parent.host.concurrent_upload_count += 1
+
+    def delete_peer_in_edges(self, peer_id: str) -> None:
+        with self._lock:
+            for parent in self.dag.parents(peer_id):
+                parent.host.concurrent_upload_count = max(
+                    parent.host.concurrent_upload_count - 1, 0
+                )
+            self.dag.delete_vertex_in_edges(peer_id)
+
+    def delete_peer_out_edges(self, peer) -> None:
+        with self._lock:
+            n = self.dag.vertex(peer.id).out_degree
+            peer.host.concurrent_upload_count = max(
+                peer.host.concurrent_upload_count - n, 0
+            )
+            self.dag.delete_vertex_out_edges(peer.id)
+
+    def peer_parents(self, peer_id: str):
+        return self.dag.parents(peer_id)
+
+    def peer_children(self, peer_id: str):
+        return self.dag.children(peer_id)
+
+    # -- scope / lifecycle ----------------------------------------------------
+
+    def size_scope(self) -> SizeScope:
+        if self.content_length < 0 or self.total_piece_count < 0:
+            return SizeScope.UNKNOW
+        if self.content_length == EMPTY_FILE_SIZE:
+            return SizeScope.EMPTY
+        if self.content_length <= TINY_FILE_SIZE:
+            return SizeScope.TINY
+        if self.total_piece_count == 1:
+            return SizeScope.SMALL
+        return SizeScope.NORMAL
+
+    def can_back_to_source(self) -> bool:
+        """(task.go:467-470) budget not exhausted and task type supports
+        origin downloads."""
+        return len(self.back_to_source_peers) <= self.back_to_source_limit and (
+            self.type in (TaskType.DFDAEMON, TaskType.DFSTORE)
+        )
+
+    def has_available_peer(self, blocklist: set[str] | None = None) -> bool:
+        """Any peer in a state that could serve pieces (task.go
+        HasAvailablePeer)."""
+        from dragonfly2_tpu.scheduler.resource.peer import PeerState
+
+        block = blocklist or set()
+        for peer in self.dag.values():
+            if peer.id in block:
+                continue
+            if peer.fsm.is_state(
+                PeerState.SUCCEEDED, PeerState.RUNNING, PeerState.BACK_TO_SOURCE
+            ):
+                return True
+        return False
+
+    def report_success(self, content_length: int, total_piece_count: int) -> None:
+        with self._lock:
+            if self.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
+                self.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
+            self.content_length = content_length
+            self.total_piece_count = total_piece_count
+            self.peer_failed_count = 0

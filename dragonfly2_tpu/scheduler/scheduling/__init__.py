@@ -1,0 +1,9 @@
+"""Scheduling core (reference: scheduler/scheduling/scheduling.go)."""
+
+from dragonfly2_tpu.scheduler.scheduling.core import (
+    SchedulingConfig,
+    Scheduling,
+    ScheduleError,
+)
+
+__all__ = ["Scheduling", "SchedulingConfig", "ScheduleError"]

@@ -1,0 +1,214 @@
+"""Parent-selection retry loop + candidate filtering.
+
+Reference counterpart: scheduler/scheduling/scheduling.go:43-536. Semantics
+preserved (same filters, same back-to-source decision ladder, same retry
+budgets — defaults from scheduler/config/constants.go: filter 15, candidates
+4, retry 10, retry-back-to-source 5, max schedule count 30); transport
+decoupled: decisions are delivered through the peer's attached
+``announce_channel`` (the gRPC service layer binds a stream; tests bind a
+recorder), so the core never imports a wire format.
+
+The hot loop (FindCandidateParents → evaluate) is where the <1 ms p50
+target lives: filtering is O(filter_limit) set/DAG checks and scoring is one
+batched evaluator call (rule-based numpy or the TPU MLEvaluator).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Protocol, Sequence
+
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerState
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FILTER_PARENT_LIMIT = 15
+DEFAULT_CANDIDATE_PARENT_LIMIT = 4
+
+
+class PeerChannel(Protocol):
+    """Where scheduling decisions go (one per peer announce session)."""
+
+    def send_candidate_parents(self, peer: Peer, parents: Sequence[Peer]) -> bool:
+        """v2 NormalTaskResponse. Returns False if the channel is gone."""
+        ...
+
+    def send_need_back_to_source(self, peer: Peer, description: str) -> bool:
+        """v2 NeedBackToSourceResponse."""
+        ...
+
+
+class ScheduleError(RuntimeError):
+    pass
+
+
+@dataclass
+class SchedulingConfig:
+    retry_limit: int = 10
+    retry_back_to_source_limit: int = 5
+    retry_interval: float = 0.05  # seconds
+    max_schedule_count: int = 30
+    filter_parent_limit: int = DEFAULT_FILTER_PARENT_LIMIT
+    candidate_parent_limit: int = DEFAULT_CANDIDATE_PARENT_LIMIT
+
+
+class Scheduling:
+    def __init__(self, evaluator, config: SchedulingConfig | None = None):
+        self.evaluator = evaluator
+        self.config = config or SchedulingConfig()
+
+    # -- v2 entry point -------------------------------------------------------
+
+    def schedule_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> None:
+        """The v2 retry loop (scheduling.go:80-214).
+
+        Ladder per iteration:
+        1. task can back-to-source AND (peer asked for it OR schedule count
+           exhausted) → NeedBackToSourceResponse
+        2. task can back-to-source AND retries exceeded
+           retry_back_to_source_limit → NeedBackToSourceResponse
+        3. retries exceeded retry_limit → ScheduleError
+        4. candidates found AND channel accepts them → done (DAG edges added)
+        else: sleep retry_interval, retry.
+        """
+        blocklist = blocklist or set()
+        cfg = self.config
+        n = 0
+        while True:
+            if peer.task.can_back_to_source():
+                if peer.need_back_to_source or peer.schedule_count >= cfg.max_schedule_count:
+                    self._send_back_to_source(
+                        peer,
+                        f"peer need_back_to_source={peer.need_back_to_source} "
+                        f"schedule_count={peer.schedule_count}",
+                    )
+                    return
+                if n >= cfg.retry_back_to_source_limit:
+                    self._send_back_to_source(
+                        peer, "scheduling exceeded RetryBackToSourceLimit"
+                    )
+                    return
+
+            if n >= cfg.retry_limit:
+                raise ScheduleError(
+                    f"peer {peer.id} scheduling exceeded RetryLimit {cfg.retry_limit}"
+                )
+
+            # Reschedule from a clean slate: detach from current parents.
+            peer.task.delete_peer_in_edges(peer.id)
+
+            candidates = self.find_candidate_parents(peer, blocklist)
+            if candidates:
+                channel = getattr(peer, "announce_channel", None)
+                if channel is None:
+                    raise ScheduleError(f"peer {peer.id} has no announce channel")
+                if channel.send_candidate_parents(peer, candidates):
+                    for parent in candidates:
+                        if peer.task.can_add_peer_edge(parent.id, peer.id):
+                            peer.task.add_peer_edge(parent, peer)
+                    peer.schedule_count += 1
+                    return
+                logger.warning("peer %s channel rejected candidates", peer.id)
+
+            n += 1
+            logger.info("peer %s schedule retry %d", peer.id, n)
+            if cfg.retry_interval > 0:
+                time.sleep(cfg.retry_interval)
+
+    # -- v1 entry point -------------------------------------------------------
+
+    def schedule_parent_and_candidate_parents(
+        self, peer: Peer, blocklist: set[str] | None = None
+    ) -> tuple[Optional[Peer], List[Peer]]:
+        """The v1 flavor (scheduling.go:218-388): returns (main parent,
+        candidates) for a PeerPacket instead of streaming; back-to-source
+        intent is signaled on the peer. Retries are the caller's loop in v1,
+        so this is single-shot."""
+        blocklist = blocklist or set()
+        candidates = self.find_candidate_parents(peer, blocklist)
+        if not candidates:
+            if peer.task.can_back_to_source() and peer.schedule_count == 0:
+                peer.need_back_to_source = True
+            return None, []
+        peer.task.delete_peer_in_edges(peer.id)
+        for parent in candidates:
+            if peer.task.can_add_peer_edge(parent.id, peer.id):
+                peer.task.add_peer_edge(parent, peer)
+        peer.schedule_count += 1
+        return candidates[0], candidates
+
+    # -- candidate selection --------------------------------------------------
+
+    def find_candidate_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
+        """(scheduling.go:391-430) running peers only; filter → evaluate →
+        truncate to candidate_parent_limit."""
+        if not peer.fsm.is_state(PeerState.RUNNING):
+            logger.debug("peer %s state %s cannot schedule", peer.id, peer.fsm.current)
+            return []
+        candidates = self._filter_candidate_parents(peer, blocklist)
+        if not candidates:
+            return []
+        ranked = self.evaluator.evaluate_parents(
+            candidates, peer, peer.task.total_piece_count
+        )
+        return list(ranked[: self.config.candidate_parent_limit])
+
+    def find_success_parent(self, peer: Peer, blocklist: set[str]) -> Optional[Peer]:
+        """(scheduling.go:433-462) best fully-downloaded parent, for task
+        reuse paths."""
+        candidates = [
+            p
+            for p in self._filter_candidate_parents(peer, blocklist)
+            if p.fsm.is_state(PeerState.SUCCEEDED)
+        ]
+        if not candidates:
+            return None
+        ranked = self.evaluator.evaluate_parents(
+            candidates, peer, peer.task.total_piece_count
+        )
+        return ranked[0]
+
+    def _filter_candidate_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
+        """(scheduling.go:465-536) — the six filters, applied to a random
+        sample of filter_parent_limit peers from the task DAG."""
+        task = peer.task
+        out = []
+        for candidate in task.dag.random_vertices(self.config.filter_parent_limit):
+            if candidate.id in blocklist:
+                continue
+            # Cycle-safe (also rejects self and duplicate edges).
+            if not task.can_add_peer_edge(candidate.id, peer.id):
+                continue
+            # Same host cannot serve itself (dfdaemon cannot express mutual
+            # downloads between two local tasks).
+            if candidate.host.id == peer.host.id:
+                continue
+            if self.evaluator.is_bad_node(candidate):
+                continue
+            # A normal-host parent must itself have a source of pieces:
+            # a parent, back-to-source, or completed download. Seeds are
+            # exempt (they fetch on demand).
+            in_degree = task.dag.vertex(candidate.id).in_degree
+            if (
+                candidate.host.type == HostType.NORMAL
+                and in_degree == 0
+                and not candidate.fsm.is_state(PeerState.BACK_TO_SOURCE, PeerState.SUCCEEDED)
+            ):
+                continue
+            if candidate.host.free_upload_count() <= 0:
+                continue
+            out.append(candidate)
+        return out
+
+    # -- helpers --------------------------------------------------------------
+
+    def _send_back_to_source(self, peer: Peer, description: str) -> None:
+        channel = getattr(peer, "announce_channel", None)
+        if channel is None:
+            raise ScheduleError(f"peer {peer.id} has no announce channel")
+        if not channel.send_need_back_to_source(peer, description):
+            raise ScheduleError(f"peer {peer.id} channel closed")
+        peer.task.back_to_source_peers.add(peer.id)

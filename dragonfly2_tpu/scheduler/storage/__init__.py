@@ -1,0 +1,5 @@
+"""Dataset sink (reference: scheduler/storage/)."""
+
+from dragonfly2_tpu.scheduler.storage.storage import Storage, StorageConfig
+
+__all__ = ["Storage", "StorageConfig"]

@@ -1,0 +1,171 @@
+"""Rotating training-dataset sink.
+
+Reference counterpart: scheduler/storage/storage.go:59-475. Buffered appends
+of Download / NetworkTopology records into size-rotated files with bounded
+backups, plus open/list/clear used by the announcer to stream datasets to
+the trainer.
+
+Differences from the reference (deliberate):
+- Files are our headered CSV (readable by read_csv_records and convertible
+  to parquet via csv_to_parquet for the training pipeline); the reference's
+  headerless format is still readable on the ingest side.
+- ``export_parquet`` is new: the trainer consumes columnar shards, so the
+  sink can emit them directly instead of round-tripping CSV.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Type
+
+from dragonfly2_tpu.schema import Download, NetworkTopology
+from dragonfly2_tpu.schema.io import (
+    CsvRecordWriter,
+    csv_to_parquet,
+    read_csv_records,
+)
+
+DOWNLOAD_FILE_PREFIX = "download"
+NETWORK_TOPOLOGY_FILE_PREFIX = "networktopology"
+CSV_EXT = ".csv"
+
+
+@dataclass
+class StorageConfig:
+    max_size: int = 100 * (1 << 20)  # bytes before rotation
+    max_backups: int = 10
+    buffer_size: int = 100  # records buffered before flush
+
+
+class _RotatingDataset:
+    """One record type's rotating file set."""
+
+    def __init__(self, base_dir: str, prefix: str, record_type: Type,
+                 config: StorageConfig):
+        self.base_dir = base_dir
+        self.prefix = prefix
+        self.record_type = record_type
+        self.config = config
+        self._buffer: List = []
+        self._count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def active_path(self) -> str:
+        return os.path.join(self.base_dir, f"{self.prefix}{CSV_EXT}")
+
+    def backups(self) -> List[str]:
+        pattern = os.path.join(self.base_dir, f"{self.prefix}-*{CSV_EXT}")
+        return sorted(glob.glob(pattern))
+
+    def all_files(self) -> List[str]:
+        files = self.backups()
+        if os.path.exists(self.active_path):
+            files.append(self.active_path)
+        return files
+
+    def create(self, record) -> None:
+        with self._lock:
+            self._buffer.append(record)
+            if len(self._buffer) >= self.config.buffer_size:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buffer:
+            return
+        self._maybe_rotate()
+        with CsvRecordWriter(self.record_type, self.active_path) as w:
+            for r in self._buffer:
+                w.write(r)
+        self._count += len(self._buffer)
+        self._buffer = []
+
+    def _maybe_rotate(self) -> None:
+        path = self.active_path
+        if os.path.exists(path) and os.path.getsize(path) >= self.config.max_size:
+            stamp = time.strftime("%Y-%m-%dT%H-%M-%S") + f".{int(time.time()*1000)%1000:03d}"
+            os.rename(path, os.path.join(self.base_dir, f"{self.prefix}-{stamp}{CSV_EXT}"))
+        backups = self.backups()
+        while len(backups) + 1 > self.config.max_backups:
+            os.remove(backups.pop(0))
+
+    def count(self) -> int:
+        return self._count
+
+    def records(self) -> Iterator:
+        self.flush()
+        for path in self.all_files():
+            yield from read_csv_records(self.record_type, path)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buffer = []
+            for path in self.all_files():
+                os.remove(path)
+
+    def export_parquet(self, out_dir: str) -> List[str]:
+        self.flush()
+        os.makedirs(out_dir, exist_ok=True)
+        out = []
+        for i, path in enumerate(self.all_files()):
+            dst = os.path.join(out_dir, f"{self.prefix}-{i:05d}.parquet")
+            csv_to_parquet(self.record_type, path, dst)
+            out.append(dst)
+        return out
+
+
+class Storage:
+    """The scheduler's dataset sink: one rotating set per record type."""
+
+    def __init__(self, base_dir: str, config: StorageConfig | None = None):
+        os.makedirs(base_dir, exist_ok=True)
+        config = config or StorageConfig()
+        self.download = _RotatingDataset(
+            base_dir, DOWNLOAD_FILE_PREFIX, Download, config
+        )
+        self.network_topology = _RotatingDataset(
+            base_dir, NETWORK_TOPOLOGY_FILE_PREFIX, NetworkTopology, config
+        )
+
+    # Interface names mirror storage.go:59-89.
+    def create_download(self, record: Download) -> None:
+        self.download.create(record)
+
+    def create_network_topology(self, record: NetworkTopology) -> None:
+        self.network_topology.create(record)
+
+    def list_download(self) -> List[Download]:
+        return list(self.download.records())
+
+    def list_network_topology(self) -> List[NetworkTopology]:
+        return list(self.network_topology.records())
+
+    def download_count(self) -> int:
+        return self.download.count()
+
+    def network_topology_count(self) -> int:
+        return self.network_topology.count()
+
+    def open_download(self) -> List[str]:
+        """Paths of all download dataset files, oldest first (announcer
+        streams them to the trainer)."""
+        self.download.flush()
+        return self.download.all_files()
+
+    def open_network_topology(self) -> List[str]:
+        self.network_topology.flush()
+        return self.network_topology.all_files()
+
+    def clear_download(self) -> None:
+        self.download.clear()
+
+    def clear_network_topology(self) -> None:
+        self.network_topology.clear()

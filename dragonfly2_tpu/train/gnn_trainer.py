@@ -60,10 +60,24 @@ class GNNTrainResult:
         return GraphSAGE(hidden=self.config.hidden, embed=self.config.embed)
 
 
-def _edge_split(n_edges: int, eval_fraction: float, seed: int):
-    order = np.random.default_rng((seed, 1)).permutation(n_edges)
-    n_eval = int(n_edges * eval_fraction)
-    return order[n_eval:], order[:n_eval]
+def _edge_split(graph: Graph, eval_fraction: float, seed: int):
+    """Split edges by (src, dst) PAIR, not edge id.
+
+    Probe datasets contain repeated sightings of the same ordered pair;
+    splitting by edge id would leave a same-pair train edge in the message
+    graph for most eval edges — a near-direct probe of the answer sitting
+    in the sampled neighborhood. Pair-level splitting keeps every sighting
+    of an eval pair out of training entirely.
+    """
+    pair_key = graph.edge_src.astype(np.int64) * graph.n_nodes + graph.edge_dst
+    uniq_pairs, pair_idx = np.unique(pair_key, return_inverse=True)
+    order = np.random.default_rng((seed, 1)).permutation(len(uniq_pairs))
+    n_eval_pairs = int(len(uniq_pairs) * eval_fraction)
+    eval_pair_mask = np.zeros(len(uniq_pairs), bool)
+    eval_pair_mask[order[:n_eval_pairs]] = True
+    is_eval = eval_pair_mask[pair_idx]
+    all_ids = np.arange(graph.n_edges)
+    return all_ids[~is_eval], all_ids[is_eval]
 
 
 def make_train_step(model: GraphSAGE, mesh: MeshContext):
@@ -114,7 +128,7 @@ def train_gnn(
 ) -> GNNTrainResult:
     mesh = mesh or data_parallel_mesh()
     labels = graph.edge_labels(config.rtt_threshold_ns)
-    train_ids, eval_ids = _edge_split(graph.n_edges, config.eval_fraction, config.seed)
+    train_ids, eval_ids = _edge_split(graph, config.eval_fraction, config.seed)
     batch_size = (min(config.batch_size, len(train_ids)) // mesh.n_data) * mesh.n_data
     if batch_size == 0:
         raise ValueError(

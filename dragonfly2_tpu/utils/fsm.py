@@ -1,0 +1,62 @@
+"""Minimal finite-state machine.
+
+Backs the peer/task lifecycle state (reference uses looplab/fsm via
+scheduler/resource/peer.go:230-251 and task.go:197-202). Transitions are a
+static event table; firing an event from a wrong source state raises —
+bugs in lifecycle logic surface immediately instead of corrupting
+scheduling state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, Mapping, Tuple
+
+
+class InvalidTransitionError(RuntimeError):
+    def __init__(self, event: str, state: str):
+        super().__init__(f"event {event!r} inappropriate in current state {state!r}")
+        self.event = event
+        self.state = state
+
+
+class FSM:
+    """Thread-safe event-table state machine."""
+
+    def __init__(
+        self,
+        initial: str,
+        events: Mapping[str, Tuple[Iterable[str], str]],
+        on_transition: Callable[[str, str, str], None] | None = None,
+    ):
+        """``events`` maps event name → (allowed source states, destination).
+
+        ``on_transition(event, src, dst)`` fires after every state change.
+        """
+        self._state = initial
+        self._events: Dict[str, Tuple[frozenset, str]] = {
+            name: (frozenset(srcs), dst) for name, (srcs, dst) in events.items()
+        }
+        self._lock = threading.Lock()
+        self._on_transition = on_transition
+
+    @property
+    def current(self) -> str:
+        return self._state
+
+    def is_state(self, *states: str) -> bool:
+        return self._state in states
+
+    def can(self, event: str) -> bool:
+        srcs, _ = self._events[event]
+        return self._state in srcs
+
+    def fire(self, event: str) -> None:
+        with self._lock:
+            srcs, dst = self._events[event]
+            if self._state not in srcs:
+                raise InvalidTransitionError(event, self._state)
+            src = self._state
+            self._state = dst
+        if self._on_transition is not None:
+            self._on_transition(event, src, dst)

@@ -1,0 +1,125 @@
+"""Tests for FSM, DAG, and GC infrastructure."""
+
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils.dag import DAG, CycleError, VertexExistsError, VertexNotFoundError
+from dragonfly2_tpu.utils.fsm import FSM, InvalidTransitionError
+from dragonfly2_tpu.utils.gc import GC
+
+
+class TestFSM:
+    def make(self):
+        return FSM("A", {"go": (["A"], "B"), "back": (["B"], "A"),
+                         "end": (["A", "B"], "C")})
+
+    def test_transitions(self):
+        m = self.make()
+        assert m.current == "A" and m.can("go") and not m.can("back")
+        m.fire("go")
+        assert m.current == "B" and m.is_state("B")
+        m.fire("end")
+        assert m.current == "C"
+
+    def test_invalid_transition_raises(self):
+        m = self.make()
+        with pytest.raises(InvalidTransitionError, match="back"):
+            m.fire("back")
+        assert m.current == "A"  # state unchanged
+
+    def test_callback(self):
+        seen = []
+        m = FSM("A", {"go": (["A"], "B")}, on_transition=lambda *a: seen.append(a))
+        m.fire("go")
+        assert seen == [("go", "A", "B")]
+
+
+class TestDAG:
+    def test_vertices(self):
+        d = DAG()
+        d.add_vertex("a", 1)
+        assert "a" in d and d.vertex("a").value == 1
+        with pytest.raises(VertexExistsError):
+            d.add_vertex("a", 2)
+        with pytest.raises(VertexNotFoundError):
+            d.vertex("zz")
+        d.delete_vertex("a")
+        assert "a" not in d
+
+    def test_cycle_rejected(self):
+        d = DAG()
+        for v in "abc":
+            d.add_vertex(v, v)
+        d.add_edge("a", "b")
+        d.add_edge("b", "c")
+        assert not d.can_add_edge("c", "a")  # would close the cycle
+        assert not d.can_add_edge("a", "a")  # self-loop
+        assert not d.can_add_edge("a", "b")  # duplicate
+        assert d.can_add_edge("a", "c")
+        with pytest.raises(CycleError):
+            d.add_edge("c", "a")
+
+    def test_delete_vertex_cleans_edges(self):
+        d = DAG()
+        for v in "abc":
+            d.add_vertex(v, v)
+        d.add_edge("a", "b")
+        d.add_edge("b", "c")
+        d.delete_vertex("b")
+        assert d.vertex("a").out_degree == 0
+        assert d.vertex("c").in_degree == 0
+
+    def test_in_out_edge_deletion(self):
+        d = DAG()
+        for v in "abcd":
+            d.add_vertex(v, v)
+        d.add_edge("a", "c")
+        d.add_edge("b", "c")
+        d.add_edge("c", "d")
+        d.delete_vertex_in_edges("c")
+        assert d.vertex("c").in_degree == 0 and d.vertex("a").out_degree == 0
+        d.delete_vertex_out_edges("c")
+        assert d.vertex("d").in_degree == 0
+
+    def test_random_vertices(self):
+        d = DAG()
+        for i in range(20):
+            d.add_vertex(str(i), i)
+        got = d.random_vertices(5)
+        assert len(got) == 5 and len(set(got)) == 5
+        assert len(d.random_vertices(50)) == 20
+
+
+class TestGC:
+    def test_interval_and_run_now(self):
+        gc = GC()
+        counter = {"n": 0}
+        gc.add("t", 0.05, lambda: counter.__setitem__("n", counter["n"] + 1))
+        gc.serve()
+        try:
+            time.sleep(0.3)
+            assert counter["n"] >= 3
+            gc.run("t")
+            assert counter["n"] >= 4
+        finally:
+            gc.stop()
+
+    def test_duplicate_task_rejected(self):
+        gc = GC()
+        gc.add("t", 1, lambda: None)
+        with pytest.raises(ValueError):
+            gc.add("t", 1, lambda: None)
+
+    def test_failing_task_does_not_kill_loop(self):
+        gc = GC()
+        hits = []
+        gc.add("bad", 0.03, lambda: 1 / 0)
+        gc.add("good", 0.03, lambda: hits.append(1))
+        gc.serve()
+        try:
+            time.sleep(0.2)
+            assert len(hits) >= 2
+        finally:
+            gc.stop()

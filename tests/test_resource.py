@@ -1,0 +1,203 @@
+"""Resource model tests (modeled on scheduler/resource/{task,peer}_test.go)."""
+
+import time
+
+import pytest
+
+from dragonfly2_tpu.scheduler.resource import (
+    Host,
+    Peer,
+    PeerEvent,
+    PeerState,
+    Piece,
+    Resource,
+    SizeScope,
+    Task,
+    TaskEvent,
+    TaskState,
+    TaskType,
+)
+from dragonfly2_tpu.utils.fsm import InvalidTransitionError
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+
+def make_host(i=0, **kw):
+    return Host(id=f"host-{i}", hostname=f"h{i}", ip=f"10.0.0.{i}", **kw)
+
+
+def make_peer(i=0, task=None, host=None):
+    return Peer(f"peer-{i}", task or Task("task-1", "https://e.com/f"),
+                host or make_host(i))
+
+
+class TestHost:
+    def test_upload_limit_defaults(self):
+        assert make_host(0).concurrent_upload_limit == 50
+        assert make_host(1, type=HostType.SUPER_SEED).concurrent_upload_limit == 300
+
+    def test_upload_accounting(self):
+        h = make_host(0)
+        assert h.acquire_upload()
+        assert h.free_upload_count() == 49
+        h.release_upload(success=False)
+        assert h.upload_count == 1 and h.upload_failed_count == 1
+        assert h.free_upload_count() == 50
+
+    def test_acquire_respects_limit(self):
+        h = make_host(0)
+        h.concurrent_upload_limit = 1
+        assert h.acquire_upload() and not h.acquire_upload()
+
+
+class TestTask:
+    def test_size_scope(self):
+        t = Task("t", "u")
+        assert t.size_scope() is SizeScope.UNKNOW
+        t.content_length = 0
+        assert t.size_scope() is SizeScope.EMPTY
+        t.content_length = 100
+        assert t.size_scope() is SizeScope.TINY
+        t.content_length = 1 << 20
+        t.total_piece_count = 1
+        assert t.size_scope() is SizeScope.SMALL
+        t.total_piece_count = 4
+        assert t.size_scope() is SizeScope.NORMAL
+
+    def test_fsm(self):
+        t = Task("t", "u")
+        assert t.fsm.current == TaskState.PENDING
+        t.fsm.fire(TaskEvent.DOWNLOAD)
+        assert t.fsm.current == TaskState.RUNNING
+        t.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
+        # Re-download from Succeeded is allowed (new peers join).
+        t.fsm.fire(TaskEvent.DOWNLOAD)
+        t.fsm.fire(TaskEvent.DOWNLOAD_FAILED)
+        with pytest.raises(InvalidTransitionError):
+            t.fsm.fire(TaskEvent.DOWNLOAD_FAILED)
+
+    def test_back_to_source_budget(self):
+        t = Task("t", "u", back_to_source_limit=1)
+        assert t.can_back_to_source()
+        t.back_to_source_peers |= {"a", "b"}
+        assert not t.can_back_to_source()
+        t2 = Task("t2", "u", type=TaskType.DFCACHE)
+        assert not t2.can_back_to_source()
+
+    def test_peer_edges_count_upload_slots(self):
+        t = Task("t", "u")
+        h_parent, h_child = make_host(1), make_host(2)
+        parent = Peer("p", t, h_parent)
+        child = Peer("c", t, h_child)
+        t.store_peer(parent)
+        t.store_peer(child)
+        assert t.can_add_peer_edge("p", "c")
+        t.add_peer_edge(parent, child)
+        assert h_parent.concurrent_upload_count == 1
+        assert not t.can_add_peer_edge("c", "p")  # cycle
+        assert [p.id for p in t.peer_parents("c")] == ["p"]
+        t.delete_peer_in_edges("c")
+        assert h_parent.concurrent_upload_count == 0
+
+    def test_has_available_peer(self):
+        t = Task("t", "u")
+        p = Peer("p", t, make_host(1))
+        t.store_peer(p)
+        assert not t.has_available_peer()
+        p.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        p.fsm.fire(PeerEvent.DOWNLOAD)
+        assert t.has_available_peer()
+        assert not t.has_available_peer(blocklist={"p"})
+
+
+class TestPeer:
+    def test_fsm_register_paths(self):
+        for ev, state in [
+            (PeerEvent.REGISTER_EMPTY, PeerState.RECEIVED_EMPTY),
+            (PeerEvent.REGISTER_TINY, PeerState.RECEIVED_TINY),
+            (PeerEvent.REGISTER_SMALL, PeerState.RECEIVED_SMALL),
+            (PeerEvent.REGISTER_NORMAL, PeerState.RECEIVED_NORMAL),
+        ]:
+            p = make_peer()
+            p.fsm.fire(ev)
+            assert p.fsm.current == state
+
+    def test_out_of_order_success(self):
+        # Result may arrive before any piece report (peer.go comment).
+        p = make_peer()
+        p.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        p.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        assert p.fsm.current == PeerState.SUCCEEDED
+        # Succeeded → Failed is allowed (validation failures post-success).
+        p.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
+        assert p.fsm.current == PeerState.FAILED
+
+    def test_piece_bookkeeping(self):
+        p = make_peer()
+        p.store_piece(Piece(number=3, length=1024, cost=0.5))
+        p.store_piece(Piece(number=7, length=1024, cost=0.7))
+        assert p.finished_piece_count() == 2
+        assert p.piece_costs() == [0.5, 0.7]
+        assert p.load_piece(3).length == 1024
+
+    def test_evaluator_protocol(self):
+        # The resource Peer/Host must satisfy the evaluator's duck types.
+        from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+
+        t = Task("t", "u")
+        child = Peer("c", t, make_host(1))
+        a = Peer("a", t, make_host(2))
+        b = Peer("b", t, make_host(3))
+        a.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        a.fsm.fire(PeerEvent.DOWNLOAD)
+        b.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        b.fsm.fire(PeerEvent.DOWNLOAD)
+        a.finished_pieces |= {0, 1, 2, 3}
+        ranked = BaseEvaluator().evaluate_parents([b, a], child, 4)
+        assert ranked[0].id == "a"
+        assert not BaseEvaluator().is_bad_node(a)
+
+
+class TestManagersAndGC:
+    def test_store_load_cascade_delete(self):
+        r = Resource()
+        h = make_host(1)
+        t = Task("t", "u")
+        r.host_manager.store(h)
+        r.task_manager.store(t)
+        p = Peer("p", t, h)
+        r.peer_manager.store(p)
+        assert t.load_peer("p") is p and h.load_peer("p") is p
+        r.peer_manager.delete("p")
+        assert t.load_peer("p") is None and h.load_peer("p") is None
+
+    def test_gc_reclaims_stale(self):
+        r = Resource()
+        r.host_manager.ttl = r.task_manager.ttl = 0.01
+        h, t = make_host(1), Task("t", "u")
+        r.host_manager.store(h)
+        r.task_manager.store(t)
+        time.sleep(0.05)
+        r.host_manager.run_gc()
+        r.task_manager.run_gc()
+        assert r.host_manager.load(h.id) is None
+        assert r.task_manager.load(t.id) is None
+
+    def test_gc_leaves_then_reclaims_peers(self):
+        r = Resource()
+        h, t = make_host(1), Task("t", "u")
+        r.host_manager.store(h)
+        r.task_manager.store(t)
+        p = Peer("p", t, h)
+        r.peer_manager.store(p)
+        r.peer_manager.ttl = 0.01
+        time.sleep(0.05)
+        r.peer_manager.run_gc()  # stale → Leave
+        assert p.fsm.current == PeerState.LEAVE
+        r.peer_manager.run_gc()  # Leave → reclaimed
+        assert r.peer_manager.load("p") is None
+
+    def test_load_or_store_idempotent(self):
+        r = Resource()
+        h1, h2 = make_host(1), make_host(1)
+        assert r.host_manager.load_or_store(h1) is h1
+        assert r.host_manager.load_or_store(h2) is h1

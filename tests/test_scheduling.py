@@ -1,0 +1,200 @@
+"""Scheduling core tests (modeled on scheduling_test.go:1-1545 cases)."""
+
+from dataclasses import dataclass, field
+from typing import List
+
+import pytest
+
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.resource import Host, Peer, PeerEvent, PeerState, Task
+from dragonfly2_tpu.scheduler.scheduling import (
+    ScheduleError,
+    Scheduling,
+    SchedulingConfig,
+)
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+
+@dataclass
+class RecorderChannel:
+    """Test double for the announce stream."""
+
+    sent_parents: List[tuple] = field(default_factory=list)
+    back_to_source: List[str] = field(default_factory=list)
+    accept: bool = True
+
+    def send_candidate_parents(self, peer, parents):
+        if self.accept:
+            self.sent_parents.append((peer.id, [p.id for p in parents]))
+        return self.accept
+
+    def send_need_back_to_source(self, peer, description):
+        self.back_to_source.append(description)
+        return True
+
+
+def scheduling(**kw):
+    kw.setdefault("retry_interval", 0.0)
+    return Scheduling(BaseEvaluator(), SchedulingConfig(**kw))
+
+
+def make_cluster(n_parents=3, *, seed=False, succeeded=True):
+    """A task with n ready parents and one running child."""
+    task = Task("task-1", "https://e.com/f")
+    task.total_piece_count = 64
+    task.content_length = 64 << 22
+    parents = []
+    for i in range(n_parents):
+        host = Host(id=f"host-p{i}", ip=f"10.0.1.{i}",
+                    type=HostType.SUPER_SEED if seed else HostType.NORMAL)
+        p = Peer(f"parent-{i}", task, host)
+        p.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        if succeeded:
+            p.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        else:
+            p.fsm.fire(PeerEvent.DOWNLOAD)
+        p.finished_pieces |= set(range(64))
+        task.store_peer(p)
+        parents.append(p)
+    child_host = Host(id="host-c", ip="10.0.2.1")
+    child = Peer("child", task, child_host)
+    child.fsm.fire(PeerEvent.REGISTER_NORMAL)
+    child.fsm.fire(PeerEvent.DOWNLOAD)
+    task.store_peer(child)
+    child.announce_channel = RecorderChannel()
+    return task, parents, child
+
+
+class TestFindCandidateParents:
+    def test_happy_path(self):
+        _, parents, child = make_cluster(3)
+        got = scheduling().find_candidate_parents(child, set())
+        assert {p.id for p in got} == {p.id for p in parents}
+
+    def test_only_running_child_schedules(self):
+        _, _, child = make_cluster(3)
+        child.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        assert scheduling().find_candidate_parents(child, set()) == []
+
+    def test_truncates_to_candidate_limit(self):
+        _, _, child = make_cluster(8)
+        got = scheduling().find_candidate_parents(child, set())
+        assert len(got) == 4  # DefaultSchedulerCandidateParentLimit
+
+    def test_blocklist(self):
+        _, parents, child = make_cluster(2)
+        got = scheduling().find_candidate_parents(child, {parents[0].id})
+        assert [p.id for p in got] == [parents[1].id]
+
+    def test_same_host_filtered(self):
+        task, parents, child = make_cluster(1)
+        same = Peer("same-host", task, child.host)
+        same.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        same.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        task.store_peer(same)
+        got = scheduling().find_candidate_parents(child, set())
+        assert "same-host" not in {p.id for p in got}
+
+    def test_bad_node_filtered(self):
+        _, parents, child = make_cluster(2)
+        parents[0].fsm.fire(PeerEvent.DOWNLOAD_FAILED)  # failed = bad node
+        got = scheduling().find_candidate_parents(child, set())
+        assert parents[0].id not in {p.id for p in got}
+
+    def test_rootless_normal_parent_filtered(self):
+        # A normal-host running parent with no in-edges and no
+        # back-to-source can't source pieces.
+        _, parents, child = make_cluster(1, succeeded=False)
+        got = scheduling().find_candidate_parents(child, set())
+        assert got == []
+        # ... but the same peer on a seed host is fine.
+        _, parents, child = make_cluster(1, seed=True, succeeded=False)
+        got = scheduling().find_candidate_parents(child, set())
+        assert len(got) == 1
+
+    def test_no_free_upload_filtered(self):
+        _, parents, child = make_cluster(1)
+        parents[0].host.concurrent_upload_count = (
+            parents[0].host.concurrent_upload_limit
+        )
+        assert scheduling().find_candidate_parents(child, set()) == []
+
+
+class TestScheduleCandidateParents:
+    def test_schedules_and_adds_edges(self):
+        task, parents, child = make_cluster(3)
+        scheduling().schedule_candidate_parents(child)
+        assert child.announce_channel.sent_parents
+        assert child.schedule_count == 1
+        assert {p.id for p in task.peer_parents("child")} == {
+            p.id for p in parents
+        }
+
+    def test_back_to_source_when_no_candidates(self):
+        task, _, child = make_cluster(0)
+        scheduling(retry_back_to_source_limit=2).schedule_candidate_parents(child)
+        assert child.announce_channel.back_to_source
+        assert "child" in task.back_to_source_peers
+
+    def test_need_back_to_source_flag_short_circuits(self):
+        task, parents, child = make_cluster(3)
+        child.need_back_to_source = True
+        scheduling().schedule_candidate_parents(child)
+        assert child.announce_channel.back_to_source
+        assert not child.announce_channel.sent_parents
+
+    def test_exhausted_schedule_count_goes_back_to_source(self):
+        task, parents, child = make_cluster(3)
+        child.schedule_count = 30
+        scheduling().schedule_candidate_parents(child)
+        assert child.announce_channel.back_to_source
+
+    def test_retry_limit_errors_when_no_back_to_source(self):
+        task, _, child = make_cluster(0)
+        task.type = __import__(
+            "dragonfly2_tpu.scheduler.resource.task", fromlist=["TaskType"]
+        ).TaskType.DFCACHE  # cache tasks can't back-to-source
+        with pytest.raises(ScheduleError, match="RetryLimit"):
+            scheduling(retry_limit=2).schedule_candidate_parents(child)
+
+    def test_reschedule_detaches_old_parents(self):
+        task, parents, child = make_cluster(2)
+        s = scheduling()
+        s.schedule_candidate_parents(child)
+        before = {p.id for p in task.peer_parents("child")}
+        s.schedule_candidate_parents(child)
+        assert child.schedule_count == 2
+        # Still exactly one generation of edges (no accumulation).
+        assert len(task.peer_parents("child")) <= len(before) + 2
+
+
+class TestV1Flavor:
+    def test_returns_main_and_candidates(self):
+        _, parents, child = make_cluster(3)
+        # Break score ties so the expected ranking is unique regardless of
+        # the random pre-sample order.
+        for i, p in enumerate(parents):
+            p.host.upload_count = 100
+            p.host.upload_failed_count = 10 * i
+        main, cands = scheduling().schedule_parent_and_candidate_parents(child)
+        assert main is not None and main in cands
+        # Main parent is the best-ranked candidate.
+        assert main.id == parents[0].id
+
+    def test_signals_back_to_source_intent(self):
+        _, _, child = make_cluster(0)
+        main, cands = scheduling().schedule_parent_and_candidate_parents(child)
+        assert main is None and cands == []
+        assert child.need_back_to_source
+
+
+class TestFindSuccessParent:
+    def test_prefers_succeeded(self):
+        task, parents, child = make_cluster(2)
+        running = Peer("running", task, Host(id="host-r", ip="10.0.3.1",
+                                             type=HostType.SUPER_SEED))
+        running.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        running.fsm.fire(PeerEvent.DOWNLOAD)
+        task.store_peer(running)
+        got = scheduling().find_success_parent(child, set())
+        assert got is not None and got.id.startswith("parent-")

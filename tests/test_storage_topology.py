@@ -1,0 +1,136 @@
+"""Dataset storage rotation + probe store tests (modeled on
+scheduler/storage/storage_test.go and networktopology tests)."""
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.schema import Download, NetworkTopology
+from dragonfly2_tpu.scheduler.networktopology import (
+    NetworkTopologyConfig,
+    NetworkTopologyStore,
+    Probe,
+)
+from dragonfly2_tpu.scheduler.resource import Host, Resource
+from dragonfly2_tpu.scheduler.storage import Storage, StorageConfig
+from dragonfly2_tpu.schema.records import Network
+
+
+def make_download(i):
+    return Download(id=f"peer-{i}", state="Succeeded", cost=1000 + i)
+
+
+class TestStorage:
+    def test_buffered_append_and_list(self, tmp_path):
+        s = Storage(str(tmp_path), StorageConfig(buffer_size=3))
+        for i in range(5):
+            s.create_download(make_download(i))
+        # Buffer flushes at 3; the last 2 flush on list.
+        assert s.download_count() >= 3
+        got = s.list_download()
+        assert [d.id for d in got] == [f"peer-{i}" for i in range(5)]
+
+    def test_rotation_and_backup_pruning(self, tmp_path):
+        s = Storage(str(tmp_path), StorageConfig(max_size=2000, max_backups=3,
+                                                 buffer_size=1))
+        for i in range(40):
+            s.create_download(make_download(i))
+        files = s.open_download()
+        assert len(files) <= 3
+        assert any(f.endswith("download.csv") for f in files)
+        # Every surviving record is still parseable.
+        assert len(s.list_download()) > 0
+
+    def test_clear(self, tmp_path):
+        s = Storage(str(tmp_path), StorageConfig(buffer_size=1))
+        s.create_download(make_download(0))
+        s.create_network_topology(NetworkTopology(id="nt"))
+        s.clear_download()
+        assert s.open_download() == []
+        assert len(s.open_network_topology()) == 1  # untouched
+
+    def test_export_parquet(self, tmp_path):
+        s = Storage(str(tmp_path / "data"), StorageConfig(buffer_size=1))
+        for i in range(4):
+            s.create_download(make_download(i))
+        shards = s.download.export_parquet(str(tmp_path / "out"))
+        assert shards
+        from dragonfly2_tpu.schema.io import read_parquet
+
+        assert sum(read_parquet(p).num_rows for p in shards) == 4
+
+
+@pytest.fixture
+def topo(tmp_path):
+    resource = Resource()
+    for i in range(10):
+        resource.host_manager.store(
+            Host(id=f"h{i}", hostname=f"h{i}", ip=f"10.0.0.{i}",
+                 network=Network(idc=f"idc-{i%2}"))
+        )
+    storage = Storage(str(tmp_path), StorageConfig(buffer_size=1))
+    store = NetworkTopologyStore(
+        NetworkTopologyConfig(probe_count=3), resource, storage
+    )
+    return store, resource, storage
+
+
+class TestNetworkTopologyStore:
+    def test_enqueue_ewma_matches_reference_recurrence(self, topo):
+        store, *_ = topo
+        rtts = [0.010, 0.020, 0.030]
+        for r in rtts:
+            store.enqueue_probe("h0", Probe("h1", r))
+        # Reference recurrence: seed with first, then 0.1*avg + 0.9*rtt.
+        avg = rtts[0]
+        for r in rtts[1:]:
+            avg = avg * 0.1 + r * 0.9
+        assert store.average_rtt("h0", "h1") == pytest.approx(avg)
+        assert store.probed_count("h1") == 3
+
+    def test_queue_evicts_oldest(self, topo):
+        store, *_ = topo
+        for i in range(8):
+            store.enqueue_probe("h0", Probe("h1", 0.001 * (i + 1)))
+        probes = store.probes("h0", "h1")
+        assert len(probes) == 5  # DefaultProbeQueueLength
+        assert probes[0].rtt == pytest.approx(0.004)
+
+    def test_find_probed_hosts_least_probed(self, topo):
+        store, *_ = topo
+        # Make h1..h3 heavily probed.
+        for h in ("h1", "h2", "h3"):
+            for _ in range(5):
+                store.enqueue_probe("h0", Probe(h, 0.01))
+        got = store.find_probed_hosts("h0")
+        assert len(got) == 3
+        assert {h.id for h in got} & {"h1", "h2", "h3"} == set()
+        assert all(h.id != "h0" for h in got)  # never probes itself
+
+    def test_delete_host_cascades(self, topo):
+        store, *_ = topo
+        store.enqueue_probe("h0", Probe("h1", 0.01))
+        store.enqueue_probe("h1", Probe("h2", 0.01))
+        store.delete_host("h1")
+        assert not store.has("h0", "h1") and not store.has("h1", "h2")
+        assert store.probed_count("h1") == 0
+
+    def test_snapshot_writes_dataset(self, topo):
+        store, resource, storage = topo
+        for dst in ("h1", "h2", "h3", "h4", "h5", "h6"):
+            store.enqueue_probe("h0", Probe(dst, 0.005))
+        store.enqueue_probe("h1", Probe("h2", 0.007))
+        n = store.snapshot()
+        assert n == 2
+        got = storage.list_network_topology()
+        assert len(got) == 2
+        by_src = {r.host.id: r for r in got}
+        assert len(by_src["h0"].dest_hosts) == 5  # capped at MAX_DEST_HOSTS
+        assert by_src["h1"].dest_hosts[0].probes.average_rtt == int(0.007 * 1e9)
+        # Host metadata joined from the resource model.
+        assert by_src["h0"].host.network.idc == "idc-0"
+
+    def test_snapshot_skips_unknown_hosts(self, topo):
+        store, resource, storage = topo
+        store.enqueue_probe("ghost", Probe("h1", 0.01))
+        assert store.snapshot() == 0

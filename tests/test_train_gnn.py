@@ -110,6 +110,48 @@ class TestTrainGNN:
         assert res.history[-1] < 0.3
         assert res.samples_per_sec > 0
 
+    def test_pair_level_split_no_leak(self, graph):
+        from dragonfly2_tpu.train.gnn_trainer import _edge_split
+
+        train_ids, eval_ids = _edge_split(graph, 0.2, seed=0)
+        assert len(train_ids) + len(eval_ids) == graph.n_edges
+        train_pairs = set(zip(graph.edge_src[train_ids], graph.edge_dst[train_ids]))
+        eval_pairs = set(zip(graph.edge_src[eval_ids], graph.edge_dst[eval_ids]))
+        # No ordered (src, dst) pair may appear on both sides.
+        assert not train_pairs & eval_pairs
+
+    def test_gnn_checkpoint_roundtrip(self, graph, tmp_path):
+        import jax.numpy as jnp
+
+        from dragonfly2_tpu.data.graph_sampler import CSRGraph, EdgeBatchSampler
+        from dragonfly2_tpu.train import checkpoint as ckpt
+
+        res = train_gnn(
+            graph,
+            GNNTrainConfig(hidden=16, embed=8, batch_size=512, epochs=1),
+            data_parallel_mesh(),
+        )
+        path = str(tmp_path / "gnn")
+        ckpt.save_model(
+            path,
+            ckpt.gnn_tree(res.params, res.node_features),
+            ckpt.ModelMetadata(model_id="g1", model_type="gnn",
+                               evaluation={"f1": res.f1}),
+        )
+        tree, meta = ckpt.load_model(path)
+        params, nf = ckpt.gnn_from_tree(tree)
+        assert meta.model_type == "gnn"
+        np.testing.assert_array_equal(nf, res.node_features)
+
+        csr = CSRGraph.from_graph(graph)
+        s = EdgeBatchSampler(csr, graph.edge_src, graph.edge_dst,
+                             graph.edge_labels(), res.config.fanouts)
+        batch = s.sample(np.arange(32), np.random.default_rng(0))
+        args = tuple(map(jnp.asarray, batch.astuple()[:-1]))
+        a = res.model.apply(res.params, *args)
+        b = res.model.apply(params, *args)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
     def test_too_few_edges_raises(self):
         g = SyntheticCluster(n_hosts=10, seed=0).probe_graph(4)
         with pytest.raises(ValueError, match="can't fill"):
