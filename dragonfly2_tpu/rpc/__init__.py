@@ -1,0 +1,28 @@
+"""gRPC control plane without protoc.
+
+The reference's control plane is gRPC with protobuf messages vendored from
+``d7y.io/api/v2`` (pkg/rpc/*). We keep real gRPC (HTTP/2, streaming,
+deadlines, health) but define messages as registered Python dataclasses with
+a compact binary codec (JSON header + raw byte tail), so no codegen step is
+needed and numpy arrays / piece payloads ride as zero-copy byte spans.
+
+- codec:      message registry + encode/decode (codec.py)
+- service:    declarative method specs + server assembly (service.py)
+- client:     retrying client stubs + consistent-hash balancing (client.py)
+"""
+
+from dragonfly2_tpu.rpc.codec import decode, encode, message
+from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec, serve
+from dragonfly2_tpu.rpc.client import HashRing, ServiceClient, BalancedClient
+
+__all__ = [
+    "message",
+    "encode",
+    "decode",
+    "MethodKind",
+    "ServiceSpec",
+    "serve",
+    "ServiceClient",
+    "BalancedClient",
+    "HashRing",
+]

@@ -1,0 +1,262 @@
+"""Client stubs: retry/backoff + consistent-hash multi-target balancing.
+
+- ``ServiceClient`` wraps one channel with per-method multicallables and
+  exponential-backoff retry on UNAVAILABLE (pkg/rpc interceptor stack).
+- ``HashRing`` is the consistent-hashing balancer keyed by task ID
+  (pkg/balancer/consistent_hashing.go:51-124): all peers of a task reach the
+  same scheduler instance regardless of which daemon they sit on.
+- ``BalancedClient`` keeps one ServiceClient per live target and routes each
+  call by key through the ring, mirroring the resolver+balancer pair fed by
+  dynconfig (pkg/resolver/scheduler_resolver.go).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import grpc
+
+from dragonfly2_tpu.rpc.codec import decode, encode
+from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
+
+_RETRYABLE = (grpc.StatusCode.UNAVAILABLE,)
+
+
+class RpcRetryError(RuntimeError):
+    pass
+
+
+class ServiceClient:
+    """One target, one channel; methods appear as attributes.
+
+    Streaming request methods take an iterator; streaming responses return
+    an iterator. Retries apply only to unary-request kinds (a consumed
+    request iterator cannot be replayed).
+    """
+
+    def __init__(
+        self,
+        target: str,
+        spec: ServiceSpec,
+        retries: int = 3,
+        backoff: float = 0.05,
+        options: Optional[Iterable[tuple[str, Any]]] = None,
+    ) -> None:
+        self.target = target
+        self.spec = spec
+        self.retries = retries
+        self.backoff = backoff
+        self._channel = grpc.insecure_channel(
+            target,
+            options=list(
+                options
+                or [
+                    ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                ]
+            ),
+        )
+        ctor = {
+            MethodKind.UNARY_UNARY: self._channel.unary_unary,
+            MethodKind.UNARY_STREAM: self._channel.unary_stream,
+            MethodKind.STREAM_UNARY: self._channel.stream_unary,
+            MethodKind.STREAM_STREAM: self._channel.stream_stream,
+        }
+        self._calls: Dict[str, Callable] = {}
+        self._kinds: Dict[str, MethodKind] = {}
+        for method, kind in spec.methods.items():
+            self._calls[method] = ctor[kind](
+                spec.full_method(method),
+                request_serializer=encode,
+                response_deserializer=decode,
+            )
+            self._kinds[method] = kind
+
+    def __getattr__(self, method: str) -> Callable:
+        try:
+            call = self._calls[method]
+            kind = self._kinds[method]
+        except KeyError:
+            raise AttributeError(method) from None
+        if kind in (MethodKind.UNARY_UNARY, MethodKind.UNARY_STREAM):
+            # unary_stream returns a lazy iterator that raises only at the
+            # first next(); prefetch inside the retry loop so UNAVAILABLE is
+            # actually retried as the class docstring promises.
+            prefetch = kind == MethodKind.UNARY_STREAM
+
+            def invoke(request, timeout: Optional[float] = None, **kw):
+                return self._retrying(
+                    call, request, timeout=timeout, prefetch=prefetch, **kw
+                )
+        else:
+            def invoke(request_iterator, timeout: Optional[float] = None, **kw):
+                return call(request_iterator, timeout=timeout, **kw)
+        invoke.__name__ = method
+        return invoke
+
+    def _retrying(self, call, request, prefetch: bool = False, **kw):
+        delay = self.backoff
+        for attempt in range(self.retries + 1):
+            try:
+                result = call(request, **kw)
+                return _prefetched(result) if prefetch else result
+            except grpc.RpcError as err:
+                if err.code() not in _RETRYABLE or attempt == self.retries:
+                    raise
+                time.sleep(delay)
+                delay *= 2
+        raise RpcRetryError("unreachable")
+
+    def wait_ready(self, timeout: float = 5.0) -> None:
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (sha256, 100 replicas)."""
+
+    REPLICAS = 100
+
+    def __init__(self, targets: Sequence[str] = ()) -> None:
+        self._lock = threading.Lock()
+        self._ring: List[tuple[int, str]] = []
+        self._targets: set[str] = set()
+        for t in targets:
+            self.add(t)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(hashlib.sha256(key.encode()).digest()[:8], "big")
+
+    def add(self, target: str) -> None:
+        with self._lock:
+            if target in self._targets:
+                return
+            self._targets.add(target)
+            for i in range(self.REPLICAS):
+                bisect.insort(self._ring, (self._hash(f"{target}#{i}"), target))
+
+    def remove(self, target: str) -> None:
+        with self._lock:
+            if target not in self._targets:
+                return
+            self._targets.discard(target)
+            self._ring = [(h, t) for h, t in self._ring if t != target]
+
+    @property
+    def targets(self) -> set[str]:
+        with self._lock:
+            return set(self._targets)
+
+    def pick(self, key: str) -> str:
+        with self._lock:
+            if not self._ring:
+                raise RpcRetryError("hash ring is empty")
+            h = self._hash(key)
+            idx = bisect.bisect_left(self._ring, (h, ""))
+            if idx == len(self._ring):
+                idx = 0
+            return self._ring[idx][1]
+
+    def walk(self, key: str) -> Iterator[str]:
+        """Targets in ring order from the key's owner — failover order."""
+        seen = set()
+        with self._lock:
+            ring = list(self._ring)
+        if not ring:
+            return
+        h = self._hash(key)
+        idx = bisect.bisect_left(ring, (h, ""))
+        for i in range(len(ring)):
+            t = ring[(idx + i) % len(ring)][1]
+            if t not in seen:
+                seen.add(t)
+                yield t
+
+
+def _prefetched(stream) -> Iterator[Any]:
+    """Pull the first item eagerly so connect errors raise at call time."""
+    try:
+        first = next(stream)
+    except StopIteration:
+        return iter(())
+    import itertools
+
+    return itertools.chain([first], stream)
+
+
+class BalancedClient:
+    """Task-affine multi-target client (balancer + resolver pair).
+
+    ``update_targets`` is the dynconfig observer hook: when the manager's
+    scheduler list changes, the ring and the client cache follow.
+    """
+
+    def __init__(self, spec: ServiceSpec, targets: Sequence[str] = (), **client_kw) -> None:
+        self.spec = spec
+        self._client_kw = client_kw
+        self.ring = HashRing(targets)
+        self._clients: Dict[str, ServiceClient] = {}
+        self._lock = threading.Lock()
+
+    def update_targets(self, targets: Sequence[str]) -> None:
+        desired = set(targets)
+        for t in desired - self.ring.targets:
+            self.ring.add(t)
+        for t in self.ring.targets - desired:
+            self.ring.remove(t)
+            with self._lock:
+                old = self._clients.pop(t, None)
+            if old is not None:
+                old.close()
+
+    def client_for(self, key: str) -> ServiceClient:
+        return self._client_at(self.ring.pick(key))
+
+    def _client_at(self, target: str) -> ServiceClient:
+        with self._lock:
+            cli = self._clients.get(target)
+            if cli is None:
+                cli = ServiceClient(target, self.spec, **self._client_kw)
+                self._clients[target] = cli
+        return cli
+
+    def call(self, key: str, method: str, request, failover: bool = True, **kw):
+        """Unary-request call routed by key; on UNAVAILABLE walk the ring.
+
+        Server-streaming responses are lazy in grpc — UNAVAILABLE surfaces
+        at the first ``next()``, not at call time — so the first response is
+        prefetched here to keep failover inside this loop. Stream-request
+        methods are not balanceable (a consumed iterator cannot replay);
+        use ``client_for(key)`` and manage the stream directly.
+        """
+        kind = self.spec.methods[method]
+        if kind in (MethodKind.STREAM_UNARY, MethodKind.STREAM_STREAM):
+            raise ValueError(
+                f"{method} has a streaming request; use client_for(key)"
+            )
+        last: Optional[Exception] = None
+        for target in self.ring.walk(key) if failover else [self.ring.pick(key)]:
+            cli = self._client_at(target)
+            try:
+                result = getattr(cli, method)(request, **kw)
+                if kind == MethodKind.UNARY_STREAM:
+                    return _prefetched(result)
+                return result
+            except grpc.RpcError as err:
+                if err.code() not in _RETRYABLE:
+                    raise
+                last = err
+        raise last if last is not None else RpcRetryError("no targets")
+
+    def close(self) -> None:
+        with self._lock:
+            for cli in self._clients.values():
+                cli.close()
+            self._clients.clear()

@@ -1,0 +1,126 @@
+"""Declarative gRPC service assembly over generic handlers.
+
+A ``ServiceSpec`` lists methods with their streaming kinds; ``serve`` mounts
+implementations onto a ``grpc.Server`` with the DF2 codec as the
+(de)serializer and the standard health service registered — the same shell
+the reference builds per service (scheduler/rpcserver/rpcserver.go,
+pkg/rpc/mux) minus the protoc step.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence
+
+import grpc
+
+from dragonfly2_tpu.rpc.codec import decode, encode
+
+logger = logging.getLogger(__name__)
+
+
+class MethodKind(enum.Enum):
+    UNARY_UNARY = "uu"
+    UNARY_STREAM = "us"
+    STREAM_UNARY = "su"
+    STREAM_STREAM = "ss"
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    """Full service name + method kinds, e.g. ``df2.scheduler.Scheduler``."""
+
+    name: str
+    methods: Dict[str, MethodKind] = field(default_factory=dict)
+
+    def full_method(self, method: str) -> str:
+        return f"/{self.name}/{method}"
+
+
+_HANDLER_CTOR = {
+    MethodKind.UNARY_UNARY: grpc.unary_unary_rpc_method_handler,
+    MethodKind.UNARY_STREAM: grpc.unary_stream_rpc_method_handler,
+    MethodKind.STREAM_UNARY: grpc.stream_unary_rpc_method_handler,
+    MethodKind.STREAM_STREAM: grpc.stream_stream_rpc_method_handler,
+}
+
+
+def _wrap(fn: Callable, name: str) -> Callable:
+    """Log + convert uncaught impl errors to INTERNAL with a message."""
+
+    def call(request_or_iterator, context):
+        try:
+            return fn(request_or_iterator, context)
+        except grpc.RpcError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — service boundary
+            logger.exception("rpc %s failed", name)
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+
+    def call_gen(request_or_iterator, context):
+        try:
+            yield from fn(request_or_iterator, context)
+        except grpc.RpcError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("rpc %s failed", name)
+            context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+
+    import inspect
+
+    return call_gen if inspect.isgeneratorfunction(fn) else call
+
+
+def generic_handler(spec: ServiceSpec, impl: Any) -> grpc.GenericRpcHandler:
+    handlers = {}
+    for method, kind in spec.methods.items():
+        fn = getattr(impl, method)
+        handlers[method] = _HANDLER_CTOR[kind](
+            _wrap(fn, spec.full_method(method)),
+            request_deserializer=decode,
+            response_serializer=encode,
+        )
+    return grpc.method_handlers_generic_handler(spec.name, handlers)
+
+
+@dataclass
+class RpcServer:
+    server: grpc.Server
+    port: int
+
+    @property
+    def target(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self.server.stop(grace).wait()
+
+
+def serve(
+    services: Sequence[tuple[ServiceSpec, Any]],
+    host: str = "127.0.0.1",
+    port: int = 0,
+    max_workers: int = 16,
+    options: Optional[Iterable[tuple[str, Any]]] = None,
+) -> RpcServer:
+    """Bind and start a server hosting the given (spec, impl) pairs."""
+    opts = list(
+        options
+        or [
+            ("grpc.max_send_message_length", 256 * 1024 * 1024),
+            ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+        ]
+    )
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
+    )
+    for spec, impl in services:
+        server.add_generic_rpc_handlers((generic_handler(spec, impl),))
+    bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        raise OSError(f"cannot bind {host}:{port}")
+    server.start()
+    return RpcServer(server=server, port=bound)

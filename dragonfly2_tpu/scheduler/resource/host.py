@@ -90,6 +90,16 @@ class Host:
             if not success:
                 self.upload_failed_count += 1
 
+    def adjust_uploads(self, delta: int) -> None:
+        """Atomic slot adjustment for DAG edge add/remove (floored at 0).
+
+        Unlike acquire_upload this never refuses: the scheduling filter has
+        already checked free_upload_count, and edge bookkeeping must stay
+        consistent with the DAG even when racing other announce threads.
+        """
+        with self._lock:
+            self.concurrent_upload_count = max(self.concurrent_upload_count + delta, 0)
+
     # -- peer registry --------------------------------------------------------
 
     def store_peer(self, peer) -> None:

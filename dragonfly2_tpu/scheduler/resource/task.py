@@ -166,23 +166,20 @@ class Task:
     def add_peer_edge(self, parent, child) -> None:
         """parent serves pieces to child; counts an upload slot on the
         parent's host (task.go AddPeerEdge)."""
-        self.dag.add_edge(parent.id, child.id)
-        parent.host.concurrent_upload_count += 1
+        with self._lock:
+            self.dag.add_edge(parent.id, child.id)
+            parent.host.adjust_uploads(+1)
 
     def delete_peer_in_edges(self, peer_id: str) -> None:
         with self._lock:
             for parent in self.dag.parents(peer_id):
-                parent.host.concurrent_upload_count = max(
-                    parent.host.concurrent_upload_count - 1, 0
-                )
+                parent.host.adjust_uploads(-1)
             self.dag.delete_vertex_in_edges(peer_id)
 
     def delete_peer_out_edges(self, peer) -> None:
         with self._lock:
             n = self.dag.vertex(peer.id).out_degree
-            peer.host.concurrent_upload_count = max(
-                peer.host.concurrent_upload_count - n, 0
-            )
+            peer.host.adjust_uploads(-n)
             self.dag.delete_vertex_out_edges(peer.id)
 
     def peer_parents(self, peer_id: str):

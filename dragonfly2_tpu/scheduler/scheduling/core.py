@@ -128,12 +128,15 @@ class Scheduling:
         intent is signaled on the peer. Retries are the caller's loop in v1,
         so this is single-shot."""
         blocklist = blocklist or set()
+        # Detach from current parents BEFORE filtering, like the v2 loop:
+        # otherwise can_add_peer_edge's duplicate-edge check permanently
+        # rejects the currently-attached (possibly best) parent.
+        peer.task.delete_peer_in_edges(peer.id)
         candidates = self.find_candidate_parents(peer, blocklist)
         if not candidates:
             if peer.task.can_back_to_source() and peer.schedule_count == 0:
                 peer.need_back_to_source = True
             return None, []
-        peer.task.delete_peer_in_edges(peer.id)
         for parent in candidates:
             if peer.task.can_add_peer_edge(parent.id, peer.id):
                 peer.task.add_peer_edge(parent, peer)

@@ -53,6 +53,9 @@ class _RotatingDataset:
         self._buffer: List = []
         self._count = 0
         self._lock = threading.Lock()
+        # Monotonic suffix makes backup names unique even when two
+        # rotations land in the same wall-clock second.
+        self._rotation_seq = len(self.backups())
 
     @property
     def active_path(self) -> str:
@@ -91,8 +94,12 @@ class _RotatingDataset:
     def _maybe_rotate(self) -> None:
         path = self.active_path
         if os.path.exists(path) and os.path.getsize(path) >= self.config.max_size:
-            stamp = time.strftime("%Y-%m-%dT%H-%M-%S") + f".{int(time.time()*1000)%1000:03d}"
-            os.rename(path, os.path.join(self.base_dir, f"{self.prefix}-{stamp}{CSV_EXT}"))
+            stamp = time.strftime("%Y-%m-%dT%H-%M-%S")
+            self._rotation_seq += 1
+            backup = os.path.join(
+                self.base_dir, f"{self.prefix}-{stamp}.{self._rotation_seq:06d}{CSV_EXT}"
+            )
+            os.rename(path, backup)
         backups = self.backups()
         while len(backups) + 1 > self.config.max_backups:
             os.remove(backups.pop(0))

@@ -1,0 +1,254 @@
+"""RPC layer: codec round-trips, consistent-hash ring, live gRPC services."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import field
+from typing import Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc import (
+    BalancedClient,
+    HashRing,
+    MethodKind,
+    ServiceClient,
+    ServiceSpec,
+    decode,
+    encode,
+    message,
+    serve,
+)
+
+
+@message("test.Inner")
+class Inner:
+    name: str
+    weight: float
+
+
+@message("test.Envelope")
+class Envelope:
+    id: int
+    payload: bytes = b""
+    inner: Optional[Inner] = None
+    items: List[Inner] = field(default_factory=list)
+    tags: Dict[str, int] = field(default_factory=dict)
+    members: set = field(default_factory=set)
+    features: Optional[np.ndarray] = None
+
+    def __eq__(self, other):  # ndarray-aware equality for tests
+        if not isinstance(other, Envelope):
+            return NotImplemented
+        same = (
+            self.id == other.id
+            and self.payload == other.payload
+            and self.inner == other.inner
+            and self.items == other.items
+            and self.tags == other.tags
+            and self.members == other.members
+        )
+        if self.features is None or other.features is None:
+            return same and self.features is other.features
+        return same and np.array_equal(self.features, other.features)
+
+
+class TestCodec:
+    def test_roundtrip_nested(self):
+        msg = Envelope(
+            id=7,
+            payload=b"\x00\x01piece-bytes\xff" * 100,
+            inner=Inner(name="host-a", weight=0.25),
+            items=[Inner(name="x", weight=1.0), Inner(name="y", weight=-2.5)],
+            tags={"idc": 3, "location": 9},
+            members={"a", "b"},
+            features=np.arange(12, dtype=np.float32).reshape(3, 4),
+        )
+        assert decode(encode(msg)) == msg
+
+    def test_defaults_and_none(self):
+        msg = Envelope(id=1)
+        out = decode(encode(msg))
+        assert out == msg and out.inner is None and out.items == []
+
+    def test_nan_inf(self):
+        got = decode(encode(Inner(name="n", weight=float("nan"))))
+        assert got.weight != got.weight
+        got = decode(encode(Inner(name="i", weight=float("inf"))))
+        assert got.weight == float("inf")
+
+    def test_large_binary_is_not_base64(self):
+        blob = bytes(range(256)) * 4096  # 1 MiB
+        wire = encode(Envelope(id=1, payload=blob))
+        # raw tail: total size ≈ payload + small header
+        assert len(wire) < len(blob) + 1024
+        assert decode(wire).payload == blob
+
+    def test_unknown_fields_ignored(self):
+        # Forward compat: decoding drops fields removed from the dataclass.
+        import json, struct
+
+        wire = bytearray(encode(Envelope(id=3)))
+        hlen = struct.unpack("<I", wire[4:8])[0]
+        header = json.loads(wire[8 : 8 + hlen].decode())
+        header["d"]["added_in_v3"] = 42
+        new_header = json.dumps(header, separators=(",", ":")).encode()
+        rebuilt = b"DF2\x01" + struct.pack("<I", len(new_header)) + new_header + bytes(wire[8 + hlen :])
+        assert decode(rebuilt).id == 3
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            decode(b"NOPE" + b"\x00" * 16)
+
+
+class TestHashRing:
+    def test_deterministic_and_affine(self):
+        ring = HashRing(["s1:80", "s2:80", "s3:80"])
+        keys = [f"task-{i}" for i in range(1000)]
+        first = {k: ring.pick(k) for k in keys}
+        assert first == {k: ring.pick(k) for k in keys}
+        assert set(first.values()) == {"s1:80", "s2:80", "s3:80"}
+
+    def test_removal_remaps_only_owned_keys(self):
+        ring = HashRing(["s1:80", "s2:80", "s3:80"])
+        keys = [f"task-{i}" for i in range(3000)]
+        before = {k: ring.pick(k) for k in keys}
+        ring.remove("s2:80")
+        after = {k: ring.pick(k) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert all(before[k] == "s2:80" for k in moved)
+        assert "s2:80" not in set(after.values())
+
+    def test_walk_failover_order(self):
+        ring = HashRing(["a", "b", "c"])
+        order = list(ring.walk("task-42"))
+        assert order[0] == ring.pick("task-42")
+        assert sorted(order) == ["a", "b", "c"]
+
+    def test_empty_ring(self):
+        with pytest.raises(Exception):
+            HashRing().pick("k")
+
+
+@message("test.EchoRequest")
+class EchoRequest:
+    text: str
+    n: int = 1
+
+
+@message("test.EchoReply")
+class EchoReply:
+    text: str
+
+
+ECHO_SPEC = ServiceSpec(
+    name="df2.test.Echo",
+    methods={
+        "Say": MethodKind.UNARY_UNARY,
+        "Stream": MethodKind.UNARY_STREAM,
+        "Collect": MethodKind.STREAM_UNARY,
+        "Chat": MethodKind.STREAM_STREAM,
+        "Boom": MethodKind.UNARY_UNARY,
+    },
+)
+
+
+class EchoService:
+    def __init__(self, label: str = "") -> None:
+        self.label = label
+
+    def Say(self, request: EchoRequest, context) -> EchoReply:
+        return EchoReply(text=self.label + request.text)
+
+    def Stream(self, request: EchoRequest, context):
+        for i in range(request.n):
+            yield EchoReply(text=f"{request.text}:{i}")
+
+    def Collect(self, request_iterator, context) -> EchoReply:
+        return EchoReply(text="".join(r.text for r in request_iterator))
+
+    def Chat(self, request_iterator, context):
+        for r in request_iterator:
+            yield EchoReply(text=r.text.upper())
+
+    def Boom(self, request: EchoRequest, context) -> EchoReply:
+        raise RuntimeError("kaboom")
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    srv = serve([(ECHO_SPEC, EchoService())])
+    yield srv
+    srv.stop()
+
+
+class TestLiveGrpc:
+    def test_unary_unary(self, echo_server):
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        assert cli.Say(EchoRequest(text="hi")).text == "hi"
+        cli.close()
+
+    def test_unary_stream(self, echo_server):
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        out = [r.text for r in cli.Stream(EchoRequest(text="p", n=3))]
+        assert out == ["p:0", "p:1", "p:2"]
+        cli.close()
+
+    def test_stream_unary(self, echo_server):
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        reply = cli.Collect(iter([EchoRequest(text="a"), EchoRequest(text="b")]))
+        assert reply.text == "ab"
+        cli.close()
+
+    def test_stream_stream(self, echo_server):
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        out = [r.text for r in cli.Chat(iter([EchoRequest(text="x"), EchoRequest(text="y")]))]
+        assert out == ["X", "Y"]
+        cli.close()
+
+    def test_server_error_surfaces_as_internal(self, echo_server):
+        import grpc
+
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        with pytest.raises(grpc.RpcError) as exc:
+            cli.Boom(EchoRequest(text="x"), timeout=5)
+        assert exc.value.code() == grpc.StatusCode.INTERNAL
+        assert "kaboom" in exc.value.details()
+        cli.close()
+
+    def test_balanced_client_failover(self, echo_server):
+        # One live target + one dead target: calls routed to the dead one
+        # walk the ring to the live one.
+        bal = BalancedClient(ECHO_SPEC, [echo_server.target, "127.0.0.1:1"], retries=0)
+        for i in range(20):
+            reply = bal.call(f"task-{i}", "Say", EchoRequest(text=str(i)), timeout=5)
+            assert reply.text == str(i)
+        bal.close()
+
+    def test_balanced_update_targets(self, echo_server):
+        bal = BalancedClient(ECHO_SPEC, ["127.0.0.1:1"], retries=0)
+        bal.update_targets([echo_server.target])
+        assert bal.ring.targets == {echo_server.target}
+        assert bal.call("k", "Say", EchoRequest(text="ok"), timeout=5).text == "ok"
+        bal.close()
+
+
+class TestConcurrency:
+    def test_parallel_unary_calls(self, echo_server):
+        cli = ServiceClient(echo_server.target, ECHO_SPEC)
+        errors: list[Exception] = []
+
+        def worker(i: int):
+            try:
+                assert cli.Say(EchoRequest(text=f"t{i}"), timeout=10).text == f"t{i}"
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        cli.close()
