@@ -245,10 +245,9 @@ class BalancedClient:
         for target in self.ring.walk(key) if failover else [self.ring.pick(key)]:
             cli = self._client_at(target)
             try:
-                result = getattr(cli, method)(request, **kw)
-                if kind == MethodKind.UNARY_STREAM:
-                    return _prefetched(result)
-                return result
+                # ServiceClient already prefetches UNARY_STREAM results, so
+                # connect errors raise here, inside the failover walk.
+                return getattr(cli, method)(request, **kw)
             except grpc.RpcError as err:
                 if err.code() not in _RETRYABLE:
                     raise

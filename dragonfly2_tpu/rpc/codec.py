@@ -69,13 +69,8 @@ class _Blob:
 
 
 def _enc(value: Any, blob: _Blob) -> Any:
-    if value is None or isinstance(value, (bool, int, str)):
-        return value
-    if isinstance(value, float):
-        # JSON has no inf/nan literals; tag them.
-        if value != value or value in (float("inf"), float("-inf")):
-            return {"$f": repr(value)}
-        return value
+    # Enum first: IntEnum/StrEnum members are also int/str instances and
+    # would otherwise silently lose their type on the wire.
     if isinstance(value, Enum):
         tag = _TAGS.get(type(value))
         if tag is None:
@@ -84,6 +79,13 @@ def _enc(value: Any, blob: _Blob) -> Any:
                 "decorate it with @register_enum"
             )
         return {"$e": [tag, value.value]}
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, float):
+        # JSON has no inf/nan literals; tag them.
+        if value != value or value in (float("inf"), float("-inf")):
+            return {"$f": repr(value)}
+        return value
     if isinstance(value, (bytes, bytearray, memoryview)):
         off, n = blob.add(value)
         return {"$b": [off, n]}

@@ -2,9 +2,10 @@
 
 A ``ServiceSpec`` lists methods with their streaming kinds; ``serve`` mounts
 implementations onto a ``grpc.Server`` with the DF2 codec as the
-(de)serializer and the standard health service registered — the same shell
-the reference builds per service (scheduler/rpcserver/rpcserver.go,
-pkg/rpc/mux) minus the protoc step.
+(de)serializer — the same shell the reference builds per service
+(scheduler/rpcserver/rpcserver.go, pkg/rpc/mux) minus the protoc step.
+Liveness is a DF2-spec'd Health service (see health.py), not
+grpc.health.v1 (which would need protobuf codegen).
 """
 
 from __future__ import annotations
@@ -48,6 +49,13 @@ _HANDLER_CTOR = {
 }
 
 
+def _already_aborted(context) -> bool:
+    """context.abort() raises a bare Exception after marking state; such
+    exceptions must propagate untouched or the status turns INTERNAL."""
+    state = getattr(context, "_state", None)
+    return bool(getattr(state, "aborted", False))
+
+
 def _wrap(fn: Callable, name: str) -> Callable:
     """Log + convert uncaught impl errors to INTERNAL with a message."""
 
@@ -57,6 +65,8 @@ def _wrap(fn: Callable, name: str) -> Callable:
         except grpc.RpcError:
             raise
         except Exception as exc:  # noqa: BLE001 — service boundary
+            if _already_aborted(context):
+                raise
             logger.exception("rpc %s failed", name)
             context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
 
@@ -66,6 +76,8 @@ def _wrap(fn: Callable, name: str) -> Callable:
         except grpc.RpcError:
             raise
         except Exception as exc:  # noqa: BLE001
+            if _already_aborted(context):
+                raise
             logger.exception("rpc %s failed", name)
             context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
 
@@ -117,8 +129,13 @@ def serve(
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
     )
-    for spec, impl in services:
+    from dragonfly2_tpu.rpc.health import HEALTH_SPEC, HealthService
+
+    health = HealthService()
+    for spec, impl in list(services) + [(HEALTH_SPEC, health)]:
         server.add_generic_rpc_handlers((generic_handler(spec, impl),))
+        if spec is not HEALTH_SPEC:
+            health.set_status(spec.name, "SERVING")
     bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise OSError(f"cannot bind {host}:{port}")

@@ -126,7 +126,12 @@ class Scheduling:
         """The v1 flavor (scheduling.go:218-388): returns (main parent,
         candidates) for a PeerPacket instead of streaming; back-to-source
         intent is signaled on the peer. Retries are the caller's loop in v1,
-        so this is single-shot."""
+        so this is single-shot.
+
+        Like the reference (scheduling.go:326-337), the peer detaches from
+        its current parents BEFORE candidate search; on a no-candidate
+        round it stays detached and recovery comes from the caller's retry
+        loop / back-to-source ladder."""
         blocklist = blocklist or set()
         # Detach from current parents BEFORE filtering, like the v2 loop:
         # otherwise can_add_peer_edge's duplicate-edge check permanently

@@ -53,6 +53,9 @@ class _RotatingDataset:
         self._buffer: List = []
         self._count = 0
         self._lock = threading.Lock()
+        # Records flushed per live file; keeps count() consistent when
+        # snapshots/backup-eviction remove individual files.
+        self._file_counts: dict = {}
         # Monotonic suffix makes backup names unique even when two
         # rotations land in the same wall-clock second.
         self._rotation_seq = len(self.backups())
@@ -89,32 +92,70 @@ class _RotatingDataset:
             for r in self._buffer:
                 w.write(r)
         self._count += len(self._buffer)
+        self._file_counts[self.active_path] = (
+            self._file_counts.get(self.active_path, 0) + len(self._buffer)
+        )
         self._buffer = []
 
     def _maybe_rotate(self) -> None:
         path = self.active_path
         if os.path.exists(path) and os.path.getsize(path) >= self.config.max_size:
-            stamp = time.strftime("%Y-%m-%dT%H-%M-%S")
-            self._rotation_seq += 1
-            backup = os.path.join(
-                self.base_dir, f"{self.prefix}-{stamp}.{self._rotation_seq:06d}{CSV_EXT}"
-            )
-            os.rename(path, backup)
+            self._rotate_locked(path)
         backups = self.backups()
         while len(backups) + 1 > self.config.max_backups:
-            os.remove(backups.pop(0))
+            victim = backups.pop(0)
+            os.remove(victim)
+            self._count = max(self._count - self._file_counts.pop(victim, 0), 0)
+
+    def _rotate_locked(self, path: str) -> None:
+        stamp = time.strftime("%Y-%m-%dT%H-%M-%S")
+        self._rotation_seq += 1
+        backup = os.path.join(
+            self.base_dir, f"{self.prefix}-{stamp}.{self._rotation_seq:06d}{CSV_EXT}"
+        )
+        os.rename(path, backup)
+        self._file_counts[backup] = self._file_counts.pop(path, 0)
 
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count + len(self._buffer)
 
     def records(self) -> Iterator:
         self.flush()
         for path in self.all_files():
             yield from read_csv_records(self.record_type, path)
 
+    def take_snapshot(self) -> List[str]:
+        """Freeze current data for upload: flush, force-rotate the active
+        file, return every closed file. Records created after this call go
+        to a fresh active file and are NOT part of the snapshot — so the
+        announcer can stream for minutes while appends continue, then
+        delete exactly what it sent (remove_files)."""
+        with self._lock:
+            self._flush_locked()
+            path = self.active_path
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                self._rotate_locked(path)
+            return self.backups()
+
+    def remove_files(self, paths: List[str]) -> None:
+        removed = 0
+        with self._lock:
+            for path in paths:
+                if path == self.active_path:
+                    raise ValueError("cannot remove the active file; snapshot first")
+                try:
+                    os.remove(path)
+                    removed += self._file_counts.pop(path, 0)
+                except FileNotFoundError:
+                    pass
+            self._count = max(self._count - removed, 0)
+
     def clear(self) -> None:
         with self._lock:
             self._buffer = []
+            self._count = 0
+            self._file_counts.clear()
             for path in self.all_files():
                 os.remove(path)
 
@@ -170,6 +211,19 @@ class Storage:
     def open_network_topology(self) -> List[str]:
         self.network_topology.flush()
         return self.network_topology.all_files()
+
+    def snapshot_download(self) -> List[str]:
+        """Freeze+list download files for upload (see take_snapshot)."""
+        return self.download.take_snapshot()
+
+    def snapshot_network_topology(self) -> List[str]:
+        return self.network_topology.take_snapshot()
+
+    def remove_download_files(self, paths: List[str]) -> None:
+        self.download.remove_files(paths)
+
+    def remove_network_topology_files(self, paths: List[str]) -> None:
+        self.network_topology.remove_files(paths)
 
     def clear_download(self) -> None:
         self.download.clear()

@@ -1,0 +1,153 @@
+"""Trainer gRPC service: client-streaming dataset ingest.
+
+Reference counterpart: trainer/service/service_v1.go:59-162 — the first
+message identifies the source scheduler host, chunks append to per-host
+dataset files by request type, and EOF kicks off training asynchronously.
+Our chunks additionally carry ``new_file`` marking rotated-file boundaries
+(each CSV segment has its own header; see trainer.storage).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import grpc
+
+from dragonfly2_tpu.rpc import MethodKind, ServiceSpec, message
+from dragonfly2_tpu.trainer.storage import (
+    DOWNLOAD_PREFIX,
+    NETWORK_TOPOLOGY_PREFIX,
+    TrainerStorage,
+)
+from dragonfly2_tpu.trainer.training import Training
+
+logger = logging.getLogger(__name__)
+
+
+@message("trainer.TrainGnnRequest")
+class TrainGnnRequest:
+    dataset: bytes = b""
+    new_file: bool = False
+
+
+@message("trainer.TrainMlpRequest")
+class TrainMlpRequest:
+    dataset: bytes = b""
+    new_file: bool = False
+
+
+@message("trainer.TrainRequest")
+class TrainRequest:
+    host_id: str = ""
+    ip: str = ""
+    hostname: str = ""
+    gnn: Optional[TrainGnnRequest] = None
+    mlp: Optional[TrainMlpRequest] = None
+
+
+@message("trainer.TrainResponse")
+class TrainResponse:
+    host_id: str = ""
+    accepted_bytes: int = 0
+
+
+TRAINER_SPEC = ServiceSpec(
+    name="df2.trainer.Trainer",
+    methods={"Train": MethodKind.STREAM_UNARY},
+)
+
+
+class TrainerService:
+    """``Train`` stream handler + async training kick-off.
+
+    ``train_async=False`` runs training inline before replying — used by
+    tests and by deployments where the driver wants backpressure on the
+    announcer instead of queued jobs.
+    """
+
+    def __init__(
+        self,
+        storage: TrainerStorage,
+        training: Training,
+        train_async: bool = True,
+    ) -> None:
+        self.storage = storage
+        self.training = training
+        self.train_async = train_async
+        self._jobs: list[threading.Thread] = []
+
+    def Train(self, request_iterator, context) -> TrainResponse:
+        first: Optional[TrainRequest] = None
+        accepted = 0
+        written: list[str] = []
+        try:
+            for req in request_iterator:
+                if first is None:
+                    if not req.host_id:
+                        context.abort(
+                            grpc.StatusCode.INVALID_ARGUMENT,
+                            "first TrainRequest must carry host_id",
+                        )
+                    first = req
+                if req.gnn is not None:
+                    written.append(
+                        self.storage.append(
+                            NETWORK_TOPOLOGY_PREFIX, req.host_id,
+                            req.gnn.dataset, req.gnn.new_file,
+                        )
+                    )
+                    accepted += len(req.gnn.dataset)
+                if req.mlp is not None:
+                    written.append(
+                        self.storage.append(
+                            DOWNLOAD_PREFIX, req.host_id,
+                            req.mlp.dataset, req.mlp.new_file,
+                        )
+                    )
+                    accepted += len(req.mlp.dataset)
+        except Exception:
+            # A stream that dies mid-upload rolls back its segments: the
+            # announcer retries with the FULL dataset next tick, so keeping
+            # partial (possibly row-truncated) files would duplicate every
+            # delivered record and can break CSV parsing.
+            if first is not None:
+                self.storage.close_host(first.host_id)
+                self.storage.discard_files(sorted(set(written)))
+            raise
+        finally:
+            if first is not None:
+                self.storage.close_host(first.host_id)
+
+        if first is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty Train stream")
+
+        if self.train_async:
+            self._jobs = [j for j in self._jobs if j.is_alive()]
+            job = threading.Thread(
+                target=self._safe_train,
+                args=(first.ip, first.hostname, first.host_id),
+                name=f"train-{first.host_id}",
+                daemon=True,
+            )
+            job.start()
+            self._jobs.append(job)
+        else:
+            self._safe_train(first.ip, first.hostname, first.host_id)
+        return TrainResponse(host_id=first.host_id, accepted_bytes=accepted)
+
+    def _safe_train(self, ip: str, hostname: str, host_id: str) -> None:
+        try:
+            outcome = self.training.train(ip, hostname, host_id)
+            if outcome.errors:
+                logger.error("training for %s finished with errors: %s",
+                             host_id, outcome.errors)
+        except Exception:  # noqa: BLE001 — job boundary
+            logger.exception("training job for %s crashed", host_id)
+
+    def wait_idle(self, timeout: Optional[float] = None) -> None:
+        """Join outstanding async jobs (tests / graceful shutdown)."""
+        for job in self._jobs:
+            job.join(timeout)
+        self._jobs = [j for j in self._jobs if j.is_alive()]

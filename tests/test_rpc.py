@@ -252,3 +252,53 @@ class TestConcurrency:
             t.join()
         assert not errors
         cli.close()
+
+
+class TestHealthAndEnums:
+    def test_health_auto_mounted(self, echo_server):
+        from dragonfly2_tpu.rpc.health import (
+            HEALTH_SPEC,
+            HealthCheckRequest,
+            SERVING,
+            UNKNOWN,
+        )
+
+        cli = ServiceClient(echo_server.target, HEALTH_SPEC)
+        assert cli.Check(HealthCheckRequest(), timeout=5).status == SERVING
+        assert (
+            cli.Check(HealthCheckRequest(service="df2.test.Echo"), timeout=5).status
+            == SERVING
+        )
+        assert (
+            cli.Check(HealthCheckRequest(service="nope"), timeout=5).status == UNKNOWN
+        )
+        cli.close()
+
+    def test_intenum_roundtrip(self):
+        from dragonfly2_tpu.rpc.codec import register_enum
+        import enum
+
+        @register_enum("test.Color")
+        class Color(enum.IntEnum):
+            RED = 1
+            BLUE = 2
+
+        @message("test.Painted")
+        class Painted:
+            color: Color = Color.RED
+
+        out = decode(encode(Painted(color=Color.BLUE)))
+        assert out.color is Color.BLUE and isinstance(out.color, Color)
+
+    def test_unregistered_enum_raises(self):
+        import enum
+
+        class Rogue(enum.Enum):
+            X = "x"
+
+        @message("test.RogueCarrier")
+        class RogueCarrier:
+            val: object = None
+
+        with pytest.raises(TypeError, match="unregistered enum"):
+            encode(RogueCarrier(val=Rogue.X))
