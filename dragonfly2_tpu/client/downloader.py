@@ -1,0 +1,161 @@
+"""Piece downloader and dispatcher — the peer-to-peer data path.
+
+Reference counterparts:
+- ``PieceDownloader`` (client/daemon/peer/piece_downloader.go:67,165-225):
+  HTTP ``GET http://{parent}/download/{taskID[:3]}/{taskID}?peerId=...`` with
+  a ``Range`` header selecting the piece bytes; md5-verified on arrival.
+- ``PieceDispatcher`` (client/daemon/peer/piece_dispatcher.go:33-172): queues
+  candidate (parent, piece) requests, scores parents by smoothed download
+  time (``score = (last + cost)/2``, failures pulled toward a 60 s penalty),
+  serves the best-scored parent with ε-random exploration (``random_ratio``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from dragonfly2_tpu.client.piece import PieceMetadata
+
+MAX_SCORE_NS = 0                     # best (lower is better)
+MIN_SCORE_NS = 60 * 1_000_000_000    # failure penalty pole
+
+
+class DownloadPieceError(Exception):
+    pass
+
+
+class DispatcherClosedError(Exception):
+    pass
+
+
+@dataclass
+class DownloadPieceRequest:
+    """One (piece, parent) download assignment."""
+
+    task_id: str
+    src_peer_id: str
+    dst_peer_id: str
+    dst_addr: str  # host:port of the parent's upload server
+    piece: PieceMetadata
+
+
+@dataclass
+class DownloadPieceResult:
+    dst_peer_id: str
+    piece_num: int
+    fail: bool
+    cost_ns: int = 0
+
+
+class PieceDispatcher:
+    """Parent-scored piece request queue (piece_dispatcher.go:47-172)."""
+
+    def __init__(self, random_ratio: float = 0.1, seed: int | None = None):
+        self._requests: Dict[str, List[DownloadPieceRequest]] = {}
+        self._score: Dict[str, int] = {}
+        self._downloaded: Set[int] = set()
+        self._sum = 0
+        self._closed = False
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.random_ratio = random_ratio
+        self._rand = random.Random(seed)
+
+    def put(self, req: DownloadPieceRequest) -> None:
+        with self._cond:
+            self._requests.setdefault(req.dst_peer_id, []).append(req)
+            self._score.setdefault(req.dst_peer_id, MAX_SCORE_NS)
+            self._sum += 1
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> Optional[DownloadPieceRequest]:
+        """Next request from the best (or ε-randomly shuffled) parent; None
+        when no valid request is available right now; raises when closed."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self._sum == 0 and not self._closed:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._closed:
+                raise DispatcherClosedError
+            return self._get_desired()
+
+    def _get_desired(self) -> Optional[DownloadPieceRequest]:
+        peers = list(self._score)
+        if self._rand.random() < self.random_ratio:
+            self._rand.shuffle(peers)
+        else:
+            peers.sort(key=lambda p: self._score[p])
+        for peer in peers:
+            queue = self._requests.get(peer) or []
+            while queue:
+                n = self._rand.randrange(len(queue))
+                req = queue.pop(n)
+                self._sum -= 1
+                if req.piece.num in self._downloaded:
+                    continue
+                return req
+        return None
+
+    def report(self, result: DownloadPieceResult) -> None:
+        with self._lock:
+            if not result.dst_peer_id:
+                return
+            last = self._score.get(result.dst_peer_id, MAX_SCORE_NS)
+            if result.fail:
+                self._score[result.dst_peer_id] = (last + MIN_SCORE_NS) // 2
+            else:
+                self._downloaded.add(result.piece_num)
+                self._score[result.dst_peer_id] = (last + result.cost_ns) // 2
+
+    def is_downloaded(self, piece_num: int) -> bool:
+        with self._lock:
+            return piece_num in self._downloaded
+
+    def scores(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._score)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+class PieceDownloader:
+    """HTTP piece fetch from a parent's upload server
+    (piece_downloader.go:165-225)."""
+
+    def __init__(self, timeout: float = 30.0, scheme: str = "http"):
+        self.timeout = timeout
+        self.scheme = scheme
+
+    def download_piece(self, req: DownloadPieceRequest) -> bytes:
+        if len(req.task_id) <= 3:
+            raise DownloadPieceError(f"invalid task id {req.task_id!r}")
+        url = (
+            f"{self.scheme}://{req.dst_addr}/download/"
+            f"{req.task_id[:3]}/{req.task_id}?peerId={req.dst_peer_id}"
+        )
+        http_req = urllib.request.Request(
+            url, headers={"Range": req.piece.range.http_header()}
+        )
+        try:
+            with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except urllib.error.URLError as exc:
+            raise DownloadPieceError(f"{url}: {exc}") from exc
+        if len(data) != req.piece.length:
+            raise DownloadPieceError(
+                f"piece {req.piece.num}: got {len(data)} bytes, "
+                f"want {req.piece.length}"
+            )
+        return data

@@ -1,0 +1,101 @@
+"""Piece layout math shared by storage, download and upload paths.
+
+Reference counterpart: internal/util/util.go:22-50 (ComputePieceSize grows
+the piece from 4 MiB by 1 MiB per 100 MiB of content past 200 MiB, capped at
+15 MiB; ComputePieceCount is a ceiling divide). Identical constants and
+growth rule so piece boundaries — and therefore piece digests and training
+labels derived from piece costs — line up with the reference's.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+DEFAULT_PIECE_SIZE = 4 * 1024 * 1024
+PIECE_SIZE_LIMIT = 15 * 1024 * 1024
+
+
+def compute_piece_size(content_length: int) -> int:
+    """Piece size for a task of ``content_length`` bytes (<0 = unknown)."""
+    if content_length <= 200 * 1024 * 1024:
+        return DEFAULT_PIECE_SIZE
+    gap_count = content_length // (100 * 1024 * 1024)
+    size = (gap_count - 2) * 1024 * 1024 + DEFAULT_PIECE_SIZE
+    return min(size, PIECE_SIZE_LIMIT)
+
+
+def compute_piece_count(content_length: int, piece_size: int) -> int:
+    return int(math.ceil(content_length / piece_size))
+
+
+@dataclass(frozen=True)
+class Range:
+    """A byte range [start, start+length) within a task's content."""
+
+    start: int
+    length: int
+
+    @property
+    def end(self) -> int:
+        """Inclusive end offset (HTTP Range convention)."""
+        return self.start + self.length - 1
+
+    def http_header(self) -> str:
+        return f"bytes={self.start}-{self.end}"
+
+
+def parse_http_range(header: str, total: int) -> Range:
+    """Parse a single-range ``bytes=a-b`` header against ``total`` bytes.
+
+    Mirrors the subset the reference accepts on the upload path
+    (client/daemon/upload/upload_manager.go:214-227: exactly one range).
+    Suffix ranges (``bytes=-n``) and open ends (``bytes=a-``) are resolved
+    against ``total``.
+    """
+    if not header.startswith("bytes="):
+        raise ValueError(f"unsupported range unit in {header!r}")
+    spec = header[len("bytes="):]
+    if "," in spec:
+        raise ValueError("multi-range not supported")
+    start_s, sep, end_s = spec.partition("-")
+    if not sep:
+        raise ValueError(f"malformed range {header!r}")
+    if not start_s:  # suffix: last n bytes
+        n = int(end_s)
+        start = max(0, total - n)
+        return Range(start, total - start)
+    start = int(start_s)
+    end = int(end_s) if end_s else total - 1
+    if end >= total:
+        end = total - 1
+    if start > end:
+        raise ValueError(f"range {header!r} unsatisfiable for length {total}")
+    return Range(start, end - start + 1)
+
+
+@dataclass(frozen=True)
+class PieceMetadata:
+    """One stored piece (reference: client/daemon/storage/metadata.go:47-56)."""
+
+    num: int
+    md5: str = ""
+    offset: int = 0  # offset in the data file
+    start: int = 0   # offset in the task content (== offset for full tasks)
+    length: int = 0
+    cost_ns: int = 0
+
+    @property
+    def range(self) -> Range:
+        return Range(self.start, self.length)
+
+
+def piece_range(num: int, piece_size: int, content_length: int) -> Range:
+    """The content range of piece ``num`` in a fully-known-length task."""
+    start = num * piece_size
+    length = min(piece_size, content_length - start)
+    if length <= 0:
+        raise ValueError(
+            f"piece {num} out of range for length {content_length}"
+        )
+    return Range(start, length)

@@ -1,0 +1,276 @@
+"""Back-to-source protocol registry and HTTP resource client.
+
+Reference counterpart: pkg/source — the ``ResourceClient`` interface
+(source_client.go:102-121: GetContentLength / IsSupportRange / IsExpired /
+Download / GetLastModified) with per-scheme registration (source_client.go:267)
+and the HTTP implementation (pkg/source/clients/httpprotocol). ``file://`` is
+added for hermetic tests (the reference's e2e fixtures use an HTTP
+file-server pod; our single-process harness uses either).
+"""
+
+from __future__ import annotations
+
+import email.utils
+import os
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import BinaryIO, Dict, Optional
+
+from dragonfly2_tpu.client.piece import Range
+
+UNKNOWN_SOURCE_FILE_LEN = -2
+
+
+class SourceError(Exception):
+    pass
+
+
+@dataclass
+class Request:
+    """A back-to-source request (pkg/source/request.go)."""
+
+    url: str
+    header: Dict[str, str] = field(default_factory=dict)
+    rng: Optional[Range] = None
+
+    @property
+    def scheme(self) -> str:
+        return urllib.parse.urlparse(self.url).scheme.lower()
+
+
+@dataclass
+class Response:
+    body: BinaryIO
+    content_length: int = -1
+    status: int = 200
+    header: Dict[str, str] = field(default_factory=dict)
+
+    def close(self) -> None:
+        try:
+            self.body.close()
+        except Exception:
+            pass
+
+
+class ResourceClient:
+    """Per-scheme back-to-source client (source_client.go:102-121)."""
+
+    def get_content_length(self, request: Request) -> int:
+        raise NotImplementedError
+
+    def is_support_range(self, request: Request) -> bool:
+        raise NotImplementedError
+
+    def is_expired(self, request: Request, last_modified: str, etag: str) -> bool:
+        raise NotImplementedError
+
+    def download(self, request: Request) -> Response:
+        raise NotImplementedError
+
+    def get_last_modified(self, request: Request) -> int:
+        raise NotImplementedError
+
+
+class _Registry:
+    """Scheme → client map with plugin-style registration
+    (source_client.go Register/UnRegister)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._clients: Dict[str, ResourceClient] = {}
+
+    def register(self, scheme: str, client: ResourceClient,
+                 replace: bool = False) -> None:
+        with self._lock:
+            if scheme in self._clients and not replace:
+                raise SourceError(f"scheme {scheme!r} already registered")
+            self._clients[scheme.lower()] = client
+
+    def unregister(self, scheme: str) -> None:
+        with self._lock:
+            self._clients.pop(scheme.lower(), None)
+
+    def client(self, scheme: str) -> ResourceClient:
+        with self._lock:
+            try:
+                return self._clients[scheme.lower()]
+            except KeyError:
+                raise SourceError(f"no source client for scheme {scheme!r}")
+
+
+_registry = _Registry()
+register = _registry.register
+unregister = _registry.unregister
+
+
+def client_for(request: Request) -> ResourceClient:
+    return _registry.client(request.scheme)
+
+
+def get_content_length(request: Request) -> int:
+    return client_for(request).get_content_length(request)
+
+
+def is_support_range(request: Request) -> bool:
+    return client_for(request).is_support_range(request)
+
+
+def download(request: Request) -> Response:
+    return client_for(request).download(request)
+
+
+class HTTPSourceClient(ResourceClient):
+    """HTTP(S) back-to-source (pkg/source/clients/httpprotocol).
+
+    Content length and range support come from a GET with ``Range: bytes=0-0``
+    (falling back to plain GET), matching the reference's probe behavior;
+    206 ⇒ ranges supported.
+    """
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _open(self, request: Request, method: str = "GET",
+              extra_header: Dict[str, str] | None = None):
+        headers = dict(request.header)
+        if extra_header:
+            headers.update(extra_header)
+        if request.rng is not None and "Range" not in headers:
+            headers["Range"] = request.rng.http_header()
+        req = urllib.request.Request(request.url, headers=headers, method=method)
+        try:
+            return urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as exc:
+            raise SourceError(f"{request.url}: HTTP {exc.code}") from exc
+        except urllib.error.URLError as exc:
+            raise SourceError(f"{request.url}: {exc.reason}") from exc
+
+    def get_content_length(self, request: Request) -> int:
+        probe = Request(request.url, dict(request.header))
+        resp = self._open(probe, extra_header={"Range": "bytes=0-0"})
+        try:
+            if resp.status == 206:
+                content_range = resp.headers.get("Content-Range", "")
+                if "/" in content_range:
+                    total = content_range.rsplit("/", 1)[1]
+                    if total.isdigit():
+                        return int(total)
+            length = resp.headers.get("Content-Length")
+            return int(length) if length is not None else UNKNOWN_SOURCE_FILE_LEN
+        finally:
+            resp.close()
+
+    def is_support_range(self, request: Request) -> bool:
+        probe = Request(request.url, dict(request.header))
+        resp = self._open(probe, extra_header={"Range": "bytes=0-0"})
+        try:
+            return resp.status == 206
+        finally:
+            resp.close()
+
+    def is_expired(self, request: Request, last_modified: str, etag: str) -> bool:
+        headers = {}
+        if last_modified:
+            headers["If-Modified-Since"] = last_modified
+        if etag:
+            headers["If-None-Match"] = etag
+        if not headers:
+            return True
+        try:
+            resp = self._open(Request(request.url, dict(request.header)),
+                              extra_header=headers)
+            status = resp.status
+            resp.close()
+        except SourceError:
+            return True
+        return status != 304
+
+    def download(self, request: Request) -> Response:
+        resp = self._open(request)
+        if request.rng is not None and resp.status != 206:
+            # A server that ignores Range would hand back the whole body;
+            # treating it as the requested slice silently corrupts pieces.
+            resp.close()
+            raise SourceError(
+                f"{request.url}: server ignored Range (status {resp.status})"
+            )
+        length = resp.headers.get("Content-Length")
+        return Response(
+            body=resp,
+            content_length=int(length) if length is not None else -1,
+            status=resp.status,
+            header={k: v for k, v in resp.headers.items()},
+        )
+
+    def get_last_modified(self, request: Request) -> int:
+        resp = self._open(request, method="HEAD")
+        try:
+            lm = resp.headers.get("Last-Modified")
+            if not lm:
+                return -1
+            dt = email.utils.parsedate_to_datetime(lm)
+            return int(dt.timestamp() * 1000)
+        finally:
+            resp.close()
+
+
+class FileSourceClient(ResourceClient):
+    """``file://`` source for hermetic tests."""
+
+    @staticmethod
+    def _path(request: Request) -> str:
+        parsed = urllib.parse.urlparse(request.url)
+        return urllib.request.url2pathname(parsed.path)
+
+    def get_content_length(self, request: Request) -> int:
+        try:
+            return os.path.getsize(self._path(request))
+        except OSError as exc:
+            raise SourceError(str(exc)) from exc
+
+    def is_support_range(self, request: Request) -> bool:
+        return True
+
+    def is_expired(self, request: Request, last_modified: str, etag: str) -> bool:
+        return True
+
+    def download(self, request: Request) -> Response:
+        path = self._path(request)
+        try:
+            size = os.path.getsize(path)
+            f = open(path, "rb")
+        except OSError as exc:
+            raise SourceError(str(exc)) from exc
+        if request.rng is not None:
+            f.seek(request.rng.start)
+            data = f.read(request.rng.length)
+            f.close()
+            import io
+
+            return Response(io.BytesIO(data), content_length=len(data), status=206)
+        return Response(f, content_length=size)
+
+    def get_last_modified(self, request: Request) -> int:
+        try:
+            return int(os.path.getmtime(self._path(request)) * 1000)
+        except OSError:
+            return -1
+
+
+def register_defaults() -> None:
+    """Install the built-in clients (pkg/source/clients registration)."""
+    for scheme, client in (
+        ("http", HTTPSourceClient()),
+        ("https", HTTPSourceClient()),
+        ("file", FileSourceClient()),
+    ):
+        try:
+            _registry.register(scheme, client)
+        except SourceError:
+            pass
+
+
+register_defaults()

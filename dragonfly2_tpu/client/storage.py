@@ -1,0 +1,422 @@
+"""Piece-granular local task storage with persisted metadata and reuse.
+
+Reference counterpart: client/daemon/storage — ``TaskStorageDriver``
+(storage_manager.go:52-77), the simple on-disk layout (local_storage.go:
+one data file per peer task + metadata JSON), completed-task reuse lookup
+(storage_manager.go:101-106 FindCompletedTask), and TTL/disk-usage GC
+(storage_manager.go TryGC). Layout here: ``<root>/<taskID>/<peerID>/data``
+plus ``metadata.json``; md5-per-piece verification happens at write time via
+:class:`~dragonfly2_tpu.utils.digest.DigestReader` semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import BinaryIO, Dict, Iterable, List, Optional, Tuple
+
+from dragonfly2_tpu.client.piece import PieceMetadata, Range
+from dragonfly2_tpu.utils import digest as digestutil
+
+logger = logging.getLogger(__name__)
+
+METADATA_FILE = "metadata.json"
+DATA_FILE = "data"
+
+
+class StorageError(Exception):
+    pass
+
+
+class InvalidPieceDigestError(StorageError):
+    """Piece payload did not match its announced md5."""
+
+
+@dataclass
+class WritePieceRequest:
+    task_id: str
+    peer_id: str
+    piece: PieceMetadata
+    # Unknown-length pieces may pass length<0 and learn it from the stream.
+    unknown_length: bool = False
+
+
+@dataclass
+class TaskMetadata:
+    """Persisted per-peer-task state
+    (reference: client/daemon/storage/metadata.go:29-45)."""
+
+    task_id: str
+    peer_id: str
+    content_length: int = -1
+    total_pieces: int = -1
+    piece_md5_sign: str = ""
+    header: Dict[str, str] = field(default_factory=dict)
+    done: bool = False
+    pieces: Dict[int, PieceMetadata] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["pieces"] = {str(k): asdict(v) for k, v in self.pieces.items()}
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "TaskMetadata":
+        d = json.loads(raw)
+        d["pieces"] = {
+            int(k): PieceMetadata(**v) for k, v in d.get("pieces", {}).items()
+        }
+        return cls(**d)
+
+
+class TaskStorage:
+    """One peer task's on-disk state: sparse data file + metadata."""
+
+    def __init__(self, directory: str, meta: TaskMetadata):
+        self.directory = directory
+        self.meta = meta
+        self._lock = threading.Lock()
+        self.last_access = time.monotonic()
+        os.makedirs(directory, exist_ok=True)
+        self.data_path = os.path.join(directory, DATA_FILE)
+        if not os.path.exists(self.data_path):
+            open(self.data_path, "wb").close()
+        self._invalid = False
+
+    # -- write path --------------------------------------------------------
+
+    def write_piece(self, req: WritePieceRequest, reader: BinaryIO) -> int:
+        """Stream a piece into the data file at its offset, hashing as we
+        write; raises :class:`InvalidPieceDigestError` on md5 mismatch and
+        discards nothing (the slot is simply not recorded). Returns bytes
+        written. Idempotent per piece number."""
+        self.touch()
+        piece = req.piece
+        with self._lock:
+            duplicate = self.meta.pieces.get(piece.num)
+        if duplicate is not None:
+            # Duplicate of an already-verified piece: drain and ignore
+            # (outside the lock — the reader may be a slow network stream).
+            while reader.read(1 << 20):
+                pass
+            return duplicate.length
+        src = (
+            digestutil.DigestReader(reader, digestutil.ALGORITHM_MD5,
+                                    expected=piece.md5)
+            if piece.md5 else None
+        )
+        written = 0
+        with open(self.data_path, "r+b") as f:
+            f.seek(piece.offset)
+            remaining = None if req.unknown_length else piece.length
+            while remaining is None or remaining > 0:
+                n = 1 << 20 if remaining is None else min(1 << 20, remaining)
+                chunk = (src or reader).read(n)
+                if not chunk:
+                    break
+                f.write(chunk)
+                written += len(chunk)
+                if remaining is not None:
+                    remaining -= len(chunk)
+        if not req.unknown_length and written != piece.length:
+            raise StorageError(
+                f"piece {piece.num}: wrote {written}, expected {piece.length}"
+            )
+        if src is not None and not src.validate():
+            raise InvalidPieceDigestError(
+                f"piece {piece.num}: md5 {src.hexdigest()} != {piece.md5}"
+            )
+        final = PieceMetadata(
+            num=piece.num, md5=piece.md5, offset=piece.offset,
+            start=piece.start, length=written, cost_ns=piece.cost_ns,
+        )
+        with self._lock:
+            self.meta.pieces[piece.num] = final
+        return written
+
+    def update(self, content_length: int | None = None,
+               total_pieces: int | None = None,
+               piece_md5_sign: str | None = None,
+               header: Dict[str, str] | None = None) -> None:
+        with self._lock:
+            if content_length is not None:
+                self.meta.content_length = content_length
+            if total_pieces is not None:
+                self.meta.total_pieces = total_pieces
+            if piece_md5_sign is not None:
+                self.meta.piece_md5_sign = piece_md5_sign
+            if header is not None:
+                self.meta.header = dict(header)
+
+    def mark_done(self) -> None:
+        """Validate completeness, compute the piece-md5 signature, persist.
+
+        The signature is the sha256 over the ordered piece md5s — the same
+        whole-task integrity construct as the reference's PieceMd5Sign
+        (client/daemon/storage/local_storage.go digest of sorted piece md5s).
+        """
+        with self._lock:
+            n = self.meta.total_pieces
+            if n >= 0 and len(self.meta.pieces) < n:
+                raise StorageError(
+                    f"task {self.meta.task_id}: {len(self.meta.pieces)}/{n} pieces"
+                )
+            md5s = [self.meta.pieces[i].md5 for i in sorted(self.meta.pieces)]
+            if all(md5s):
+                self.meta.piece_md5_sign = digestutil.sha256_from_strings(*md5s)
+            self.meta.done = True
+        self.persist()
+
+    def persist(self) -> None:
+        tmp = os.path.join(self.directory, METADATA_FILE + ".tmp")
+        with self._lock:
+            raw = self.meta.to_json()
+        with open(tmp, "w") as f:
+            f.write(raw)
+        os.replace(tmp, os.path.join(self.directory, METADATA_FILE))
+
+    # -- read path ---------------------------------------------------------
+
+    def read_piece(self, num: int = -1, rng: Range | None = None) -> bytes:
+        """Read one piece by number, or an arbitrary content range
+        (num=-1 + rng), the upload server's access pattern
+        (upload_manager.go:229-237 reads Num:-1 with an HTTP range)."""
+        self.touch()
+        if num >= 0:
+            with self._lock:
+                piece = self.meta.pieces.get(num)
+            if piece is None:
+                raise StorageError(f"piece {num} not present")
+            rng = Range(piece.start, piece.length)
+        if rng is None:
+            raise StorageError("need piece num or range")
+        with open(self.data_path, "rb") as f:
+            f.seek(rng.start)
+            return f.read(rng.length)
+
+    def iter_content(self, rng: Range | None = None,
+                     chunk: int = 1 << 20) -> Iterable[bytes]:
+        self.touch()
+        if rng is None:
+            # Unknown content length (never learned from source): fall back
+            # to the verified extent — the end of the last stored piece.
+            total = self.meta.content_length
+            if total < 0:
+                with self._lock:
+                    total = max(
+                        (p.start + p.length for p in self.meta.pieces.values()),
+                        default=0,
+                    )
+            rng = Range(0, total)
+        with open(self.data_path, "rb") as f:
+            f.seek(rng.start)
+            remaining = rng.length
+            while remaining > 0:
+                data = f.read(min(chunk, remaining))
+                if not data:
+                    return
+                remaining -= len(data)
+                yield data
+
+    def covers(self, rng: Range) -> bool:
+        """True when [start, end] is fully covered by verified pieces —
+        guards range reads on incomplete stores from serving sparse-file
+        zeros."""
+        if self.meta.done:
+            return True
+        with self._lock:
+            spans = sorted(
+                (p.start, p.start + p.length) for p in self.meta.pieces.values()
+            )
+        pos = rng.start
+        end = rng.start + rng.length
+        for s, e in spans:
+            if s > pos:
+                return False
+            pos = max(pos, e)
+            if pos >= end:
+                return True
+        return pos >= end
+
+    def pieces_in(self, nums: Iterable[int]) -> List[PieceMetadata]:
+        with self._lock:
+            return [self.meta.pieces[n] for n in nums if n in self.meta.pieces]
+
+    def existing_piece_nums(self) -> List[int]:
+        with self._lock:
+            return sorted(self.meta.pieces)
+
+    @property
+    def done(self) -> bool:
+        return self.meta.done
+
+    def touch(self) -> None:
+        self.last_access = time.monotonic()
+
+    def invalidate(self) -> None:
+        self._invalid = True
+
+    @property
+    def valid(self) -> bool:
+        return not self._invalid
+
+    def disk_usage(self) -> int:
+        try:
+            return os.path.getsize(self.data_path)
+        except OSError:
+            return 0
+
+
+@dataclass
+class StorageOptions:
+    """(reference: client/config/peerhost.go StorageOption)"""
+
+    root: str = ""
+    task_expire_seconds: float = 6 * 60 * 60.0
+    disk_gc_threshold_bytes: int = 0  # 0 = unlimited
+    keep_storage: bool = True
+
+
+class StorageManager:
+    """Registry of :class:`TaskStorage` keyed by (taskID, peerID), with
+    completed-task reuse and TTL/usage GC
+    (reference: client/daemon/storage/storage_manager.go:91-154)."""
+
+    def __init__(self, opts: StorageOptions):
+        if not opts.root:
+            raise ValueError("storage root required")
+        self.opts = opts
+        os.makedirs(opts.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._tasks: Dict[Tuple[str, str], TaskStorage] = {}
+        if opts.keep_storage:
+            self._reload()
+
+    def _reload(self) -> None:
+        """Recover persisted tasks after restart (KeepStorage semantics,
+        client/config/peerhost.go:63)."""
+        for task_id in os.listdir(self.opts.root):
+            task_dir = os.path.join(self.opts.root, task_id)
+            if not os.path.isdir(task_dir):
+                continue
+            for peer_id in os.listdir(task_dir):
+                meta_path = os.path.join(task_dir, peer_id, METADATA_FILE)
+                if not os.path.exists(meta_path):
+                    continue
+                try:
+                    with open(meta_path) as f:
+                        meta = TaskMetadata.from_json(f.read())
+                except (OSError, ValueError, TypeError, KeyError) as exc:
+                    logger.warning("skip corrupt metadata %s: %s", meta_path, exc)
+                    continue
+                store = TaskStorage(os.path.join(task_dir, peer_id), meta)
+                self._tasks[(task_id, peer_id)] = store
+
+    def register_task(self, task_id: str, peer_id: str) -> TaskStorage:
+        with self._lock:
+            key = (task_id, peer_id)
+            if key not in self._tasks:
+                directory = os.path.join(self.opts.root, task_id, peer_id)
+                self._tasks[key] = TaskStorage(
+                    directory, TaskMetadata(task_id=task_id, peer_id=peer_id)
+                )
+            return self._tasks[key]
+
+    def get(self, task_id: str, peer_id: str) -> Optional[TaskStorage]:
+        with self._lock:
+            return self._tasks.get((task_id, peer_id))
+
+    def find_completed_task(self, task_id: str) -> Optional[TaskStorage]:
+        """Any valid, done storage for this task — the reuse fast path
+        (storage_manager.go:101-106)."""
+        with self._lock:
+            for (tid, _), store in self._tasks.items():
+                if tid == task_id and store.done and store.valid:
+                    return store
+        return None
+
+    def read_piece_any(self, task_id: str, peer_id: str,
+                       num: int = -1, rng: Range | None = None) -> bytes:
+        """Serve a read preferring the exact peer, falling back to any
+        completed replica of the task (the upload server's lookup)."""
+        store = self.get(task_id, peer_id)
+        if (
+            store is None
+            or (num >= 0 and num not in store.meta.pieces)
+            or (num < 0 and rng is not None and not store.covers(rng))
+        ):
+            fallback = self.find_completed_task(task_id)
+            if fallback is not None:
+                store = fallback
+        if store is None:
+            raise StorageError(f"task {task_id} not in storage")
+        if num < 0 and rng is not None and not store.covers(rng):
+            raise StorageError(
+                f"task {task_id}: range {rng.start}+{rng.length} not stored"
+            )
+        return store.read_piece(num=num, rng=rng)
+
+    def delete_task(self, task_id: str, peer_id: str | None = None) -> int:
+        """Remove task storage (all peers when peer_id is None)."""
+        removed = 0
+        with self._lock:
+            keys = [
+                k for k in self._tasks
+                if k[0] == task_id and (peer_id is None or k[1] == peer_id)
+            ]
+            for k in keys:
+                store = self._tasks.pop(k)
+                store.invalidate()
+                shutil.rmtree(store.directory, ignore_errors=True)
+                removed += 1
+        task_dir = os.path.join(self.opts.root, task_id)
+        if peer_id is None:
+            shutil.rmtree(task_dir, ignore_errors=True)
+        else:
+            try:  # reap the parent dir once its last peer is gone
+                os.rmdir(task_dir)
+            except OSError:
+                pass
+        return removed
+
+    def total_usage(self) -> int:
+        with self._lock:
+            return sum(s.disk_usage() for s in self._tasks.values())
+
+    def try_gc(self) -> int:
+        """Reclaim expired tasks, then oldest-first until under the disk
+        threshold. Returns tasks removed. (storage_manager.go TryGC)"""
+        now = time.monotonic()
+        removed = 0
+        with self._lock:
+            items = sorted(self._tasks.items(), key=lambda kv: kv[1].last_access)
+        for key, store in items:
+            if now - store.last_access >= self.opts.task_expire_seconds:
+                self.delete_task(*key)
+                removed += 1
+        if self.opts.disk_gc_threshold_bytes > 0:
+            with self._lock:
+                items = sorted(
+                    self._tasks.items(), key=lambda kv: kv[1].last_access
+                )
+            for key, _ in items:
+                if self.total_usage() <= self.opts.disk_gc_threshold_bytes:
+                    break
+                self.delete_task(*key)
+                removed += 1
+        return removed
+
+    def persist_all(self) -> None:
+        with self._lock:
+            stores = list(self._tasks.values())
+        for s in stores:
+            s.persist()
+
+    def task_count(self) -> int:
+        with self._lock:
+            return len(self._tasks)

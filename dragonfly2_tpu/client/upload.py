@@ -1,0 +1,122 @@
+"""HTTP piece upload server — what other peers download pieces from.
+
+Reference counterpart: client/daemon/upload/upload_manager.go:92-188. Route
+shape is identical: ``GET /download/{task_prefix}/{task_id}?peerId=...`` with
+a single HTTP ``Range`` header selecting the piece bytes, plus ``/healthy``.
+Rate-limited by a token bucket (the reference uses x/time/rate at :110).
+Implementation is stdlib ThreadingHTTPServer — the daemon's data plane needs
+no framework.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from dragonfly2_tpu.client.piece import parse_http_range
+from dragonfly2_tpu.client.storage import StorageError, StorageManager
+from dragonfly2_tpu.utils.ratelimit import INF, Limiter
+
+logger = logging.getLogger(__name__)
+
+ROUTE_DOWNLOAD = "/download"
+ROUTE_HEALTHY = "/healthy"
+
+
+class UploadServer:
+    """Serves stored piece bytes to child peers."""
+
+    def __init__(self, storage: StorageManager, host: str = "127.0.0.1",
+                 port: int = 0, rate_limit_bps: float = INF):
+        self.storage = storage
+        self.limiter = Limiter(rate_limit_bps, burst=int(rate_limit_bps)
+                               if rate_limit_bps != INF else None)
+        manager = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to our logger
+                logger.debug("upload: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                manager._handle(self)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="upload-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- request handling --------------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urllib.parse.urlparse(req.path)
+        if parsed.path == ROUTE_HEALTHY:
+            body = b'"OK"'
+            req.send_response(200)
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+            return
+        if not parsed.path.startswith(ROUTE_DOWNLOAD + "/"):
+            req.send_error(404)
+            return
+        parts = parsed.path[len(ROUTE_DOWNLOAD) + 1:].split("/")
+        if len(parts) != 2:  # task_prefix/task_id (upload_manager.go:184)
+            req.send_error(422, "expected /download/{prefix}/{task_id}")
+            return
+        task_id = parts[1]
+        query = urllib.parse.parse_qs(parsed.query)
+        peer_id = (query.get("peerId") or [""])[0]
+        range_header = req.headers.get("Range")
+        if not range_header:
+            req.send_error(400, "Range header required")
+            return
+        if range_header.startswith("bytes=-"):
+            # Suffix ranges need the total length, which piece requests
+            # never use; reject rather than resolve against a sentinel.
+            req.send_error(400, "suffix ranges not supported")
+            return
+        try:
+            rng = parse_http_range(range_header, 1 << 62)
+        except ValueError as exc:
+            req.send_error(400, str(exc))
+            return
+        try:
+            data = self.storage.read_piece_any(task_id, peer_id, rng=rng)
+        except StorageError as exc:
+            req.send_error(500, str(exc))
+            return
+        if not data:
+            req.send_error(416, "range past end of stored content")
+            return
+        self.limiter.wait_n(min(len(data), self.limiter.burst))
+        req.send_response(206)
+        req.send_header("Content-Length", str(len(data)))
+        req.send_header(
+            "Content-Range", f"bytes {rng.start}-{rng.start + len(data) - 1}/*"
+        )
+        req.end_headers()
+        req.wfile.write(data)

@@ -1,0 +1,83 @@
+"""Range-capable HTTP file server fixture.
+
+Test-infra counterpart of the reference's e2e file-server pod
+(test/testdata/k8s file-server) — serves a directory with single-range
+support so back-to-source and proxy paths can be exercised hermetically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from dragonfly2_tpu.client.piece import parse_http_range
+
+
+class FileServer:
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 support_range: bool = True, send_content_length: bool = True):
+        self.root = root
+        self.support_range = support_range
+        self.send_content_length = send_content_length
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                path = os.path.join(server.root, self.path.lstrip("/"))
+                if not os.path.isfile(path):
+                    self.send_error(404)
+                    return
+                size = os.path.getsize(path)
+                rng_header = self.headers.get("Range")
+                with open(path, "rb") as f:
+                    if rng_header and server.support_range:
+                        rng = parse_http_range(rng_header, size)
+                        f.seek(rng.start)
+                        data = f.read(rng.length)
+                        self.send_response(206)
+                        self.send_header(
+                            "Content-Range",
+                            f"bytes {rng.start}-{rng.end}/{size}",
+                        )
+                    else:
+                        data = f.read()
+                        self.send_response(200)
+                    if server.send_content_length:
+                        self.send_header("Content-Length", str(len(data)))
+                    else:
+                        # Chunked-less close-delimited body (the reference's
+                        # no-content-length fixture, test/tools/no-content-length).
+                        self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(data)
+
+            do_HEAD = do_GET
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def url(self, name: str) -> str:
+        return f"http://127.0.0.1:{self.port}/{name}"
+
+    def __enter__(self) -> "FileServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,402 @@
+"""Client storage / piece-transfer layer tests.
+
+Modeled on the reference's white-box storage tests
+(client/daemon/storage/*_test.go) and piece dispatcher tests
+(piece_dispatcher_test.go): piece-size math, digest-verified writes,
+metadata persistence + reuse across restart, GC, the upload server ↔
+downloader HTTP roundtrip, and source clients.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+
+import pytest
+
+from dragonfly2_tpu.client import source as source_mod
+from dragonfly2_tpu.client.downloader import (
+    DownloadPieceRequest,
+    DownloadPieceResult,
+    PieceDispatcher,
+    PieceDownloader,
+)
+from dragonfly2_tpu.client.piece import (
+    DEFAULT_PIECE_SIZE,
+    PIECE_SIZE_LIMIT,
+    PieceMetadata,
+    Range,
+    compute_piece_count,
+    compute_piece_size,
+    parse_http_range,
+    piece_range,
+)
+from dragonfly2_tpu.client.storage import (
+    InvalidPieceDigestError,
+    StorageManager,
+    StorageOptions,
+    WritePieceRequest,
+)
+from dragonfly2_tpu.client.upload import UploadServer
+from dragonfly2_tpu.utils.ratelimit import Limiter
+
+MiB = 1024 * 1024
+
+
+class TestPieceMath:
+    def test_piece_size_growth_rule(self):
+        # internal/util/util.go:33-45 semantics
+        assert compute_piece_size(-1) == DEFAULT_PIECE_SIZE
+        assert compute_piece_size(200 * MiB) == DEFAULT_PIECE_SIZE
+        assert compute_piece_size(300 * MiB) == 5 * MiB
+        assert compute_piece_size(1000 * MiB) == 12 * MiB
+        assert compute_piece_size(10_000 * MiB) == PIECE_SIZE_LIMIT
+
+    def test_piece_count(self):
+        assert compute_piece_count(0, 4) == 0
+        assert compute_piece_count(1, 4) == 1
+        assert compute_piece_count(8, 4) == 2
+        assert compute_piece_count(9, 4) == 3
+
+    def test_piece_range(self):
+        assert piece_range(0, 10, 25) == Range(0, 10)
+        assert piece_range(2, 10, 25) == Range(20, 5)
+        with pytest.raises(ValueError):
+            piece_range(3, 10, 25)
+
+    def test_parse_http_range(self):
+        assert parse_http_range("bytes=0-9", 100) == Range(0, 10)
+        assert parse_http_range("bytes=90-", 100) == Range(90, 10)
+        assert parse_http_range("bytes=-10", 100) == Range(90, 10)
+        assert parse_http_range("bytes=50-1000", 100) == Range(50, 50)
+        with pytest.raises(ValueError):
+            parse_http_range("bytes=5-2", 100)
+        with pytest.raises(ValueError):
+            parse_http_range("items=0-1", 100)
+        with pytest.raises(ValueError):
+            parse_http_range("bytes=0-1,3-4", 100)
+
+
+def make_piece(num: int, data: bytes, piece_size: int) -> PieceMetadata:
+    return PieceMetadata(
+        num=num, md5=hashlib.md5(data).hexdigest(),
+        offset=num * piece_size, start=num * piece_size, length=len(data),
+    )
+
+
+def write_task(manager: StorageManager, task_id: str, peer_id: str,
+               content: bytes, piece_size: int):
+    store = manager.register_task(task_id, peer_id)
+    pieces = []
+    for num in range(compute_piece_count(len(content), piece_size)):
+        chunk = content[num * piece_size:(num + 1) * piece_size]
+        piece = make_piece(num, chunk, piece_size)
+        store.write_piece(
+            WritePieceRequest(task_id=task_id, peer_id=peer_id, piece=piece),
+            io.BytesIO(chunk),
+        )
+        pieces.append(piece)
+    store.update(content_length=len(content), total_pieces=len(pieces))
+    store.mark_done()
+    return store, pieces
+
+
+class TestStorage:
+    def test_write_read_roundtrip(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        content = os.urandom(2500)
+        store, pieces = write_task(manager, "t" * 32, "p1", content, 1000)
+        assert store.done
+        assert store.read_piece(num=1) == content[1000:2000]
+        assert store.read_piece(rng=Range(500, 700)) == content[500:1200]
+        assert b"".join(store.iter_content()) == content
+        assert store.meta.piece_md5_sign  # whole-task integrity signature
+
+    def test_bad_digest_rejected(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        store = manager.register_task("t" * 32, "p1")
+        piece = PieceMetadata(num=0, md5="0" * 32, offset=0, start=0, length=4)
+        with pytest.raises(InvalidPieceDigestError):
+            store.write_piece(
+                WritePieceRequest("t" * 32, "p1", piece), io.BytesIO(b"data")
+            )
+        assert 0 not in store.meta.pieces
+
+    def test_duplicate_piece_is_idempotent(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        store = manager.register_task("t" * 32, "p1")
+        data = b"hello world!"
+        piece = make_piece(0, data, len(data))
+        req = WritePieceRequest("t" * 32, "p1", piece)
+        assert store.write_piece(req, io.BytesIO(data)) == len(data)
+        assert store.write_piece(req, io.BytesIO(b"x" * len(data))) == len(data)
+        assert store.read_piece(num=0) == data
+
+    def test_incomplete_task_cannot_finish(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        store = manager.register_task("t" * 32, "p1")
+        store.update(content_length=100, total_pieces=2)
+        with pytest.raises(Exception):
+            store.mark_done()
+
+    def test_reload_and_reuse_across_restart(self, tmp_path):
+        content = os.urandom(1500)
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        write_task(manager, "t" * 32, "p1", content, 1000)
+        manager.persist_all()
+        # restart
+        manager2 = StorageManager(StorageOptions(root=str(tmp_path)))
+        found = manager2.find_completed_task("t" * 32)
+        assert found is not None
+        assert b"".join(found.iter_content()) == content
+        # read_piece_any falls back to the completed replica for unknown peers
+        assert manager2.read_piece_any("t" * 32, "other-peer", num=0) == content[:1000]
+
+    def test_keep_storage_false_skips_reload(self, tmp_path):
+        content = os.urandom(100)
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        write_task(manager, "t" * 32, "p1", content, 1000)
+        manager.persist_all()
+        manager2 = StorageManager(
+            StorageOptions(root=str(tmp_path), keep_storage=False)
+        )
+        assert manager2.task_count() == 0
+
+    def test_gc_expired_and_disk_pressure(self, tmp_path):
+        manager = StorageManager(
+            StorageOptions(root=str(tmp_path), task_expire_seconds=0.0)
+        )
+        write_task(manager, "a" * 32, "p1", os.urandom(100), 1000)
+        assert manager.try_gc() == 1
+        assert manager.task_count() == 0
+
+        manager = StorageManager(
+            StorageOptions(root=str(tmp_path), disk_gc_threshold_bytes=1500)
+        )
+        write_task(manager, "b" * 32, "p1", os.urandom(1000), 1000)
+        write_task(manager, "c" * 32, "p2", os.urandom(1000), 1000)
+        assert manager.total_usage() == 2000
+        removed = manager.try_gc()
+        assert removed == 1
+        assert manager.total_usage() <= 1500
+
+    def test_incomplete_store_range_read_falls_back_not_zeros(self, tmp_path):
+        """A sparse local store must never serve zeros for a range it does
+        not cover; it falls back to a completed replica or errors."""
+        from dragonfly2_tpu.client.storage import StorageError
+
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        content = os.urandom(3000)
+        task_id = "g" * 32
+        write_task(manager, task_id, "done-peer", content, 1000)
+        # sparse store for the same task: only piece 0 and 2 present
+        sparse = manager.register_task(task_id, "sparse-peer")
+        for num in (0, 2):
+            chunk = content[num * 1000:(num + 1) * 1000]
+            sparse.write_piece(
+                WritePieceRequest(task_id, "sparse-peer", make_piece(num, chunk, 1000)),
+                io.BytesIO(chunk),
+            )
+        got = manager.read_piece_any(task_id, "sparse-peer", rng=Range(1000, 1000))
+        assert got == content[1000:2000]  # from the completed replica
+        # no replica at all → error, not zeros
+        manager.delete_task(task_id, "done-peer")
+        with pytest.raises(StorageError):
+            manager.read_piece_any(task_id, "sparse-peer", rng=Range(1000, 1000))
+        # covered ranges still served locally
+        assert manager.read_piece_any(
+            task_id, "sparse-peer", rng=Range(2000, 1000)
+        ) == content[2000:3000]
+
+    def test_iter_content_unknown_length(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        store = manager.register_task("h" * 32, "p1")
+        data = os.urandom(700)
+        store.write_piece(
+            WritePieceRequest("h" * 32, "p1",
+                              PieceMetadata(num=0, length=-1),
+                              unknown_length=True),
+            io.BytesIO(data),
+        )
+        assert b"".join(store.iter_content()) == data
+
+    def test_delete_task(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        write_task(manager, "d" * 32, "p1", os.urandom(10), 1000)
+        assert manager.delete_task("d" * 32) == 1
+        assert manager.find_completed_task("d" * 32) is None
+        assert not os.path.exists(os.path.join(str(tmp_path), "d" * 32))
+
+
+class TestDispatcher:
+    def test_prefers_lower_score_parent(self):
+        d = PieceDispatcher(random_ratio=0.0, seed=7)
+        for num in range(4):
+            for peer in ("fast", "slow"):
+                d.put(DownloadPieceRequest(
+                    "t" * 32, "src", peer, "addr",
+                    PieceMetadata(num=num, length=1),
+                ))
+        d.report(DownloadPieceResult("slow", 99, fail=False, cost_ns=10**9))
+        d.report(DownloadPieceResult("fast", 98, fail=False, cost_ns=10**6))
+        got = [d.get(timeout=1).dst_peer_id for _ in range(4)]
+        assert got == ["fast"] * 4
+
+    def test_failure_penalty_and_smoothing(self):
+        d = PieceDispatcher(random_ratio=0.0)
+        d.report(DownloadPieceResult("p", 0, fail=True))
+        score_after_fail = d.scores()["p"]
+        assert score_after_fail == 30 * 10**9  # (0 + 60s)/2
+        d.report(DownloadPieceResult("p", 0, fail=False, cost_ns=0))
+        assert d.scores()["p"] == score_after_fail // 2
+
+    def test_skips_downloaded_pieces(self):
+        d = PieceDispatcher(random_ratio=0.0)
+        d.put(DownloadPieceRequest(
+            "t" * 32, "src", "a", "addr", PieceMetadata(num=5, length=1)
+        ))
+        d.report(DownloadPieceResult("a", 5, fail=False, cost_ns=1))
+        assert d.get(timeout=0.05) is None
+
+    def test_close_raises(self):
+        import threading
+
+        from dragonfly2_tpu.client.downloader import DispatcherClosedError
+
+        d = PieceDispatcher()
+        threading.Timer(0.05, d.close).start()
+        with pytest.raises(DispatcherClosedError):
+            d.get()
+
+
+class TestUploadDownloadRoundtrip:
+    def test_peer_fetches_pieces_over_http(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        content = os.urandom(3000)
+        task_id = "e" * 32
+        _, pieces = write_task(manager, task_id, "seed-peer", content, 1024)
+        server = UploadServer(manager)
+        server.start()
+        try:
+            downloader = PieceDownloader()
+            got = bytearray(len(content))
+            for piece in pieces:
+                data = downloader.download_piece(DownloadPieceRequest(
+                    task_id=task_id, src_peer_id="child",
+                    dst_peer_id="seed-peer", dst_addr=server.address,
+                    piece=piece,
+                ))
+                assert hashlib.md5(data).hexdigest() == piece.md5
+                got[piece.start:piece.start + piece.length] = data
+            assert bytes(got) == content
+        finally:
+            server.stop()
+
+    def test_upload_server_errors(self, tmp_path):
+        import urllib.error
+        import urllib.request
+
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        server = UploadServer(manager)
+        server.start()
+        try:
+            base = f"http://{server.address}"
+            with urllib.request.urlopen(f"{base}/healthy") as resp:
+                assert resp.status == 200
+            # missing range
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/download/abc/{'f'*32}?peerId=x")
+            assert exc_info.value.code == 400
+            # unknown task
+            req = urllib.request.Request(
+                f"{base}/download/abc/{'f'*32}?peerId=x",
+                headers={"Range": "bytes=0-9"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 500
+            # suffix ranges are rejected (total length unknown server-side)
+            req = urllib.request.Request(
+                f"{base}/download/abc/{'f'*32}?peerId=x",
+                headers={"Range": "bytes=-10"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 400
+        finally:
+            server.stop()
+
+
+class TestSourceClients:
+    def test_file_source(self, tmp_path):
+        path = tmp_path / "blob.bin"
+        content = os.urandom(500)
+        path.write_bytes(content)
+        url = path.as_uri()
+        req = source_mod.Request(url)
+        assert source_mod.get_content_length(req) == 500
+        assert source_mod.is_support_range(req)
+        resp = source_mod.download(req)
+        assert resp.body.read() == content
+        resp.close()
+        ranged = source_mod.download(
+            source_mod.Request(url, rng=Range(100, 50))
+        )
+        assert ranged.body.read() == content[100:150]
+        ranged.close()
+
+    def test_http_source(self, tmp_path):
+        from tests.fileserver import FileServer
+
+        content = os.urandom(2048)
+        (tmp_path / "file.bin").write_bytes(content)
+        with FileServer(str(tmp_path)) as fs:
+            req = source_mod.Request(fs.url("file.bin"))
+            assert source_mod.get_content_length(req) == 2048
+            assert source_mod.is_support_range(req)
+            resp = source_mod.download(
+                source_mod.Request(fs.url("file.bin"), rng=Range(0, 100))
+            )
+            assert resp.body.read() == content[:100]
+            resp.close()
+
+    def test_http_source_no_range_support(self, tmp_path):
+        from tests.fileserver import FileServer
+
+        (tmp_path / "f.bin").write_bytes(b"x" * 100)
+        with FileServer(str(tmp_path), support_range=False) as fs:
+            req = source_mod.Request(fs.url("f.bin"))
+            assert not source_mod.is_support_range(req)
+            assert source_mod.get_content_length(req) == 100
+            # a ranged download against a server that ignores Range must
+            # fail loudly, not hand back the whole body as the slice
+            with pytest.raises(source_mod.SourceError):
+                source_mod.download(
+                    source_mod.Request(fs.url("f.bin"), rng=Range(10, 10))
+                )
+
+    def test_unknown_scheme(self):
+        with pytest.raises(source_mod.SourceError):
+            source_mod.client_for(source_mod.Request("gopher://x/y"))
+
+
+class TestLimiter:
+    def test_allow_and_refill(self):
+        lim = Limiter(rate=1000.0, burst=100)
+        assert lim.allow_n(100)
+        assert not lim.allow_n(100)
+        assert lim.wait_n(50, timeout=1.0)
+
+    def test_infinite(self):
+        from dragonfly2_tpu.utils.ratelimit import INF
+
+        lim = Limiter(rate=INF)
+        assert lim.allow_n(10**12)
+
+    def test_wait_timeout_restores_tokens(self):
+        lim = Limiter(rate=10.0, burst=10)
+        assert lim.wait_n(10)
+        assert not lim.wait_n(10, timeout=0.01)
+        # tokens restored: a later generous wait succeeds
+        assert lim.wait_n(1, timeout=2.0)
