@@ -1,0 +1,218 @@
+"""Daemon assembly — storage + upload server + peer engine + seed role.
+
+Reference counterpart: client/daemon/daemon.go:76-364 (New/Serve wiring) and
+peertask_manager.go (task frontends + reuse fast path), plus the seeder
+surface (client/daemon/rpcserver/seeder.go:41-332 ObtainSeeds) through which
+the scheduler triggers seed-peer back-source downloads.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from dragonfly2_tpu.client.peer_task import (
+    PeerTaskConductor,
+    PeerTaskOptions,
+    PeerTaskResult,
+    SchedulerAPI,
+)
+from dragonfly2_tpu.client.storage import StorageManager, StorageOptions
+from dragonfly2_tpu.client.traffic_shaper import (
+    TrafficShaper,
+    new_traffic_shaper,
+)
+from dragonfly2_tpu.client.upload import UploadServer
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.utils import idgen
+from dragonfly2_tpu.utils.hosttypes import HostType
+from dragonfly2_tpu.utils.ratelimit import INF
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DaemonConfig:
+    """(client/config/peerhost.go:47-77, trimmed to wired options)"""
+
+    storage_root: str = ""
+    ip: str = "127.0.0.1"
+    hostname: str = "localhost"
+    host_type: HostType = HostType.NORMAL
+    idc: str = ""
+    location: str = ""
+    upload_rate_bps: float = INF
+    total_download_rate_bps: float = INF
+    traffic_shaper_type: str = "plain"
+    task_options: PeerTaskOptions = field(default_factory=PeerTaskOptions)
+    keep_storage: bool = True
+
+
+class Daemon:
+    """One dfdaemon instance (in-process)."""
+
+    def __init__(self, scheduler: SchedulerAPI, config: DaemonConfig):
+        if not config.storage_root:
+            raise ValueError("storage_root required")
+        self.scheduler = scheduler
+        self.config = config
+        self.storage = StorageManager(StorageOptions(
+            root=config.storage_root, keep_storage=config.keep_storage,
+        ))
+        self.upload = UploadServer(
+            self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps
+        )
+        self.shaper: TrafficShaper = new_traffic_shaper(
+            config.traffic_shaper_type, config.total_download_rate_bps
+        )
+        self.host_id = idgen.host_id_v1(config.hostname, self.upload.port)
+        self._started = False
+        self._conductors_lock = threading.Lock()
+        self._conductors: Dict[str, PeerTaskConductor] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self.upload.start()
+        self.shaper.start()
+        # host_id depends on the bound port only when port=0 was requested;
+        # recompute now that the listener exists.
+        self.host_id = idgen.host_id_v1(self.config.hostname, self.upload.port)
+        self.announce()
+        self._started = True
+
+    def stop(self) -> None:
+        self.shaper.stop()
+        self.upload.stop()
+        self.storage.persist_all()
+        self._started = False
+
+    def announce(self) -> None:
+        """AnnounceHost (client/daemon/announcer/announcer.go:45-158)."""
+        host = self.build_host()
+        self.scheduler.announce_host(host)
+
+    def build_host(self) -> Host:
+        from dragonfly2_tpu.schema import records
+
+        return Host(
+            id=self.host_id,
+            hostname=self.config.hostname,
+            ip=self.config.ip,
+            port=self.upload.port,
+            download_port=self.upload.port,
+            type=self.config.host_type,
+            network=records.Network(
+                idc=self.config.idc, location=self.config.location,
+            ),
+        )
+
+    # -- task frontends (peertask_manager.go StartFileTask) ----------------
+
+    def download_file(self, url: str, *, output_path: str | None = None,
+                      request_header: Dict[str, str] | None = None,
+                      tag: str = "", application: str = "",
+                      filtered_query_params=None) -> PeerTaskResult:
+        task_id = idgen.task_id_v1(
+            url, tag=tag, application=application,
+            filters="&".join(filtered_query_params or []),
+        )
+        # Reuse fast path (peertask_reuse.go; FindCompletedTask
+        # storage_manager.go:101-106).
+        done = self.storage.find_completed_task(task_id)
+        if done is not None:
+            logger.info("task %s reused from storage", task_id[:16])
+            result = PeerTaskResult(
+                task_id, done.meta.peer_id, True,
+                content_length=done.meta.content_length, storage=done,
+            )
+            if output_path:
+                result.save_to(output_path)
+            return result
+
+        peer_id = (
+            idgen.seed_peer_id_v1(self.config.ip)
+            if self.config.host_type.is_seed
+            else idgen.peer_id_v1(self.config.ip)
+        ) + "-" + uuid.uuid4().hex[:8]
+        self.shaper.add_task(task_id)
+        try:
+            conductor = PeerTaskConductor(
+                self.scheduler, self.storage,
+                host_id=self.host_id, task_id=task_id, peer_id=peer_id,
+                url=url, request_header=request_header, shaper=self.shaper,
+                options=self.config.task_options,
+                is_seed=self.config.host_type.is_seed,
+            )
+            with self._conductors_lock:
+                self._conductors[peer_id] = conductor
+            result = conductor.run()
+        finally:
+            self.shaper.remove_task(task_id)
+            with self._conductors_lock:
+                self._conductors.pop(peer_id, None)
+        if result.success and output_path:
+            result.save_to(output_path)
+        return result
+
+    # -- seeder surface (scheduler → seed daemon) --------------------------
+
+    def seed_client(self) -> "SeedPeerDaemonClient":
+        return SeedPeerDaemonClient(self)
+
+
+class SeedPeerDaemonClient:
+    """The scheduler-side SeedPeerClient protocol bound to a seed daemon —
+    ObtainSeeds semantics (seeder.go:53): trigger a back-source download on
+    the seed so its pieces become the task's origin in the mesh."""
+
+    def __init__(self, daemon: Daemon):
+        self.daemon = daemon
+        self._inflight_lock = threading.Lock()
+        self._inflight: set[str] = set()
+
+    def trigger_task(self, task) -> None:
+        with self._inflight_lock:
+            if task.id in self._inflight:
+                return
+            self._inflight.add(task.id)
+        try:
+            daemon = self.daemon
+            peer_id = (
+                idgen.seed_peer_id_v1(daemon.config.ip)
+                + "-" + uuid.uuid4().hex[:8]
+            )
+            conductor = PeerTaskConductor(
+                daemon.scheduler, daemon.storage,
+                host_id=daemon.host_id, task_id=task.id, peer_id=peer_id,
+                url=task.url, request_header=dict(task.request_header),
+                shaper=daemon.shaper, options=daemon.config.task_options,
+                is_seed=True,
+            )
+            # Seeds go straight to source (StartSeedTask → back-source);
+            # register first so the peer exists in the scheduler's DAG.
+            from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+            daemon.scheduler.register_peer(
+                RegisterPeerRequest(
+                    host_id=daemon.host_id, task_id=task.id,
+                    peer_id=peer_id, url=task.url,
+                    request_header=dict(task.request_header),
+                ),
+                channel=conductor.channel,
+            )
+            conductor.store = daemon.storage.register_task(task.id, peer_id)
+            conductor._started_at = time.monotonic()
+            result = conductor._run_back_to_source(report=True)
+            if not result.success:
+                logger.warning("seed trigger for %s failed: %s",
+                               task.id, result.error)
+        finally:
+            with self._inflight_lock:
+                self._inflight.discard(task.id)

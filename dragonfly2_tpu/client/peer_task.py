@@ -1,0 +1,650 @@
+"""Peer-task engine: one conductor per running download.
+
+Reference counterpart: client/daemon/peer/peertask_conductor.go:68-1021 and
+peertask_manager.go:47-377. The conductor registers with the scheduler,
+consumes scheduling decisions (candidate parents / back-to-source), syncs
+piece metadata from each parent (the SyncPieceTasks role,
+peertask_piecetask_synchronizer.go:45-300 — here an HTTP metadata poll
+against the parent's upload server), fans piece downloads across a worker
+pool fed by the scored :class:`PieceDispatcher`, verifies+stores pieces, and
+reports every outcome back to the scheduler so the peer DAG and the ML
+dataset stay truthful.
+
+The scheduler is reached through the ``SchedulerAPI`` protocol — satisfied
+directly by ``scheduler.service.SchedulerService`` in-process (single-proc
+harness, tests) or by the gRPC client adapter (multi-process deployment).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Protocol, Sequence
+
+from dragonfly2_tpu.client import source as source_mod
+from dragonfly2_tpu.client.downloader import (
+    DownloadPieceError,
+    DownloadPieceRequest,
+    DownloadPieceResult,
+    DispatcherClosedError,
+    PieceDispatcher,
+    PieceDownloader,
+)
+from dragonfly2_tpu.client.piece import (
+    PieceMetadata,
+    compute_piece_count,
+    compute_piece_size,
+    piece_range,
+)
+from dragonfly2_tpu.client.storage import (
+    StorageManager,
+    TaskStorage,
+    WritePieceRequest,
+)
+from dragonfly2_tpu.client.traffic_shaper import PlainTrafficShaper, TrafficShaper
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    RegisterPeerRequest,
+    RegisterPeerResponse,
+)
+from dragonfly2_tpu.utils import digest as digestutil
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+logger = logging.getLogger(__name__)
+
+TRAFFIC_REMOTE_PEER = "remote_peer"
+TRAFFIC_BACK_TO_SOURCE = "back_to_source"
+
+
+class SchedulerAPI(Protocol):
+    """What the conductor needs from a scheduler (in-process service or
+    gRPC adapter — method-for-method with SchedulerService)."""
+
+    def announce_host(self, host) -> None: ...
+    def register_peer(self, req: RegisterPeerRequest, channel=None) -> RegisterPeerResponse: ...
+    def download_peer_started(self, peer_id: str) -> None: ...
+    def download_peer_back_to_source_started(self, peer_id: str) -> None: ...
+    def download_piece_finished(self, report: PieceFinished) -> None: ...
+    def download_piece_failed(self, peer_id: str, parent_id: str, piece_number: int) -> None: ...
+    def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None: ...
+    def download_peer_back_to_source_finished(
+        self, peer_id: str, content_length: int, total_piece_count: int,
+        cost_seconds: float = 0.0) -> None: ...
+    def download_peer_failed(self, peer_id: str) -> None: ...
+    def download_peer_back_to_source_failed(self, peer_id: str) -> None: ...
+
+
+# ----------------------------------------------------------------------
+# Scheduling decisions delivered to the conductor
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParentInfo:
+    peer_id: str
+    addr: str  # host:download_port of the parent's upload server
+
+
+@dataclass(frozen=True)
+class CandidateParents:
+    parents: Sequence[ParentInfo]
+
+
+@dataclass(frozen=True)
+class NeedBackToSource:
+    reason: str
+
+
+class QueueChannel:
+    """PeerChannel bound to a conductor-side queue — the in-process stand-in
+    for the v2 AnnouncePeer response stream."""
+
+    def __init__(self) -> None:
+        self.decisions: "queue.Queue" = queue.Queue()
+        self.closed = False
+
+    # scheduling.core.PeerChannel protocol (receives scheduler-side peers)
+    def send_candidate_parents(self, peer, parents) -> bool:
+        if self.closed:
+            return False
+        infos = [
+            ParentInfo(p.id, f"{p.host.ip}:{p.host.download_port}")
+            for p in parents
+        ]
+        self.decisions.put(CandidateParents(infos))
+        return True
+
+    def send_need_back_to_source(self, peer, description: str) -> bool:
+        if self.closed:
+            return False
+        self.decisions.put(NeedBackToSource(description))
+        return True
+
+    def close(self) -> None:
+        self.closed = True
+
+
+# ----------------------------------------------------------------------
+# Conductor
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class PeerTaskOptions:
+    piece_concurrency: int = 4
+    back_source_concurrency: int = 4
+    metadata_poll_interval: float = 0.2
+    timeout: float = 120.0
+    random_ratio: float = 0.1  # dispatcher exploration
+
+
+@dataclass
+class PeerTaskResult:
+    task_id: str
+    peer_id: str
+    success: bool
+    content_length: int = -1
+    direct_bytes: bytes | None = None  # EMPTY/TINY fast-path payload
+    storage: Optional[TaskStorage] = None
+    error: str = ""
+
+    def read_all(self) -> bytes:
+        if self.direct_bytes is not None:
+            return self.direct_bytes
+        if self.storage is None:
+            raise RuntimeError("no storage for task")
+        return b"".join(self.storage.iter_content())
+
+    def save_to(self, path: str) -> None:
+        if self.direct_bytes is not None:
+            with open(path, "wb") as f:
+                f.write(self.direct_bytes)
+            return
+        if self.storage is None:
+            raise RuntimeError("no storage for task")
+        with open(path, "wb") as f:
+            for chunk in self.storage.iter_content():
+                f.write(chunk)
+
+
+class PeerTaskConductor:
+    """Drives one peer download end to end
+    (peertask_conductor.go:174-380 newPeerTaskConductor/start)."""
+
+    def __init__(
+        self,
+        scheduler: SchedulerAPI,
+        storage: StorageManager,
+        *,
+        host_id: str,
+        task_id: str,
+        peer_id: str,
+        url: str,
+        request_header: Dict[str, str] | None = None,
+        shaper: TrafficShaper | None = None,
+        options: PeerTaskOptions | None = None,
+        is_seed: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.storage_manager = storage
+        self.host_id = host_id
+        self.task_id = task_id
+        self.peer_id = peer_id
+        self.url = url
+        self.request_header = dict(request_header or {})
+        self.shaper = shaper or PlainTrafficShaper()
+        self.opts = options or PeerTaskOptions()
+        self.is_seed = is_seed
+
+        self.channel = QueueChannel()
+        self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
+        self.downloader = PieceDownloader()
+        self.store: Optional[TaskStorage] = None
+        self.content_length = -1
+        self.total_pieces = -1
+        self.piece_size = compute_piece_size(-1)
+
+        self._done = threading.Event()
+        self._success = False
+        self._error = ""
+        self._enqueued: set[int] = set()
+        self._written_lock = threading.Lock()
+        self._written: set[int] = set()
+        self._sync_stop = threading.Event()
+        self._syncers: Dict[str, threading.Thread] = {}
+        self._workers: List[threading.Thread] = []
+        self._started_at = 0.0
+
+    # -- public entry ------------------------------------------------------
+
+    def run(self) -> PeerTaskResult:
+        self._started_at = time.monotonic()
+        try:
+            register = RegisterPeerRequest(
+                host_id=self.host_id, task_id=self.task_id,
+                peer_id=self.peer_id, url=self.url,
+                request_header=self.request_header,
+            )
+            try:
+                resp = self.scheduler.register_peer(register, channel=self.channel)
+            except Exception as exc:
+                # Scheduler unreachable → degrade to pure back-to-source,
+                # like the conductor's dummy-scheduler fallback
+                # (peertask_conductor.go:285-289).
+                logger.warning("register failed (%s); back-to-source", exc)
+                return self._run_back_to_source(report=False)
+
+            from dragonfly2_tpu.scheduler.resource.task import SizeScope
+
+            if resp.size_scope == SizeScope.EMPTY:
+                return PeerTaskResult(self.task_id, self.peer_id, True,
+                                      content_length=0, direct_bytes=b"")
+            if resp.size_scope == SizeScope.TINY and resp.direct_piece:
+                return PeerTaskResult(
+                    self.task_id, self.peer_id, True,
+                    content_length=len(resp.direct_piece),
+                    direct_bytes=resp.direct_piece,
+                )
+
+            self.store = self.storage_manager.register_task(
+                self.task_id, self.peer_id
+            )
+            if resp.content_length >= 0:
+                self._learn_length(resp.content_length, resp.total_piece_count)
+
+            try:
+                self.scheduler.download_peer_started(self.peer_id)
+            except Exception as exc:
+                logger.warning("download started failed (%s); back-to-source", exc)
+                return self._run_back_to_source(report=False)
+
+            return self._pull_pieces()
+        finally:
+            self._shutdown_workers()
+
+    # -- scheduling decision loop (receivePeerPacket / pullPiecesWithP2P) --
+
+    def _pull_pieces(self) -> PeerTaskResult:
+        self._start_workers()
+        deadline = time.monotonic() + self.opts.timeout
+        while not self._done.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return self._fail("peer task timeout")
+            try:
+                decision = self.channel.decisions.get(timeout=min(remaining, 0.5))
+            except queue.Empty:
+                self._check_finished()
+                continue
+            if isinstance(decision, NeedBackToSource):
+                logger.info("peer %s told to back-to-source: %s",
+                            self.peer_id, decision.reason)
+                return self._run_back_to_source(report=True)
+            if isinstance(decision, CandidateParents):
+                for parent in decision.parents:
+                    self._start_syncer(parent)
+        if self._success:
+            return PeerTaskResult(
+                self.task_id, self.peer_id, True,
+                content_length=self.content_length, storage=self.store,
+            )
+        return PeerTaskResult(self.task_id, self.peer_id, False,
+                              storage=self.store, error=self._error)
+
+    # -- piece metadata sync per parent (synchronizer role) ----------------
+
+    def _start_syncer(self, parent: ParentInfo) -> None:
+        if parent.peer_id == self.peer_id:
+            return
+        # Replace dead syncers: a reschedule may re-offer a parent whose
+        # previous sync thread already exited, and a failed piece can only
+        # be re-enqueued by a live syncer.
+        existing = self._syncers.get(parent.peer_id)
+        if existing is not None and existing.is_alive():
+            return
+        t = threading.Thread(
+            target=self._sync_parent, args=(parent,),
+            name=f"piece-sync-{parent.peer_id[:8]}", daemon=True,
+        )
+        self._syncers[parent.peer_id] = t
+        t.start()
+
+    def _sync_parent(self, parent: ParentInfo) -> None:
+        url = (
+            f"http://{parent.addr}/metadata/{self.task_id}"
+            f"?peerId={parent.peer_id}"
+        )
+        failures = 0
+        while not self._sync_stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    meta = json.loads(resp.read())
+                failures = 0
+                if meta.get("contentLength", -1) >= 0:
+                    self._learn_length(meta["contentLength"],
+                                       meta.get("totalPieces", -1))
+                for p in meta.get("pieces", []):
+                    self._enqueue_piece(parent, PieceMetadata(
+                        num=p["num"], md5=p.get("md5", ""),
+                        offset=p["offset"], start=p["start"],
+                        length=p["length"],
+                    ))
+                # Stay alive until the task completes: pieces that fail
+                # download are discarded from _enqueued and only a live
+                # syncer poll re-enqueues them.
+                if meta.get("done") and self._all_written():
+                    return
+            except Exception as exc:
+                failures += 1
+                logger.debug("metadata sync %s failed (%d): %s",
+                             parent.addr, failures, exc)
+                if failures >= 3:
+                    # Watchdog gives up on the parent
+                    # (peertask_piecetask_synchronizer.go:70 watchdog).
+                    self._report_piece_failed(parent.peer_id, -1)
+                    return
+            self._sync_stop.wait(self.opts.metadata_poll_interval)
+
+    def _all_written(self) -> bool:
+        if self.total_pieces < 0:
+            return False
+        with self._written_lock:
+            return len(self._written) >= self.total_pieces
+
+    def _enqueue_piece(self, parent: ParentInfo, piece: PieceMetadata) -> None:
+        with self._written_lock:
+            # Dedup on _enqueued alone: retry re-entry happens by the
+            # failure path discarding the piece from _enqueued.
+            if piece.num in self._enqueued or piece.num in self._written:
+                return
+            self._enqueued.add(piece.num)
+        self.dispatcher.put(DownloadPieceRequest(
+            task_id=self.task_id, src_peer_id=self.peer_id,
+            dst_peer_id=parent.peer_id, dst_addr=parent.addr, piece=piece,
+        ))
+
+    # -- piece download workers (downloadPieceWorker) ----------------------
+
+    def _start_workers(self) -> None:
+        for i in range(self.opts.piece_concurrency):
+            t = threading.Thread(
+                target=self._piece_worker, name=f"piece-worker-{i}", daemon=True
+            )
+            self._workers.append(t)
+            t.start()
+
+    def _piece_worker(self) -> None:
+        while not self._done.is_set():
+            try:
+                req = self.dispatcher.get(timeout=0.2)
+            except DispatcherClosedError:
+                return
+            if req is None:
+                continue
+            with self._written_lock:
+                if req.piece.num in self._written:
+                    continue
+            self.shaper.wait_n(self.task_id, req.piece.length)
+            begin = time.monotonic_ns()
+            try:
+                data = self.downloader.download_piece(req)
+            except DownloadPieceError as exc:
+                logger.debug("piece %d from %s failed: %s",
+                             req.piece.num, req.dst_peer_id, exc)
+                self.dispatcher.report(DownloadPieceResult(
+                    req.dst_peer_id, req.piece.num, fail=True))
+                self._report_piece_failed(req.dst_peer_id, req.piece.num)
+                # Requeue for another parent (or the same one later).
+                with self._written_lock:
+                    self._enqueued.discard(req.piece.num)
+                continue
+            cost = time.monotonic_ns() - begin
+            self.dispatcher.report(DownloadPieceResult(
+                req.dst_peer_id, req.piece.num, fail=False, cost_ns=cost))
+            self._store_piece(req, data, cost)
+
+    def _store_piece(self, req: DownloadPieceRequest, data: bytes,
+                     cost_ns: int) -> None:
+        piece = req.piece
+        try:
+            self.store.write_piece(
+                WritePieceRequest(self.task_id, self.peer_id, piece),
+                io.BytesIO(data),
+            )
+        except Exception as exc:
+            logger.warning("store piece %d failed: %s", piece.num, exc)
+            self._report_piece_failed(req.dst_peer_id, piece.num)
+            with self._written_lock:
+                self._enqueued.discard(piece.num)
+            return
+        with self._written_lock:
+            self._written.add(piece.num)
+        self.shaper.record(self.task_id, piece.length)
+        try:
+            self.scheduler.download_piece_finished(PieceFinished(
+                peer_id=self.peer_id, piece_number=piece.num,
+                parent_id=req.dst_peer_id, offset=piece.offset,
+                length=piece.length, digest=f"md5:{piece.md5}" if piece.md5 else "",
+                cost_ns=cost_ns, traffic_type=TRAFFIC_REMOTE_PEER,
+            ))
+        except Exception:
+            logger.debug("piece finished report failed", exc_info=True)
+        self._check_finished()
+
+    def _report_piece_failed(self, parent_id: str, piece_number: int) -> None:
+        try:
+            self.scheduler.download_piece_failed(
+                self.peer_id, parent_id, piece_number)
+        except Exception:
+            logger.debug("piece failed report failed", exc_info=True)
+
+    # -- completion --------------------------------------------------------
+
+    def _learn_length(self, content_length: int, total_pieces: int) -> None:
+        if content_length < 0 or self.content_length >= 0:
+            return
+        self.content_length = content_length
+        self.piece_size = compute_piece_size(content_length)
+        self.total_pieces = (
+            total_pieces if total_pieces and total_pieces > 0
+            else compute_piece_count(content_length, self.piece_size)
+        )
+        if self.store is not None:
+            self.store.update(content_length=content_length,
+                              total_pieces=self.total_pieces)
+
+    def _check_finished(self) -> None:
+        if self._done.is_set() or self.total_pieces < 0:
+            return
+        with self._written_lock:
+            complete = len(self._written) >= self.total_pieces
+        if not complete:
+            return
+        try:
+            self.store.mark_done()
+        except Exception as exc:
+            self._fail(f"finalize failed: {exc}")
+            return
+        cost = time.monotonic() - self._started_at
+        try:
+            self.scheduler.download_peer_finished(self.peer_id, cost)
+        except Exception:
+            logger.debug("peer finished report failed", exc_info=True)
+        self._success = True
+        self._done.set()
+
+    def _fail(self, error: str) -> PeerTaskResult:
+        self._error = error
+        self._success = False
+        self._done.set()
+        try:
+            self.scheduler.download_peer_failed(self.peer_id)
+        except Exception:
+            pass
+        return PeerTaskResult(self.task_id, self.peer_id, False,
+                              storage=self.store, error=error)
+
+    def _shutdown_workers(self) -> None:
+        self._done.set()
+        self._sync_stop.set()
+        self.dispatcher.close()
+        self.channel.close()
+        for t in self._workers:
+            t.join(timeout=2)
+        for t in self._syncers.values():
+            t.join(timeout=2)
+
+    # -- back-to-source (pullPiecesFromSource / DownloadSource) ------------
+
+    def _run_back_to_source(self, report: bool = True) -> PeerTaskResult:
+        if self.store is None:
+            self.store = self.storage_manager.register_task(
+                self.task_id, self.peer_id
+            )
+        if report:
+            try:
+                self.scheduler.download_peer_back_to_source_started(self.peer_id)
+            except Exception:
+                logger.debug("back-to-source started report failed", exc_info=True)
+        try:
+            content_length, total = self._download_source()
+        except Exception as exc:
+            if report:
+                try:
+                    self.scheduler.download_peer_back_to_source_failed(self.peer_id)
+                except Exception:
+                    pass
+            self._error = f"back-to-source failed: {exc}"
+            return PeerTaskResult(self.task_id, self.peer_id, False,
+                                  storage=self.store, error=self._error)
+        cost = time.monotonic() - self._started_at
+        if report:
+            try:
+                self.scheduler.download_peer_back_to_source_finished(
+                    self.peer_id, content_length, total, cost)
+            except Exception:
+                logger.debug("back-to-source finished report failed",
+                             exc_info=True)
+        self._success = True
+        return PeerTaskResult(self.task_id, self.peer_id, True,
+                              content_length=content_length, storage=self.store)
+
+    def _download_source(self) -> tuple[int, int]:
+        """(piece_manager.go:301 DownloadSource; known-length concurrent
+        ranged path at :791-891, unknown-length stream at :535)."""
+        request = source_mod.Request(self.url, dict(self.request_header))
+        client = source_mod.client_for(request)
+        length = client.get_content_length(request)
+        ranged = length >= 0 and client.is_support_range(request)
+        if not ranged:
+            return self._download_source_stream(request)
+
+        self._learn_length(length, -1)
+        total = self.total_pieces
+        piece_queue: "queue.Queue[int]" = queue.Queue()
+        for num in range(total):
+            piece_queue.put(num)
+        errors: List[str] = []
+        lock = threading.Lock()
+
+        def fetch(num: int) -> None:
+            rng = piece_range(num, self.piece_size, length)
+            begin = time.monotonic_ns()
+            try:
+                self.shaper.wait_n(self.task_id, rng.length)
+                resp = client.download(
+                    source_mod.Request(self.url, dict(self.request_header),
+                                       rng=rng))
+                reader = digestutil.DigestReader(resp.body, "md5")
+                self.store.write_piece(
+                    WritePieceRequest(
+                        self.task_id, self.peer_id,
+                        PieceMetadata(num=num, md5="", offset=rng.start,
+                                      start=rng.start, length=rng.length),
+                    ),
+                    reader,
+                )
+                resp.close()
+            except Exception as exc:
+                with lock:
+                    errors.append(f"piece {num}: {exc}")
+                return
+            cost = time.monotonic_ns() - begin
+            # Record the piece md5 observed on the wire so children can
+            # verify (back-source pieces define the task's truth).
+            self.store.set_piece_digest(num, reader.hexdigest(), cost)
+            self.shaper.record(self.task_id, rng.length)
+            try:
+                self.scheduler.download_piece_finished(PieceFinished(
+                    peer_id=self.peer_id, piece_number=num, parent_id="",
+                    offset=rng.start, length=rng.length,
+                    digest=f"md5:{reader.hexdigest()}", cost_ns=cost,
+                    traffic_type=TRAFFIC_BACK_TO_SOURCE,
+                ))
+            except Exception:
+                logger.debug("piece report failed", exc_info=True)
+
+        def worker() -> None:
+            while True:
+                try:
+                    num = piece_queue.get_nowait()
+                except queue.Empty:
+                    return
+                fetch(num)
+
+        threads = [
+            threading.Thread(target=worker, daemon=True,
+                             name=f"back-source-{i}")
+            for i in range(min(self.opts.back_source_concurrency, total) or 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+        self.store.mark_done()
+        return length, total
+
+    def _download_source_stream(self, request: source_mod.Request) -> tuple[int, int]:
+        """Unknown length / no range support: single sequential stream cut
+        into pieces as it arrives (piece_manager.go:535)."""
+        resp = source_mod.download(request)
+        num = 0
+        offset = 0
+        piece_size = self.piece_size
+        while True:
+            data = resp.body.read(piece_size)
+            if not data:
+                break
+            md5 = digestutil.hash_bytes(data, "md5")
+            self.store.write_piece(
+                WritePieceRequest(
+                    self.task_id, self.peer_id,
+                    PieceMetadata(num=num, md5=md5, offset=offset,
+                                  start=offset, length=len(data)),
+                ),
+                io.BytesIO(data),
+            )
+            try:
+                self.scheduler.download_piece_finished(PieceFinished(
+                    peer_id=self.peer_id, piece_number=num, parent_id="",
+                    offset=offset, length=len(data), digest=f"md5:{md5}",
+                    traffic_type=TRAFFIC_BACK_TO_SOURCE,
+                ))
+            except Exception:
+                logger.debug("piece report failed", exc_info=True)
+            offset += len(data)
+            num += 1
+        resp.close()
+        self.store.update(content_length=offset, total_pieces=num)
+        self.content_length = offset
+        self.total_pieces = num
+        self.store.mark_done()
+        return offset, num

@@ -139,6 +139,18 @@ class TaskStorage:
             self.meta.pieces[piece.num] = final
         return written
 
+    def set_piece_digest(self, num: int, md5: str, cost_ns: int = 0) -> None:
+        """Attach an after-the-fact digest to a stored piece (the
+        back-to-source path learns the md5 from the wire while writing)."""
+        with self._lock:
+            existing = self.meta.pieces.get(num)
+            if existing is None:
+                raise StorageError(f"piece {num} not present")
+            self.meta.pieces[num] = PieceMetadata(
+                num=num, md5=md5, offset=existing.offset,
+                start=existing.start, length=existing.length, cost_ns=cost_ns,
+            )
+
     def update(self, content_length: int | None = None,
                total_pieces: int | None = None,
                piece_md5_sign: str | None = None,

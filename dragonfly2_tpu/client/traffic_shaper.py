@@ -1,0 +1,162 @@
+"""Per-task bandwidth allocation.
+
+Reference counterpart: client/daemon/peer/traffic_shaper.go:36-271 — two
+strategies: ``plain`` (every task draws from one global token bucket) and
+``sampling`` (per-second usage sampling; each task gets a per-task limiter
+whose rate is recomputed from observed demand, surplus redistributed to
+needy tasks, with a bandwidth floor of one piece size per task).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict
+
+from dragonfly2_tpu.client.piece import DEFAULT_PIECE_SIZE
+from dragonfly2_tpu.utils.ratelimit import INF, Limiter
+
+TYPE_PLAIN = "plain"
+TYPE_SAMPLING = "sampling"
+
+
+class TrafficShaper:
+    """Interface (traffic_shaper.go:36-54)."""
+
+    def start(self) -> None: ...
+    def stop(self) -> None: ...
+    def add_task(self, task_id: str, content_length: int = -1) -> None: ...
+    def remove_task(self, task_id: str) -> None: ...
+    def record(self, task_id: str, n: int) -> None:
+        """Account ``n`` bytes downloaded for the task."""
+
+    def wait_n(self, task_id: str, n: int) -> None:
+        """Block until the task may transfer ``n`` bytes."""
+
+
+class PlainTrafficShaper(TrafficShaper):
+    """All tasks share the global limiter (traffic_shaper.go plain mode)."""
+
+    def __init__(self, total_rate_bps: float = INF):
+        self._limiter = Limiter(total_rate_bps,
+                                burst=int(total_rate_bps) if total_rate_bps != INF else None)
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def add_task(self, task_id: str, content_length: int = -1) -> None:
+        pass
+
+    def remove_task(self, task_id: str) -> None:
+        pass
+
+    def record(self, task_id: str, n: int) -> None:
+        pass
+
+    def wait_n(self, task_id: str, n: int) -> None:
+        self._limiter.wait_n(min(n, self._limiter.burst))
+
+
+@dataclass
+class _TaskEntry:
+    limiter: Limiter
+    used: int = 0           # bytes since last sample
+    needed: int = 0         # bytes requested since last sample
+    content_length: int = -1
+    created_at: float = field(default_factory=time.time)
+
+
+class SamplingTrafficShaper(TrafficShaper):
+    """Per-second demand sampling with surplus redistribution
+    (traffic_shaper.go:139-271)."""
+
+    def __init__(self, total_rate_bps: float, interval: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.total_rate = float(total_rate_bps)
+        self.interval = interval
+        self._clock = clock
+        self._tasks: Dict[str, _TaskEntry] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="traffic-shaper", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.update_limits()
+
+    def add_task(self, task_id: str, content_length: int = -1) -> None:
+        with self._lock:
+            # A new task starts with an equal share of the total rate
+            # (traffic_shaper.go AddTask: totalRateLimit / (nTasks+1)).
+            n = len(self._tasks) + 1
+            share = self.total_rate / n
+            self._tasks[task_id] = _TaskEntry(
+                limiter=Limiter(share, burst=int(share)),
+                content_length=content_length,
+            )
+
+    def remove_task(self, task_id: str) -> None:
+        with self._lock:
+            self._tasks.pop(task_id, None)
+
+    def record(self, task_id: str, n: int) -> None:
+        with self._lock:
+            entry = self._tasks.get(task_id)
+            if entry is not None:
+                entry.used += n
+
+    def wait_n(self, task_id: str, n: int) -> None:
+        with self._lock:
+            entry = self._tasks.get(task_id)
+            if entry is not None:
+                entry.needed += n
+                limiter = entry.limiter
+            else:
+                limiter = None
+        if limiter is not None:
+            limiter.wait_n(min(n, limiter.burst))
+
+    def update_limits(self) -> None:
+        """Recompute per-task rates from last-interval demand: tasks that
+        used less than their allocation donate the surplus to those that
+        wanted more, floored at one piece size/sec each."""
+        with self._lock:
+            if not self._tasks:
+                return
+            entries = list(self._tasks.values())
+            demands = [max(e.used, e.needed) for e in entries]
+            total_demand = sum(demands)
+            for entry, demand in zip(entries, demands):
+                if total_demand > 0:
+                    share = self.total_rate * (demand / total_demand)
+                else:
+                    share = self.total_rate / len(entries)
+                share = min(max(share, DEFAULT_PIECE_SIZE), self.total_rate)
+                entry.limiter.set_rate(share, burst=int(share))
+                entry.used = 0
+                entry.needed = 0
+
+
+def new_traffic_shaper(kind: str, total_rate_bps: float = INF) -> TrafficShaper:
+    """(traffic_shaper.go:36-54 NewTrafficShaper)"""
+    if kind == TYPE_SAMPLING and total_rate_bps != INF:
+        return SamplingTrafficShaper(total_rate_bps)
+    return PlainTrafficShaper(total_rate_bps)

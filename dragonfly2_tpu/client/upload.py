@@ -23,6 +23,7 @@ from dragonfly2_tpu.utils.ratelimit import INF, Limiter
 logger = logging.getLogger(__name__)
 
 ROUTE_DOWNLOAD = "/download"
+ROUTE_METADATA = "/metadata"
 ROUTE_HEALTHY = "/healthy"
 
 
@@ -80,6 +81,9 @@ class UploadServer:
             req.end_headers()
             req.wfile.write(body)
             return
+        if parsed.path.startswith(ROUTE_METADATA + "/"):
+            self._handle_metadata(req, parsed)
+            return
         if not parsed.path.startswith(ROUTE_DOWNLOAD + "/"):
             req.send_error(404)
             return
@@ -120,3 +124,43 @@ class UploadServer:
         )
         req.end_headers()
         req.wfile.write(data)
+
+    def _handle_metadata(self, req: BaseHTTPRequestHandler, parsed) -> None:
+        """``GET /metadata/{task_id}?peerId=`` — the parent's piece
+        inventory. Plays the role of the reference's peer-to-peer piece
+        metadata sync (dfdaemon GetPieceTasks / SyncPieceTasks,
+        client/daemon/rpcserver/rpcserver.go:934,1079) over the same HTTP
+        server that serves the piece bytes."""
+        import json
+
+        task_id = parsed.path[len(ROUTE_METADATA) + 1:]
+        query = urllib.parse.parse_qs(parsed.query)
+        peer_id = (query.get("peerId") or [""])[0]
+        store = self.storage.get(task_id, peer_id) if peer_id else None
+        if store is None or not store.meta.pieces:
+            # Prefer a completed replica, but a registered-and-still-empty
+            # store (a seed mid-back-source) must answer 200 with an empty
+            # piece list — 404 would trip the child's sync watchdog and
+            # permanently block a healthy parent.
+            store = self.storage.find_completed_task(task_id) or store
+        if store is None:
+            req.send_error(404, f"task {task_id} unknown")
+            return
+        meta = store.meta
+        body = json.dumps({
+            "taskId": task_id,
+            "peerId": meta.peer_id,
+            "contentLength": meta.content_length,
+            "totalPieces": meta.total_pieces,
+            "done": meta.done,
+            "pieces": [
+                {"num": p.num, "md5": p.md5, "offset": p.offset,
+                 "start": p.start, "length": p.length}
+                for p in (meta.pieces[n] for n in store.existing_piece_nums())
+            ],
+        }).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)

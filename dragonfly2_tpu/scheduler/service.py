@@ -1,0 +1,438 @@
+"""Scheduler service — the announce/probe event loop over the resource model.
+
+Reference counterpart: scheduler/service/service_v2.go:88-1459 (AnnouncePeer
+dispatch and its typed sub-request handlers) plus the v1-only pieces our
+clients still need (createDownloadRecord, service_v1.go:1418). Transport
+neutral: gRPC binds these methods to a stream (rpc layer), the in-process
+harness calls them directly. Scheduling decisions reach the peer through its
+``announce_channel`` (see scheduling.core.PeerChannel).
+
+Flow per download (call stack 3.2 in SURVEY.md):
+  register_peer → (size-scope fast path | normal) → download_peer_started →
+  schedule_candidate_parents → piece finished/failed reports →
+  download_peer_finished → Download record appended to the dataset sink.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from dragonfly2_tpu.schema import records as schema
+from dragonfly2_tpu.scheduler.networktopology.store import NetworkTopologyStore, Probe
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerEvent, PeerState
+from dragonfly2_tpu.scheduler.resource.resource import Resource
+from dragonfly2_tpu.scheduler.resource.task import (
+    Piece,
+    SizeScope,
+    Task,
+    TaskEvent,
+    TaskState,
+)
+from dragonfly2_tpu.scheduler.scheduling.core import ScheduleError, Scheduling
+from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+logger = logging.getLogger(__name__)
+
+
+class ServiceError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+NOT_FOUND = "NotFound"
+INVALID_ARGUMENT = "InvalidArgument"
+FAILED_PRECONDITION = "FailedPrecondition"
+
+
+@dataclass
+class RegisterPeerRequest:
+    host_id: str
+    task_id: str
+    peer_id: str
+    url: str = ""
+    tag: str = ""
+    application: str = ""
+    priority: int = 0
+    filtered_query_params: List[str] = field(default_factory=list)
+    request_header: Dict[str, str] = field(default_factory=dict)
+    piece_length: int = 0
+    need_back_to_source: bool = False
+
+
+@dataclass
+class RegisterPeerResponse:
+    """Size-scope dispatch result (service_v2.go:829-982)."""
+
+    size_scope: SizeScope
+    direct_piece: bytes = b""  # TINY payload, inline
+    content_length: int = -1
+    total_piece_count: int = 0
+
+
+@dataclass
+class PieceFinished:
+    peer_id: str
+    piece_number: int
+    parent_id: str = ""  # empty for back-to-source
+    offset: int = 0
+    length: int = 0
+    digest: str = ""
+    cost_ns: int = 0
+    traffic_type: str = "remote_peer"
+
+
+@dataclass
+class ProbeResult:
+    """One measured RTT from the probing host to ``dest_host_id``."""
+
+    dest_host_id: str
+    rtt_seconds: float
+    created_at: float = field(default_factory=time.time)
+
+
+class SchedulerService:
+    """One scheduler instance's service surface."""
+
+    def __init__(
+        self,
+        resource: Resource,
+        scheduling: Scheduling,
+        storage: Optional[Storage] = None,
+        network_topology: Optional[NetworkTopologyStore] = None,
+        seed_peer_client=None,
+    ):
+        self.resource = resource
+        self.scheduling = scheduling
+        self.storage = storage
+        self.network_topology = network_topology
+        # SeedPeerClient protocol: trigger_task(task, url_meta) — implemented
+        # by the daemon's seeder binding (resource/seed_peer.go:101).
+        self.seed_peer_client = seed_peer_client
+
+    # ------------------------------------------------------------------
+    # Host lifecycle (service_v2.go:AnnounceHost at 594, LeaveHost at 658)
+    # ------------------------------------------------------------------
+
+    def announce_host(self, host: Host) -> None:
+        existing = self.resource.host_manager.load(host.id)
+        if existing is None:
+            self.resource.host_manager.store(host)
+            return
+        # Refresh telemetry in place — identity fields are immutable.
+        for attr in ("ip", "port", "download_port", "cpu", "memory",
+                     "network", "disk", "build", "concurrent_upload_limit"):
+            setattr(existing, attr, getattr(host, attr))
+        existing.touch()
+
+    def leave_host(self, host_id: str) -> None:
+        host = self.resource.host_manager.load(host_id)
+        if host is None:
+            raise ServiceError(NOT_FOUND, f"host {host_id} not found")
+        host.leave_peers()
+        if self.network_topology is not None:
+            self.network_topology.delete_host(host_id)
+        self.resource.host_manager.delete(host_id)
+
+    # ------------------------------------------------------------------
+    # Peer registration (service_v2.go:829-982 handleRegisterPeerRequest)
+    # ------------------------------------------------------------------
+
+    def register_peer(self, req: RegisterPeerRequest,
+                      channel=None) -> RegisterPeerResponse:
+        host = self.resource.host_manager.load(req.host_id)
+        if host is None:
+            raise ServiceError(NOT_FOUND, f"host {req.host_id} not announced")
+        task = self.resource.task_manager.load_or_store(
+            Task(req.task_id, url=req.url, tag=req.tag,
+                 application=req.application,
+                 filtered_query_params=req.filtered_query_params,
+                 request_header=req.request_header,
+                 piece_length=req.piece_length)
+        )
+        peer = self.resource.peer_manager.load_or_store(
+            Peer(req.peer_id, task, host, tag=req.tag,
+                 application=req.application, priority=req.priority)
+        )
+        peer.need_back_to_source = req.need_back_to_source
+        if channel is not None:
+            peer.announce_channel = channel
+
+        self._maybe_trigger_seed_peer(task)
+
+        scope = task.size_scope()
+        if task.fsm.is_state(TaskState.SUCCEEDED) and scope == SizeScope.EMPTY:
+            peer.fsm.fire(PeerEvent.REGISTER_EMPTY)
+            return RegisterPeerResponse(SizeScope.EMPTY, content_length=0)
+        if (task.fsm.is_state(TaskState.SUCCEEDED) and scope == SizeScope.TINY
+                and task.direct_piece):
+            peer.fsm.fire(PeerEvent.REGISTER_TINY)
+            return RegisterPeerResponse(
+                SizeScope.TINY, direct_piece=task.direct_piece,
+                content_length=task.content_length,
+                total_piece_count=task.total_piece_count,
+            )
+        if scope == SizeScope.SMALL and task.has_available_peer():
+            peer.fsm.fire(PeerEvent.REGISTER_SMALL)
+        else:
+            peer.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        return RegisterPeerResponse(
+            SizeScope.NORMAL if scope in (SizeScope.UNKNOW,) else scope,
+            content_length=task.content_length,
+            total_piece_count=task.total_piece_count,
+        )
+
+    def _maybe_trigger_seed_peer(self, task: Task) -> None:
+        """First download of a pending task fans a seed-peer back-source
+        trigger (service_v2.go:1308 downloadTaskBySeedPeer; async like the
+        reference's goroutine)."""
+        if self.seed_peer_client is None:
+            return
+        if not task.fsm.is_state(TaskState.PENDING):
+            return
+        if task.fsm.can(TaskEvent.DOWNLOAD):
+            task.fsm.fire(TaskEvent.DOWNLOAD)
+        threading.Thread(
+            target=self._trigger_seed_peer_safe, args=(task,),
+            name=f"seed-trigger-{task.id[:8]}", daemon=True,
+        ).start()
+
+    def _trigger_seed_peer_safe(self, task: Task) -> None:
+        try:
+            self.seed_peer_client.trigger_task(task)
+        except Exception:
+            logger.exception("seed peer trigger failed for task %s", task.id)
+
+    # ------------------------------------------------------------------
+    # Download lifecycle
+    # ------------------------------------------------------------------
+
+    def download_peer_started(self, peer_id: str) -> None:
+        """(service_v2.go DownloadPeerStartedRequest) → schedule."""
+        peer = self._peer(peer_id)
+        if peer.task.fsm.can(TaskEvent.DOWNLOAD):
+            peer.task.fsm.fire(TaskEvent.DOWNLOAD)
+        peer.fsm.fire(PeerEvent.DOWNLOAD)
+        self.scheduling.schedule_candidate_parents(peer, set(peer.block_parents))
+
+    def download_peer_back_to_source_started(self, peer_id: str) -> None:
+        peer = self._peer(peer_id)
+        if peer.task.fsm.can(TaskEvent.DOWNLOAD):
+            peer.task.fsm.fire(TaskEvent.DOWNLOAD)
+        peer.fsm.fire(PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+        peer.task.back_to_source_peers.add(peer.id)
+
+    def download_piece_finished(self, report: PieceFinished) -> None:
+        """(service_v2.go:1095 handleDownloadPieceFinishedRequest)"""
+        peer = self._peer(report.peer_id)
+        piece = Piece(
+            number=report.piece_number, parent_id=report.parent_id,
+            offset=report.offset, length=report.length,
+            digest=report.digest, cost=report.cost_ns / 1e9,
+            traffic_type=report.traffic_type,
+        )
+        peer.store_piece(piece)
+        # Back-to-source pieces become task pieces (the metadata other
+        # peers will sync).
+        if not report.parent_id:
+            peer.task.store_piece(piece)
+        parent = self.resource.peer_manager.load(report.parent_id) \
+            if report.parent_id else None
+        if parent is not None:
+            parent.piece_updated_at = time.time()
+
+    def download_piece_failed(self, peer_id: str, parent_id: str,
+                              piece_number: int) -> None:
+        """(service_v2.go handleDownloadPieceFailedRequest) — block the
+        failing parent and reschedule."""
+        peer = self._peer(peer_id)
+        if parent_id:
+            peer.block_parents.add(parent_id)
+        self.scheduling.schedule_candidate_parents(peer, set(peer.block_parents))
+
+    def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
+        peer = self._peer(peer_id)
+        peer.cost = cost_seconds
+        peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        self._create_download_record(peer)
+
+    def download_peer_back_to_source_finished(
+        self, peer_id: str, content_length: int, total_piece_count: int,
+        cost_seconds: float = 0.0,
+    ) -> None:
+        peer = self._peer(peer_id)
+        peer.cost = cost_seconds
+        peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        task = peer.task
+        task.report_success(content_length, total_piece_count)
+        if task.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
+            task.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
+        self._create_download_record(peer)
+
+    def download_peer_failed(self, peer_id: str) -> None:
+        peer = self._peer(peer_id)
+        peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
+        peer.task.peer_failed_count += 1
+        self._create_download_record(peer)
+
+    def download_peer_back_to_source_failed(self, peer_id: str) -> None:
+        peer = self._peer(peer_id)
+        peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
+        task = peer.task
+        task.back_to_source_peers.discard(peer.id)
+        if task.fsm.can(TaskEvent.DOWNLOAD_FAILED):
+            task.fsm.fire(TaskEvent.DOWNLOAD_FAILED)
+        # Unverified metadata dies with the failed back-source attempt
+        # (service_v2.go: task pieces reset).
+        task.pieces.clear()
+        task.content_length = -1
+        task.total_piece_count = 0
+        self._create_download_record(peer)
+
+    def leave_peer(self, peer_id: str) -> None:
+        peer = self._peer(peer_id)
+        peer.leave()
+        peer.task.delete_peer_in_edges(peer.id)
+        peer.task.delete_peer_out_edges(peer)
+        self.resource.peer_manager.delete(peer_id)
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def stat_task(self, task_id: str) -> Task:
+        task = self.resource.task_manager.load(task_id)
+        if task is None:
+            raise ServiceError(NOT_FOUND, f"task {task_id} not found")
+        return task
+
+    def _peer(self, peer_id: str) -> Peer:
+        peer = self.resource.peer_manager.load(peer_id)
+        if peer is None:
+            raise ServiceError(NOT_FOUND, f"peer {peer_id} not found")
+        return peer
+
+    # ------------------------------------------------------------------
+    # Probes (service_v2.go:684-826 SyncProbes)
+    # ------------------------------------------------------------------
+
+    def probe_started(self, host_id: str) -> List[Host]:
+        """Candidates for the prober to ICMP-ping (FindProbedHosts:
+        networktopology/network_topology.go:166-223)."""
+        if self.network_topology is None:
+            raise ServiceError(FAILED_PRECONDITION, "network topology disabled")
+        if self.resource.host_manager.load(host_id) is None:
+            raise ServiceError(NOT_FOUND, f"host {host_id} not announced")
+        return self.network_topology.find_probed_hosts(host_id)
+
+    def probe_finished(self, host_id: str, results: Sequence[ProbeResult]) -> int:
+        if self.network_topology is None:
+            raise ServiceError(FAILED_PRECONDITION, "network topology disabled")
+        stored = 0
+        for result in results:
+            if self.resource.host_manager.load(result.dest_host_id) is None:
+                continue
+            self.network_topology.store(host_id, result.dest_host_id)
+            self.network_topology.enqueue_probe(
+                host_id,
+                Probe(host_id=result.dest_host_id,
+                      rtt=result.rtt_seconds, created_at=result.created_at),
+            )
+            stored += 1
+        return stored
+
+    def probe_failed(self, host_id: str,
+                     results: Sequence[ProbeResult]) -> None:
+        for result in results:
+            logger.debug("probe %s -> %s failed", host_id, result.dest_host_id)
+
+    # ------------------------------------------------------------------
+    # Dataset sink (service_v1.go:1418 createDownloadRecord)
+    # ------------------------------------------------------------------
+
+    def _create_download_record(self, peer: Peer) -> None:
+        if self.storage is None:
+            return
+        try:
+            record = build_download_record(peer)
+            self.storage.create_download(record)
+        except Exception:
+            logger.exception("create download record failed for %s", peer.id)
+
+
+# ----------------------------------------------------------------------
+# Record builders (resource objects → schema records)
+# ----------------------------------------------------------------------
+
+
+def host_record(host: Host) -> schema.Host:
+    return schema.Host(
+        id=host.id, type=host.type.type_name, hostname=host.hostname,
+        ip=host.ip, port=host.port, download_port=host.download_port,
+        os=host.os, platform=host.platform,
+        platform_family=host.platform_family,
+        platform_version=host.platform_version,
+        kernel_version=host.kernel_version,
+        concurrent_upload_limit=host.concurrent_upload_limit,
+        concurrent_upload_count=host.concurrent_upload_count,
+        upload_count=host.upload_count,
+        upload_failed_count=host.upload_failed_count,
+        cpu=host.cpu, memory=host.memory, network=host.network,
+        disk=host.disk, build=host.build,
+        scheduler_cluster_id=host.scheduler_cluster_id,
+        created_at=int(host.created_at * 1e9),
+        updated_at=int(host.updated_at * 1e9),
+    )
+
+
+def build_download_record(peer: Peer) -> schema.Download:
+    """One finished/failed peer download → an MLP training example
+    (service_v1.go:1418-1496; schema scheduler/storage/types.go:189-225)."""
+    task = peer.task
+    parents = []
+    for parent in list(peer.parents())[: schema.MAX_PARENTS]:
+        pieces = [
+            schema.Piece(
+                length=pp.length, cost=int(pp.cost * 1e9),
+                created_at=int(peer.created_at * 1e9),
+            )
+            for pp in list(peer.pieces.values())
+            if pp.parent_id == parent.id
+        ][: schema.MAX_PIECES_PER_PARENT]
+        parents.append(
+            schema.Parent(
+                id=parent.id, tag=parent.tag, application=parent.application,
+                state=parent.fsm.current, cost=int(parent.cost * 1e9),
+                upload_piece_count=len(pieces),
+                finished_piece_count=parent.finished_piece_count(),
+                host=host_record(parent.host), pieces=pieces,
+                created_at=int(parent.created_at * 1e9),
+                updated_at=int(parent.updated_at * 1e9),
+            )
+        )
+    return schema.Download(
+        id=str(uuid.uuid4()), tag=peer.tag, application=peer.application,
+        state=peer.fsm.current,
+        cost=int(peer.cost * 1e9),
+        finished_piece_count=peer.finished_piece_count(),
+        task=schema.Task(
+            id=task.id, url=task.url, type=task.type.value,
+            content_length=max(task.content_length, 0),
+            total_piece_count=task.total_piece_count,
+            back_to_source_limit=task.back_to_source_limit,
+            back_to_source_peer_count=len(task.back_to_source_peers),
+            state=task.fsm.current,
+            created_at=int(task.created_at * 1e9),
+            updated_at=int(task.updated_at * 1e9),
+        ),
+        host=host_record(peer.host),
+        parents=parents,
+        created_at=int(peer.created_at * 1e9),
+        updated_at=int(peer.updated_at * 1e9),
+    )

@@ -1,0 +1,70 @@
+"""Traffic shaper + upload metadata edge cases
+(reference: client/daemon/peer/traffic_shaper_test.go)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from dragonfly2_tpu.client.storage import StorageManager, StorageOptions
+from dragonfly2_tpu.client.traffic_shaper import (
+    PlainTrafficShaper,
+    SamplingTrafficShaper,
+    new_traffic_shaper,
+)
+from dragonfly2_tpu.client.upload import UploadServer
+from dragonfly2_tpu.utils.ratelimit import INF
+
+
+class TestSamplingShaper:
+    def test_total_rate_never_exceeded(self):
+        """Per-task shares must sum to ≤ total even when every task demands
+        far more than it used (demand normalization, not usage)."""
+        shaper = SamplingTrafficShaper(total_rate_bps=100 * 1024 * 1024)
+        shaper.add_task("a")
+        shaper.add_task("b")
+        for task in ("a", "b"):
+            shaper.record(task, 1 * 1024 * 1024)
+            with shaper._lock:
+                shaper._tasks[task].needed = 100 * 1024 * 1024
+        shaper.update_limits()
+        total = sum(e.limiter.rate for e in shaper._tasks.values())
+        assert total <= shaper.total_rate * 1.001
+
+    def test_surplus_flows_to_needy_task(self):
+        shaper = SamplingTrafficShaper(total_rate_bps=10_000_000)
+        shaper.add_task("idle")
+        shaper.add_task("busy")
+        shaper.record("busy", 8_000_000)
+        with shaper._lock:
+            shaper._tasks["busy"].needed = 9_000_000
+            shaper._tasks["idle"].needed = 0
+        shaper.update_limits()
+        rates = {k: e.limiter.rate for k, e in shaper._tasks.items()}
+        assert rates["busy"] > rates["idle"]
+
+    def test_factory(self):
+        assert isinstance(new_traffic_shaper("plain"), PlainTrafficShaper)
+        assert isinstance(new_traffic_shaper("sampling", INF), PlainTrafficShaper)
+        assert isinstance(
+            new_traffic_shaper("sampling", 1e6), SamplingTrafficShaper
+        )
+
+
+class TestMetadataRoute:
+    def test_registered_empty_store_returns_200(self, tmp_path):
+        """A parent that registered a task but has no pieces yet (seed
+        mid-back-source) must answer an empty list, not 404."""
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        manager.register_task("m" * 32, "seed-peer")
+        server = UploadServer(manager)
+        server.start()
+        try:
+            url = f"http://{server.address}/metadata/{'m'*32}?peerId=seed-peer"
+            with urllib.request.urlopen(url) as resp:
+                assert resp.status == 200
+                meta = json.loads(resp.read())
+            assert meta["pieces"] == []
+            assert meta["done"] is False
+        finally:
+            server.stop()
