@@ -1,0 +1,24 @@
+"""Manager — control-plane registry for clusters, instances, and ML models.
+
+Reference counterpart: manager/ — the durable control plane: scheduler /
+seed-peer cluster CRUD and dynconfig answers (``service``), keepalive
+active/inactive marking, the ML model registry with single-active-version
+activation (``service.create_model``), cluster affinity search
+(``searcher``), and object storage for model artifacts (``objectstore``).
+SQLite replaces MySQL/Postgres+GORM; a filesystem bucket replaces S3/OSS
+(both behind the same interfaces the reference hides its backends behind).
+"""
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.objectstore import FilesystemObjectStore, ObjectStore
+from dragonfly2_tpu.manager.searcher import Searcher, Scopes
+from dragonfly2_tpu.manager.service import ManagerService
+
+__all__ = [
+    "Database",
+    "FilesystemObjectStore",
+    "ManagerService",
+    "ObjectStore",
+    "Scopes",
+    "Searcher",
+]

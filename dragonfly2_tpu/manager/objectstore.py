@@ -1,0 +1,107 @@
+"""Object storage for model artifacts and preheat payloads.
+
+Reference counterpart: pkg/objectstorage (S3/OSS/OBS behind one interface,
+objectstorage.go:215 factory). The filesystem backend is the hermetic
+default; cloud backends slot in behind the same interface.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Iterator, List, Optional
+
+
+class ObjectStoreError(Exception):
+    pass
+
+
+class ObjectStore:
+    """(pkg/objectstorage/objectstorage.go ObjectStorage interface, trimmed
+    to the operations the manager uses)."""
+
+    def create_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    def is_bucket_exist(self, bucket: str) -> bool:
+        raise NotImplementedError
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        raise NotImplementedError
+
+    def is_object_exist(self, bucket: str, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        raise NotImplementedError
+
+    def list_objects(self, bucket: str, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+
+class FilesystemObjectStore(ObjectStore):
+    """Bucket = directory, object = file; keys may contain '/'."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _bucket_dir(self, bucket: str) -> str:
+        if not bucket or "/" in bucket or bucket in (".", ".."):
+            raise ObjectStoreError(f"invalid bucket name {bucket!r}")
+        return os.path.join(self.root, bucket)
+
+    def _object_path(self, bucket: str, key: str) -> str:
+        path = os.path.normpath(os.path.join(self._bucket_dir(bucket), key))
+        if not path.startswith(self._bucket_dir(bucket) + os.sep):
+            raise ObjectStoreError(f"key {key!r} escapes bucket")
+        return path
+
+    def create_bucket(self, bucket: str) -> None:
+        os.makedirs(self._bucket_dir(bucket), exist_ok=True)
+
+    def is_bucket_exist(self, bucket: str) -> bool:
+        return os.path.isdir(self._bucket_dir(bucket))
+
+    def delete_bucket(self, bucket: str) -> None:
+        shutil.rmtree(self._bucket_dir(bucket), ignore_errors=True)
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        path = self._object_path(bucket, key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        try:
+            with open(self._object_path(bucket, key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise ObjectStoreError(f"{bucket}/{key} not found") from None
+
+    def is_object_exist(self, bucket: str, key: str) -> bool:
+        return os.path.isfile(self._object_path(bucket, key))
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            os.remove(self._object_path(bucket, key))
+        except FileNotFoundError:
+            pass
+
+    def list_objects(self, bucket: str, prefix: str = "") -> List[str]:
+        bucket_dir = self._bucket_dir(bucket)
+        if not os.path.isdir(bucket_dir):
+            return []
+        out = []
+        for dirpath, _, filenames in os.walk(bucket_dir):
+            for name in filenames:
+                key = os.path.relpath(os.path.join(dirpath, name), bucket_dir)
+                key = key.replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)

@@ -1,0 +1,345 @@
+"""Manager service: instance registry, keepalive, dynconfig, model registry.
+
+Reference counterpart: manager/rpcserver/manager_server_v2.go (UpdateScheduler
+:290, UpdateSeedPeer :180, ListSchedulers :500, KeepAlive :968, CreateModel
+:816) and manager/service/model.go:109-190 (single-active-version
+activation). The model blob layout mirrors manager/types/model.go:66-73
+(``<model>/<version>/model.*`` + per-model serving config) with a TPU/JAX
+serving config in place of the Triton ``tensorrt_plan`` one — the artifact
+is an orbax-style checkpoint dir consumed by the inference sidecar.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tarfile
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.manager.database import (
+    Database,
+    Row,
+    STATE_ACTIVE,
+    STATE_INACTIVE,
+)
+from dragonfly2_tpu.manager.objectstore import ObjectStore
+from dragonfly2_tpu.manager.searcher import Searcher
+
+logger = logging.getLogger(__name__)
+
+MODELS_BUCKET = "models"
+MODEL_FILE_NAME = "model.tar"          # types/model.go:25 model.graphdef
+MODEL_CONFIG_FILE_NAME = "config.json"  # types/model.go:28 config.pbtxt
+DEFAULT_SERVING_PLATFORM = "jax_xla"    # replaces DefaultTritonPlatform
+
+DEFAULT_KEEPALIVE_TTL = 60.0
+
+
+class ManagerError(Exception):
+    pass
+
+
+def make_model_file_key(model_name: str, version: str) -> str:
+    """(types/model.go:66-69 MakeObjectKeyOfModelFile)"""
+    return f"{model_name}/{version}/{MODEL_FILE_NAME}"
+
+
+def make_model_config_key(model_name: str) -> str:
+    """(types/model.go:71-73 MakeObjectKeyOfModelConfigFile)"""
+    return f"{model_name}/{MODEL_CONFIG_FILE_NAME}"
+
+
+@dataclass
+class ActiveModel:
+    name: str
+    type: str
+    version: str
+    evaluation: Dict
+    scheduler_id: int
+    artifact: bytes  # model.tar payload
+
+
+class ManagerService:
+    def __init__(self, database: Database, object_store: ObjectStore,
+                 keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL):
+        self.db = database
+        self.store = object_store
+        self.searcher = Searcher()
+        self.keepalive_ttl = keepalive_ttl
+        self.store.create_bucket(MODELS_BUCKET)
+
+    # ------------------------------------------------------------------
+    # Cluster CRUD (manager/service/scheduler_cluster.go, seed_peer_cluster)
+    # ------------------------------------------------------------------
+
+    def create_scheduler_cluster(self, name: str, *, config: Dict | None = None,
+                                 client_config: Dict | None = None,
+                                 scopes: Dict | None = None,
+                                 is_default: bool = False) -> Row:
+        cluster_id = self.db.insert(
+            "scheduler_clusters", name=name, config=config or {},
+            client_config=client_config or {}, scopes=scopes or {},
+            is_default=int(is_default),
+        )
+        return self.db.get("scheduler_clusters", cluster_id)
+
+    def create_seed_peer_cluster(self, name: str,
+                                 config: Dict | None = None) -> Row:
+        cluster_id = self.db.insert(
+            "seed_peer_clusters", name=name, config=config or {}
+        )
+        return self.db.get("seed_peer_clusters", cluster_id)
+
+    def list_scheduler_clusters(self) -> List[Row]:
+        return self.db.find("scheduler_clusters")
+
+    # ------------------------------------------------------------------
+    # Instance registration (UpdateScheduler/UpdateSeedPeer upserts)
+    # ------------------------------------------------------------------
+
+    def update_scheduler(self, *, hostname: str, ip: str, port: int,
+                         scheduler_cluster_id: int,
+                         features: List[str] | None = None) -> Row:
+        existing = self.db.find_one(
+            "schedulers", hostname=hostname, ip=ip,
+            scheduler_cluster_id=scheduler_cluster_id,
+        )
+        if existing is not None:
+            self.db.update("schedulers", existing.id, port=port,
+                           features=features or [])
+            return self.db.get("schedulers", existing.id)
+        row_id = self.db.insert(
+            "schedulers", hostname=hostname, ip=ip, port=port,
+            scheduler_cluster_id=scheduler_cluster_id,
+            features=features or [], state=STATE_INACTIVE,
+        )
+        return self.db.get("schedulers", row_id)
+
+    def update_seed_peer(self, *, hostname: str, ip: str, port: int,
+                         download_port: int, seed_peer_cluster_id: int,
+                         type: str = "super", idc: str = "",
+                         location: str = "") -> Row:
+        existing = self.db.find_one(
+            "seed_peers", hostname=hostname, ip=ip,
+            seed_peer_cluster_id=seed_peer_cluster_id,
+        )
+        if existing is not None:
+            self.db.update("seed_peers", existing.id, port=port,
+                           download_port=download_port, type=type,
+                           idc=idc, location=location)
+            return self.db.get("seed_peers", existing.id)
+        row_id = self.db.insert(
+            "seed_peers", hostname=hostname, ip=ip, port=port,
+            download_port=download_port, type=type, idc=idc,
+            location=location, seed_peer_cluster_id=seed_peer_cluster_id,
+            state=STATE_INACTIVE,
+        )
+        return self.db.get("seed_peers", row_id)
+
+    # ------------------------------------------------------------------
+    # Keepalive (manager_server_v2.go:968-1050)
+    # ------------------------------------------------------------------
+
+    def keepalive(self, *, source_type: str, hostname: str, ip: str,
+                  cluster_id: int) -> None:
+        """Mark the instance active and stamp the keepalive time; the
+        expiry sweep flips instances inactive after ``keepalive_ttl``."""
+        table = "schedulers" if source_type == "scheduler" else "seed_peers"
+        cluster_col = ("scheduler_cluster_id" if table == "schedulers"
+                       else "seed_peer_cluster_id")
+        row = self.db.find_one(
+            table, hostname=hostname, ip=ip, **{cluster_col: cluster_id}
+        )
+        if row is None:
+            raise ManagerError(f"{source_type} {hostname}/{ip} not registered")
+        self.db.update(table, row.id, state=STATE_ACTIVE,
+                       last_keepalive=time.time())
+
+    def sweep_keepalive(self) -> int:
+        """Expire silent instances (the stream-drop path of KeepAlive)."""
+        cutoff = time.time() - self.keepalive_ttl
+        flipped = 0
+        for table in ("schedulers", "seed_peers"):
+            for row in self.db.query(
+                f"SELECT * FROM {table} WHERE state=? AND last_keepalive<?",
+                [STATE_ACTIVE, cutoff],
+            ):
+                self.db.update(table, row.id, state=STATE_INACTIVE)
+                flipped += 1
+        return flipped
+
+    # ------------------------------------------------------------------
+    # Dynconfig answers (ListSchedulers :500 / ListApplications / configs)
+    # ------------------------------------------------------------------
+
+    def list_schedulers(self, *, ip: str = "", hostname: str = "",
+                        conditions: Dict[str, str] | None = None) -> List[Row]:
+        """Active schedulers of the best-matching cluster for this daemon —
+        the searcher path of ListSchedulers (manager_server_v2.go:500-560)."""
+        clusters = self.db.find("scheduler_clusters")
+        counts = {
+            r.scheduler_cluster_id: r.n
+            for r in self.db.query(
+                "SELECT scheduler_cluster_id, COUNT(*) AS n FROM schedulers "
+                "WHERE state=? GROUP BY scheduler_cluster_id",
+                [STATE_ACTIVE],
+            )
+        }
+        ranked = self.searcher.find_scheduler_clusters(
+            clusters, ip, hostname, conditions,
+            has_active_schedulers=lambda c: counts.get(c.id, 0) > 0,
+        )
+        if not ranked:
+            return []
+        return self.db.query(
+            "SELECT * FROM schedulers WHERE scheduler_cluster_id=? AND state=?",
+            [ranked[0].id, STATE_ACTIVE],
+        )
+
+    def list_seed_peers(self, seed_peer_cluster_id: int | None = None) -> List[Row]:
+        if seed_peer_cluster_id is None:
+            return self.db.query(
+                "SELECT * FROM seed_peers WHERE state=?", [STATE_ACTIVE]
+            )
+        return self.db.query(
+            "SELECT * FROM seed_peers WHERE seed_peer_cluster_id=? AND state=?",
+            [seed_peer_cluster_id, STATE_ACTIVE],
+        )
+
+    def get_scheduler_cluster_config(self, cluster_id: int) -> Dict:
+        cluster = self.db.get("scheduler_clusters", cluster_id)
+        if cluster is None:
+            raise ManagerError(f"scheduler cluster {cluster_id} not found")
+        return dict(cluster.config or {})
+
+    # ------------------------------------------------------------------
+    # Applications (priority config used by schedulers)
+    # ------------------------------------------------------------------
+
+    def create_application(self, name: str, *, url: str = "", bio: str = "",
+                           priorities: Dict | None = None) -> Row:
+        row_id = self.db.insert("applications", name=name, url=url, bio=bio,
+                                priorities=priorities or {})
+        return self.db.get("applications", row_id)
+
+    def list_applications(self) -> List[Row]:
+        return self.db.find("applications")
+
+    # ------------------------------------------------------------------
+    # Model registry (manager_server_v2.go:816-965 CreateModel;
+    # manager/service/model.go:109-190 activation invariant)
+    # ------------------------------------------------------------------
+
+    def create_model(self, model_id: str, model_type: str, host_id: str,
+                     ip: str, hostname: str, evaluation: Dict,
+                     artifact_dir: str, scheduler_id: int = 0) -> Row:
+        """trainer.ModelRegistry protocol: ingest a trained model.
+
+        The artifact dir is tarred into the object store under the
+        versioned key; the new version becomes the single active one for
+        its (type, scheduler) pair atomically.
+        """
+        version = uuid.uuid4().hex[:12]
+        artifact = _tar_directory(artifact_dir)
+        file_key = make_model_file_key(model_id, version)
+        self.store.put_object(MODELS_BUCKET, file_key, artifact)
+        # Per-model serving config — the reference writes a Triton
+        # config.pbtxt pinning the served version (model.go:153-190
+        # updateModelConfig); ours pins the active version for the JAX
+        # sidecar.
+        self.store.put_object(
+            MODELS_BUCKET, make_model_config_key(model_id),
+            json.dumps({
+                "name": model_id,
+                "platform": DEFAULT_SERVING_PLATFORM,
+                "version_policy": {"specific": {"versions": [version]}},
+            }).encode(),
+        )
+        with self.db.transaction() as txn:
+            # Single-active is per (type, scheduler) — NOT per model name:
+            # model ids are host-derived (idgen gnn/mlp_model_id_v1), so
+            # filtering by name would leave one active model per host.
+            txn.execute(
+                "UPDATE models SET state=?, updated_at=? "
+                "WHERE type=? AND scheduler_id=?",
+                [STATE_INACTIVE, time.time(), model_type, scheduler_id],
+            )
+            now = time.time()
+            cur = txn.execute(
+                "INSERT INTO models (name, type, bio, version, state, "
+                "evaluation, scheduler_id, object_key, created_at, updated_at) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?)",
+                [model_id, model_type, f"{hostname}/{ip}/{host_id}", version,
+                 STATE_ACTIVE, json.dumps(evaluation), scheduler_id,
+                 file_key, now, now],
+            )
+            row_id = int(cur.lastrowid)
+        logger.info("model %s type=%s version=%s activated",
+                    model_id, model_type, version)
+        return self.db.get("models", row_id)
+
+    def list_models(self, scheduler_id: int | None = None) -> List[Row]:
+        if scheduler_id is None:
+            return self.db.find("models")
+        return self.db.find("models", scheduler_id=scheduler_id)
+
+    def get_active_model(self, model_type: str,
+                         scheduler_id: int = 0) -> Optional[ActiveModel]:
+        """What the inference sidecar loads (the Triton-bucket handoff)."""
+        row = self.db.find_one("models", type=model_type,
+                               scheduler_id=scheduler_id, state=STATE_ACTIVE)
+        if row is None:
+            return None
+        return ActiveModel(
+            name=row.name, type=row.type, version=row.version,
+            evaluation=row.evaluation or {}, scheduler_id=row.scheduler_id,
+            artifact=self.store.get_object(MODELS_BUCKET, row.object_key),
+        )
+
+    def set_model_state(self, row_id: int, state: str) -> None:
+        """REST UpdateModel (handlers/model.go): manual (de)activation,
+        preserving the single-active invariant."""
+        row = self.db.get("models", row_id)
+        if row is None:
+            raise ManagerError(f"model row {row_id} not found")
+        with self.db.transaction() as txn:
+            if state == STATE_ACTIVE:
+                txn.execute(
+                    "UPDATE models SET state=? WHERE type=? AND scheduler_id=?",
+                    [STATE_INACTIVE, row.type, row.scheduler_id],
+                )
+            txn.execute(
+                "UPDATE models SET state=?, updated_at=? WHERE id=?",
+                [state, time.time(), row_id],
+            )
+
+
+def _tar_directory(directory: str) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        for name in sorted(os.listdir(directory)):
+            tar.add(os.path.join(directory, name), arcname=name)
+    return buf.getvalue()
+
+
+def untar_to_directory(artifact: bytes, directory: str) -> None:
+    """Unpack a model.tar payload (sidecar side)."""
+    import io
+
+    os.makedirs(directory, exist_ok=True)
+    base = os.path.abspath(directory)
+    with tarfile.open(fileobj=io.BytesIO(artifact), mode="r") as tar:
+        for member in tar.getmembers():
+            target = os.path.abspath(os.path.join(base, member.name))
+            if target != base and not target.startswith(base + os.sep):
+                raise ManagerError(f"unsafe tar member {member.name!r}")
+        try:
+            tar.extractall(base, filter="data")
+        except TypeError:  # Python < 3.10.12: no 'filter' kwarg
+            tar.extractall(base)

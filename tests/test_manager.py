@@ -1,0 +1,211 @@
+"""Manager tests: registry, keepalive, searcher math, model lifecycle.
+
+Modeled on the reference's manager tests (manager/searcher/searcher_test.go
+score cases; model activation invariant from manager/service/model.go).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.manager import (
+    Database,
+    FilesystemObjectStore,
+    ManagerService,
+    Scopes,
+    Searcher,
+)
+from dragonfly2_tpu.manager.database import STATE_ACTIVE, STATE_INACTIVE
+from dragonfly2_tpu.manager.searcher import (
+    cidr_affinity_score,
+    idc_affinity_score,
+    location_affinity_score,
+)
+from dragonfly2_tpu.manager.service import untar_to_directory
+
+
+@pytest.fixture()
+def service(tmp_path):
+    return ManagerService(
+        Database(), FilesystemObjectStore(str(tmp_path / "objects")),
+        keepalive_ttl=0.5,
+    )
+
+
+class TestSearcherMath:
+    def test_cidr(self):
+        assert cidr_affinity_score("10.0.1.5", ["10.0.0.0/16"]) == 1.0
+        assert cidr_affinity_score("192.168.1.1", ["10.0.0.0/16"]) == 0.0
+        assert cidr_affinity_score("bad-ip", ["10.0.0.0/16"]) == 0.0
+        assert cidr_affinity_score("10.0.1.5", ["not-a-cidr"]) == 0.0
+
+    def test_idc(self):
+        assert idc_affinity_score("idc1", "idc1") == 1.0
+        assert idc_affinity_score("IDC1", "idc1") == 1.0
+        assert idc_affinity_score("idc2", "idc1|idc2|idc3") == 1.0
+        assert idc_affinity_score("idc9", "idc1|idc2") == 0.0
+        assert idc_affinity_score("", "idc1") == 0.0
+
+    def test_location_prefix(self):
+        # searcher.go:214-239: matched-prefix/5
+        assert location_affinity_score("a|b|c", "a|b|c") == 1.0
+        assert location_affinity_score("a|b|x", "a|b|c") == 2 / 5
+        assert location_affinity_score("a", "a|b|c") == 1 / 5
+        assert location_affinity_score("x|b", "a|b") == 0.0
+        assert location_affinity_score("", "a") == 0.0
+
+    def test_ranking_weights(self):
+        searcher = Searcher()
+        # CIDR (0.4) should beat IDC (0.35)
+        cidr_only = searcher.evaluate(
+            "10.0.0.1", {"idc": "other"}, Scopes(cidrs=["10.0.0.0/8"]), False)
+        idc_only = searcher.evaluate(
+            "1.2.3.4", {"idc": "idc1"}, Scopes(idc="idc1"), False)
+        assert cidr_only > idc_only
+
+
+class TestInstanceLifecycle:
+    def test_scheduler_upsert_and_keepalive(self, service):
+        cluster = service.create_scheduler_cluster("c1", is_default=True)
+        row = service.update_scheduler(
+            hostname="sched-1", ip="10.0.0.1", port=8002,
+            scheduler_cluster_id=cluster.id,
+        )
+        assert row.state == STATE_INACTIVE
+        # same identity upserts, port change persists
+        row2 = service.update_scheduler(
+            hostname="sched-1", ip="10.0.0.1", port=9999,
+            scheduler_cluster_id=cluster.id,
+        )
+        assert row2.id == row.id and row2.port == 9999
+
+        service.keepalive(source_type="scheduler", hostname="sched-1",
+                          ip="10.0.0.1", cluster_id=cluster.id)
+        schedulers = service.list_schedulers(ip="10.0.0.9")
+        assert [s.hostname for s in schedulers] == ["sched-1"]
+
+    def test_keepalive_expiry(self, service):
+        import time
+
+        cluster = service.create_scheduler_cluster("c1")
+        service.update_scheduler(hostname="s", ip="1.1.1.1", port=1,
+                                 scheduler_cluster_id=cluster.id)
+        service.keepalive(source_type="scheduler", hostname="s",
+                          ip="1.1.1.1", cluster_id=cluster.id)
+        assert service.sweep_keepalive() == 0
+        time.sleep(0.6)
+        assert service.sweep_keepalive() == 1
+        assert service.list_schedulers(ip="2.2.2.2") == []
+
+    def test_keepalive_unknown_instance(self, service):
+        from dragonfly2_tpu.manager.service import ManagerError
+
+        with pytest.raises(ManagerError):
+            service.keepalive(source_type="scheduler", hostname="ghost",
+                              ip="0.0.0.0", cluster_id=1)
+
+    def test_cluster_affinity_routing(self, service):
+        """A daemon lands on the cluster matching its CIDR, not the default."""
+        near = service.create_scheduler_cluster(
+            "near", scopes={"cidrs": ["10.1.0.0/16"]})
+        default = service.create_scheduler_cluster("default", is_default=True)
+        for cluster, host in ((near, "sched-near"), (default, "sched-def")):
+            service.update_scheduler(hostname=host, ip="10.9.9.9", port=1,
+                                     scheduler_cluster_id=cluster.id)
+            service.keepalive(source_type="scheduler", hostname=host,
+                              ip="10.9.9.9", cluster_id=cluster.id)
+        got = service.list_schedulers(ip="10.1.2.3")
+        assert [s.hostname for s in got] == ["sched-near"]
+        got = service.list_schedulers(ip="172.16.0.1")
+        assert [s.hostname for s in got] == ["sched-def"]
+
+    def test_seed_peers(self, service):
+        cluster = service.create_seed_peer_cluster("sp1")
+        service.update_seed_peer(
+            hostname="seed-1", ip="10.0.0.2", port=65000,
+            download_port=65001, seed_peer_cluster_id=cluster.id,
+        )
+        assert service.list_seed_peers() == []  # inactive until keepalive
+        service.keepalive(source_type="seed_peer", hostname="seed-1",
+                          ip="10.0.0.2", cluster_id=cluster.id)
+        peers = service.list_seed_peers()
+        assert len(peers) == 1 and peers[0].download_port == 65001
+
+
+class TestModelRegistry:
+    def make_artifact(self, tmp_path, tag: str) -> str:
+        d = tmp_path / f"artifact-{tag}"
+        d.mkdir()
+        (d / "params.npz").write_bytes(os.urandom(64))
+        (d / "metadata.json").write_text(json.dumps({"tag": tag}))
+        return str(d)
+
+    def test_create_activates_single_version(self, service, tmp_path):
+        first = service.create_model(
+            "df2-gnn-abc", "gnn", "h1", "10.0.0.1", "host-1",
+            {"precision": 0.9, "recall": 0.8, "f1_score": 0.85},
+            self.make_artifact(tmp_path, "v1"),
+        )
+        assert first.state == STATE_ACTIVE
+        second = service.create_model(
+            "df2-gnn-abc", "gnn", "h1", "10.0.0.1", "host-1",
+            {"precision": 0.95, "recall": 0.9, "f1_score": 0.92},
+            self.make_artifact(tmp_path, "v2"),
+        )
+        rows = service.list_models()
+        states = {r.version: r.state for r in rows}
+        assert states[second.version] == STATE_ACTIVE
+        assert states[first.version] == STATE_INACTIVE
+        assert sum(1 for s in states.values() if s == STATE_ACTIVE) == 1
+
+    def test_active_model_roundtrip(self, service, tmp_path):
+        service.create_model(
+            "df2-mlp-xyz", "mlp", "h1", "10.0.0.1", "host-1",
+            {"mse": 0.1, "mae": 0.2}, self.make_artifact(tmp_path, "m1"),
+        )
+        active = service.get_active_model("mlp")
+        assert active is not None
+        assert active.evaluation["mae"] == 0.2
+        out = tmp_path / "unpacked"
+        untar_to_directory(active.artifact, str(out))
+        assert json.loads((out / "metadata.json").read_text())["tag"] == "m1"
+        assert service.get_active_model("gnn") is None
+
+    def test_single_active_across_host_named_models(self, service, tmp_path):
+        """Model ids are host-derived, so two hosts' models of one type
+        must still collapse to ONE active per (type, scheduler)."""
+        service.create_model("df2-mlp-hostA", "mlp", "hA", "1.1.1.1", "A",
+                             {}, self.make_artifact(tmp_path, "ha"))
+        service.create_model("df2-mlp-hostB", "mlp", "hB", "2.2.2.2", "B",
+                             {}, self.make_artifact(tmp_path, "hb"))
+        rows = service.list_models()
+        active = [r for r in rows if r.state == STATE_ACTIVE]
+        assert len(active) == 1 and active[0].name == "df2-mlp-hostB"
+
+    def test_manual_state_flip_keeps_invariant(self, service, tmp_path):
+        service.create_model("m", "mlp", "h", "ip", "hn", {},
+                             self.make_artifact(tmp_path, "a"))
+        service.create_model("m", "mlp", "h", "ip", "hn", {},
+                             self.make_artifact(tmp_path, "b"))
+        rows = service.list_models()
+        inactive = next(r for r in rows if r.state == STATE_INACTIVE)
+        service.set_model_state(inactive.id, STATE_ACTIVE)
+        rows = service.list_models()
+        assert sum(1 for r in rows if r.state == STATE_ACTIVE) == 1
+        assert next(r for r in rows if r.state == STATE_ACTIVE).id == inactive.id
+
+    def test_trainer_integration(self, service, tmp_path):
+        """The trainer's ModelRegistry protocol is satisfied directly by
+        ManagerService.create_model — the 3.3 call-stack handoff."""
+        from dragonfly2_tpu.trainer.training import ModelRegistry
+
+        registry: ModelRegistry = service
+        registry.create_model(
+            model_id="df2-mlp-host", model_type="mlp", host_id="h",
+            ip="1.1.1.1", hostname="hn", evaluation={"mae": 1.0},
+            artifact_dir=self.make_artifact(tmp_path, "t"),
+        )
+        assert service.get_active_model("mlp") is not None
