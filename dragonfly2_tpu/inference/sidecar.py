@@ -1,0 +1,324 @@
+"""TPU inference sidecar — the KServe-style model server the reference only
+had a client for.
+
+Reference counterpart: pkg/rpc/inference/client/client_v1.go:50-106 defines a
+Triton ``GRPCInferenceService`` client (ModelInfer / ModelReady /
+ServerLive / ServerReady) that nothing serves — the GPU sidecar was assumed
+external. Here the server exists: it pulls the ACTIVE model from the manager
+registry (the Triton-bucket handoff, manager/service/model.go), reconstructs
+the jit-compiled :class:`ParentScorer`, and serves scoring over the same
+four-method surface. A background watcher hot-reloads when the manager
+activates a new version.
+
+``RemoteMLEvaluator`` is the scheduler-side consumer — the ``MLAlgorithm``
+the reference left TODO (scheduler/scheduling/evaluator/evaluator.go:48) —
+with rule-based fallback while the sidecar is unreachable or model-less.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from dragonfly2_tpu.inference.scorer import MLEvaluator, ParentScorer
+from dragonfly2_tpu.rpc.codec import message
+from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
+
+logger = logging.getLogger(__name__)
+
+MODEL_NAME_MLP = "mlp"
+MODEL_NAME_GNN = "gnn"
+
+
+@message("inference.ModelInferRequest")
+@dataclass
+class ModelInferRequest:
+    model_name: str = ""
+    # Feature matrix [batch, FEATURE_DIM]; the codec ships numpy natively.
+    inputs: Optional[np.ndarray] = None
+
+
+@message("inference.ModelInferResponse")
+@dataclass
+class ModelInferResponse:
+    model_name: str = ""
+    model_version: str = ""
+    outputs: Optional[np.ndarray] = None
+
+
+@message("inference.ModelReadyRequest")
+@dataclass
+class ModelReadyRequest:
+    name: str = ""
+
+
+@message("inference.ModelReadyResponse")
+@dataclass
+class ModelReadyResponse:
+    ready: bool = False
+    version: str = ""
+
+
+@message("inference.ServerLiveRequest")
+@dataclass
+class ServerLiveRequest:
+    pass
+
+
+@message("inference.ServerLiveResponse")
+@dataclass
+class ServerLiveResponse:
+    live: bool = True
+
+
+@message("inference.ServerReadyRequest")
+@dataclass
+class ServerReadyRequest:
+    pass
+
+
+@message("inference.ServerReadyResponse")
+@dataclass
+class ServerReadyResponse:
+    ready: bool = False
+
+
+INFERENCE_SPEC = ServiceSpec(
+    name="df2.inference.GRPCInferenceService",
+    methods={
+        "ModelInfer": MethodKind.UNARY_UNARY,
+        "ModelReady": MethodKind.UNARY_UNARY,
+        "ServerLive": MethodKind.UNARY_UNARY,
+        "ServerReady": MethodKind.UNARY_UNARY,
+    },
+)
+
+
+@dataclass
+class _LoadedModel:
+    version: str
+    scorer: ParentScorer
+
+
+class InferenceService:
+    """Serves jit-compiled scorers reloaded from the manager registry."""
+
+    def __init__(self, manager=None, scheduler_id: int = 0,
+                 reload_interval: float = 30.0):
+        self.manager = manager  # ManagerService or None (push-only mode)
+        self.scheduler_id = scheduler_id
+        self.reload_interval = reload_interval
+        self._models: Dict[str, _LoadedModel] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+
+    # -- model management --------------------------------------------------
+
+    def install_scorer(self, name: str, scorer: ParentScorer,
+                       version: str = "local") -> None:
+        """Direct install (tests / in-process trainer handoff)."""
+        with self._lock:
+            self._models[name] = _LoadedModel(version, scorer)
+
+    def reload_from_manager(self) -> bool:
+        """Pull the active MLP model if its version changed. Returns True
+        when a (re)load happened. The steady-state poll is metadata-only:
+        the artifact is fetched only after the version check."""
+        if self.manager is None:
+            return False
+        version = self.manager.get_active_model_version(
+            MODEL_NAME_MLP, self.scheduler_id
+        )
+        if version is None:
+            return False
+        with self._lock:
+            current = self._models.get(MODEL_NAME_MLP)
+            if current is not None and current.version == version:
+                return False
+        active = self.manager.get_active_model(
+            MODEL_NAME_MLP, self.scheduler_id
+        )
+        if active is None:
+            return False
+        scorer = _scorer_from_artifact(active.artifact)
+        with self._lock:
+            self._models[MODEL_NAME_MLP] = _LoadedModel(active.version, scorer)
+        logger.info("inference sidecar loaded mlp version %s", active.version)
+        return True
+
+    def serve_watcher(self) -> None:
+        if self._watcher is not None:
+            return
+        self._stop.clear()  # allow restart after stop()
+        self._watcher = threading.Thread(
+            target=self._watch_loop, name="model-watcher", daemon=True
+        )
+        self._watcher.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+
+    def _watch_loop(self) -> None:
+        while not self._stop.wait(self.reload_interval):
+            try:
+                self.reload_from_manager()
+            except Exception:
+                logger.exception("model reload failed")
+
+    # -- gRPC surface ------------------------------------------------------
+
+    def ModelInfer(self, request: ModelInferRequest, context):  # noqa: N802
+        import grpc
+
+        from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+        with self._lock:
+            model = self._models.get(request.model_name)
+        if model is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"model {request.model_name!r} not loaded")
+        inputs = request.inputs
+        if inputs is None or inputs.size == 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty inputs")
+        inputs = np.asarray(inputs, dtype=np.float32)
+        if inputs.ndim != 2 or inputs.shape[1] != FEATURE_DIM:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"inputs must be [batch, {FEATURE_DIM}], got {inputs.shape}",
+            )
+        if inputs.shape[0] > model.scorer.max_batch:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"batch {inputs.shape[0]} exceeds max {model.scorer.max_batch}",
+            )
+        scores = model.scorer.score(inputs)
+        return ModelInferResponse(
+            model_name=request.model_name, model_version=model.version,
+            outputs=np.asarray(scores),
+        )
+
+    def ModelReady(self, request: ModelReadyRequest, context):  # noqa: N802
+        with self._lock:
+            model = self._models.get(request.name)
+        return ModelReadyResponse(
+            ready=model is not None,
+            version=model.version if model else "",
+        )
+
+    def ServerLive(self, request, context):  # noqa: N802
+        return ServerLiveResponse(live=True)
+
+    def ServerReady(self, request, context):  # noqa: N802
+        with self._lock:
+            ready = bool(self._models)
+        return ServerReadyResponse(ready=ready)
+
+
+def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
+    """model.tar → ParentScorer (checkpoint load + jit warm-up)."""
+    from dragonfly2_tpu.manager.service import untar_to_directory
+    from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor
+    from dragonfly2_tpu.train.checkpoint import load_model, mlp_from_tree
+
+    tmp = tempfile.mkdtemp(prefix="df2-sidecar-")
+    try:
+        untar_to_directory(artifact, tmp)
+        tree, metadata = load_model(tmp)
+        params, normalizer, target_norm = mlp_from_tree(tree)
+        hidden = tuple(metadata.config.get("hidden", (128, 128, 64)))
+        model = MLPBandwidthPredictor(hidden=hidden)
+        return ParentScorer(model, params, normalizer, target_norm)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+class InferenceClient:
+    """Scheduler-side client (pkg/rpc/inference/client/client_v1.go:81-106
+    surface over our RPC layer)."""
+
+    def __init__(self, target: str, timeout: float = 1.0):
+        from dragonfly2_tpu.rpc.client import ServiceClient
+
+        self._client = ServiceClient(target, INFERENCE_SPEC)
+        self.timeout = timeout
+
+    def model_infer(self, model_name: str, inputs: np.ndarray) -> np.ndarray:
+        resp = self._client.ModelInfer(
+            ModelInferRequest(model_name=model_name, inputs=inputs),
+            timeout=self.timeout,
+        )
+        return np.asarray(resp.outputs)
+
+    def model_ready(self, name: str) -> bool:
+        return bool(self._client.ModelReady(
+            ModelReadyRequest(name=name), timeout=self.timeout).ready)
+
+    def server_live(self) -> bool:
+        return bool(self._client.ServerLive(
+            ServerLiveRequest(), timeout=self.timeout).live)
+
+    def server_ready(self) -> bool:
+        return bool(self._client.ServerReady(
+            ServerReadyRequest(), timeout=self.timeout).ready)
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised instead of a remote call while the breaker cools down."""
+
+
+class _RemoteScorer:
+    """Sidecar-backed ``score()`` with an open-after-failure circuit
+    breaker: while open, calls fail instantly (→ rule fallback) instead of
+    eating the client retry/timeout ladder on every scheduling decision."""
+
+    def __init__(self, client: InferenceClient, model_name: str,
+                 cooldown: float = 5.0):
+        self.client = client
+        self.model_name = model_name
+        self.cooldown = cooldown
+        self._open_until = 0.0
+        self._lock = threading.Lock()
+
+    def score(self, features: np.ndarray) -> np.ndarray:
+        import time
+
+        with self._lock:
+            if time.monotonic() < self._open_until:
+                raise CircuitOpenError("inference sidecar circuit open")
+        try:
+            scores = self.client.model_infer(
+                self.model_name, np.asarray(features, dtype=np.float32))
+        except Exception:
+            with self._lock:
+                self._open_until = time.monotonic() + self.cooldown
+            raise
+        with self._lock:
+            self._open_until = 0.0
+        return scores
+
+
+class RemoteMLEvaluator(MLEvaluator):
+    """The ``ml`` evaluator backed by the sidecar — fills the reference's
+    MLAlgorithm TODO (evaluator.go:48). Delegates ranking, fallback
+    counting, and loud first-failure logging to :class:`MLEvaluator`; the
+    remote scorer only adds transport + the circuit breaker."""
+
+    def __init__(self, client: InferenceClient,
+                 model_name: str = MODEL_NAME_MLP, cooldown: float = 5.0):
+        super().__init__(_RemoteScorer(client, model_name, cooldown))
+        self.client = client

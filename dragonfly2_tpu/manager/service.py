@@ -287,6 +287,14 @@ class ManagerService:
             return self.db.find("models")
         return self.db.find("models", scheduler_id=scheduler_id)
 
+    def get_active_model_version(self, model_type: str,
+                                 scheduler_id: int = 0) -> Optional[str]:
+        """Metadata-only poll target for the sidecar's reload watcher —
+        no artifact fetch."""
+        row = self.db.find_one("models", type=model_type,
+                               scheduler_id=scheduler_id, state=STATE_ACTIVE)
+        return row.version if row is not None else None
+
     def get_active_model(self, model_type: str,
                          scheduler_id: int = 0) -> Optional[ActiveModel]:
         """What the inference sidecar loads (the Triton-bucket handoff)."""

@@ -20,11 +20,50 @@ from dragonfly2_tpu.scheduler.evaluator.scoring import (
     rule_scores,
 )
 
+ALGORITHM_DEFAULT = "default"
+ALGORITHM_ML = "ml"
+ALGORITHM_PLUGIN = "plugin"
+
+
+def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
+                  sidecar_target: str | None = None):
+    """Evaluator factory (evaluator.go:36-57 New).
+
+    ``ml``: in-process :class:`MLEvaluator` when a scorer is handed over
+    directly, or the sidecar-backed :class:`RemoteMLEvaluator` when a
+    gRPC target is given. ``plugin``: loaded from the
+    ``dragonfly2_tpu.evaluator`` entry-point group (the reference loads
+    ``d7y-evaluator-plugin-*.so``, evaluator/plugin.go:30-45).
+    """
+    if algorithm == ALGORITHM_ML:
+        if sidecar_target:
+            from dragonfly2_tpu.inference.sidecar import (
+                InferenceClient,
+                RemoteMLEvaluator,
+            )
+
+            return RemoteMLEvaluator(InferenceClient(sidecar_target))
+        from dragonfly2_tpu.inference.scorer import MLEvaluator
+
+        return MLEvaluator(scorer)
+    if algorithm == ALGORITHM_PLUGIN:
+        from importlib.metadata import entry_points
+
+        for ep in entry_points(group="dragonfly2_tpu.evaluator"):
+            return ep.load()()
+        raise ValueError("no evaluator plugin installed")
+    return BaseEvaluator()
+
+
 __all__ = [
+    "ALGORITHM_DEFAULT",
+    "ALGORITHM_ML",
+    "ALGORITHM_PLUGIN",
     "BaseEvaluator",
     "FEATURE_DIM",
     "FEATURE_NAMES",
     "idc_match",
     "location_matches",
+    "new_evaluator",
     "rule_scores",
 ]
