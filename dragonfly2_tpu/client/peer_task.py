@@ -101,6 +101,16 @@ class NeedBackToSource:
     reason: str
 
 
+@dataclass(frozen=True)
+class ScheduleFailed:
+    """Scheduling gave up (retry limit without back-to-source permission).
+    The wire analogue of ScheduleError raising out of
+    download_peer_started in-process — the conductor degrades to a
+    non-reporting back-to-source attempt either way."""
+
+    reason: str
+
+
 class QueueChannel:
     """PeerChannel bound to a conductor-side queue — the in-process stand-in
     for the v2 AnnouncePeer response stream."""
@@ -286,6 +296,10 @@ class PeerTaskConductor:
                 logger.info("peer %s told to back-to-source: %s",
                             self.peer_id, decision.reason)
                 return self._run_back_to_source(report=True)
+            if isinstance(decision, ScheduleFailed):
+                logger.warning("peer %s scheduling failed (%s); "
+                               "back-to-source", self.peer_id, decision.reason)
+                return self._run_back_to_source(report=False)
             if isinstance(decision, CandidateParents):
                 for parent in decision.parents:
                     self._start_syncer(parent)

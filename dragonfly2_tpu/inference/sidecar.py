@@ -154,7 +154,7 @@ class InferenceService:
         return True
 
     def serve_watcher(self) -> None:
-        if self._watcher is not None:
+        if self._watcher is not None and self._watcher.is_alive():
             return
         self._stop.clear()  # allow restart after stop()
         self._watcher = threading.Thread(
@@ -166,7 +166,10 @@ class InferenceService:
         self._stop.set()
         if self._watcher is not None:
             self._watcher.join(timeout=5)
-            self._watcher = None
+            if not self._watcher.is_alive():
+                self._watcher = None
+            # A still-alive watcher (stuck reload) keeps its slot so a
+            # restart cannot double it; it exits at the next loop check.
 
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.reload_interval):

@@ -1,0 +1,644 @@
+"""Scheduler gRPC surface — the AnnouncePeer/SyncProbes wire binding.
+
+Reference counterpart: scheduler/rpcserver/scheduler_server_v2.go (the bidi
+``AnnouncePeer`` stream with typed sub-requests, service_v2.go:88-300
+dispatch) and ``SyncProbes`` (service_v2.go:684-826). The transport-neutral
+:class:`~dragonfly2_tpu.scheduler.service.SchedulerService` does the work;
+this module adds (1) wire messages, (2) the server stream pump, and (3)
+``GrpcSchedulerClient`` — the daemon-side adapter satisfying the conductor's
+``SchedulerAPI`` protocol so daemons run against a remote scheduler
+unchanged (pkg/rpc/scheduler/client role, with per-task scheduler affinity
+left to the caller's consistent-hash ring, client_v1.go:171).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.rpc.codec import message
+from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.task import SizeScope
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    ProbeResult,
+    RegisterPeerRequest,
+    RegisterPeerResponse,
+    SchedulerService,
+    ServiceError,
+)
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+logger = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Wire messages
+# ----------------------------------------------------------------------
+
+
+@message("scheduler.AnnounceHostRequest")
+@dataclass
+class AnnounceHostRequest:
+    id: str = ""
+    hostname: str = ""
+    ip: str = ""
+    port: int = 0
+    download_port: int = 0
+    type: str = "normal"
+    idc: str = ""
+    location: str = ""
+    concurrent_upload_limit: int = 0
+    telemetry: dict = field(default_factory=dict)
+
+    @classmethod
+    def from_host(cls, host: Host) -> "AnnounceHostRequest":
+        return cls(
+            id=host.id, hostname=host.hostname, ip=host.ip, port=host.port,
+            download_port=host.download_port, type=host.type.type_name,
+            idc=host.network.idc, location=host.network.location,
+            concurrent_upload_limit=host.concurrent_upload_limit,
+        )
+
+    def to_host(self) -> Host:
+        from dragonfly2_tpu.schema import records
+
+        return Host(
+            id=self.id, hostname=self.hostname, ip=self.ip, port=self.port,
+            download_port=self.download_port,
+            type=HostType.from_name(self.type),
+            concurrent_upload_limit=self.concurrent_upload_limit,
+            network=records.Network(idc=self.idc, location=self.location),
+        )
+
+
+@message("scheduler.Empty")
+@dataclass
+class Empty:
+    pass
+
+
+@message("scheduler.HostID")
+@dataclass
+class HostID:
+    host_id: str = ""
+
+
+@message("scheduler.PeerID")
+@dataclass
+class PeerID:
+    peer_id: str = ""
+
+
+@message("scheduler.TaskID")
+@dataclass
+class TaskID:
+    task_id: str = ""
+
+
+@message("scheduler.StatTaskResponse")
+@dataclass
+class StatTaskResponse:
+    task_id: str = ""
+    state: str = ""
+    content_length: int = -1
+    total_piece_count: int = 0
+    peer_count: int = 0
+
+
+# -- AnnouncePeer sub-requests (service_v2.go typed oneof) --------------
+
+
+@message("scheduler.WireRegisterPeer")
+@dataclass
+class WireRegisterPeer:
+    host_id: str = ""
+    task_id: str = ""
+    peer_id: str = ""
+    url: str = ""
+    tag: str = ""
+    application: str = ""
+    priority: int = 0
+    request_header: Dict[str, str] = field(default_factory=dict)
+    filtered_query_params: List[str] = field(default_factory=list)
+    piece_length: int = 0
+    need_back_to_source: bool = False
+
+
+@message("scheduler.WirePeerEvent")
+@dataclass
+class WirePeerEvent:
+    """started | back_to_source_started | finished | back_to_source_finished
+    | failed | back_to_source_failed — the non-payload lifecycle events."""
+
+    peer_id: str = ""
+    event: str = ""
+    cost_seconds: float = 0.0
+    content_length: int = -1
+    total_piece_count: int = 0
+
+
+@message("scheduler.WirePieceFinished")
+@dataclass
+class WirePieceFinished:
+    peer_id: str = ""
+    piece_number: int = 0
+    parent_id: str = ""
+    offset: int = 0
+    length: int = 0
+    digest: str = ""
+    cost_ns: int = 0
+    traffic_type: str = "remote_peer"
+
+
+@message("scheduler.WirePieceFailed")
+@dataclass
+class WirePieceFailed:
+    peer_id: str = ""
+    parent_id: str = ""
+    piece_number: int = 0
+
+
+# -- AnnouncePeer responses --------------------------------------------
+
+
+@message("scheduler.WireRegisterResponse")
+@dataclass
+class WireRegisterResponse:
+    size_scope: str = "normal"
+    direct_piece: bytes = b""
+    content_length: int = -1
+    total_piece_count: int = 0
+
+
+@message("scheduler.WireParent")
+@dataclass
+class WireParent:
+    peer_id: str = ""
+    addr: str = ""
+
+
+@message("scheduler.WireCandidateParents")
+@dataclass
+class WireCandidateParents:
+    parents: List[WireParent] = field(default_factory=list)
+
+
+@message("scheduler.WireNeedBackToSource")
+@dataclass
+class WireNeedBackToSource:
+    reason: str = ""
+
+
+@message("scheduler.WireError")
+@dataclass
+class WireError:
+    code: str = ""
+    message: str = ""
+
+
+# -- SyncProbes ---------------------------------------------------------
+
+
+@message("scheduler.WireProbeStarted")
+@dataclass
+class WireProbeStarted:
+    host_id: str = ""
+
+
+@message("scheduler.WireProbeCandidates")
+@dataclass
+class WireProbeCandidates:
+    hosts: List[WireParent] = field(default_factory=list)  # peer_id=host_id
+
+
+@message("scheduler.WireProbeResult")
+@dataclass
+class WireProbeResult:
+    dest_host_id: str = ""
+    rtt_seconds: float = 0.0
+    ok: bool = True
+
+
+@message("scheduler.WireProbeFinished")
+@dataclass
+class WireProbeFinished:
+    host_id: str = ""
+    results: List[WireProbeResult] = field(default_factory=list)
+
+
+SCHEDULER_SPEC = ServiceSpec(
+    name="df2.scheduler.Scheduler",
+    methods={
+        "AnnounceHost": MethodKind.UNARY_UNARY,
+        "LeaveHost": MethodKind.UNARY_UNARY,
+        "LeavePeer": MethodKind.UNARY_UNARY,
+        "StatTask": MethodKind.UNARY_UNARY,
+        "AnnouncePeer": MethodKind.STREAM_STREAM,
+        "SyncProbes": MethodKind.STREAM_STREAM,
+    },
+)
+
+
+# ----------------------------------------------------------------------
+# Server
+# ----------------------------------------------------------------------
+
+
+class _StreamChannel:
+    """scheduling.core.PeerChannel bound to the response stream's queue."""
+
+    def __init__(self, outbound: "queue.Queue"):
+        self.outbound = outbound
+        self.closed = False
+
+    def send_candidate_parents(self, peer, parents) -> bool:
+        if self.closed:
+            return False
+        self.outbound.put(WireCandidateParents([
+            WireParent(p.id, f"{p.host.ip}:{p.host.download_port}")
+            for p in parents
+        ]))
+        return True
+
+    def send_need_back_to_source(self, peer, description: str) -> bool:
+        if self.closed:
+            return False
+        self.outbound.put(WireNeedBackToSource(description))
+        return True
+
+
+class SchedulerRpcService:
+    """gRPC method surface over a SchedulerService."""
+
+    def __init__(self, service: SchedulerService):
+        self.service = service
+
+    # -- unary ----------------------------------------------------------
+
+    def AnnounceHost(self, request: AnnounceHostRequest, context) -> Empty:  # noqa: N802
+        self.service.announce_host(request.to_host())
+        return Empty()
+
+    def LeaveHost(self, request: HostID, context) -> Empty:  # noqa: N802
+        self._guard(context, self.service.leave_host, request.host_id)
+        return Empty()
+
+    def LeavePeer(self, request: PeerID, context) -> Empty:  # noqa: N802
+        self._guard(context, self.service.leave_peer, request.peer_id)
+        return Empty()
+
+    def StatTask(self, request: TaskID, context) -> StatTaskResponse:  # noqa: N802
+        task = self._guard(context, self.service.stat_task, request.task_id)
+        return StatTaskResponse(
+            task_id=task.id, state=task.fsm.current,
+            content_length=task.content_length,
+            total_piece_count=task.total_piece_count,
+            peer_count=task.peer_count(),
+        )
+
+    @staticmethod
+    def _guard(context, fn, *args):
+        import grpc
+
+        try:
+            return fn(*args)
+        except ServiceError as exc:
+            code = (grpc.StatusCode.NOT_FOUND if exc.code == "NotFound"
+                    else grpc.StatusCode.FAILED_PRECONDITION)
+            context.abort(code, str(exc))
+
+    # -- AnnouncePeer bidi ----------------------------------------------
+
+    def AnnouncePeer(self, request_iterator, context):  # noqa: N802
+        outbound: "queue.Queue" = queue.Queue()
+        channel = _StreamChannel(outbound)
+        done = object()
+
+        def pump() -> None:
+            try:
+                for req in request_iterator:
+                    self._dispatch(req, channel, outbound)
+            except Exception as exc:
+                logger.debug("announce stream pump ended: %s", exc)
+            finally:
+                channel.closed = True
+                outbound.put(done)
+
+        threading.Thread(target=pump, name="announce-pump", daemon=True).start()
+        while True:
+            item = outbound.get()
+            if item is done:
+                return
+            yield item
+
+    def _dispatch(self, req, channel, outbound: "queue.Queue") -> None:
+        svc = self.service
+        try:
+            if isinstance(req, WireRegisterPeer):
+                resp = svc.register_peer(
+                    RegisterPeerRequest(
+                        host_id=req.host_id, task_id=req.task_id,
+                        peer_id=req.peer_id, url=req.url, tag=req.tag,
+                        application=req.application, priority=req.priority,
+                        request_header=dict(req.request_header),
+                        filtered_query_params=list(req.filtered_query_params),
+                        piece_length=req.piece_length,
+                        need_back_to_source=req.need_back_to_source,
+                    ),
+                    channel=channel,
+                )
+                outbound.put(WireRegisterResponse(
+                    size_scope=resp.size_scope.value,
+                    direct_piece=resp.direct_piece,
+                    content_length=resp.content_length,
+                    total_piece_count=resp.total_piece_count,
+                ))
+            elif isinstance(req, WirePeerEvent):
+                self._peer_event(req)
+            elif isinstance(req, WirePieceFinished):
+                svc.download_piece_finished(PieceFinished(
+                    peer_id=req.peer_id, piece_number=req.piece_number,
+                    parent_id=req.parent_id, offset=req.offset,
+                    length=req.length, digest=req.digest,
+                    cost_ns=req.cost_ns, traffic_type=req.traffic_type,
+                ))
+            elif isinstance(req, WirePieceFailed):
+                svc.download_piece_failed(
+                    req.peer_id, req.parent_id, req.piece_number)
+            else:
+                outbound.put(WireError("InvalidArgument",
+                                       f"unknown request {type(req).__name__}"))
+        except ServiceError as exc:
+            outbound.put(WireError(exc.code, str(exc)))
+        except Exception as exc:  # scheduling errors → peer-visible error
+            logger.exception("announce dispatch failed")
+            outbound.put(WireError("Internal", f"{type(exc).__name__}: {exc}"))
+
+    def _peer_event(self, req: WirePeerEvent) -> None:
+        svc = self.service
+        event = req.event
+        if event == "started":
+            svc.download_peer_started(req.peer_id)
+        elif event == "back_to_source_started":
+            svc.download_peer_back_to_source_started(req.peer_id)
+        elif event == "finished":
+            svc.download_peer_finished(req.peer_id, req.cost_seconds)
+        elif event == "back_to_source_finished":
+            svc.download_peer_back_to_source_finished(
+                req.peer_id, req.content_length, req.total_piece_count,
+                req.cost_seconds)
+        elif event == "failed":
+            svc.download_peer_failed(req.peer_id)
+        elif event == "back_to_source_failed":
+            svc.download_peer_back_to_source_failed(req.peer_id)
+        else:
+            raise ServiceError("InvalidArgument", f"unknown event {event!r}")
+
+    # -- SyncProbes bidi -------------------------------------------------
+
+    def SyncProbes(self, request_iterator, context):  # noqa: N802
+        import grpc
+
+        try:
+            yield from self._sync_probes(request_iterator)
+        except ServiceError as exc:
+            code = (grpc.StatusCode.NOT_FOUND if exc.code == "NotFound"
+                    else grpc.StatusCode.FAILED_PRECONDITION)
+            context.abort(code, str(exc))
+
+    def _sync_probes(self, request_iterator):
+        for req in request_iterator:
+            if isinstance(req, WireProbeStarted):
+                hosts = self.service.probe_started(req.host_id)
+                yield WireProbeCandidates([
+                    WireParent(h.id, f"{h.ip}:{h.port}") for h in hosts
+                ])
+            elif isinstance(req, WireProbeFinished):
+                ok = [ProbeResult(r.dest_host_id, r.rtt_seconds)
+                      for r in req.results if r.ok]
+                failed = [ProbeResult(r.dest_host_id, r.rtt_seconds)
+                          for r in req.results if not r.ok]
+                if ok:
+                    self.service.probe_finished(req.host_id, ok)
+                if failed:
+                    self.service.probe_failed(req.host_id, failed)
+
+
+# ----------------------------------------------------------------------
+# Client adapter (daemon side)
+# ----------------------------------------------------------------------
+
+
+class _AnnounceSession:
+    """One open AnnouncePeer stream for one peer."""
+
+    def __init__(self, responses, send_queue: "queue.Queue"):
+        self.responses = responses
+        self.send_queue = send_queue
+        self.register_reply: "queue.Queue" = queue.Queue()
+
+    def send(self, msg) -> None:
+        self.send_queue.put(msg)
+
+    def close(self) -> None:
+        self.send_queue.put(None)
+
+
+class GrpcSchedulerClient:
+    """SchedulerAPI over the wire — what the conductor/daemon use when the
+    scheduler is a separate process."""
+
+    def __init__(self, target: str):
+        from dragonfly2_tpu.rpc.client import ServiceClient
+
+        self._client = ServiceClient(target, SCHEDULER_SPEC)
+        self._sessions: Dict[str, _AnnounceSession] = {}
+        self._lock = threading.Lock()
+
+    # -- host lifecycle --------------------------------------------------
+
+    def announce_host(self, host: Host) -> None:
+        self._client.AnnounceHost(AnnounceHostRequest.from_host(host),
+                                  timeout=10)
+
+    def leave_host(self, host_id: str) -> None:
+        self._client.LeaveHost(HostID(host_id), timeout=10)
+
+    def leave_peer(self, peer_id: str) -> None:
+        self._client.LeavePeer(PeerID(peer_id), timeout=10)
+
+    def stat_task(self, task_id: str) -> StatTaskResponse:
+        return self._client.StatTask(TaskID(task_id), timeout=10)
+
+    # -- SchedulerAPI ----------------------------------------------------
+
+    def register_peer(self, req: RegisterPeerRequest,
+                      channel=None) -> RegisterPeerResponse:
+        send_queue: "queue.Queue" = queue.Queue()
+
+        def requests():
+            while True:
+                item = send_queue.get()
+                if item is None:
+                    return
+                yield item
+
+        responses = self._client.AnnouncePeer(requests())
+        session = _AnnounceSession(responses, send_queue)
+        with self._lock:
+            self._sessions[req.peer_id] = session
+        session.send(WireRegisterPeer(
+            host_id=req.host_id, task_id=req.task_id, peer_id=req.peer_id,
+            url=req.url, tag=req.tag, application=req.application,
+            priority=req.priority, request_header=dict(req.request_header),
+            filtered_query_params=list(req.filtered_query_params),
+            piece_length=req.piece_length,
+            need_back_to_source=req.need_back_to_source,
+        ))
+        reader = threading.Thread(
+            target=self._read_loop, args=(session, channel),
+            name=f"announce-read-{req.peer_id[-8:]}", daemon=True,
+        )
+        reader.start()
+        try:
+            reply = session.register_reply.get(timeout=30)
+        except queue.Empty:
+            self._drop_session(req.peer_id)
+            raise ServiceError(
+                "DeadlineExceeded",
+                f"scheduler did not answer register for {req.peer_id} in 30s",
+            ) from None
+        if isinstance(reply, WireError):
+            self._drop_session(req.peer_id)
+            raise ServiceError(reply.code, reply.message)
+        if isinstance(reply, Exception):
+            self._drop_session(req.peer_id)
+            raise reply
+        return RegisterPeerResponse(
+            size_scope=SizeScope(reply.size_scope),
+            direct_piece=reply.direct_piece,
+            content_length=reply.content_length,
+            total_piece_count=reply.total_piece_count,
+        )
+
+    def _read_loop(self, session: _AnnounceSession, channel) -> None:
+        from dragonfly2_tpu.client.peer_task import (
+            CandidateParents,
+            NeedBackToSource,
+            ParentInfo,
+            ScheduleFailed,
+        )
+
+        registered = False
+        try:
+            for resp in session.responses:
+                if isinstance(resp, WireRegisterResponse) and not registered:
+                    registered = True
+                    session.register_reply.put(resp)
+                elif isinstance(resp, WireError) and not registered:
+                    registered = True
+                    session.register_reply.put(resp)
+                elif isinstance(resp, WireCandidateParents):
+                    if channel is not None:
+                        channel.decisions.put(CandidateParents([
+                            ParentInfo(p.peer_id, p.addr)
+                            for p in resp.parents
+                        ]))
+                elif isinstance(resp, WireNeedBackToSource):
+                    if channel is not None:
+                        channel.decisions.put(NeedBackToSource(resp.reason))
+                elif isinstance(resp, WireError):
+                    # Post-registration scheduling errors must reach the
+                    # conductor — in-process they raise out of
+                    # download_peer_started and trigger back-to-source.
+                    logger.warning("scheduler error on stream: %s %s",
+                                   resp.code, resp.message)
+                    if channel is not None:
+                        channel.decisions.put(
+                            ScheduleFailed(f"{resp.code}: {resp.message}"))
+        except Exception as exc:
+            if not registered:
+                session.register_reply.put(exc)
+            else:
+                logger.debug("announce read loop ended: %s", exc)
+
+    def _session(self, peer_id: str) -> Optional[_AnnounceSession]:
+        with self._lock:
+            return self._sessions.get(peer_id)
+
+    def _require_session(self, peer_id: str) -> _AnnounceSession:
+        session = self._session(peer_id)
+        if session is None:
+            raise ServiceError("NotFound", f"no announce session for {peer_id}")
+        return session
+
+    def _drop_session(self, peer_id: str) -> None:
+        with self._lock:
+            session = self._sessions.pop(peer_id, None)
+        if session is not None:
+            session.close()
+
+    def _send_event(self, peer_id: str, event: str, *, cost: float = 0.0,
+                    content_length: int = -1, total: int = 0,
+                    final: bool = False) -> None:
+        session = self._require_session(peer_id)
+        session.send(WirePeerEvent(
+            peer_id=peer_id, event=event, cost_seconds=cost,
+            content_length=content_length, total_piece_count=total,
+        ))
+        if final:
+            self._drop_session(peer_id)
+
+    def download_peer_started(self, peer_id: str) -> None:
+        self._send_event(peer_id, "started")
+
+    def download_peer_back_to_source_started(self, peer_id: str) -> None:
+        self._send_event(peer_id, "back_to_source_started")
+
+    def download_piece_finished(self, report: PieceFinished) -> None:
+        session = self._require_session(report.peer_id)
+        session.send(WirePieceFinished(
+            peer_id=report.peer_id, piece_number=report.piece_number,
+            parent_id=report.parent_id, offset=report.offset,
+            length=report.length, digest=report.digest,
+            cost_ns=report.cost_ns, traffic_type=report.traffic_type,
+        ))
+
+    def download_piece_failed(self, peer_id: str, parent_id: str,
+                              piece_number: int) -> None:
+        session = self._require_session(peer_id)
+        session.send(WirePieceFailed(
+            peer_id=peer_id, parent_id=parent_id, piece_number=piece_number))
+
+    def download_peer_finished(self, peer_id: str,
+                               cost_seconds: float = 0.0) -> None:
+        self._send_event(peer_id, "finished", cost=cost_seconds, final=True)
+
+    def download_peer_back_to_source_finished(
+        self, peer_id: str, content_length: int, total_piece_count: int,
+        cost_seconds: float = 0.0,
+    ) -> None:
+        self._send_event(
+            peer_id, "back_to_source_finished", cost=cost_seconds,
+            content_length=content_length, total=total_piece_count,
+            final=True,
+        )
+
+    def download_peer_failed(self, peer_id: str) -> None:
+        self._send_event(peer_id, "failed", final=True)
+
+    def download_peer_back_to_source_failed(self, peer_id: str) -> None:
+        self._send_event(peer_id, "back_to_source_failed", final=True)
+
+    def close(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            s.close()
+        self._client.close()
