@@ -118,7 +118,8 @@ class Daemon:
     def download_file(self, url: str, *, output_path: str | None = None,
                       request_header: Dict[str, str] | None = None,
                       tag: str = "", application: str = "",
-                      filtered_query_params=None) -> PeerTaskResult:
+                      filtered_query_params=None,
+                      piece_sink=None) -> PeerTaskResult:
         task_id = idgen.task_id_v1(
             url, tag=tag, application=application,
             filters="&".join(filtered_query_params or []),
@@ -149,6 +150,7 @@ class Daemon:
                 url=url, request_header=request_header, shaper=self.shaper,
                 options=self.config.task_options,
                 is_seed=self.config.host_type.is_seed,
+                piece_sink=piece_sink,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor

@@ -1,0 +1,356 @@
+"""TPU HBM sink — P2P-fetched safetensors land directly in device memory.
+
+North-star config #5 (BASELINE.md): dfget fans a model's safetensors across
+the mesh and the bytes end on-device without a load-from-disk pass. The
+reference has no analogue (its daemon ends at local disk); this is the
+TPU-native extension point: an offset-indexed host staging buffer absorbs
+pieces in arrival order (bursty, unordered — SURVEY.md §7 hard parts), the
+safetensors header is parsed as soon as its bytes are covered, and each
+tensor is ``jax.device_put`` as soon as its span completes — transfers
+overlap the remaining download instead of waiting for the file.
+
+Safetensors layout: u64-LE header length, then a JSON header mapping tensor
+name → {dtype, shape, data_offsets=[begin, end)} relative to the end of the
+header, then the packed tensor data.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "BF16": None,  # resolved via ml_dtypes below
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _dtype(name: str) -> np.dtype:
+    if name == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_DTYPES[name])
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {name!r}") from None
+
+
+@dataclass(frozen=True)
+class TensorSpec:
+    name: str
+    dtype: str
+    shape: Tuple[int, ...]
+    start: int  # absolute offset in the file
+    end: int
+
+    @property
+    def nbytes(self) -> int:
+        return self.end - self.start
+
+
+def parse_safetensors_header(raw: bytes) -> Tuple[List[TensorSpec], int]:
+    """Parse a safetensors header prefix → (specs, data_start_offset).
+
+    ``raw`` must contain at least the 8-byte length and the full JSON
+    header; tensor offsets are rebased to absolute file offsets.
+    """
+    if len(raw) < 8:
+        raise ValueError("need at least 8 bytes for the header length")
+    (header_len,) = struct.unpack("<Q", raw[:8])
+    if len(raw) < 8 + header_len:
+        raise ValueError(f"header incomplete: have {len(raw)}, "
+                         f"need {8 + header_len}")
+    header = json.loads(raw[8:8 + header_len])
+    data_start = 8 + header_len
+    specs = []
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        begin, end = info["data_offsets"]
+        specs.append(TensorSpec(
+            name=name, dtype=info["dtype"], shape=tuple(info["shape"]),
+            start=data_start + begin, end=data_start + end,
+        ))
+    specs.sort(key=lambda s: s.start)
+    return specs, data_start
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray],
+                      metadata: Dict[str, str] | None = None) -> None:
+    """Minimal safetensors writer (test fixtures + export path)."""
+    _REV = {np.dtype(v): k for k, v in _DTYPES.items() if v is not None}
+    try:
+        import ml_dtypes
+
+        _REV[np.dtype(ml_dtypes.bfloat16)] = "BF16"
+    except ImportError:
+        pass
+    header: Dict[str, dict] = {}
+    offset = 0
+    blobs = []
+    for name, arr in tensors.items():
+        raw = np.ascontiguousarray(arr).tobytes()
+        header[name] = {
+            "dtype": _REV[arr.dtype],
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + len(raw)],
+        }
+        blobs.append(raw)
+        offset += len(raw)
+    if metadata:
+        header["__metadata__"] = metadata
+    header_json = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(header_json)))
+        f.write(header_json)
+        for blob in blobs:
+            f.write(blob)
+
+
+class _Coverage:
+    """Merged interval set tracking which byte ranges have arrived."""
+
+    def __init__(self) -> None:
+        self._spans: List[Tuple[int, int]] = []
+
+    def add(self, start: int, end: int) -> None:
+        spans = self._spans
+        spans.append((start, end))
+        spans.sort()
+        merged = [spans[0]]
+        for s, e in spans[1:]:
+            if s <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((s, e))
+        self._spans = merged
+
+    def covers(self, start: int, end: int) -> bool:
+        for s, e in self._spans:
+            if s <= start and end <= e:
+                return True
+            if s > start:
+                break
+        return False
+
+    def covered_bytes(self) -> int:
+        return sum(e - s for s, e in self._spans)
+
+
+class HBMSink:
+    """Reassembles unordered pieces and streams completed tensors to HBM.
+
+    ``device`` may be a jax.Device or a ``jax.sharding.Sharding`` (for
+    multi-chip layouts, pass a NamedSharding and tensors land sharded);
+    ``sharding_for(name)`` overrides placement per tensor.
+    """
+
+    def __init__(self, content_length: int, device=None,
+                 sharding_for: Optional[Callable[[str], object]] = None,
+                 transfer_workers: int = 2):
+        import jax
+
+        self.content_length = content_length
+        self._device = device if device is not None else jax.devices()[0]
+        self._sharding_for = sharding_for
+        # Host staging area. On TPU hosts this buffer is what device_put
+        # DMAs from; one contiguous allocation keeps transfers zero-copy
+        # slices rather than per-piece allocations.
+        self._staging = np.zeros(content_length, dtype=np.uint8)
+        self._coverage = _Coverage()
+        self._lock = threading.Lock()
+        self._specs: Optional[List[TensorSpec]] = None
+        self._pending: List[TensorSpec] = []
+        self._arrays: Dict[str, object] = {}
+        self._errors: List[str] = []
+        self._queue: "queue.Queue[Optional[TensorSpec]]" = queue.Queue()
+        self._workers = [
+            threading.Thread(target=self._transfer_loop,
+                             name=f"hbm-transfer-{i}", daemon=True)
+            for i in range(transfer_workers)
+        ]
+        for w in self._workers:
+            w.start()
+        self._closed = False
+
+    # -- ingest ------------------------------------------------------------
+
+    def write(self, offset: int, data: bytes) -> None:
+        """Absorb one piece at its absolute file offset (any order)."""
+        end = offset + len(data)
+        if end > self.content_length:
+            raise ValueError(f"write [{offset}, {end}) beyond "
+                             f"content length {self.content_length}")
+        with self._lock:
+            self._staging[offset:end] = np.frombuffer(data, dtype=np.uint8)
+            self._coverage.add(offset, end)
+            self._maybe_parse_header_locked()
+            self._dispatch_ready_locked()
+
+    def _maybe_parse_header_locked(self) -> None:
+        if self._specs is not None:
+            return
+        if not self._coverage.covers(0, 8):
+            return
+        (header_len,) = struct.unpack("<Q", self._staging[:8].tobytes())
+        if not self._coverage.covers(0, 8 + header_len):
+            return
+        specs, _ = parse_safetensors_header(
+            self._staging[:8 + header_len + 1].tobytes())
+        self._specs = specs
+        self._pending = list(specs)
+        logger.info("hbm sink: header parsed, %d tensors", len(specs))
+
+    def _dispatch_ready_locked(self) -> None:
+        if self._specs is None:
+            return
+        still_pending = []
+        for spec in self._pending:
+            if self._coverage.covers(spec.start, spec.end):
+                self._queue.put(spec)
+            else:
+                still_pending.append(spec)
+        self._pending = still_pending
+
+    # -- device transfer ---------------------------------------------------
+
+    def _transfer_loop(self) -> None:
+        import jax
+
+        while True:
+            spec = self._queue.get()
+            if spec is None:
+                return
+            try:
+                view = self._staging[spec.start:spec.end]
+                arr = view.view(_dtype(spec.dtype)).reshape(spec.shape)
+                placement = (
+                    self._sharding_for(spec.name)
+                    if self._sharding_for is not None else self._device
+                )
+                device_arr = jax.device_put(arr, placement)
+                with self._lock:
+                    self._arrays[spec.name] = device_arr
+            except Exception as exc:
+                logger.exception("hbm transfer failed for %s", spec.name)
+                with self._lock:
+                    self._errors.append(f"{spec.name}: {exc}")
+
+    # -- completion --------------------------------------------------------
+
+    def wait(self, timeout: float = 300.0) -> Dict[str, object]:
+        """Block until every tensor is on device; returns name → jax.Array."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self._errors:
+                    raise RuntimeError("; ".join(self._errors))
+                total = len(self._specs) if self._specs is not None else None
+                done = len(self._arrays)
+            if total is not None and done >= total and self._queue.empty():
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"hbm sink: {done}/{total} tensors after {timeout}s "
+                    f"({self._coverage.covered_bytes()}/{self.content_length} "
+                    "bytes covered)")
+            time.sleep(0.01)
+        self.close()
+        import jax
+
+        for arr in self._arrays.values():
+            arr.block_until_ready()
+        return dict(self._arrays)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._workers:
+            self._queue.put(None)
+        for w in self._workers:
+            w.join(timeout=10)
+
+    @property
+    def tensors_on_device(self) -> int:
+        with self._lock:
+            return len(self._arrays)
+
+
+def download_to_hbm(daemon, url: str, *, device=None,
+                    sharding_for: Optional[Callable[[str], object]] = None,
+                    timeout: float = 300.0,
+                    **download_kwargs) -> Dict[str, object]:
+    """P2P-download a safetensors file straight into TPU HBM.
+
+    Config #5's entry point: pieces stream into the sink as they verify;
+    tensors whose spans complete are transferred while the rest of the file
+    is still downloading. Content length may be unknown at start (pieces
+    buffer as metadata until the length is learned, then flush). Returns
+    name → jax.Array.
+    """
+    lock = threading.Lock()
+    state: dict = {"sink": None, "backlog": []}
+
+    def ensure_sink(store) -> Optional[HBMSink]:
+        if state["sink"] is None:
+            length = store.meta.content_length
+            if length < 0:
+                return None
+            state["sink"] = HBMSink(length, device=device,
+                                    sharding_for=sharding_for)
+            for piece_num in state["backlog"]:
+                state["sink"].write(
+                    store.meta.pieces[piece_num].start,
+                    store.read_piece(num=piece_num),
+                )
+            state["backlog"].clear()
+        return state["sink"]
+
+    def on_piece(store, piece) -> None:
+        with lock:
+            sink = ensure_sink(store)
+            if sink is None:
+                state["backlog"].append(piece.num)
+                return
+            sink.write(piece.start, store.read_piece(num=piece.num))
+
+    result = daemon.download_file(url, piece_sink=on_piece, **download_kwargs)
+    if not result.success:
+        raise RuntimeError(f"download failed: {result.error}")
+    if result.direct_bytes is not None:
+        # EMPTY/TINY size-scope fast path: no storage, payload is inline.
+        sink = HBMSink(len(result.direct_bytes), device=device,
+                       sharding_for=sharding_for)
+        sink.write(0, result.direct_bytes)
+        return sink.wait(timeout=timeout)
+    store = result.storage
+    with lock:
+        sink = ensure_sink(store)
+        if sink is None:
+            raise RuntimeError("content length never learned")
+        # Reuse fast path (or a raced hook): feed any pieces the hook
+        # never saw.
+        seen = sink._coverage.covered_bytes()
+        if seen < store.meta.content_length:
+            for num in store.existing_piece_nums():
+                piece = store.meta.pieces[num]
+                if not sink._coverage.covers(piece.start,
+                                             piece.start + piece.length):
+                    sink.write(piece.start, store.read_piece(num=num))
+    return sink.wait(timeout=timeout)

@@ -200,6 +200,7 @@ class PeerTaskConductor:
         shaper: TrafficShaper | None = None,
         options: PeerTaskOptions | None = None,
         is_seed: bool = False,
+        piece_sink=None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -211,6 +212,10 @@ class PeerTaskConductor:
         self.shaper = shaper or PlainTrafficShaper()
         self.opts = options or PeerTaskOptions()
         self.is_seed = is_seed
+        # Optional hook called (store, PieceMetadata) after each verified
+        # piece write — feeds the HBM sink (client/hbm_sink.py) without
+        # bypassing storage.
+        self.piece_sink = piece_sink
 
         self.channel = QueueChannel()
         self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
@@ -439,6 +444,7 @@ class PeerTaskConductor:
             return
         with self._written_lock:
             self._written.add(piece.num)
+        self._notify_piece_sink(piece.num)
         self.shaper.record(self.task_id, piece.length)
         try:
             self.scheduler.download_piece_finished(PieceFinished(
@@ -450,6 +456,15 @@ class PeerTaskConductor:
         except Exception:
             logger.debug("piece finished report failed", exc_info=True)
         self._check_finished()
+
+    def _notify_piece_sink(self, piece_num: int) -> None:
+        if self.piece_sink is None:
+            return
+        try:
+            piece = self.store.meta.pieces[piece_num]
+            self.piece_sink(self.store, piece)
+        except Exception:
+            logger.exception("piece sink failed for piece %d", piece_num)
 
     def _report_piece_failed(self, parent_id: str, piece_number: int) -> None:
         try:
@@ -593,6 +608,7 @@ class PeerTaskConductor:
             # Record the piece md5 observed on the wire so children can
             # verify (back-source pieces define the task's truth).
             self.store.set_piece_digest(num, reader.hexdigest(), cost)
+            self._notify_piece_sink(num)
             self.shaper.record(self.task_id, rng.length)
             try:
                 self.scheduler.download_piece_finished(PieceFinished(
@@ -654,6 +670,7 @@ class PeerTaskConductor:
                 ))
             except Exception:
                 logger.debug("piece report failed", exc_info=True)
+            self._notify_piece_sink(num)
             offset += len(data)
             num += 1
         resp.close()

@@ -155,7 +155,15 @@ class InferenceService:
 
     def serve_watcher(self) -> None:
         if self._watcher is not None and self._watcher.is_alive():
-            return
+            if not self._stop.is_set():
+                return  # already running
+            # Stop was requested but the thread is still draining a slow
+            # reload; wait it out before starting the replacement.
+            self._watcher.join(timeout=5)
+            if self._watcher.is_alive():
+                logger.warning("previous model watcher still draining; "
+                               "restart deferred")
+                return
         self._stop.clear()  # allow restart after stop()
         self._watcher = threading.Thread(
             target=self._watch_loop, name="model-watcher", daemon=True

@@ -336,6 +336,16 @@ class SchedulerRpcService:
                 return
             yield item
 
+    @staticmethod
+    def _is_scheduling_request(req) -> bool:
+        """Only registration and download-start drive scheduling; errors on
+        report-only messages (piece results, finish/fail events) must not
+        abort a progressing download — in-process the conductor swallows
+        those same exceptions."""
+        return isinstance(req, WireRegisterPeer) or (
+            isinstance(req, WirePeerEvent) and req.event == "started"
+        )
+
     def _dispatch(self, req, channel, outbound: "queue.Queue") -> None:
         svc = self.service
         try:
@@ -374,10 +384,15 @@ class SchedulerRpcService:
                 outbound.put(WireError("InvalidArgument",
                                        f"unknown request {type(req).__name__}"))
         except ServiceError as exc:
-            outbound.put(WireError(exc.code, str(exc)))
+            if self._is_scheduling_request(req):
+                outbound.put(WireError(exc.code, str(exc)))
+            else:
+                logger.debug("report dispatch failed: %s", exc)
         except Exception as exc:  # scheduling errors → peer-visible error
             logger.exception("announce dispatch failed")
-            outbound.put(WireError("Internal", f"{type(exc).__name__}: {exc}"))
+            if self._is_scheduling_request(req):
+                outbound.put(WireError("Internal",
+                                       f"{type(exc).__name__}: {exc}"))
 
     def _peer_event(self, req: WirePeerEvent) -> None:
         svc = self.service

@@ -1,0 +1,200 @@
+"""HBM sink tests — config #5: P2P safetensors → device memory.
+
+Covers the safetensors codec, out-of-order reassembly with eager per-tensor
+transfer, the conductor piece_sink hook end to end through the P2P mesh,
+and sharded placement over the virtual device mesh.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.client.hbm_sink import (
+    HBMSink,
+    download_to_hbm,
+    parse_safetensors_header,
+    write_safetensors,
+)
+
+
+def make_tensors(seed: int = 0) -> dict:
+    rng = np.random.default_rng(seed)
+    return {
+        "embed.weight": rng.normal(size=(256, 64)).astype(np.float32),
+        "layer0.w": rng.normal(size=(64, 128)).astype(np.float32),
+        "layer0.b": rng.normal(size=(128,)).astype(np.float32),
+        "head.weight": rng.normal(size=(128, 32)).astype(np.float16),
+        "counts": rng.integers(0, 100, size=(7,)).astype(np.int32),
+    }
+
+
+class TestSafetensorsCodec:
+    def test_roundtrip(self, tmp_path):
+        tensors = make_tensors()
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors, metadata={"format": "pt"})
+        raw = open(path, "rb").read()
+        specs, data_start = parse_safetensors_header(raw)
+        assert {s.name for s in specs} == set(tensors)
+        for spec in specs:
+            got = np.frombuffer(
+                raw[spec.start:spec.end],
+                dtype=tensors[spec.name].dtype,
+            ).reshape(spec.shape)
+            np.testing.assert_array_equal(got, tensors[spec.name])
+
+    def test_bf16(self, tmp_path):
+        import ml_dtypes
+
+        arr = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        path = str(tmp_path / "bf16.safetensors")
+        write_safetensors(path, {"w": arr})
+        specs, _ = parse_safetensors_header(open(path, "rb").read())
+        assert specs[0].dtype == "BF16"
+
+    def test_incomplete_header_raises(self):
+        with pytest.raises(ValueError):
+            parse_safetensors_header(b"\x00" * 4)
+
+
+class TestHBMSink:
+    def test_out_of_order_pieces_land_all_tensors(self, tmp_path):
+        tensors = make_tensors()
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors)
+        raw = open(path, "rb").read()
+        sink = HBMSink(len(raw))
+        piece = 1000
+        offsets = list(range(0, len(raw), piece))
+        # Arrival order: reversed — header arrives LAST; tensors must
+        # still all land (burst/unordered hard-part from SURVEY §7).
+        for off in reversed(offsets):
+            sink.write(off, raw[off:off + piece])
+        arrays = sink.wait(timeout=60)
+        assert set(arrays) == set(tensors)
+        for name, arr in arrays.items():
+            np.testing.assert_array_equal(np.asarray(arr), tensors[name])
+
+    def test_eager_transfer_before_completion(self, tmp_path):
+        """A tensor whose span is complete transfers while later bytes are
+        still missing."""
+        import time
+
+        tensors = make_tensors()
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors)
+        raw = open(path, "rb").read()
+        specs, _ = parse_safetensors_header(raw)
+        sink = HBMSink(len(raw))
+        first = specs[0]
+        sink.write(0, raw[:first.end])  # header + first tensor only
+        deadline = time.monotonic() + 30
+        while sink.tensors_on_device < 1:
+            assert time.monotonic() < deadline, "first tensor never landed"
+            time.sleep(0.01)
+        assert sink.tensors_on_device >= 1
+        sink.write(first.end, raw[first.end:])
+        arrays = sink.wait(timeout=60)
+        assert set(arrays) == set(tensors)
+
+    def test_write_past_end_rejected(self):
+        sink = HBMSink(100)
+        with pytest.raises(ValueError):
+            sink.write(90, b"x" * 20)
+        sink.close()
+
+    def test_wait_timeout_reports_progress(self, tmp_path):
+        tensors = make_tensors()
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors)
+        raw = open(path, "rb").read()
+        sink = HBMSink(len(raw))
+        sink.write(0, raw[:2000])  # header only, tensors incomplete
+        with pytest.raises(TimeoutError):
+            sink.wait(timeout=0.2)
+        sink.close()
+
+
+class TestP2PToHBM:
+    def test_download_to_hbm_through_mesh(self, tmp_path):
+        """Full config #5 slice: origin safetensors → P2P (seed + peer) →
+        HBM; tensors verified element-exact against the origin."""
+        from tests.fileserver import FileServer
+        from tests.test_p2p_e2e import make_daemon, make_scheduler
+        from dragonfly2_tpu.utils.hosttypes import HostType
+
+        tensors = make_tensors(seed=7)
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        write_safetensors(str(origin_root / "model.safetensors"), tensors)
+        with FileServer(str(origin_root)) as fs:
+            scheduler = make_scheduler(tmp_path)
+            seed = make_daemon(scheduler, tmp_path, "seed", HostType.SUPER_SEED)
+            scheduler.seed_peer_client = seed.seed_client()
+            peer = make_daemon(scheduler, tmp_path, "peer-hbm")
+            try:
+                arrays = download_to_hbm(
+                    peer, fs.url("model.safetensors"), timeout=120)
+                assert set(arrays) == set(tensors)
+                for name, arr in arrays.items():
+                    np.testing.assert_array_equal(
+                        np.asarray(arr), tensors[name])
+            finally:
+                peer.stop()
+                seed.stop()
+
+    def test_reuse_path_feeds_sink(self, tmp_path):
+        """Second download of the same file hits the storage reuse fast
+        path — the sink must still fill from stored pieces."""
+        from tests.fileserver import FileServer
+        from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+        tensors = make_tensors(seed=9)
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        write_safetensors(str(origin_root / "m.safetensors"), tensors)
+        with FileServer(str(origin_root)) as fs:
+            scheduler = make_scheduler(tmp_path)
+            peer = make_daemon(scheduler, tmp_path, "peer-a")
+            try:
+                url = fs.url("m.safetensors")
+                assert peer.download_file(url).success
+                arrays = download_to_hbm(peer, url, timeout=60)
+                assert set(arrays) == set(tensors)
+            finally:
+                peer.stop()
+
+    def test_sharded_placement_on_mesh(self, tmp_path):
+        """sharding_for routes tensors onto a NamedSharding — the
+        multi-chip fan-out layout (validated on the virtual CPU mesh)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dragonfly2_tpu.parallel import data_parallel_mesh
+
+        mesh = data_parallel_mesh()
+        if mesh.n_data < 2:
+            pytest.skip("needs multi-device mesh")
+        sharding = NamedSharding(mesh.mesh, PartitionSpec("data"))
+
+        replicated = NamedSharding(mesh.mesh, PartitionSpec())
+
+        def sharding_for(name: str):
+            # rows divisible by mesh size → shard; else replicate
+            return sharding if name == "embed.weight" else replicated
+
+        tensors = make_tensors(seed=3)
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors)
+        raw = open(path, "rb").read()
+        sink = HBMSink(len(raw), sharding_for=sharding_for)
+        for off in range(0, len(raw), 4096):
+            sink.write(off, raw[off:off + 4096])
+        arrays = sink.wait(timeout=60)
+        embed = arrays["embed.weight"]
+        assert len(embed.sharding.device_set) == mesh.n_data
+        np.testing.assert_array_equal(
+            np.asarray(embed), tensors["embed.weight"])
