@@ -5,12 +5,13 @@ training.go:82-98, both empty TODOs) and its registry stores their metrics
 (manager/models/model.go:19-46: ``mlp`` with mse/mae, ``gnn`` with
 precision/recall/f1). We implement both, plus the scale-out GAT config:
 
-- :mod:`.mlp`       — bandwidth predictor over (parent, child) pair features
-- :mod:`.graphsage` — GraphSAGE over the probe topology graph
-- :mod:`.gat`       — attention variant for the full-cluster config
+- :mod:`.mlp`               — bandwidth predictor over (parent, child) pair features
+- :mod:`.graphsage`         — GraphSAGE over the probe topology graph
+- :mod:`.graph_transformer` — full-graph attention for the cluster-scale config
 """
 
+from dragonfly2_tpu.models.graph_transformer import GraphTransformer
 from dragonfly2_tpu.models.graphsage import GraphSAGE
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 
-__all__ = ["GraphSAGE", "MLPBandwidthPredictor", "Normalizer"]
+__all__ = ["GraphSAGE", "GraphTransformer", "MLPBandwidthPredictor", "Normalizer"]

@@ -1,0 +1,170 @@
+"""GraphTransformer — full-graph attention over the cluster topology
+(BASELINE config #3, the scale-out GNN).
+
+Where GraphSAGE (config #2) trains on sampled fixed-fanout subgraphs, this
+model attends over the ENTIRE probe graph at once: every host embedding is
+refined by multi-head attention restricted to its probe neighbors, with the
+measured RTT injected as an additive attention bias — the graph structure
+lives in the bias matrix, not in gathers.
+
+TPU mapping:
+- The graph is dense tensors end to end: node features [N, F] and an edge
+  bias/mask pair [N, N] built host-side once. Attention is three bf16
+  matmuls per head group — pure MXU work, no scatter/gather, no dynamic
+  shapes.
+- Sharding: rows (query nodes) shard over the mesh's ``data`` axis; K/V
+  stay full-width, so XLA inserts an all-gather of the [N, H] activations
+  over ICI and every device computes attention for its N/d query rows —
+  the canonical row-sharded attention layout. Pad N to a multiple of the
+  mesh size (``pad_graph``).
+- Heads are a plain reshape of the feature axis; with a ``model`` mesh
+  axis, Dense kernels shard over it (tensor parallelism) without touching
+  this module — annotations live in the trainer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e9
+
+
+def replicate(x):
+    """All-gather a row-sharded activation when running under an explicit
+    mesh (K/V and the embedding table must be full-width on every device
+    for row-sharded attention); no-op outside a mesh context."""
+    if jax.sharding.get_abstract_mesh().empty:
+        return x
+    return jax.sharding.reshard(x, P(*(None,) * x.ndim))
+
+
+def build_bias(n_nodes: int, edge_src: np.ndarray, edge_dst: np.ndarray,
+               edge_rtt_ns: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: (rtt_bias [N, N] float32, mask [N, N] float32).
+
+    ``rtt_bias[s, d]`` is −log1p(rtt_ms) for a probed edge (faster paths
+    get larger bias → more attention); mask is 1 for probed edges and the
+    diagonal (self-attention), 0 elsewhere. Probes are directed; both
+    directions are added since parent quality is what either endpoint
+    observed.
+    """
+    rtt_ms = edge_rtt_ns.astype(np.float64) / 1e6
+    value = -np.log1p(rtt_ms).astype(np.float32)
+    # Order-independent aggregation: repeated sightings of a pair (either
+    # direction) resolve to the BEST observed RTT (max bias), never
+    # last-write-wins over the probe record order.
+    bias = np.full((n_nodes, n_nodes), -np.inf, dtype=np.float32)
+    np.maximum.at(bias, (edge_src, edge_dst), value)
+    np.maximum.at(bias, (edge_dst, edge_src), value)
+    mask = np.isfinite(bias).astype(np.float32)
+    bias[~np.isfinite(bias)] = 0.0
+    idx = np.arange(n_nodes)
+    mask[idx, idx] = 1.0
+    return bias, mask
+
+
+def pad_graph(node_features: np.ndarray, bias: np.ndarray, mask: np.ndarray,
+              multiple: int) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Pad node count up to ``multiple`` so rows shard evenly; padded rows
+    are fully masked (attend to nothing, attended by nothing)."""
+    n = node_features.shape[0]
+    padded = ((n + multiple - 1) // multiple) * multiple
+    if padded == n:
+        return node_features, bias, mask, n
+    node_features = np.pad(node_features, ((0, padded - n), (0, 0)))
+    bias = np.pad(bias, ((0, padded - n), (0, padded - n)))
+    mask = np.pad(mask, ((0, padded - n), (0, padded - n)))
+    return node_features, bias, mask, n
+
+
+class GraphAttentionBlock(nn.Module):
+    """Pre-LN multi-head graph attention + MLP, residual throughout."""
+
+    hidden: int
+    heads: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h, bias, mask):
+        # h: [N, H]; bias/mask: [N, N]
+        head_dim = self.hidden // self.heads
+        x = nn.LayerNorm(dtype=self.dtype)(h)
+        q = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        k = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        v = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
+
+        def split(t):  # [N, H] -> [heads, N, head_dim]
+            return t.reshape(-1, self.heads, head_dim).transpose(1, 0, 2)
+
+        # Queries keep their row sharding; K/V all-gather over ICI so each
+        # device scores its rows against every node.
+        q, k, v = split(q), replicate(split(k)), replicate(split(v))
+        scores = jnp.einsum("hnd,hmd->hnm", q, k) / np.sqrt(head_dim)
+        scores = scores + bias[None, :, :].astype(self.dtype)
+        scores = jnp.where(mask[None, :, :] > 0, scores, NEG_INF)
+        # Softmax in f32 for stability, back to bf16 for the AV matmul.
+        attn = nn.softmax(scores.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("hnm,hmd->hnd", attn, v)
+        out = out.transpose(1, 0, 2).reshape(-1, self.hidden)
+        out = nn.Dense(self.hidden, dtype=self.dtype,
+                       param_dtype=jnp.float32)(out)
+        h = h + out
+        # MLP block
+        y = nn.LayerNorm(dtype=self.dtype)(h)
+        y = nn.Dense(self.hidden * 2, dtype=self.dtype,
+                     param_dtype=jnp.float32)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(y)
+        return h + y
+
+
+class GraphTransformer(nn.Module):
+    """L attention blocks over the full topology + edge scoring head.
+
+    ``__call__`` returns per-edge logits for (src, dst) index arrays —
+    same contract as GraphSAGE's edge head, so eval/registry plumbing is
+    shared.
+    """
+
+    hidden: int = 128
+    embed: int = 64
+    layers: int = 2
+    heads: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self):
+        self.input_proj = nn.Dense(self.hidden, dtype=self.dtype,
+                                   param_dtype=jnp.float32)
+        self.blocks = [
+            GraphAttentionBlock(self.hidden, self.heads, self.dtype)
+            for _ in range(self.layers)
+        ]
+        self.final_norm = nn.LayerNorm(dtype=self.dtype)
+        self.embed_proj = nn.Dense(self.embed, dtype=self.dtype,
+                                   param_dtype=jnp.float32)
+        self.head_hidden = nn.Dense(self.embed, dtype=self.dtype,
+                                    param_dtype=jnp.float32)
+        self.head_out = nn.Dense(1, dtype=jnp.float32,
+                                 param_dtype=jnp.float32)
+
+    def node_embeddings(self, node_features, bias, mask):
+        """[N, F] → [N, E]; exposed for serving (embedding export)."""
+        h = self.input_proj(node_features.astype(self.dtype))
+        for block in self.blocks:
+            h = block(h, bias, mask)
+        return self.embed_proj(self.final_norm(h))
+
+    def __call__(self, node_features, bias, mask, edge_src, edge_dst):
+        emb = self.node_embeddings(node_features, bias, mask)  # [N, E]
+        # One all-gather of the (small) embedding table per step; edge
+        # index gathers then stay local.
+        emb = replicate(emb)
+        src = emb[edge_src]                                    # [B, E]
+        dst = emb[edge_dst]
+        pair = jnp.concatenate([src, dst], axis=-1)
+        x = nn.relu(self.head_hidden(pair))
+        return self.head_out(x)[..., 0]

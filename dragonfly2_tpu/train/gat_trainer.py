@@ -1,0 +1,204 @@
+"""Full-graph GraphTransformer training (BASELINE config #3).
+
+Sharding layout (the scaling-book recipe — annotate, let XLA insert
+collectives):
+- node features / bias / mask rows shard over ``data`` (each device owns
+  N/d query rows and their outgoing-attention rows);
+- params and optimizer state replicate (allreduce gradients over ICI);
+- the per-step edge minibatch replicates (it indexes the full embedding
+  table, whose row shards XLA all-gathers exactly once per step where the
+  gather needs them).
+
+Train-graph/eval-edge leakage discipline matches gnn_trainer: the attention
+bias is built from TRAIN edges only, so an eval edge's RTT (a deterministic
+function of its label) never appears in the message structure.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+
+from dragonfly2_tpu.data.features import Graph
+from dragonfly2_tpu.models.graph_transformer import (
+    GraphTransformer,
+    build_bias,
+    pad_graph,
+)
+from dragonfly2_tpu.parallel import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.train.gnn_trainer import edge_split
+from dragonfly2_tpu.train.metrics import metrics_from_confusion, padded_chunks
+
+
+@dataclass(frozen=True)
+class GATTrainConfig:
+    hidden: int = 128
+    embed: int = 64
+    layers: int = 2
+    heads: int = 4
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-4
+    edge_batch_size: int = 4096
+    epochs: int = 5
+    seed: int = 0
+    eval_fraction: float = 0.1
+    rtt_threshold_ns: int = 20_000_000
+
+
+@dataclass
+class GATTrainResult:
+    params: dict
+    config: GATTrainConfig
+    node_features: np.ndarray  # padded
+    bias: np.ndarray
+    mask: np.ndarray
+    n_real_nodes: int
+    precision: float
+    recall: float
+    f1: float
+    accuracy: float
+    samples_per_sec: float
+    history: list = field(default_factory=list)
+
+    @property
+    def model(self) -> GraphTransformer:
+        return GraphTransformer(
+            hidden=self.config.hidden, embed=self.config.embed,
+            layers=self.config.layers, heads=self.config.heads,
+        )
+
+
+def train_gat(
+    graph: Graph,
+    config: GATTrainConfig = GATTrainConfig(),
+    mesh: MeshContext | None = None,
+) -> GATTrainResult:
+    mesh = mesh or data_parallel_mesh()
+    labels_all = graph.edge_labels(config.rtt_threshold_ns).astype(np.float32)
+    # Pair-level split (shared with gnn_trainer): every sighting of an
+    # eval (src, dst) pair stays out of training AND out of the bias.
+    train_ids, eval_ids = edge_split(graph, config.eval_fraction, config.seed)
+
+    # Attention structure from TRAIN edges only (leakage discipline).
+    bias, mask = build_bias(
+        graph.n_nodes,
+        graph.edge_src[train_ids], graph.edge_dst[train_ids],
+        graph.edge_rtt_ns[train_ids],
+    )
+    node_features, bias, mask, n_real = pad_graph(
+        graph.node_features, bias, mask, mesh.n_data
+    )
+
+    model = GraphTransformer(hidden=config.hidden, embed=config.embed,
+                             layers=config.layers, heads=config.heads)
+    params = model.init(
+        jax.random.key(config.seed),
+        jnp.asarray(node_features), jnp.asarray(bias), jnp.asarray(mask),
+        jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32),
+    )
+
+    batch = min(config.edge_batch_size, len(train_ids))
+    steps_per_epoch = max(len(train_ids) // batch, 1)
+    total_steps = max(config.epochs * steps_per_epoch, 2)
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, config.learning_rate, min(100, total_steps // 10 + 1), total_steps,
+    )
+    tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx)
+    state = mesh.put_replicated(state)
+
+    # Graph tensors: rows sharded over data; placed once, reused each step.
+    row = mesh.shard_spec("data")
+    g_feat = jax.device_put(node_features, row)
+    g_bias = jax.device_put(bias, row)
+    g_mask = jax.device_put(mask, row)
+    rep = mesh.replicated
+
+    def train_step(state, feat, bias_, mask_, src, dst, y):
+        def loss_fn(params):
+            logits = state.apply_fn(params, feat, bias_, mask_, src, dst)
+            return optax.sigmoid_binary_cross_entropy(logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    train_step = jax.jit(
+        train_step,
+        in_shardings=(None, row, row, row, rep, rep, rep),
+        donate_argnums=(0,),
+    )
+
+    def eval_step(params, feat, bias_, mask_, src, dst, y, w):
+        logits = model.apply(params, feat, bias_, mask_, src, dst)
+        pred = (logits > 0).astype(jnp.float32)
+        tp = jnp.sum(w * pred * y)
+        fp = jnp.sum(w * pred * (1 - y))
+        fn = jnp.sum(w * (1 - pred) * y)
+        tn = jnp.sum(w * (1 - pred) * (1 - y))
+        return jnp.stack([tp, fp, fn, tn])
+
+    eval_step = jax.jit(
+        eval_step, in_shardings=(None, row, row, row, rep, rep, rep, rep))
+
+    def rep_put(a):
+        return jax.device_put(np.asarray(a), rep)
+
+    rng = np.random.default_rng((config.seed, 7))
+    history = []
+    n_samples = 0
+    start = time.perf_counter()
+    # Explicit-sharding mode: the in-model reshard (K/V all-gather) needs
+    # the ambient mesh during trace.
+    with jax.set_mesh(mesh.mesh):
+        for _ in range(config.epochs):
+            order = rng.permutation(train_ids)
+            losses = []
+            for i in range(steps_per_epoch):
+                ids = order[i * batch:(i + 1) * batch]
+                if len(ids) < batch:
+                    break
+                state, loss = train_step(
+                    state, g_feat, g_bias, g_mask,
+                    rep_put(graph.edge_src[ids].astype(np.int32)),
+                    rep_put(graph.edge_dst[ids].astype(np.int32)),
+                    rep_put(labels_all[ids]),
+                )
+                losses.append(loss)
+                n_samples += len(ids)
+            if losses:
+                history.append(float(jnp.mean(jnp.stack(losses))))
+        jax.block_until_ready(state.params)
+        elapsed = time.perf_counter() - start
+
+        # Exact eval in fixed-size chunks with a zero-weighted tail.
+        cm = np.zeros(4)
+        for ids, weights in padded_chunks(eval_ids, batch):
+            cm += np.asarray(eval_step(
+                state.params, g_feat, g_bias, g_mask,
+                rep_put(graph.edge_src[ids].astype(np.int32)),
+                rep_put(graph.edge_dst[ids].astype(np.int32)),
+                rep_put(labels_all[ids]), rep_put(weights),
+            ))
+    metrics = metrics_from_confusion(cm)
+
+    return GATTrainResult(
+        params=jax.device_get(state.params),
+        config=config,
+        node_features=node_features,
+        bias=bias,
+        mask=mask,
+        n_real_nodes=n_real,
+        precision=metrics["precision"],
+        recall=metrics["recall"],
+        f1=metrics["f1"],
+        accuracy=metrics["accuracy"],
+        samples_per_sec=n_samples / elapsed if elapsed > 0 else 0.0,
+        history=history,
+    )

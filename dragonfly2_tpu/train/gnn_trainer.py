@@ -60,7 +60,7 @@ class GNNTrainResult:
         return GraphSAGE(hidden=self.config.hidden, embed=self.config.embed)
 
 
-def _edge_split(graph: Graph, eval_fraction: float, seed: int):
+def edge_split(graph: Graph, eval_fraction: float, seed: int):
     """Split edges by (src, dst) PAIR, not edge id.
 
     Probe datasets contain repeated sightings of the same ordered pair;
@@ -128,7 +128,7 @@ def train_gnn(
 ) -> GNNTrainResult:
     mesh = mesh or data_parallel_mesh()
     labels = graph.edge_labels(config.rtt_threshold_ns)
-    train_ids, eval_ids = _edge_split(graph, config.eval_fraction, config.seed)
+    train_ids, eval_ids = edge_split(graph, config.eval_fraction, config.seed)
     batch_size = (min(config.batch_size, len(train_ids)) // mesh.n_data) * mesh.n_data
     if batch_size == 0:
         raise ValueError(
@@ -193,33 +193,26 @@ def train_gnn(
 
     # Exact eval: fixed-size chunks with a zero-weighted padded tail, so
     # every eval edge counts exactly once under static batch shapes.
+    from dragonfly2_tpu.train.metrics import metrics_from_confusion, padded_chunks
+
     cm = np.zeros(4)
     eval_rng = np.random.default_rng((config.seed, 2))
-    n_eval = eval_sampler.n_edges
-    for start in range(0, n_eval, batch_size):
-        ids = np.arange(start, min(start + batch_size, n_eval))
-        weights = np.ones(batch_size, np.float32)
-        if len(ids) < batch_size:
-            weights[len(ids):] = 0.0
-            ids = np.concatenate([ids, np.zeros(batch_size - len(ids), np.int64)])
+    for ids, weights in padded_chunks(np.arange(eval_sampler.n_edges),
+                                      batch_size):
         batch = eval_sampler.sample(ids, eval_rng)
         cm += np.asarray(
             eval_step(state.params, *put(batch), mesh.put_batch(weights))
         )
-    tp, fp, fn, tn = cm
-    precision = tp / (tp + fp) if tp + fp else 0.0
-    recall = tp / (tp + fn) if tp + fn else 0.0
-    f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
-    accuracy = (tp + tn) / cm.sum() if cm.sum() else float("nan")
+    metrics = metrics_from_confusion(cm)
 
     return GNNTrainResult(
         params=jax.device_get(state.params),
         config=config,
         node_features=csr.node_features,
-        precision=float(precision),
-        recall=float(recall),
-        f1=float(f1),
-        accuracy=float(accuracy),
+        precision=metrics["precision"],
+        recall=metrics["recall"],
+        f1=metrics["f1"],
+        accuracy=metrics["accuracy"],
         samples_per_sec=n_samples / elapsed,
         history=history,
     )

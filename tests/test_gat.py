@@ -1,0 +1,110 @@
+"""GraphTransformer (config #3) tests on the virtual 8-device mesh.
+
+Verifies the row-sharded attention layout compiles and runs sharded, the
+edge head learns on a separable synthetic topology, and padding/masking
+keep phantom nodes out of the math.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.data import SyntheticCluster
+from dragonfly2_tpu.models.graph_transformer import (
+    GraphTransformer,
+    build_bias,
+    pad_graph,
+)
+from dragonfly2_tpu.parallel import data_parallel_mesh
+from dragonfly2_tpu.train.gat_trainer import GATTrainConfig, train_gat
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cluster = SyntheticCluster(n_hosts=48, seed=0)
+    graph = cluster.probe_graph(4000)
+    mesh = data_parallel_mesh()
+    result = train_gat(
+        graph,
+        GATTrainConfig(hidden=32, embed=16, layers=2, heads=4, epochs=30,
+                       edge_batch_size=512, learning_rate=1e-2,
+                       eval_fraction=0.15),
+        mesh,
+    )
+    return {"result": result, "graph": graph, "mesh": mesh}
+
+
+class TestBiasConstruction:
+    def test_bias_and_mask(self):
+        src = np.array([0, 1], dtype=np.int64)
+        dst = np.array([1, 2], dtype=np.int64)
+        rtt = np.array([1_000_000, 50_000_000], dtype=np.int64)  # 1ms, 50ms
+        bias, mask = build_bias(4, src, dst, rtt)
+        assert mask[0, 1] == 1.0 and mask[1, 0] == 1.0  # symmetrized
+        assert mask[0, 2] == 0.0
+        assert mask[3, 3] == 1.0  # self-attention on isolated node
+        assert bias[0, 1] > bias[1, 2]  # faster edge → larger bias
+
+    def test_pad_graph_multiple(self):
+        feats = np.ones((10, 4), np.float32)
+        bias = np.ones((10, 10), np.float32)
+        mask = np.ones((10, 10), np.float32)
+        f, b, m, n = pad_graph(feats, bias, mask, 8)
+        assert f.shape == (16, 4) and b.shape == (16, 16)
+        assert n == 10
+        assert m[12].sum() == 0  # padded rows fully masked
+
+
+class TestTraining:
+    def test_runs_sharded_on_mesh(self, trained):
+        mesh = trained["mesh"]
+        assert mesh.n_data == jax.device_count()
+        result = trained["result"]
+        assert result.n_real_nodes == 48
+        assert result.node_features.shape[0] % mesh.n_data == 0
+        assert len(result.history) == 30
+        assert result.samples_per_sec > 0
+
+    def test_learns_separable_topology(self, trained):
+        """Synthetic cluster RTTs are largely explained by idc/region
+        affinity present in the node features + bias — the model must beat
+        the trivial all-positive/all-negative baselines."""
+        result = trained["result"]
+        assert result.history[-1] < result.history[0]  # loss decreased
+        assert result.accuracy > 0.6
+        assert result.f1 > 0.3, (result.precision, result.recall)
+
+    def test_padded_nodes_do_not_leak(self, trained):
+        """Embeddings of real nodes must be invariant to padded phantom
+        rows: recompute with extra padding and compare."""
+        result = trained["result"]
+        graph = trained["graph"]
+        model = result.model
+        bias, mask = build_bias(graph.n_nodes, graph.edge_src,
+                                graph.edge_dst, graph.edge_rtt_ns)
+        f1, b1, m1, _ = pad_graph(graph.node_features, bias, mask, 8)
+        f2, b2, m2, _ = pad_graph(graph.node_features, bias, mask, 64)
+
+        def embed(f, b, m):
+            return model.apply(
+                result.params, f, b, m,
+                method=GraphTransformer.node_embeddings,
+            )
+
+        e1 = np.asarray(embed(f1, b1, m1))[: graph.n_nodes]
+        e2 = np.asarray(embed(f2, b2, m2))[: graph.n_nodes]
+        np.testing.assert_allclose(e1, e2, rtol=2e-2, atol=2e-2)
+
+    def test_edge_scores_finite_and_discriminative(self, trained):
+        result = trained["result"]
+        graph = trained["graph"]
+        labels = graph.edge_labels(result.config.rtt_threshold_ns)
+        logits = np.asarray(result.model.apply(
+            result.params, result.node_features, result.bias, result.mask,
+            graph.edge_src.astype(np.int32), graph.edge_dst.astype(np.int32),
+        ))
+        assert np.isfinite(logits).all()
+        # good edges should score higher on average than bad ones
+        assert logits[labels == 1].mean() > logits[labels == 0].mean()

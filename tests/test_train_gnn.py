@@ -111,7 +111,7 @@ class TestTrainGNN:
         assert res.samples_per_sec > 0
 
     def test_pair_level_split_no_leak(self, graph):
-        from dragonfly2_tpu.train.gnn_trainer import _edge_split
+        from dragonfly2_tpu.train.gnn_trainer import edge_split as _edge_split
 
         train_ids, eval_ids = _edge_split(graph, 0.2, seed=0)
         assert len(train_ids) + len(eval_ids) == graph.n_edges
