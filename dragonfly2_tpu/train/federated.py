@@ -1,0 +1,304 @@
+"""Federated multi-cluster training + manager-side aggregation
+(BASELINE config #4).
+
+The reference scaffolds exactly this shape without implementing it: the
+manager aggregates many scheduler clusters and every scheduler's trainer
+uploads its own model keyed by SchedulerID (manager/models/model.go:44,
+unique (type, version, scheduler_id)). Here the loop closes: each cluster
+trains locally on its own download dataset (pjit over its slice), the
+round's models FedAvg into a global model weighted by sample count, and the
+manager registers the aggregate under ``GLOBAL_SCHEDULER_ID`` with full
+lineage — preserving the per-cluster single-active invariant AND giving the
+fleet one blessed global model.
+
+Normalization: FedAvg of raw parameters is only meaningful under one shared
+feature/target normalization, so round 0 fits a GLOBAL normalizer from
+per-cluster moments (exact pooled mean/variance, no raw data pooling — the
+federated constraint) and every local trainer reuses it.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from dragonfly2_tpu.models.mlp import Normalizer
+from dragonfly2_tpu.parallel import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.train.mlp_trainer import (
+    MLPTrainConfig,
+    MLPTrainResult,
+    train_mlp,
+)
+
+logger = logging.getLogger(__name__)
+
+# The aggregate's registry slot. Must NOT collide with real scheduler ids:
+# the trainer's default upload path registers at scheduler_id=0, so the
+# global model lives at -1 and never evicts a cluster model.
+GLOBAL_SCHEDULER_ID = -1
+
+
+@dataclass
+class ClusterDataset:
+    """One scheduler cluster's local download examples."""
+
+    scheduler_id: int
+    X: np.ndarray  # [n, FEATURE_DIM] raw features
+    y: np.ndarray  # [n] MB/s
+
+
+@dataclass(frozen=True)
+class FederatedConfig:
+    local: MLPTrainConfig = MLPTrainConfig()
+    rounds: int = 3
+
+
+@dataclass
+class FederatedResult:
+    params: dict
+    normalizer: Normalizer
+    target_norm: Normalizer
+    config: FederatedConfig
+    mse: float
+    mae: float
+    # Lineage: per round, {scheduler_id: n_samples} that contributed.
+    lineage: List[Dict[int, int]] = field(default_factory=list)
+    per_cluster: Dict[int, MLPTrainResult] = field(default_factory=dict)
+
+    @property
+    def model(self):
+        from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor
+
+        return MLPBandwidthPredictor(hidden=tuple(self.config.local.hidden))
+
+
+def pooled_normalizers(
+    datasets: Sequence[ClusterDataset],
+) -> Tuple[Normalizer, Normalizer]:
+    """Exact pooled mean/std from per-cluster moments — each cluster ships
+    (n, Σx, Σx²), never raw rows."""
+
+    def pool(columns: List[np.ndarray]) -> Normalizer:
+        n = sum(len(c) for c in columns)
+        s1 = np.sum([c.sum(axis=0) for c in columns], axis=0)
+        s2 = np.sum([(c.astype(np.float64) ** 2).sum(axis=0) for c in columns],
+                    axis=0)
+        mean = s1 / n
+        var = np.maximum(s2 / n - mean**2, 0.0)
+        # Same epsilon convention as Normalizer.fit (+1e-6, mlp.py:40) so a
+        # pooled normalizer is bit-comparable with a centrally fitted one.
+        std = np.sqrt(var) + 1e-6
+        return Normalizer(mean=mean.astype(np.float32),
+                          std=std.astype(np.float32))
+
+    feat = pool([d.X for d in datasets])
+    target = pool([np.log1p(d.y)[:, None] for d in datasets])
+    return feat, target
+
+
+def fedavg(param_trees: Sequence, weights: Sequence[float]):
+    """Sample-weighted parameter average (McMahan et al. FedAvg)."""
+    total = float(sum(weights))
+    norm = [w / total for w in weights]
+
+    def avg(*leaves):
+        return sum(w * leaf for w, leaf in zip(norm, leaves))
+
+    return jax.tree.map(avg, *param_trees)
+
+
+def train_federated_mlp(
+    datasets: Sequence[ClusterDataset],
+    config: FederatedConfig = FederatedConfig(),
+    mesh: MeshContext | None = None,
+    eval_set: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+) -> FederatedResult:
+    """R rounds of local training + FedAvg.
+
+    On real hardware each cluster's local step runs on its own slice and
+    only parameter trees cross the DCN; in this single-process form the
+    locals run back to back on one mesh — the aggregation math and lineage
+    are identical.
+    """
+    if not datasets:
+        raise ValueError("no cluster datasets")
+    mesh = mesh or data_parallel_mesh()
+    normalizer, target_norm = pooled_normalizers(datasets)
+
+    global_params = None
+    lineage: List[Dict[int, int]] = []
+    per_cluster: Dict[int, MLPTrainResult] = {}
+    for round_idx in range(config.rounds):
+        trees, weights, contributed = [], [], {}
+        for ds in datasets:
+            result = train_mlp(
+                ds.X, ds.y, config.local, mesh,
+                init_params=global_params,
+                normalizer=normalizer, target_norm=target_norm,
+            )
+            per_cluster[ds.scheduler_id] = result
+            trees.append(result.params)
+            weights.append(len(ds.X))
+            contributed[ds.scheduler_id] = len(ds.X)
+        global_params = fedavg(trees, weights)
+        lineage.append(contributed)
+        logger.info("federated round %d: averaged %d clusters",
+                    round_idx, len(trees))
+
+    # Global eval of the aggregated model.
+    if eval_set is not None:
+        eval_X, eval_y = eval_set
+    else:
+        eval_X = np.concatenate([d.X for d in datasets])
+        eval_y = np.concatenate([d.y for d in datasets])
+    from dragonfly2_tpu.models.mlp import predict_bandwidth
+
+    model = per_cluster[datasets[0].scheduler_id].model
+    pred = np.asarray(predict_bandwidth(
+        model, global_params, normalizer, target_norm, eval_X))
+    err = pred - eval_y
+    return FederatedResult(
+        params=jax.device_get(global_params),
+        normalizer=normalizer,
+        target_norm=target_norm,
+        config=config,
+        mse=float((err**2).mean()),
+        mae=float(np.abs(err).mean()),
+        lineage=lineage,
+        per_cluster=per_cluster,
+    )
+
+
+# ----------------------------------------------------------------------
+# Manager-side aggregation (the registry half of config #4)
+# ----------------------------------------------------------------------
+
+
+def register_federated_model(manager, result: FederatedResult,
+                             model_id: str = "df2-mlp-global",
+                             hostname: str = "manager") -> None:
+    """Register the aggregate under GLOBAL_SCHEDULER_ID with lineage in the
+    evaluation payload; per-cluster models keep their own registry rows and
+    single-active invariants."""
+    import math
+    import shutil
+    import tempfile
+
+    from dragonfly2_tpu.train.checkpoint import (
+        ModelMetadata,
+        mlp_tree,
+        save_model,
+    )
+
+    lineage = [
+        {str(sid): n for sid, n in round_contrib.items()}
+        for round_contrib in result.lineage
+    ]
+    # NaN is not valid JSON to strict parsers; omit undefined metrics.
+    evaluation = {
+        k: v for k, v in (("mse", result.mse), ("mae", result.mae))
+        if not math.isnan(v)
+    }
+    tmp = tempfile.mkdtemp(prefix="df2-fed-")
+    try:
+        save_model(
+            tmp,
+            mlp_tree(result.params, result.normalizer, result.target_norm),
+            ModelMetadata(
+                model_id=model_id, model_type="mlp",
+                evaluation=evaluation,
+                config={
+                    "hidden": list(result.config.local.hidden),
+                    "federated_rounds": result.config.rounds,
+                    "lineage": lineage,
+                },
+            ),
+        )
+        manager.create_model(
+            model_id=model_id, model_type="mlp", host_id="federated",
+            ip="", hostname=hostname,
+            evaluation={
+                **evaluation,
+                "clusters": len(result.lineage[-1] if result.lineage else {}),
+            },
+            artifact_dir=tmp,
+            scheduler_id=GLOBAL_SCHEDULER_ID,
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def aggregate_cluster_models(manager, hidden: Sequence[int],
+                             model_id: str = "df2-mlp-global") -> bool:
+    """Pure manager-side FedAvg over the ACTIVE per-cluster models already
+    in the registry — the path where clusters upload independently (the
+    reference's per-SchedulerID flow) and the manager periodically blesses
+    a global aggregate. Returns False when fewer than two compatible
+    cluster models exist."""
+    import shutil
+    import tempfile
+
+    from dragonfly2_tpu.manager.service import untar_to_directory
+    from dragonfly2_tpu.train.checkpoint import load_model, mlp_from_tree
+
+    rows = [
+        r for r in manager.list_models()
+        if r.type == "mlp" and r.state == "active"
+        and r.scheduler_id != GLOBAL_SCHEDULER_ID
+    ]
+    if len(rows) < 2:
+        return False
+    trees, weights, normalizers, target_norms, contrib = [], [], [], [], {}
+    for row in rows:
+        active = manager.get_active_model("mlp", row.scheduler_id)
+        tmp = tempfile.mkdtemp(prefix="df2-agg-")
+        try:
+            untar_to_directory(active.artifact, tmp)
+            tree, metadata = load_model(tmp)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        if list(metadata.config.get("hidden", [])) != list(hidden):
+            logger.warning("skip model %s: hidden %s != %s",
+                           row.name, metadata.config.get("hidden"), hidden)
+            continue
+        params, normalizer, target_norm = mlp_from_tree(tree)
+        n = int(metadata.evaluation.get("n_samples", 0))
+        if n <= 0:
+            logger.warning("model %s lacks n_samples; weighting it as 1",
+                           row.name)
+            n = 1
+        trees.append(params)
+        weights.append(n)
+        normalizers.append(normalizer)
+        target_norms.append(target_norm)
+        contrib[int(row.scheduler_id)] = n
+    if len(trees) < 2:
+        return False
+    # FedAvg of raw parameters is meaningful ONLY under one shared
+    # normalization (module docstring). Independently-uploaded cluster
+    # models trained with per-cluster statistics cannot be averaged — the
+    # cross-normalizer case must go through train_federated_mlp, which
+    # pools moments first.
+    ref_n, ref_t = normalizers[0], target_norms[0]
+    for norm_i, tnorm_i in zip(normalizers[1:], target_norms[1:]):
+        if not (np.allclose(norm_i.mean, ref_n.mean, rtol=1e-3, atol=1e-5)
+                and np.allclose(norm_i.std, ref_n.std, rtol=1e-3, atol=1e-5)
+                and np.allclose(tnorm_i.mean, ref_t.mean, rtol=1e-3, atol=1e-5)
+                and np.allclose(tnorm_i.std, ref_t.std, rtol=1e-3, atol=1e-5)):
+            logger.warning(
+                "cluster models use different normalizers; refusing to "
+                "average raw parameters (use train_federated_mlp)")
+            return False
+    global_params = fedavg(trees, weights)
+    result = FederatedResult(
+        params=global_params, normalizer=ref_n, target_norm=ref_t,
+        config=FederatedConfig(local=MLPTrainConfig(hidden=tuple(hidden)),
+                               rounds=1),
+        mse=float("nan"), mae=float("nan"), lineage=[contrib],
+    )
+    register_federated_model(manager, result, model_id=model_id)
+    return True

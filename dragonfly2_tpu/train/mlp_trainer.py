@@ -85,10 +85,18 @@ def train_mlp(
     y: np.ndarray,
     config: MLPTrainConfig = MLPTrainConfig(),
     mesh: MeshContext | None = None,
+    *,
+    init_params=None,
+    normalizer: Normalizer | None = None,
+    target_norm: Normalizer | None = None,
 ) -> MLPTrainResult:
     """Train the bandwidth predictor on pair examples.
 
     ``X``: [n, FEATURE_DIM] float32 (raw, unnormalized); ``y``: [n] MB/s.
+    ``init_params``/``normalizer``/``target_norm`` warm-start from an
+    existing model — the federated local-round path (train/federated.py),
+    where every cluster must share one normalization for FedAvg of raw
+    parameters to be meaningful.
     """
     mesh = mesh or data_parallel_mesh()
     train_ds, eval_ds = ArrayDataset(X, y).split(config.eval_fraction, config.seed)
@@ -100,8 +108,10 @@ def train_mlp(
             f"train split ({len(train_ds)} rows) smaller than the data-parallel "
             f"degree ({mesh.n_data}); provide more data or a smaller mesh"
         )
-    normalizer = Normalizer.fit(train_ds.arrays[0])
-    target_norm = Normalizer.fit(np.log1p(train_ds.arrays[1])[:, None])
+    if normalizer is None:
+        normalizer = Normalizer.fit(train_ds.arrays[0])
+    if target_norm is None:
+        target_norm = Normalizer.fit(np.log1p(train_ds.arrays[1])[:, None])
     t_mean, t_std = float(target_norm.mean[0]), float(target_norm.std[0])
     # Normalize once host-side; the (x - mean)/std is fused trivially anyway
     # but doing it here keeps the jitted graph free of constants that would
@@ -110,7 +120,9 @@ def train_mlp(
     eval_norm = normalizer(eval_ds.arrays[0])
 
     model = MLPBandwidthPredictor(hidden=tuple(config.hidden))
-    params = model.init(jax.random.key(config.seed), jnp.zeros((1, X.shape[1])))
+    params = (init_params if init_params is not None else
+              model.init(jax.random.key(config.seed),
+                         jnp.zeros((1, X.shape[1]))))
     steps_per_epoch = max(len(train_ds) // batch_size, 1)
     total_steps = max(config.epochs * steps_per_epoch, 2)
     warmup = min(config.warmup_steps, total_steps // 10 + 1)

@@ -131,6 +131,7 @@ class Training:
             "precision": result.precision,
             "recall": result.recall,
             "f1": result.f1,
+            "n_samples": len(records),
         }
         model_id = gnn_model_id_v1(ip, hostname)
         self._register(
@@ -158,7 +159,8 @@ class Training:
             logger.info("skip MLP for %s: %d pair examples", host_id, len(X))
             return
         result = train_mlp(X, y, self.config.mlp, self.mesh)
-        evaluation = {"mse": result.mse, "mae": result.mae}
+        evaluation = {"mse": result.mse, "mae": result.mae,
+                      "n_samples": len(X)}
         model_id = mlp_model_id_v1(ip, hostname)
         self._register(
             model_id,

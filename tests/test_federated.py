@@ -1,0 +1,167 @@
+"""Federated multi-cluster training tests (config #4)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.data import SyntheticCluster
+from dragonfly2_tpu.manager import Database, FilesystemObjectStore, ManagerService
+from dragonfly2_tpu.models.mlp import Normalizer
+from dragonfly2_tpu.parallel import data_parallel_mesh
+from dragonfly2_tpu.train.federated import (
+    GLOBAL_SCHEDULER_ID,
+    ClusterDataset,
+    FederatedConfig,
+    fedavg,
+    pooled_normalizers,
+    register_federated_model,
+    train_federated_mlp,
+)
+from dragonfly2_tpu.train.mlp_trainer import MLPTrainConfig
+
+TINY = MLPTrainConfig(hidden=(16,), epochs=2, batch_size=128,
+                      eval_fraction=0.2)
+
+
+def make_datasets(n_clusters: int = 3, n: int = 800):
+    out = []
+    for k in range(n_clusters):
+        cluster = SyntheticCluster(n_hosts=12, seed=10 + k)
+        X, y = cluster.pair_example_columns(n)
+        out.append(ClusterDataset(scheduler_id=k + 1, X=X, y=y))
+    return out
+
+
+class TestFedMath:
+    def test_fedavg_weighted(self):
+        t1 = {"w": np.ones((2, 2), np.float32)}
+        t2 = {"w": np.full((2, 2), 3.0, np.float32)}
+        avg = fedavg([t1, t2], [1, 3])
+        np.testing.assert_allclose(np.asarray(avg["w"]), 2.5)
+
+    def test_pooled_normalizer_matches_exact(self):
+        datasets = make_datasets(3, 500)
+        feat, target = pooled_normalizers(datasets)
+        all_X = np.concatenate([d.X for d in datasets])
+        exact = Normalizer.fit(all_X)
+        np.testing.assert_allclose(feat.mean, exact.mean, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(feat.std, exact.std, rtol=1e-3, atol=1e-3)
+
+
+class TestFederatedTraining:
+    def test_rounds_and_lineage(self):
+        datasets = make_datasets(3)
+        result = train_federated_mlp(
+            datasets, FederatedConfig(local=TINY, rounds=2),
+            data_parallel_mesh(),
+        )
+        assert len(result.lineage) == 2
+        assert set(result.lineage[0]) == {1, 2, 3}
+        assert np.isfinite(result.mae)
+        assert set(result.per_cluster) == {1, 2, 3}
+
+    def test_global_model_beats_single_cluster_on_global_eval(self):
+        """The aggregate must generalize across clusters better than a
+        model trained on one cluster only (the point of config #4)."""
+        from dragonfly2_tpu.train.mlp_trainer import train_mlp
+
+        datasets = make_datasets(3, 1500)
+        holdout = SyntheticCluster(n_hosts=12, seed=99)
+        eval_X, eval_y = holdout.pair_example_columns(1000)
+        mesh = data_parallel_mesh()
+        config = MLPTrainConfig(hidden=(32,), epochs=6, batch_size=256,
+                                eval_fraction=0.1)
+        fed = train_federated_mlp(
+            datasets, FederatedConfig(local=config, rounds=3), mesh,
+            eval_set=(eval_X, eval_y),
+        )
+        solo = train_mlp(datasets[0].X, datasets[0].y, config, mesh)
+        import jax.numpy as jnp
+
+        t_mean = float(solo.target_norm.mean[0])
+        t_std = float(solo.target_norm.std[0])
+        pred = np.asarray(jnp.expm1(
+            solo.model.apply(solo.params,
+                             jnp.asarray(solo.normalizer(eval_X)))
+            * t_std + t_mean))
+        solo_mae = float(np.abs(pred - eval_y).mean())
+        assert fed.mae <= solo_mae * 1.2, (fed.mae, solo_mae)
+
+    def test_register_global_model(self, tmp_path):
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(tmp_path / "obj")))
+        datasets = make_datasets(2, 500)
+        result = train_federated_mlp(
+            datasets, FederatedConfig(local=TINY, rounds=1),
+            data_parallel_mesh(),
+        )
+        register_federated_model(manager, result)
+        active = manager.get_active_model("mlp", GLOBAL_SCHEDULER_ID)
+        assert active is not None
+        assert active.evaluation["clusters"] == 2
+        # global registration must not disturb per-cluster slots
+        assert manager.get_active_model("mlp", scheduler_id=5) is None
+
+    def test_empty_datasets_rejected(self):
+        with pytest.raises(ValueError):
+            train_federated_mlp([], FederatedConfig(local=TINY))
+
+
+class TestManagerAggregation:
+    def _upload(self, manager, result, scheduler_id, n, tmp_path, tag):
+        import tempfile
+
+        from dragonfly2_tpu.train.checkpoint import (
+            ModelMetadata,
+            mlp_tree,
+            save_model,
+        )
+
+        d = tempfile.mkdtemp(dir=tmp_path, prefix=tag)
+        save_model(
+            d, mlp_tree(result.params, result.normalizer, result.target_norm),
+            ModelMetadata(model_id=f"m{scheduler_id}", model_type="mlp",
+                          evaluation={"mae": result.mae, "n_samples": n},
+                          config={"hidden": list(TINY.hidden)}),
+        )
+        manager.create_model(f"m{scheduler_id}", "mlp", "h", "1.1.1.1", "hn",
+                             {"mae": result.mae, "n_samples": n}, d,
+                             scheduler_id=scheduler_id)
+
+    def test_aggregates_shared_normalizer_models(self, tmp_path):
+        """Local rounds produced under one pooled normalizer upload
+        independently; the manager blesses a global aggregate at the
+        reserved slot without evicting cluster slots."""
+        from dragonfly2_tpu.train.federated import aggregate_cluster_models
+        from dragonfly2_tpu.train.mlp_trainer import train_mlp
+
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(tmp_path / "obj")))
+        datasets = make_datasets(2, 500)
+        normalizer, target_norm = pooled_normalizers(datasets)
+        mesh = data_parallel_mesh()
+        for ds in datasets:
+            result = train_mlp(ds.X, ds.y, TINY, mesh,
+                               normalizer=normalizer, target_norm=target_norm)
+            self._upload(manager, result, ds.scheduler_id, len(ds.X),
+                         tmp_path, "shared")
+        assert aggregate_cluster_models(manager, hidden=TINY.hidden)
+        assert manager.get_active_model("mlp", GLOBAL_SCHEDULER_ID) is not None
+        # cluster slots untouched
+        for ds in datasets:
+            assert manager.get_active_model("mlp", ds.scheduler_id) is not None
+
+    def test_refuses_mismatched_normalizers(self, tmp_path):
+        from dragonfly2_tpu.train.federated import aggregate_cluster_models
+        from dragonfly2_tpu.train.mlp_trainer import train_mlp
+
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(tmp_path / "obj")))
+        mesh = data_parallel_mesh()
+        for ds in make_datasets(2, 500):
+            result = train_mlp(ds.X, ds.y, TINY, mesh)  # per-cluster stats
+            self._upload(manager, result, ds.scheduler_id, len(ds.X),
+                         tmp_path, "own")
+        assert not aggregate_cluster_models(manager, hidden=TINY.hidden)
+        assert manager.get_active_model("mlp", GLOBAL_SCHEDULER_ID) is None

@@ -137,10 +137,10 @@ class TestMLLoop:
             assert m["host_id"] == "sched-host-1"
             assert "metadata.json" in m["files"] and "tree" in m["files"]
             if m["type"] == "gnn":
-                assert set(m["evaluation"]) == {"precision", "recall", "f1"}
+                assert set(m["evaluation"]) == {"precision", "recall", "f1", "n_samples"}
                 assert 0.0 <= m["evaluation"]["f1"] <= 1.0
             else:
-                assert set(m["evaluation"]) == {"mse", "mae"}
+                assert set(m["evaluation"]) == {"mse", "mae", "n_samples"}
                 assert m["evaluation"]["mae"] >= 0.0
 
     def test_scheduler_datasets_cleared_after_accept(self, trained_cluster):
