@@ -177,13 +177,20 @@ class SeedPeerDaemonClient:
     def __init__(self, daemon: Daemon):
         self.daemon = daemon
         self._inflight_lock = threading.Lock()
-        self._inflight: set[str] = set()
+        self._inflight: Dict[str, threading.Event] = {}
+        self._outcomes: Dict[str, bool] = {}
 
-    def trigger_task(self, task) -> None:
+    def trigger_task(self, task) -> bool:
+        """Returns whether the seed holds the task. A duplicate concurrent
+        trigger WAITS for the in-flight one and reports its real outcome —
+        preheat's synchronous contract must never claim warm-before-done."""
         with self._inflight_lock:
-            if task.id in self._inflight:
-                return
-            self._inflight.add(task.id)
+            existing = self._inflight.get(task.id)
+            if existing is None:
+                self._inflight[task.id] = threading.Event()
+        if existing is not None:
+            existing.wait(timeout=self.daemon.config.task_options.timeout)
+            return self._outcomes.get(task.id, False)
         try:
             daemon = self.daemon
             peer_id = (
@@ -215,6 +222,10 @@ class SeedPeerDaemonClient:
             if not result.success:
                 logger.warning("seed trigger for %s failed: %s",
                                task.id, result.error)
+            self._outcomes[task.id] = result.success
+            return result.success
         finally:
             with self._inflight_lock:
-                self._inflight.discard(task.id)
+                done = self._inflight.pop(task.id, None)
+            if done is not None:
+                done.set()

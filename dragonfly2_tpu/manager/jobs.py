@@ -1,0 +1,305 @@
+"""Job orchestration: preheat fan-out from manager to schedulers.
+
+Reference counterparts: internal/job (machinery/Redis queues ``global`` /
+``schedulers`` / ``scheduler_<id>``, constants.go:20-42),
+manager/job/preheat.go:72-316 (image-manifest → layer URLs → group job) and
+scheduler/job/job.go:49-222 (queue workers → seed-peer trigger). The broker
+here is an in-process bus with the same queue topology; a Redis-backed bus
+can slot behind the same interface for multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+QUEUE_GLOBAL = "global"
+QUEUE_SCHEDULERS = "schedulers"
+
+
+def scheduler_queue(scheduler_id: int) -> str:
+    """(internal/job/constants.go GetSchedulerQueue)"""
+    return f"scheduler_{scheduler_id}"
+
+
+@dataclass
+class PreheatRequest:
+    """One URL for a seed peer to warm (manager/job/types PreheatRequest)."""
+
+    url: str
+    tag: str = ""
+    filtered_query_params: List[str] = field(default_factory=list)
+    headers: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Job:
+    id: str
+    type: str  # "preheat" | "sync_peers"
+    payload: PreheatRequest | dict
+    group_id: str = ""
+
+
+@dataclass
+class GroupStatus:
+    group_id: str
+    total: int
+    succeeded: int = 0
+    failed: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return self.succeeded + self.failed >= self.total
+
+    @property
+    def state(self) -> str:
+        if not self.done:
+            return "PENDING"
+        return "SUCCESS" if self.failed == 0 else "FAILURE"
+
+
+class JobBus:
+    """Named queues + worker registration (the machinery broker role)."""
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, "queue.Queue[Job]"] = {}
+        self._lock = threading.Lock()
+        self._groups: Dict[str, GroupStatus] = {}
+        self._workers: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def _queue(self, name: str) -> "queue.Queue[Job]":
+        with self._lock:
+            if name not in self._queues:
+                self._queues[name] = queue.Queue()
+            return self._queues[name]
+
+    def post(self, queue_name: str, job: Job) -> None:
+        self._queue(queue_name).put(job)
+
+    def post_group(self, queue_names: List[str], make_job) -> GroupStatus:
+        """One job per queue, tracked as a group
+        (manager/job/job.go CreateGroupJob)."""
+        group_id = uuid.uuid4().hex
+        status = GroupStatus(group_id=group_id, total=len(queue_names))
+        with self._lock:
+            self._groups[group_id] = status
+        for name in queue_names:
+            job = make_job()
+            job.group_id = group_id
+            self.post(name, job)
+        return status
+
+    def report(self, job: Job, ok: bool, error: str = "") -> None:
+        if not job.group_id:
+            return
+        with self._lock:
+            status = self._groups.get(job.group_id)
+            if status is None:
+                return
+            if ok:
+                status.succeeded += 1
+            else:
+                status.failed += 1
+                status.errors.append(error)
+
+    def group_status(self, group_id: str) -> Optional[GroupStatus]:
+        with self._lock:
+            return self._groups.get(group_id)
+
+    def serve_worker(self, queue_name: str,
+                     handler: Callable[[Job], None]) -> None:
+        """Consume a queue on a daemon thread; the handler's exception state
+        decides the group report (scheduler/job/job.go:122 Serve)."""
+
+        def loop() -> None:
+            q = self._queue(queue_name)
+            while not self._stop.is_set():
+                try:
+                    job = q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                try:
+                    handler(job)
+                except Exception as exc:
+                    logger.exception("job %s failed", job.id)
+                    self.report(job, ok=False, error=str(exc))
+                else:
+                    self.report(job, ok=True)
+
+        t = threading.Thread(target=loop, name=f"job-{queue_name}",
+                             daemon=True)
+        with self._lock:
+            self._workers.append(t)
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._workers:
+            t.join(timeout=2)
+
+
+# ----------------------------------------------------------------------
+# Image-manifest resolution (manager/job/preheat.go:168-316)
+# ----------------------------------------------------------------------
+
+MANIFEST_ACCEPT = ", ".join([
+    "application/vnd.docker.distribution.manifest.v2+json",
+    "application/vnd.docker.distribution.manifest.list.v2+json",
+    "application/vnd.oci.image.manifest.v1+json",
+    "application/vnd.oci.image.index.v1+json",
+])
+
+
+@dataclass
+class ImageRef:
+    registry: str  # scheme://host[:port]
+    name: str
+    tag: str
+
+    @classmethod
+    def parse(cls, image_url: str) -> "ImageRef":
+        """``http(s)://registry/v2/<name>/manifests/<tag>`` — the URL shape
+        the reference's preheat accepts (preheat.go parseAccessURL)."""
+        import urllib.parse
+
+        parsed = urllib.parse.urlparse(image_url)
+        parts = parsed.path.strip("/").split("/")
+        if len(parts) < 4 or parts[0] != "v2" or parts[-2] != "manifests":
+            raise ValueError(
+                f"not a registry manifest URL: {image_url!r} "
+                "(want /v2/<name>/manifests/<tag>)")
+        name = "/".join(parts[1:-2])
+        return cls(registry=f"{parsed.scheme}://{parsed.netloc}",
+                   name=name, tag=parts[-1])
+
+    def manifest_url(self, reference: str | None = None) -> str:
+        return f"{self.registry}/v2/{self.name}/manifests/{reference or self.tag}"
+
+    def blob_url(self, digest: str) -> str:
+        return f"{self.registry}/v2/{self.name}/blobs/{digest}"
+
+
+def resolve_image_layers(image_url: str, *, timeout: float = 30.0,
+                         headers: Dict[str, str] | None = None) -> List[str]:
+    """Manifest (incl. multi-arch index) → layer blob URLs."""
+    ref = ImageRef.parse(image_url)
+
+    def fetch(url: str) -> dict:
+        req = urllib.request.Request(
+            url, headers={"Accept": MANIFEST_ACCEPT, **(headers or {})})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    manifest = fetch(ref.manifest_url())
+    # Multi-arch: pick every platform's manifest (the reference fans out
+    # all architectures, preheat.go:206-246).
+    manifests = [manifest]
+    if "manifests" in manifest:  # index / manifest list
+        manifests = [fetch(ref.manifest_url(m["digest"]))
+                     for m in manifest["manifests"]]
+    urls = []
+    for m in manifests:
+        for layer in m.get("layers", []):
+            urls.append(ref.blob_url(layer["digest"]))
+    return urls
+
+
+# ----------------------------------------------------------------------
+# Manager-side preheat service
+# ----------------------------------------------------------------------
+
+
+class PreheatService:
+    """Creates preheat group jobs across the active schedulers
+    (manager/job/preheat.go:90-166 CreatePreheat)."""
+
+    def __init__(self, bus: JobBus, manager=None):
+        self.bus = bus
+        self.manager = manager  # ManagerService for scheduler discovery
+
+    def _target_queues(self, scheduler_ids: List[int] | None) -> List[str]:
+        if scheduler_ids:
+            return [scheduler_queue(i) for i in scheduler_ids]
+        if self.manager is not None:
+            from dragonfly2_tpu.manager.database import STATE_ACTIVE
+
+            rows = self.manager.db.find("schedulers", state=STATE_ACTIVE)
+            if rows:
+                return [scheduler_queue(r.id) for r in rows]
+        # The shared QUEUE_SCHEDULERS has competing consumers — exactly ONE
+        # scheduler would warm the URL while the group still reported
+        # SUCCESS for the fleet. Refuse instead of lying.
+        raise ValueError(
+            "no active schedulers known; pass scheduler_ids explicitly")
+
+    def preheat_urls(self, urls: List[str], *, tag: str = "",
+                     headers: Dict[str, str] | None = None,
+                     scheduler_ids: List[int] | None = None) -> List[GroupStatus]:
+        queues = self._target_queues(scheduler_ids)
+        groups = []
+        for url in urls:
+            groups.append(self.bus.post_group(
+                queues,
+                lambda url=url: Job(
+                    id=uuid.uuid4().hex, type="preheat",
+                    payload=PreheatRequest(url=url, tag=tag,
+                                           headers=dict(headers or {})),
+                ),
+            ))
+        return groups
+
+    def preheat_image(self, image_url: str, *, tag: str = "",
+                      headers: Dict[str, str] | None = None,
+                      scheduler_ids: List[int] | None = None) -> List[GroupStatus]:
+        layers = resolve_image_layers(image_url, headers=headers)
+        if not layers:
+            raise ValueError(f"image {image_url} resolved to no layers")
+        return self.preheat_urls(layers, tag=tag, headers=headers,
+                                 scheduler_ids=scheduler_ids)
+
+    def wait(self, groups: List[GroupStatus], timeout: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(g.done for g in groups):
+                return all(g.state == "SUCCESS" for g in groups)
+            time.sleep(0.05)
+        return False
+
+
+# ----------------------------------------------------------------------
+# Scheduler-side worker
+# ----------------------------------------------------------------------
+
+
+class SchedulerJobWorker:
+    """Consumes the scheduler's queues and triggers seed-peer downloads
+    (scheduler/job/job.go:152-222 preheat)."""
+
+    def __init__(self, bus: JobBus, scheduler_service, scheduler_id: int = 0):
+        self.bus = bus
+        self.service = scheduler_service
+        self.scheduler_id = scheduler_id
+
+    def serve(self) -> None:
+        for name in (QUEUE_GLOBAL, QUEUE_SCHEDULERS,
+                     scheduler_queue(self.scheduler_id)):
+            self.bus.serve_worker(name, self._handle)
+
+    def _handle(self, job: Job) -> None:
+        if job.type != "preheat":
+            raise ValueError(f"unknown job type {job.type!r}")
+        req: PreheatRequest = job.payload
+        self.service.preheat(req.url, tag=req.tag,
+                             filtered_query_params=req.filtered_query_params,
+                             request_header=req.headers)

@@ -49,6 +49,7 @@ class ServiceError(Exception):
 NOT_FOUND = "NotFound"
 INVALID_ARGUMENT = "InvalidArgument"
 FAILED_PRECONDITION = "FailedPrecondition"
+INTERNAL = "Internal"
 
 
 @dataclass
@@ -208,6 +209,33 @@ class SchedulerService:
             self.seed_peer_client.trigger_task(task)
         except Exception:
             logger.exception("seed peer trigger failed for task %s", task.id)
+
+    def preheat(self, url: str, *, tag: str = "",
+                filtered_query_params: Optional[List[str]] = None,
+                request_header: Optional[Dict[str, str]] = None) -> str:
+        """Warm a URL onto the seed peers, synchronously — the scheduler
+        half of the manager's preheat job (scheduler/job/job.go:152-222:
+        resolve task id, TriggerTask on the seed, job status from the
+        outcome). Returns the task id."""
+        from dragonfly2_tpu.utils import idgen
+
+        if self.seed_peer_client is None:
+            raise ServiceError(FAILED_PRECONDITION, "no seed peer client")
+        task_id = idgen.task_id_v1(
+            url, tag=tag,
+            filters="&".join(filtered_query_params or []),
+        )
+        task = self.resource.task_manager.load_or_store(
+            Task(task_id, url=url, tag=tag,
+                 filtered_query_params=list(filtered_query_params or []),
+                 request_header=dict(request_header or {}))
+        )
+        if task.fsm.is_state(TaskState.SUCCEEDED):
+            return task_id  # already warm
+        ok = self.seed_peer_client.trigger_task(task)
+        if ok is False:
+            raise ServiceError(INTERNAL, f"seed trigger failed for {url}")
+        return task_id
 
     # ------------------------------------------------------------------
     # Download lifecycle

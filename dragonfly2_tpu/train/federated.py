@@ -69,12 +69,6 @@ class FederatedResult:
     lineage: List[Dict[int, int]] = field(default_factory=list)
     per_cluster: Dict[int, MLPTrainResult] = field(default_factory=dict)
 
-    @property
-    def model(self):
-        from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor
-
-        return MLPBandwidthPredictor(hidden=tuple(self.config.local.hidden))
-
 
 def pooled_normalizers(
     datasets: Sequence[ClusterDataset],
@@ -127,6 +121,26 @@ def train_federated_mlp(
     if not datasets:
         raise ValueError("no cluster datasets")
     mesh = mesh or data_parallel_mesh()
+
+    # Honest global metrics: without a caller-provided eval set, hold out a
+    # per-cluster fraction BEFORE any training. Evaluating the aggregate on
+    # its own training rows would publish optimistically-biased registry
+    # metrics next to the per-cluster models' held-out ones.
+    if eval_set is None:
+        holdout_X, holdout_y, trimmed = [], [], []
+        fraction = max(config.local.eval_fraction, 0.05)
+        for ds in datasets:
+            rng = np.random.default_rng((config.local.seed, ds.scheduler_id))
+            perm = rng.permutation(len(ds.X))
+            n_hold = max(int(len(ds.X) * fraction), 1)
+            hold, keep = perm[:n_hold], perm[n_hold:]
+            holdout_X.append(ds.X[hold])
+            holdout_y.append(ds.y[hold])
+            trimmed.append(ClusterDataset(ds.scheduler_id,
+                                          ds.X[keep], ds.y[keep]))
+        datasets = trimmed
+        eval_set = (np.concatenate(holdout_X), np.concatenate(holdout_y))
+
     normalizer, target_norm = pooled_normalizers(datasets)
 
     global_params = None
@@ -149,12 +163,8 @@ def train_federated_mlp(
         logger.info("federated round %d: averaged %d clusters",
                     round_idx, len(trees))
 
-    # Global eval of the aggregated model.
-    if eval_set is not None:
-        eval_X, eval_y = eval_set
-    else:
-        eval_X = np.concatenate([d.X for d in datasets])
-        eval_y = np.concatenate([d.y for d in datasets])
+    # Global eval of the aggregated model on held-out data.
+    eval_X, eval_y = eval_set
     from dragonfly2_tpu.models.mlp import predict_bandwidth
 
     model = per_cluster[datasets[0].scheduler_id].model

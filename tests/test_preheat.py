@@ -1,0 +1,154 @@
+"""Preheat job tests: manifest resolution, group fan-out, seed warm-up
+(reference call stack 3.4: manager → queue → scheduler → seed ObtainSeeds),
+and that a warmed task serves peers without touching the origin."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from dragonfly2_tpu.manager.jobs import (
+    ImageRef,
+    Job,
+    JobBus,
+    PreheatRequest,
+    PreheatService,
+    SchedulerJobWorker,
+    resolve_image_layers,
+    scheduler_queue,
+)
+from dragonfly2_tpu.utils.hosttypes import HostType
+from tests.fileserver import FileServer
+from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+
+def write_registry(root, layers: dict, multi_arch: bool = False) -> str:
+    """Lay out /v2/<name>/manifests + /blobs as static files."""
+    name = "library/app"
+    blob_dir = root / "v2" / name / "blobs"
+    blob_dir.mkdir(parents=True)
+    layer_entries = []
+    for digest, content in layers.items():
+        (blob_dir / digest).write_bytes(content)
+        layer_entries.append({
+            "mediaType": "application/vnd.oci.image.layer.v1.tar",
+            "digest": digest, "size": len(content),
+        })
+    manifest = {"schemaVersion": 2, "layers": layer_entries}
+    manifest_dir = root / "v2" / name / "manifests"
+    manifest_dir.mkdir(parents=True)
+    if multi_arch:
+        digest = "sha256:" + hashlib.sha256(
+            json.dumps(manifest).encode()).hexdigest()
+        (manifest_dir / digest).write_text(json.dumps(manifest))
+        index = {"schemaVersion": 2,
+                 "manifests": [{"digest": digest, "platform":
+                                {"architecture": "amd64"}}]}
+        (manifest_dir / "latest").write_text(json.dumps(index))
+    else:
+        (manifest_dir / "latest").write_text(json.dumps(manifest))
+    return name
+
+
+class TestManifestResolution:
+    def test_image_ref_parse(self):
+        ref = ImageRef.parse("http://reg:5000/v2/library/nginx/manifests/1.25")
+        assert ref.registry == "http://reg:5000"
+        assert ref.name == "library/nginx"
+        assert ref.tag == "1.25"
+        assert ref.blob_url("sha256:abc").endswith(
+            "/v2/library/nginx/blobs/sha256:abc")
+        with pytest.raises(ValueError):
+            ImageRef.parse("http://reg/just/a/file.txt")
+
+    def test_resolve_layers(self, tmp_path):
+        layers = {f"sha256:{i:064x}": os.urandom(100) for i in range(3)}
+        name = write_registry(tmp_path, layers)
+        with FileServer(str(tmp_path)) as fs:
+            urls = resolve_image_layers(
+                f"http://127.0.0.1:{fs.port}/v2/{name}/manifests/latest")
+            assert len(urls) == 3
+            assert all("/blobs/sha256:" in u for u in urls)
+
+    def test_resolve_multi_arch(self, tmp_path):
+        layers = {f"sha256:{i:064x}": b"layer" for i in range(2)}
+        name = write_registry(tmp_path, layers, multi_arch=True)
+        with FileServer(str(tmp_path)) as fs:
+            urls = resolve_image_layers(
+                f"http://127.0.0.1:{fs.port}/v2/{name}/manifests/latest")
+            assert len(urls) == 2
+
+
+class TestJobBus:
+    def test_group_tracking(self):
+        bus = JobBus()
+        seen = []
+        bus.serve_worker("q1", lambda job: seen.append(job.id))
+
+        def boom(job):
+            raise RuntimeError("nope")
+
+        bus.serve_worker("q2", boom)
+        status = bus.post_group(
+            ["q1", "q2"],
+            lambda: Job(id="j", type="preheat",
+                        payload=PreheatRequest(url="u")),
+        )
+        import time
+
+        deadline = time.monotonic() + 5
+        while not status.done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert status.done
+        assert status.succeeded == 1 and status.failed == 1
+        assert status.state == "FAILURE"
+        assert "nope" in status.errors[0]
+        bus.stop()
+
+
+class TestPreheatE2E:
+    def test_preheat_then_peer_downloads_without_origin(self, tmp_path):
+        """Preheat a blob through the full chain; then kill the origin and
+        prove a peer still gets the bytes (from the warmed seed)."""
+        layers = {"sha256:" + "a" * 64: os.urandom(2 * 1024 * 1024)}
+        name = write_registry(tmp_path, layers)
+        scheduler = make_scheduler(tmp_path)
+        seed = make_daemon(scheduler, tmp_path, "seed", HostType.SUPER_SEED)
+        scheduler.seed_peer_client = seed.seed_client()
+        bus = JobBus()
+        worker = SchedulerJobWorker(bus, scheduler, scheduler_id=7)
+        worker.serve()
+        preheat = PreheatService(bus)
+        peer = make_daemon(scheduler, tmp_path, "peer")
+        try:
+            with FileServer(str(tmp_path)) as fs:
+                image = f"http://127.0.0.1:{fs.port}/v2/{name}/manifests/latest"
+                groups = preheat.preheat_image(
+                    image, scheduler_ids=[7])
+                assert preheat.wait(groups, timeout=60), [
+                    (g.state, g.errors) for g in groups]
+                blob_url = resolve_image_layers(image)[0]
+            # origin is now DOWN; the peer must be served by the seed
+            result = peer.download_file(blob_url)
+            assert result.success, result.error
+            digest = hashlib.sha256(
+                layers["sha256:" + "a" * 64]).hexdigest()
+            assert hashlib.sha256(result.read_all()).hexdigest() == digest
+        finally:
+            bus.stop()
+            peer.stop()
+            seed.stop()
+
+    def test_preheat_without_seed_fails_group(self, tmp_path):
+        scheduler = make_scheduler(tmp_path)  # no seed client
+        bus = JobBus()
+        SchedulerJobWorker(bus, scheduler, scheduler_id=1).serve()
+        preheat = PreheatService(bus)
+        groups = preheat.preheat_urls(
+            ["http://nowhere.invalid/blob"], scheduler_ids=[1])
+        assert not preheat.wait(groups, timeout=10)
+        assert groups[0].state == "FAILURE"
+        bus.stop()
