@@ -8,7 +8,10 @@ the scheduler triggers seed-peer back-source downloads.
 
 from __future__ import annotations
 
+import hashlib
+import io
 import logging
+import os
 import threading
 import time
 import uuid
@@ -162,6 +165,68 @@ class Daemon:
         if result.success and output_path:
             result.save_to(output_path)
         return result
+
+    # -- cache surface (client/dfcache/dfcache.go Stat/Import/Export/Delete)
+
+    @staticmethod
+    def cache_task_id(cid: str, tag: str = "") -> str:
+        """Cache-key → task id (dfcache uses idgen.TaskIDV1 over the cid)."""
+        return idgen.task_id_v1(cid, tag=tag)
+
+    def stat_cache(self, cid: str, tag: str = "") -> Optional[dict]:
+        """None when absent (dfcache stat semantics: local completed only)."""
+        store = self.storage.find_completed_task(self.cache_task_id(cid, tag))
+        if store is None:
+            return None
+        return {
+            "taskId": store.meta.task_id,
+            "contentLength": store.meta.content_length,
+            "totalPieces": store.meta.total_pieces,
+            "pieceMd5Sign": store.meta.piece_md5_sign,
+        }
+
+    def import_cache(self, path: str, cid: str, tag: str = "") -> str:
+        """Insert a local file as a completed cache task
+        (dfcache import → ImportTask, rpcserver.go:401)."""
+        from dragonfly2_tpu.client.piece import (
+            PieceMetadata,
+            compute_piece_count,
+            compute_piece_size,
+        )
+        from dragonfly2_tpu.client.storage import WritePieceRequest
+
+        task_id = self.cache_task_id(cid, tag)
+        peer_id = idgen.peer_id_v1(self.config.ip) + "-import"
+        store = self.storage.register_task(task_id, peer_id)
+        size = os.path.getsize(path)
+        piece_size = compute_piece_size(size)
+        total = compute_piece_count(size, piece_size)
+        with open(path, "rb") as f:
+            for num in range(total):
+                data = f.read(piece_size)
+                store.write_piece(
+                    WritePieceRequest(task_id, peer_id, PieceMetadata(
+                        num=num, md5=hashlib.md5(data).hexdigest(),
+                        offset=num * piece_size, start=num * piece_size,
+                        length=len(data),
+                    )),
+                    io.BytesIO(data),
+                )
+        store.update(content_length=size, total_pieces=total)
+        store.mark_done()
+        return task_id
+
+    def export_cache(self, cid: str, output_path: str, tag: str = "") -> bool:
+        store = self.storage.find_completed_task(self.cache_task_id(cid, tag))
+        if store is None:
+            return False
+        with open(output_path, "wb") as f:
+            for chunk in store.iter_content():
+                f.write(chunk)
+        return True
+
+    def delete_cache(self, cid: str, tag: str = "") -> int:
+        return self.storage.delete_task(self.cache_task_id(cid, tag))
 
     # -- seeder surface (scheduler → seed daemon) --------------------------
 

@@ -1,0 +1,310 @@
+"""HTTP forward proxy + registry mirror over the peer engine.
+
+Reference counterpart: client/daemon/proxy — the daemon-side proxy that
+turns matching GET requests into P2P tasks (proxy.go:298-372 ServeHTTP,
+shouldUseDragonfly rule ladder at :614-644), tunnels CONNECT passthrough
+(:658-697), and fronts a registry mirror so container runtimes pull layer
+blobs through the mesh (mirrorRegistry :541-567). TLS hijack (MITM cert
+minting) is intentionally out of scope — CONNECT tunnels stay passthrough.
+
+Rule semantics are the reference's exactly: first matching regex wins;
+``use_https`` upgrades the scheme; ``redirect`` rewrites host or (with '/')
+the whole URL via regex substitution; ``direct`` opts out; non-GET is never
+P2P. Responses served through the mesh carry ``X-Dragonfly-Task-ID``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import select
+import socket
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
+
+logger = logging.getLogger(__name__)
+
+HEADER_TASK_ID = "X-Dragonfly-Task-ID"
+HEADER_PEER_ID = "X-Dragonfly-Peer-ID"
+HEADER_TAG = "X-Dragonfly-Tag"
+HEADER_FILTER = "X-Dragonfly-Filter"
+
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate",
+    "proxy-authorization", "proxy-connection", "te", "trailers",
+    "transfer-encoding", "upgrade", "host", "content-length",
+}
+
+
+@dataclass
+class ProxyRule:
+    """(client/config/proxy.go ProxyRule)"""
+
+    regx: str
+    use_https: bool = False
+    direct: bool = False
+    redirect: str = ""
+
+    def __post_init__(self):
+        self._pattern = re.compile(self.regx)
+
+    def match(self, url: str) -> bool:
+        return self._pattern.search(url) is not None
+
+    def rewrite(self, url: str) -> str:
+        if self.use_https:
+            url = re.sub(r"^http:", "https:", url, count=1)
+        if "/" in self.redirect:
+            return self._pattern.sub(self.redirect, url)
+        if self.redirect:
+            parsed = urllib.parse.urlparse(url)
+            return urllib.parse.urlunparse(
+                parsed._replace(netloc=self.redirect))
+        return url
+
+
+@dataclass
+class RegistryMirror:
+    """(client/config RegistryMirror) — remote base for mirror mode."""
+
+    remote: str  # e.g. "https://index.docker.io"
+    direct: bool = False
+
+
+@dataclass
+class ProxyConfig:
+    rules: List[ProxyRule] = field(default_factory=list)
+    registry_mirror: Optional[RegistryMirror] = None
+    basic_auth: Optional[tuple] = None  # (user, password)
+    max_concurrency: int = 0  # 0 = unlimited
+    default_tag: str = ""
+    default_filter: str = ""
+
+
+class ProxyServer(ThreadedHTTPService):
+    """The daemon's proxy listener."""
+
+    def __init__(self, daemon, config: ProxyConfig | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.daemon = daemon
+        self.config = config or ProxyConfig()
+        self._semaphore = (
+            threading.Semaphore(self.config.max_concurrency)
+            if self.config.max_concurrency > 0 else None
+        )
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("proxy: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802
+                proxy._handle(self)
+
+            do_HEAD = do_GET
+            do_POST = do_GET
+            do_PUT = do_GET
+            do_DELETE = do_GET
+
+            def do_CONNECT(self):  # noqa: N802
+                proxy._tunnel(self)
+
+        super().__init__(Handler, host=host, port=port, name="proxy")
+
+    # -- request handling --------------------------------------------------
+
+    def _check_auth(self, req: BaseHTTPRequestHandler) -> bool:
+        if self.config.basic_auth is None:
+            return True
+        import base64
+
+        user, password = self.config.basic_auth
+        expected = "Basic " + base64.b64encode(
+            f"{user}:{password}".encode()).decode()
+        if req.headers.get("Proxy-Authorization") == expected:
+            return True
+        req.send_response(407)
+        req.send_header("Proxy-Authenticate", 'Basic realm="dragonfly"')
+        req.send_header("Content-Length", "0")
+        req.end_headers()
+        return False
+
+    def _target_url(self, req: BaseHTTPRequestHandler) -> str:
+        """Absolute-form proxy URL, or mirror-mode path rewrite
+        (mirrorRegistry: requests arrive origin-form and map onto the
+        configured remote)."""
+        if req.path.startswith("http://") or req.path.startswith("https://"):
+            return req.path
+        mirror = self.config.registry_mirror
+        if mirror is not None:
+            return mirror.remote.rstrip("/") + req.path
+        host = req.headers.get("Host", "")
+        return f"http://{host}{req.path}"
+
+    def _should_use_p2p(self, req, url: str) -> tuple:
+        """(use_p2p, final_url) — shouldUseDragonfly semantics."""
+        mirror = self.config.registry_mirror
+        if mirror is not None and not req.path.startswith("http"):
+            if mirror.direct:
+                return False, url
+            # Mirror mode: blobs through the mesh, manifests direct
+            # (transport.NeedUseDragonfly matches /blobs/sha256:).
+            if req.command == "GET" and "/blobs/sha256:" in url:
+                return True, url
+            return False, url
+        for rule in self.config.rules:
+            if rule.match(url):
+                final = rule.rewrite(url)
+                if req.command != "GET":
+                    return False, final
+                return not rule.direct, final
+        return False, url
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        if not self._check_auth(req):
+            return
+        if self._semaphore is not None:
+            self._semaphore.acquire()
+        try:
+            url = self._target_url(req)
+            use_p2p, url = self._should_use_p2p(req, url)
+            if use_p2p:
+                self._serve_p2p(req, url)
+            else:
+                self._serve_direct(req, url)
+        finally:
+            if self._semaphore is not None:
+                self._semaphore.release()
+
+    def _serve_p2p(self, req: BaseHTTPRequestHandler, url: str) -> None:
+        tag = req.headers.get(HEADER_TAG, self.config.default_tag)
+        filter_header = req.headers.get(HEADER_FILTER,
+                                        self.config.default_filter)
+        filtered = filter_header.split("&") if filter_header else None
+        # Forward the client's request headers to the back-source fetch —
+        # authenticated origins (private registries) need Authorization.
+        request_header = {
+            k: v for k, v in req.headers.items()
+            if k.lower() not in _HOP_HEADERS
+            and not k.lower().startswith("x-dragonfly-")
+        }
+        try:
+            result = self.daemon.download_file(
+                url, tag=tag, filtered_query_params=filtered,
+                request_header=request_header)
+        except Exception as exc:
+            req.send_error(500, f"p2p download failed: {exc}")
+            return
+        if not result.success:
+            req.send_error(500, f"p2p download failed: {result.error}")
+            return
+        req.send_response(200)
+        length = (len(result.direct_bytes) if result.direct_bytes is not None
+                  else result.storage.meta.content_length)
+        req.send_header("Content-Length", str(max(length, 0)))
+        req.send_header(HEADER_TASK_ID, result.task_id)
+        req.send_header(HEADER_PEER_ID, result.peer_id)
+        req.end_headers()
+        if req.command == "HEAD":
+            return
+        if result.direct_bytes is not None:
+            req.wfile.write(result.direct_bytes)
+            return
+        for chunk in result.storage.iter_content():
+            req.wfile.write(chunk)
+
+    def _serve_direct(self, req: BaseHTTPRequestHandler, url: str) -> None:
+        headers = {
+            k: v for k, v in req.headers.items()
+            if k.lower() not in _HOP_HEADERS
+        }
+        body = None
+        length = req.headers.get("Content-Length")
+        if length and req.command in ("POST", "PUT"):
+            body = req.rfile.read(int(length))
+        upstream = urllib.request.Request(
+            url, data=body, headers=headers, method=req.command)
+        try:
+            resp = urllib.request.urlopen(upstream, timeout=60)
+        except urllib.error.HTTPError as exc:
+            resp = exc
+        except Exception as exc:
+            req.send_error(502, str(exc))
+            return
+        try:
+            status = resp.status if hasattr(resp, "status") else resp.code
+            length = resp.headers.get("Content-Length")
+            req.send_response(status)
+            for k, v in resp.headers.items():
+                if k.lower() not in _HOP_HEADERS:
+                    req.send_header(k, v)
+            if length is not None:
+                # Known length: stream in constant memory.
+                req.send_header("Content-Length", length)
+                req.end_headers()
+                if req.command != "HEAD":
+                    remaining = int(length)
+                    while remaining > 0:
+                        chunk = resp.read(min(1 << 20, remaining))
+                        if not chunk:
+                            break
+                        req.wfile.write(chunk)
+                        remaining -= len(chunk)
+            else:
+                # Unknown length: close-delimited streaming.
+                req.send_header("Connection", "close")
+                req.end_headers()
+                if req.command != "HEAD":
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        req.wfile.write(chunk)
+                req.close_connection = True
+        finally:
+            try:
+                resp.close()
+            except Exception:
+                pass
+
+    # -- CONNECT tunnel (proxy.go:658-697 tunnelHTTPS) ---------------------
+
+    def _tunnel(self, req: BaseHTTPRequestHandler) -> None:
+        if not self._check_auth(req):
+            return
+        host, _, port = req.path.partition(":")
+        try:
+            upstream = socket.create_connection(
+                (host, int(port or 443)), timeout=10)
+        except OSError as exc:
+            req.send_error(503, str(exc))
+            return
+        req.send_response(200, "Connection Established")
+        req.end_headers()
+        client = req.connection
+        try:
+            while True:
+                readable, _, _ = select.select([client, upstream], [], [], 30)
+                if not readable:
+                    break
+                done = False
+                for sock in readable:
+                    data = sock.recv(65536)
+                    if not data:
+                        done = True
+                        break
+                    (upstream if sock is client else client).sendall(data)
+                if done:
+                    break
+        finally:
+            upstream.close()
+        req.close_connection = True

@@ -11,13 +11,12 @@ no framework.
 from __future__ import annotations
 
 import logging
-import threading
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from http.server import BaseHTTPRequestHandler
 
 from dragonfly2_tpu.client.piece import parse_http_range
 from dragonfly2_tpu.client.storage import StorageError, StorageManager
+from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
 from dragonfly2_tpu.utils.ratelimit import INF, Limiter
 
 logger = logging.getLogger(__name__)
@@ -27,7 +26,7 @@ ROUTE_METADATA = "/metadata"
 ROUTE_HEALTHY = "/healthy"
 
 
-class UploadServer:
+class UploadServer(ThreadedHTTPService):
     """Serves stored piece bytes to child peers."""
 
     def __init__(self, storage: StorageManager, host: str = "127.0.0.1",
@@ -46,29 +45,7 @@ class UploadServer:
             def do_GET(self):  # noqa: N802 (stdlib API)
                 manager._handle(self)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def port(self) -> int:
-        return self._server.server_address[1]
-
-    @property
-    def address(self) -> str:
-        host, port = self._server.server_address[:2]
-        return f"{host}:{port}"
-
-    def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="upload-server", daemon=True
-        )
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        super().__init__(Handler, host=host, port=port, name="upload-server")
 
     # -- request handling --------------------------------------------------
 

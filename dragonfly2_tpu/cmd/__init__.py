@@ -1,0 +1,9 @@
+"""CLI / service entry points (reference counterpart: cmd/).
+
+One module per binary, mirroring the reference's cobra commands:
+``dfget`` (download), ``dfcache`` (stat/import/export/delete),
+``dfstore`` (object gateway client), ``dfdaemon`` (peer daemon with upload
+server + proxy + gateway), ``scheduler``, ``manager``, ``trainer``,
+``inference`` (the TPU sidecar the reference only had a client for).
+Each exposes ``main(argv) -> int`` and is wired as a console script.
+"""

@@ -1,0 +1,63 @@
+"""``df2-cache`` — stat/import/export/delete cache entries.
+
+Reference counterpart: cmd/dfcache + client/dfcache/dfcache.go:46-300.
+Operates on a daemon storage directory (the daemon and this CLI share the
+on-disk layout, like the reference's unix-socket daemon calls).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+
+
+def _daemon(storage_dir: str):
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.cmd.dfget import _DirectScheduler
+
+    return Daemon(_DirectScheduler(), DaemonConfig(storage_root=storage_dir))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-cache")
+    parser.add_argument("command",
+                        choices=["stat", "import", "export", "delete"])
+    parser.add_argument("cid", help="cache key")
+    parser.add_argument("--storage-dir", required=True)
+    parser.add_argument("--path", default="",
+                        help="input file (import) / output file (export)")
+    parser.add_argument("--tag", default="")
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    daemon = _daemon(args.storage_dir)
+    if args.command == "stat":
+        info = daemon.stat_cache(args.cid, args.tag)
+        if info is None:
+            print("not found", file=sys.stderr)
+            return 1
+        print(json.dumps(info))
+        return 0
+    if args.command == "import":
+        if not args.path:
+            parser.error("import requires --path")
+        task_id = daemon.import_cache(args.path, args.cid, args.tag)
+        print(task_id)
+        return 0
+    if args.command == "export":
+        if not args.path:
+            parser.error("export requires --path")
+        if not daemon.export_cache(args.cid, args.path, args.tag):
+            print("not found", file=sys.stderr)
+            return 1
+        return 0
+    removed = daemon.delete_cache(args.cid, args.tag)
+    return 0 if removed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

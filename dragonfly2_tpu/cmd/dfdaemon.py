@@ -1,0 +1,114 @@
+"""``df2-daemon`` — run a peer daemon (dfdaemon).
+
+Reference counterpart: cmd/dfget daemon mode + client/daemon/daemon.go
+Serve: storage + upload server + (optional) proxy + object-storage gateway,
+announced to a remote scheduler.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+
+
+def build_daemon(args):
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.scheduler.rpcserver import GrpcSchedulerClient
+    from dragonfly2_tpu.utils.hosttypes import HostType
+    from dragonfly2_tpu.utils.ratelimit import INF
+
+    scheduler = GrpcSchedulerClient(args.scheduler)
+    daemon = Daemon(scheduler, DaemonConfig(
+        storage_root=args.storage_dir,
+        ip=args.ip,
+        hostname=args.hostname,
+        host_type=HostType.from_name(args.type),
+        idc=args.idc,
+        location=args.location,
+        total_download_rate_bps=args.download_rate or INF,
+        upload_rate_bps=args.upload_rate or INF,
+        traffic_shaper_type=args.traffic_shaper,
+    ))
+    daemon.start()
+    return daemon
+
+
+def main(argv=None) -> int:
+    import socket
+
+    parser = argparse.ArgumentParser("df2-daemon")
+    parser.add_argument("--scheduler", required=True, help="host:port")
+    parser.add_argument("--storage-dir", default="./daemon-data")
+    parser.add_argument("--ip", default="127.0.0.1")
+    parser.add_argument("--hostname", default=socket.gethostname())
+    parser.add_argument("--type", default="normal",
+                        help="normal|super|strong|weak (seed roles)")
+    parser.add_argument("--idc", default="")
+    parser.add_argument("--location", default="")
+    parser.add_argument("--download-rate", type=float, default=0,
+                        help="bytes/sec total download limit (0 = unlimited)")
+    parser.add_argument("--upload-rate", type=float, default=0)
+    parser.add_argument("--traffic-shaper", default="plain",
+                        choices=["plain", "sampling"])
+    parser.add_argument("--proxy-port", type=int, default=0,
+                        help="enable the HTTP proxy on this port")
+    parser.add_argument("--proxy-rule", action="append", default=[],
+                        help="regex of URLs routed through the mesh")
+    parser.add_argument("--registry-mirror", default="",
+                        help="remote registry base for mirror mode")
+    parser.add_argument("--object-storage-port", type=int, default=-1,
+                        help="enable the object gateway (>=0)")
+    parser.add_argument("--object-storage-dir", default="",
+                        help="filesystem object-store root for the gateway")
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    daemon = build_daemon(args)
+    print(f"daemon {daemon.host_id} upload on {daemon.upload.address}",
+          flush=True)
+
+    proxy = None
+    if args.proxy_port or args.proxy_rule or args.registry_mirror:
+        from dragonfly2_tpu.client.proxy import (
+            ProxyConfig,
+            ProxyRule,
+            ProxyServer,
+            RegistryMirror,
+        )
+
+        proxy = ProxyServer(daemon, ProxyConfig(
+            rules=[ProxyRule(regx=r) for r in args.proxy_rule],
+            registry_mirror=(RegistryMirror(remote=args.registry_mirror)
+                             if args.registry_mirror else None),
+        ), port=args.proxy_port)
+        proxy.start()
+        print(f"proxy on {proxy.address}", flush=True)
+
+    gateway = None
+    if args.object_storage_port >= 0:
+        from dragonfly2_tpu.client.objectstorage_gateway import (
+            ObjectStorageGateway,
+        )
+        from dragonfly2_tpu.manager.objectstore import FilesystemObjectStore
+
+        backend = FilesystemObjectStore(
+            args.object_storage_dir or "./object-store")
+        gateway = ObjectStorageGateway(daemon, backend,
+                                       port=args.object_storage_port)
+        gateway.start()
+        print(f"object gateway on 127.0.0.1:{gateway.port}", flush=True)
+
+    wait_for_shutdown()
+    if gateway:
+        gateway.stop()
+    if proxy:
+        proxy.stop()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""``df2-get`` — download one URL through the mesh.
+
+Reference counterpart: cmd/dfget + client/dfget/dfget.go:47-397. Spins an
+ephemeral peer (with its own storage) against the given scheduler, falls
+back to a direct source fetch when the scheduler is unreachable — the same
+daemon-first-then-source ladder dfget implements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-get")
+    parser.add_argument("url")
+    parser.add_argument("-O", "--output", required=True)
+    parser.add_argument("--scheduler", default="",
+                        help="host:port; omit for direct back-to-source")
+    parser.add_argument("--storage-dir", default="",
+                        help="persistent peer storage (default: ephemeral)")
+    parser.add_argument("--tag", default="")
+    parser.add_argument("--application", default="")
+    parser.add_argument("--header", action="append", default=[],
+                        metavar="K:V")
+    parser.add_argument("--filter", default="",
+                        help="'&'-separated query params excluded from the "
+                             "task id")
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    headers = {}
+    for item in args.header:
+        k, _, v = item.partition(":")
+        headers[k.strip()] = v.strip()
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+
+    ephemeral = not args.storage_dir
+    storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
+    if args.scheduler:
+        from dragonfly2_tpu.scheduler.rpcserver import GrpcSchedulerClient
+
+        scheduler = GrpcSchedulerClient(args.scheduler)
+    else:
+        scheduler = _DirectScheduler()
+    daemon = Daemon(scheduler, DaemonConfig(
+        storage_root=storage_dir, keep_storage=not ephemeral,
+    ))
+    daemon.start()
+    try:
+        result = daemon.download_file(
+            args.url, output_path=args.output,
+            request_header=headers, tag=args.tag,
+            application=args.application,
+            filtered_query_params=(args.filter.split("&")
+                                   if args.filter else None),
+        )
+    finally:
+        daemon.stop()
+        if ephemeral:
+            import shutil
+
+            shutil.rmtree(storage_dir, ignore_errors=True)
+    if not result.success:
+        print(f"download failed: {result.error}", file=sys.stderr)
+        return 1
+    print(f"{args.output}: {result.content_length} bytes "
+          f"(task {result.task_id[:16]}…)")
+    return 0
+
+
+class _DirectScheduler:
+    """Schedulerless mode: every registration fails, so the conductor's
+    fallback drives a pure back-to-source download (dfget's direct path)."""
+
+    def announce_host(self, host) -> None:
+        pass
+
+    def __getattr__(self, name):
+        def unavailable(*args, **kwargs):
+            raise ConnectionError("no scheduler configured")
+
+        return unavailable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""``df2-store`` — object-gateway client CLI.
+
+Reference counterpart: cmd/dfstore + client/dfstore (S3-ish verbs against
+the daemon's object-storage gateway).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-store")
+    parser.add_argument("command", choices=["get", "put", "delete", "exist"])
+    parser.add_argument("bucket")
+    parser.add_argument("key")
+    parser.add_argument("--endpoint", required=True,
+                        help="gateway base URL, e.g. http://127.0.0.1:65004")
+    parser.add_argument("--path", default="",
+                        help="local file (put source / get destination)")
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    from dragonfly2_tpu.client.objectstorage_gateway import DfstoreClient
+
+    client = DfstoreClient(args.endpoint)
+    if args.command == "put":
+        if not args.path:
+            parser.error("put requires --path")
+        with open(args.path, "rb") as f:
+            client.put_object(args.bucket, args.key, f.read())
+        return 0
+    if args.command == "get":
+        data = client.get_object(args.bucket, args.key)
+        if args.path:
+            with open(args.path, "wb") as f:
+                f.write(data)
+        else:
+            sys.stdout.buffer.write(data)
+        return 0
+    if args.command == "exist":
+        exists = client.is_object_exist(args.bucket, args.key)
+        print("true" if exists else "false")
+        return 0 if exists else 1
+    client.delete_object(args.bucket, args.key)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""``df2-inference`` — run the TPU inference sidecar.
+
+The serving half the reference left external (its scheduler only had the
+Triton client, pkg/rpc/inference/client/client_v1.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-inference")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9000)
+    parser.add_argument("--manager-db", required=True,
+                        help="manager sqlite path (model registry)")
+    parser.add_argument("--object-store-dir", default="./manager-objects")
+    parser.add_argument("--reload-interval", type=float, default=30.0)
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    from dragonfly2_tpu.inference.sidecar import (
+        INFERENCE_SPEC,
+        InferenceService,
+    )
+    from dragonfly2_tpu.manager import (
+        Database,
+        FilesystemObjectStore,
+        ManagerService,
+    )
+    from dragonfly2_tpu.rpc import serve
+
+    manager = ManagerService(
+        Database(args.manager_db),
+        FilesystemObjectStore(args.object_store_dir))
+    service = InferenceService(manager=manager,
+                               reload_interval=args.reload_interval)
+    service.reload_from_manager()
+    service.serve_watcher()
+    server = serve([(INFERENCE_SPEC, service)],
+                   host=args.host, port=args.port)
+    print(f"inference sidecar serving on {server.target}", flush=True)
+    wait_for_shutdown()
+    service.stop()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""``df2-scheduler`` — run a scheduler instance.
+
+Reference counterpart: cmd/scheduler + scheduler/scheduler.go Server
+assembly: resource model + scheduling core + dataset sink + network
+topology + gRPC surface, with optional manager registration/keepalive and
+announcer→trainer dataset streaming.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+
+
+def build_scheduler(args):
+    from dragonfly2_tpu.rpc import serve
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+    from dragonfly2_tpu.scheduler.networktopology.store import (
+        NetworkTopologyConfig,
+        NetworkTopologyStore,
+    )
+    from dragonfly2_tpu.scheduler.resource.resource import Resource
+    from dragonfly2_tpu.scheduler.rpcserver import (
+        SCHEDULER_SPEC,
+        SchedulerRpcService,
+    )
+    from dragonfly2_tpu.scheduler.scheduling.core import Scheduling
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+    resource = Resource()
+    storage = Storage(args.data_dir)
+    evaluator = new_evaluator(
+        args.algorithm,
+        sidecar_target=args.inference_sidecar or None,
+    )
+    service = SchedulerService(
+        resource=resource,
+        scheduling=Scheduling(evaluator),
+        storage=storage,
+        network_topology=NetworkTopologyStore(
+            NetworkTopologyConfig(), resource=resource, storage=storage),
+    )
+    resource.serve()
+    service.network_topology.serve()
+    server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))],
+                   host=args.host, port=args.port)
+    return service, server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-scheduler")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8002)
+    parser.add_argument("--data-dir", default="./scheduler-data",
+                        help="dataset sink directory")
+    parser.add_argument("--algorithm", default="default",
+                        choices=["default", "ml", "plugin"])
+    parser.add_argument("--inference-sidecar", default="",
+                        help="host:port of the TPU inference sidecar "
+                             "(with --algorithm ml)")
+    parser.add_argument("--trainer", default="",
+                        help="host:port of the trainer service; enables "
+                             "periodic dataset upload")
+    parser.add_argument("--train-interval", type=float, default=600.0)
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    service, server = build_scheduler(args)
+    print(f"scheduler serving on {server.target}", flush=True)
+
+    announcer = None
+    if args.trainer:
+        import socket
+        import threading
+
+        from dragonfly2_tpu.rpc import ServiceClient
+        from dragonfly2_tpu.scheduler.announcer import Announcer
+        from dragonfly2_tpu.trainer import TRAINER_SPEC
+        from dragonfly2_tpu.utils import idgen
+
+        class TrainerClient:
+            def __init__(self, target):
+                self.cli = ServiceClient(target, TRAINER_SPEC)
+
+            def train(self, requests):
+                return self.cli.Train(requests, timeout=3600)
+
+        hostname = socket.gethostname()
+        announcer = Announcer(
+            host_id=idgen.host_id_v1(hostname, args.port),
+            ip=args.host, hostname=hostname, port=args.port,
+            storage=service.storage,
+            trainer_client=TrainerClient(args.trainer),
+        )
+
+        def train_loop():
+            import time
+
+            while True:
+                time.sleep(args.train_interval)
+                try:
+                    announcer.train()
+                except Exception:
+                    import logging
+
+                    logging.getLogger(__name__).exception("train upload failed")
+
+        threading.Thread(target=train_loop, daemon=True,
+                         name="announce-train").start()
+
+    wait_for_shutdown()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

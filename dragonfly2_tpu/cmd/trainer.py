@@ -1,0 +1,57 @@
+"""``df2-trainer`` — run the trainer service (real TPU training).
+
+Reference counterpart: cmd/trainer + trainer/trainer.go — except the
+training jobs are implemented (the reference's are TODO stubs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-trainer")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument("--data-dir", default="./trainer-data")
+    parser.add_argument("--manager-db", default="",
+                        help="manager sqlite path for model registration "
+                             "(co-located deployment)")
+    parser.add_argument("--object-store-dir", default="./manager-objects")
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    init_logging(args.verbose)
+
+    from dragonfly2_tpu.rpc import serve
+    from dragonfly2_tpu.trainer import (
+        TRAINER_SPEC,
+        TrainerService,
+        TrainerStorage,
+        Training,
+    )
+
+    registry = None
+    if args.manager_db:
+        from dragonfly2_tpu.manager import (
+            Database,
+            FilesystemObjectStore,
+            ManagerService,
+        )
+
+        registry = ManagerService(
+            Database(args.manager_db),
+            FilesystemObjectStore(args.object_store_dir))
+    storage = TrainerStorage(args.data_dir)
+    service = TrainerService(storage, Training(storage, registry))
+    server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
+    print(f"trainer serving on {server.target}", flush=True)
+    wait_for_shutdown()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

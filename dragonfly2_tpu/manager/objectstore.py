@@ -35,6 +35,9 @@ class ObjectStore:
     def is_object_exist(self, bucket: str, key: str) -> bool:
         raise NotImplementedError
 
+    def object_size(self, bucket: str, key: str) -> int:
+        raise NotImplementedError
+
     def delete_object(self, bucket: str, key: str) -> None:
         raise NotImplementedError
 
@@ -86,6 +89,12 @@ class FilesystemObjectStore(ObjectStore):
 
     def is_object_exist(self, bucket: str, key: str) -> bool:
         return os.path.isfile(self._object_path(bucket, key))
+
+    def object_size(self, bucket: str, key: str) -> int:
+        try:
+            return os.path.getsize(self._object_path(bucket, key))
+        except OSError:
+            raise ObjectStoreError(f"{bucket}/{key} not found") from None
 
     def delete_object(self, bucket: str, key: str) -> None:
         try:
