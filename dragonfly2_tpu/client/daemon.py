@@ -283,7 +283,14 @@ class SeedPeerDaemonClient:
             )
             conductor.store = daemon.storage.register_task(task.id, peer_id)
             conductor._started_at = time.monotonic()
-            result = conductor._run_back_to_source(report=True)
+            # Register with the shaper like download_file does — otherwise
+            # SamplingTrafficShaper.wait_n is a no-op for the unknown task
+            # and seed warm-up traffic (preheat fan-out) runs unthrottled.
+            daemon.shaper.add_task(task.id)
+            try:
+                result = conductor._run_back_to_source(report=True)
+            finally:
+                daemon.shaper.remove_task(task.id)
             if not result.success:
                 logger.warning("seed trigger for %s failed: %s",
                                task.id, result.error)

@@ -45,13 +45,23 @@ class Range:
         return f"bytes={self.start}-{self.end}"
 
 
+class RangeNotSatisfiable(ValueError):
+    """Syntactically valid single range that no byte of the representation
+    satisfies — the only case HTTP answers with 416. Malformed or
+    unsupported specs raise plain ValueError and servers ignore the header
+    (RFC 9110 §14.1.1: an invalid Range field is ignored)."""
+
+
 def parse_http_range(header: str, total: int) -> Range:
     """Parse a single-range ``bytes=a-b`` header against ``total`` bytes.
 
     Mirrors the subset the reference accepts on the upload path
     (client/daemon/upload/upload_manager.go:214-227: exactly one range).
     Suffix ranges (``bytes=-n``) and open ends (``bytes=a-``) are resolved
-    against ``total``.
+    against ``total``. Raises RangeNotSatisfiable for valid-but-empty
+    ranges (zero suffix, start beyond EOF) and plain ValueError for
+    anything malformed or unsupported (multi-range, non-bytes units,
+    non-digit positions, end before start).
     """
     if not header.startswith("bytes="):
         raise ValueError(f"unsupported range unit in {header!r}")
@@ -62,15 +72,26 @@ def parse_http_range(header: str, total: int) -> Range:
     if not sep:
         raise ValueError(f"malformed range {header!r}")
     if not start_s:  # suffix: last n bytes
+        if not end_s.isdigit():  # catches 'bytes=--5', 'bytes=-', 'bytes=-x'
+            raise ValueError(f"malformed range {header!r}")
         n = int(end_s)
+        if n <= 0:
+            raise RangeNotSatisfiable(f"zero suffix length in {header!r}")
         start = max(0, total - n)
         return Range(start, total - start)
+    if not start_s.isdigit() or (end_s and not end_s.isdigit()):
+        raise ValueError(f"malformed range {header!r}")
     start = int(start_s)
     end = int(end_s) if end_s else total - 1
     if end >= total:
         end = total - 1
-    if start > end:
-        raise ValueError(f"range {header!r} unsatisfiable for length {total}")
+    if end_s and int(end_s) < start:
+        # end before start is a malformed spec, not an unsatisfiable one
+        # (RFC 9110 §14.1.1) — callers ignore the header.
+        raise ValueError(f"malformed range {header!r}")
+    if start >= total:
+        raise RangeNotSatisfiable(
+            f"range {header!r} unsatisfiable for length {total}")
     return Range(start, end - start + 1)
 
 

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler
 from typing import Dict, List, Optional
 
+from dragonfly2_tpu.client.piece import RangeNotSatisfiable, parse_http_range
 from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
 
 logger = logging.getLogger(__name__)
@@ -192,10 +193,16 @@ class ProxyServer(ThreadedHTTPService):
         filtered = filter_header.split("&") if filter_header else None
         # Forward the client's request headers to the back-source fetch —
         # authenticated origins (private registries) need Authorization.
+        # Range/If-Range must NOT leak into the task's back-to-source
+        # requests (they would fight the per-piece ranges); the reference
+        # converts Range into url-meta range semantics instead
+        # (transport.go RoundTrip) — we download the whole task and serve
+        # the requested sub-range from completed storage below.
         request_header = {
             k: v for k, v in req.headers.items()
             if k.lower() not in _HOP_HEADERS
             and not k.lower().startswith("x-dragonfly-")
+            and k.lower() not in ("range", "if-range")
         }
         try:
             result = self.daemon.download_file(
@@ -207,9 +214,30 @@ class ProxyServer(ThreadedHTTPService):
         if not result.success:
             req.send_error(500, f"p2p download failed: {result.error}")
             return
-        req.send_response(200)
-        length = (len(result.direct_bytes) if result.direct_bytes is not None
-                  else result.storage.meta.content_length)
+        total = (len(result.direct_bytes) if result.direct_bytes is not None
+                 else result.storage.meta.content_length)
+        rng = None
+        range_header = req.headers.get("Range")
+        # If-Range is conditional on origin validators we don't store; per
+        # RFC 9110 §13.1.5 an unverifiable condition means the full
+        # representation — never splice cached bytes into a client resume
+        # of a possibly-changed entity.
+        if range_header and total >= 0 and "If-Range" not in req.headers:
+            try:
+                rng = parse_http_range(range_header, total)
+            except RangeNotSatisfiable:
+                req.send_error(416, f"unsatisfiable range {range_header!r}")
+                return
+            except ValueError:
+                rng = None  # malformed/unsupported: ignore, serve full 200
+        if rng is not None:
+            req.send_response(206)
+            req.send_header("Content-Range",
+                            f"bytes {rng.start}-{rng.end}/{total}")
+            length = rng.length
+        else:
+            req.send_response(200)
+            length = total
         req.send_header("Content-Length", str(max(length, 0)))
         req.send_header(HEADER_TASK_ID, result.task_id)
         req.send_header(HEADER_PEER_ID, result.peer_id)
@@ -217,9 +245,12 @@ class ProxyServer(ThreadedHTTPService):
         if req.command == "HEAD":
             return
         if result.direct_bytes is not None:
-            req.wfile.write(result.direct_bytes)
+            body = result.direct_bytes
+            if rng is not None:
+                body = body[rng.start:rng.end + 1]
+            req.wfile.write(body)
             return
-        for chunk in result.storage.iter_content():
+        for chunk in result.storage.iter_content(rng):
             req.wfile.write(chunk)
 
     def _serve_direct(self, req: BaseHTTPRequestHandler, url: str) -> None:

@@ -138,7 +138,12 @@ class HTTPSourceClient(ResourceClient):
         headers = dict(request.header)
         if extra_header:
             headers.update(extra_header)
-        if request.rng is not None and "Range" not in headers:
+        if request.rng is not None:
+            # request.rng is authoritative: a caller-supplied Range header
+            # (e.g. forwarded by the proxy) must never override the piece
+            # range, or every piece fetch would return the client's range.
+            for key in [k for k in headers if k.lower() == "range"]:
+                del headers[key]
             headers["Range"] = request.rng.http_header()
         req = urllib.request.Request(request.url, headers=headers, method=method)
         try:

@@ -65,6 +65,9 @@ def main(argv=None) -> int:
                         help="host:port of the trainer service; enables "
                              "periodic dataset upload")
     parser.add_argument("--train-interval", type=float, default=600.0)
+    parser.add_argument("--scheduler-id", type=int, default=0,
+                        help="manager-assigned scheduler instance id; keys "
+                             "model uploads per cluster")
     add_common_flags(parser)
     args = parser.parse_args(argv)
     init_logging(args.verbose)
@@ -95,6 +98,7 @@ def main(argv=None) -> int:
             ip=args.host, hostname=hostname, port=args.port,
             storage=service.storage,
             trainer_client=TrainerClient(args.trainer),
+            scheduler_id=args.scheduler_id,
         )
 
         def train_loop():

@@ -347,6 +347,11 @@ def untar_to_directory(artifact: bytes, directory: str) -> None:
             target = os.path.abspath(os.path.join(base, member.name))
             if target != base and not target.startswith(base + os.sep):
                 raise ManagerError(f"unsafe tar member {member.name!r}")
+            # Links can alias paths outside base even when the member name
+            # itself is inside it (extract-through-symlink); model.tar is
+            # always plain files, so reject links outright.
+            if member.issym() or member.islnk():
+                raise ManagerError(f"link tar member {member.name!r}")
         try:
             tar.extractall(base, filter="data")
         except TypeError:  # Python < 3.10.12: no 'filter' kwarg

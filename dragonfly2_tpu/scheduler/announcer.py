@@ -55,11 +55,15 @@ class Announcer:
         trainer_client: Optional[TrainerTrainClient] = None,
         manager_client: Optional[ManagerAnnounceClient] = None,
         config: Optional[AnnouncerConfig] = None,
+        scheduler_id: int = 0,
     ) -> None:
         self.host_id = host_id
         self.ip = ip
         self.hostname = hostname
         self.port = port
+        # Manager-assigned instance id; keys trainer model uploads so
+        # multi-cluster deployments don't evict each other's models.
+        self.scheduler_id = scheduler_id
         self.storage = storage
         self.trainer_client = trainer_client
         self.manager_client = manager_client
@@ -129,7 +133,8 @@ class Announcer:
         return response
 
     def _requests(self, download_files, topology_files) -> Iterator[TrainRequest]:
-        base = dict(host_id=self.host_id, ip=self.ip, hostname=self.hostname)
+        base = dict(host_id=self.host_id, ip=self.ip, hostname=self.hostname,
+                    scheduler_id=self.scheduler_id)
         for path in topology_files:
             for i, chunk in enumerate(self._chunks(path)):
                 yield TrainRequest(

@@ -43,6 +43,10 @@ class TrainRequest:
     host_id: str = ""
     ip: str = ""
     hostname: str = ""
+    # Manager-assigned scheduler row id — keys model uploads so clusters
+    # never evict each other's active models (manager/models/model.go
+    # unique (type, version, scheduler_id)).
+    scheduler_id: int = 0
     gnn: Optional[TrainGnnRequest] = None
     mlp: Optional[TrainMlpRequest] = None
 
@@ -127,19 +131,22 @@ class TrainerService:
             self._jobs = [j for j in self._jobs if j.is_alive()]
             job = threading.Thread(
                 target=self._safe_train,
-                args=(first.ip, first.hostname, first.host_id),
+                args=(first.ip, first.hostname, first.host_id,
+                      first.scheduler_id),
                 name=f"train-{first.host_id}",
                 daemon=True,
             )
             job.start()
             self._jobs.append(job)
         else:
-            self._safe_train(first.ip, first.hostname, first.host_id)
+            self._safe_train(first.ip, first.hostname, first.host_id,
+                             first.scheduler_id)
         return TrainResponse(host_id=first.host_id, accepted_bytes=accepted)
 
-    def _safe_train(self, ip: str, hostname: str, host_id: str) -> None:
+    def _safe_train(self, ip: str, hostname: str, host_id: str,
+                    scheduler_id: int = 0) -> None:
         try:
-            outcome = self.training.train(ip, hostname, host_id)
+            outcome = self.training.train(ip, hostname, host_id, scheduler_id)
             if outcome.errors:
                 logger.error("training for %s finished with errors: %s",
                              host_id, outcome.errors)

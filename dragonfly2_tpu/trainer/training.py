@@ -56,6 +56,7 @@ class ModelRegistry(Protocol):
         hostname: str,
         evaluation: dict,
         artifact_dir: str,
+        scheduler_id: int = 0,
     ) -> None: ...
 
 
@@ -94,21 +95,29 @@ class Training:
         # One training job at a time: the device mesh is not re-entrant.
         self._train_lock = threading.Lock()
 
-    def train(self, ip: str, hostname: str, host_id: str) -> TrainOutcome:
+    def train(self, ip: str, hostname: str, host_id: str,
+              scheduler_id: int = 0) -> TrainOutcome:
         """training.go:60-78 — run both model jobs, then delete exactly the
         dataset files that were trained from. A concurrent ingest stream's
         open segments are excluded from the snapshot, so mid-write files
-        are never read or deleted; they feed the next round."""
+        are never read or deleted; they feed the next round.
+
+        ``scheduler_id`` keys the registry upload: the manager's
+        single-active invariant is per (type, scheduler_id), so every
+        cluster must upload under its own id or clusters evict each
+        other's models (manager/models/model.go:44)."""
         outcome = TrainOutcome(host_id=host_id)
         with self._train_lock:
             download_files, topology_files = self.storage.snapshot(host_id)
             try:
-                self._train_gnn(ip, hostname, host_id, topology_files, outcome)
+                self._train_gnn(ip, hostname, host_id, scheduler_id,
+                                topology_files, outcome)
             except Exception as exc:  # noqa: BLE001 — job isolation
                 logger.exception("trainGNN failed for %s", host_id)
                 outcome.errors.append(f"gnn: {exc}")
             try:
-                self._train_mlp(ip, hostname, host_id, download_files, outcome)
+                self._train_mlp(ip, hostname, host_id, scheduler_id,
+                                download_files, outcome)
             except Exception as exc:  # noqa: BLE001
                 logger.exception("trainMLP failed for %s", host_id)
                 outcome.errors.append(f"mlp: {exc}")
@@ -117,7 +126,8 @@ class Training:
 
     # -- jobs -----------------------------------------------------------------
 
-    def _train_gnn(self, ip, hostname, host_id, files, outcome: TrainOutcome) -> None:
+    def _train_gnn(self, ip, hostname, host_id, scheduler_id, files,
+                   outcome: TrainOutcome) -> None:
         records = self.storage.list_network_topology(host_id, files)
         if len(records) < self.config.min_gnn_records:
             logger.info(
@@ -137,7 +147,7 @@ class Training:
         self._register(
             model_id,
             MODEL_TYPE_GNN,
-            host_id, ip, hostname,
+            host_id, ip, hostname, scheduler_id,
             evaluation,
             tree=gnn_tree(result.params, result.node_features),
             config={"hidden": result.config.hidden, "embed": result.config.embed,
@@ -146,7 +156,8 @@ class Training:
         outcome.gnn_model_id = model_id
         outcome.gnn_evaluation = evaluation
 
-    def _train_mlp(self, ip, hostname, host_id, files, outcome: TrainOutcome) -> None:
+    def _train_mlp(self, ip, hostname, host_id, scheduler_id, files,
+                   outcome: TrainOutcome) -> None:
         records = self.storage.list_download(host_id, files)
         if len(records) < self.config.min_mlp_records:
             logger.info(
@@ -165,7 +176,7 @@ class Training:
         self._register(
             model_id,
             MODEL_TYPE_MLP,
-            host_id, ip, hostname,
+            host_id, ip, hostname, scheduler_id,
             evaluation,
             tree=mlp_tree(result.params, result.normalizer, result.target_norm),
             config={"hidden": list(result.config.hidden)},
@@ -174,7 +185,7 @@ class Training:
         outcome.mlp_evaluation = evaluation
 
     def _register(self, model_id, model_type, host_id, ip, hostname,
-                  evaluation, tree, config) -> None:
+                  scheduler_id, evaluation, tree, config) -> None:
         tmp = tempfile.mkdtemp(prefix=f"df2-model-{model_type}-")
         try:
             save_model(
@@ -196,6 +207,7 @@ class Training:
                     hostname=hostname,
                     evaluation=evaluation,
                     artifact_dir=tmp,
+                    scheduler_id=scheduler_id,
                 )
             else:
                 logger.info("no registry configured; model %s trained only", model_id)

@@ -77,6 +77,20 @@ class TestPieceMath:
         with pytest.raises(ValueError):
             parse_http_range("bytes=0-1,3-4", 100)
 
+    def test_parse_http_range_unsatisfiable_vs_malformed(self):
+        from dragonfly2_tpu.client.piece import RangeNotSatisfiable
+
+        # Valid syntax, no satisfiable byte → 416 class.
+        with pytest.raises(RangeNotSatisfiable):
+            parse_http_range("bytes=-0", 100)
+        with pytest.raises(RangeNotSatisfiable):
+            parse_http_range("bytes=200-", 100)
+        # Malformed → plain ValueError (HTTP servers ignore the header).
+        for bad in ("bytes=--5", "bytes=-", "bytes=abc-4", "bytes=4-abc"):
+            with pytest.raises(ValueError) as exc:
+                parse_http_range(bad, 100)
+            assert not isinstance(exc.value, RangeNotSatisfiable), bad
+
 
 def make_piece(num: int, data: bytes, piece_size: int) -> PieceMetadata:
     return PieceMetadata(

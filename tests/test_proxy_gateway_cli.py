@@ -84,6 +84,57 @@ class TestProxyE2E:
             proxy.stop()
             daemon.stop()
 
+    def test_ranged_get_served_from_storage_not_forwarded(self, tmp_path):
+        """A client Range header must be answered with a 206 slice from
+        completed storage and must NOT leak into the task's back-to-source
+        fetches (which would corrupt every piece)."""
+        content = bytes(range(256)) * 8 * 1024  # 2 MiB, position-identifiable
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        (origin_root / "blob.bin").write_bytes(content)
+        scheduler = make_scheduler(tmp_path)
+        daemon = make_daemon(scheduler, tmp_path, "range-peer")
+        proxy = ProxyServer(daemon, ProxyConfig(
+            rules=[ProxyRule(regx=r"\.bin$")]))
+        proxy.start()
+        try:
+            with FileServer(str(origin_root)) as fs:
+                url = fs.url("blob.bin")
+                with proxy_open(proxy.address, url,
+                                headers={"Range": "bytes=100000-100999"}) as resp:
+                    assert resp.status == 206
+                    assert resp.headers["Content-Range"] == \
+                        f"bytes 100000-100999/{len(content)}"
+                    assert resp.headers.get(HEADER_TASK_ID)
+                    assert resp.read() == content[100000:101000]
+                # Whole object must be intact in storage (the smuggled Range
+                # didn't shrink the task): full GET returns every byte.
+                with proxy_open(proxy.address, url) as resp:
+                    assert resp.status == 200
+                    assert hashlib.sha256(resp.read()).hexdigest() == \
+                        hashlib.sha256(content).hexdigest()
+                # Unsupported specs are ignored → full 200 (RFC 9110: an
+                # invalid Range field is ignored, not rejected).
+                with proxy_open(proxy.address, url,
+                                headers={"Range": "bytes=0-99,200-299"}) as resp:
+                    assert resp.status == 200
+                    assert len(resp.read()) == len(content)
+                # If-Range can't be validated (no origin validators stored):
+                # must serve the full representation, never a 206 splice.
+                with proxy_open(proxy.address, url,
+                                headers={"Range": "bytes=100-199",
+                                         "If-Range": '"some-etag"'}) as resp:
+                    assert resp.status == 200
+                    assert len(resp.read()) == len(content)
+                # Genuinely unsatisfiable → 416.
+                with pytest.raises(urllib.error.HTTPError) as exc_info:
+                    proxy_open(proxy.address, url,
+                               headers={"Range": f"bytes={len(content)}-"})
+                assert exc_info.value.code == 416
+        finally:
+            proxy.stop()
+            daemon.stop()
+
     def test_registry_mirror_blobs_via_mesh(self, tmp_path):
         """Mirror mode: origin-form /v2/... requests map onto the remote;
         blob GETs ride the mesh, manifest GETs go direct."""

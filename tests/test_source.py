@@ -1,0 +1,73 @@
+"""Back-to-source client tests.
+
+Regression coverage for the Range-precedence bug: a caller-supplied
+``Range`` header (e.g. forwarded by the proxy into the task's
+request_header) must never override the per-piece ``request.rng`` — the
+piece range is authoritative, or every piece fetch returns the client's
+range and the task stores corrupt content mesh-wide.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dragonfly2_tpu.client.piece import Range
+from dragonfly2_tpu.client.source import (
+    HTTPSourceClient,
+    Request,
+    SourceError,
+    get_content_length,
+    is_support_range,
+)
+from tests.fileserver import FileServer
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    root = tmp_path_factory.mktemp("src")
+    content = bytes(range(256)) * 40  # 10240 bytes, position-identifiable
+    (root / "blob.bin").write_bytes(content)
+    with FileServer(str(root)) as fs:
+        yield fs, content
+
+
+class TestHTTPSource:
+    def test_probe_helpers(self, served):
+        fs, content = served
+        req = Request(fs.url("blob.bin"))
+        assert get_content_length(req) == len(content)
+        assert is_support_range(req)
+
+    def test_rng_overrides_caller_range_header(self, served):
+        """The piece range wins over any header-smuggled Range —
+        case-insensitively."""
+        fs, content = served
+        cli = HTTPSourceClient()
+        for smuggled in ("Range", "range", "RANGE"):
+            req = Request(
+                fs.url("blob.bin"),
+                header={smuggled: "bytes=0-9"},
+                rng=Range(100, 50),
+            )
+            resp = cli.download(req)
+            body = resp.body.read()
+            resp.close()
+            assert body == content[100:150]
+
+    def test_plain_header_range_still_honored_without_rng(self, served):
+        """Without an explicit rng the caller's Range header passes through
+        (dfget range downloads set headers directly)."""
+        fs, content = served
+        cli = HTTPSourceClient()
+        resp = cli.download(
+            Request(fs.url("blob.bin"), header={"Range": "bytes=5-14"}))
+        body = resp.body.read()
+        resp.close()
+        assert body == content[5:15]
+
+    def test_range_ignored_by_server_is_an_error(self, tmp_path):
+        (tmp_path / "f.bin").write_bytes(b"x" * 100)
+        with FileServer(str(tmp_path), support_range=False) as fs:
+            cli = HTTPSourceClient()
+            with pytest.raises(SourceError):
+                cli.download(Request(fs.url("f.bin"), rng=Range(10, 10)))

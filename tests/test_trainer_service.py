@@ -39,12 +39,13 @@ class FakeRegistry:
         self.models = {}
 
     def create_model(self, model_id, model_type, host_id, ip, hostname,
-                     evaluation, artifact_dir):
+                     evaluation, artifact_dir, scheduler_id=0):
         # Capture a copy of the artifact dir listing to prove it existed
         # at upload time (Training deletes its tempdir afterwards).
         self.models[model_id] = {
             "type": model_type,
             "host_id": host_id,
+            "scheduler_id": scheduler_id,
             "evaluation": dict(evaluation),
             "files": sorted(os.listdir(artifact_dir)),
         }
@@ -107,6 +108,7 @@ def trained_cluster(tmp_path_factory):
         storage=storage,
         trainer_client=GrpcTrainerClient(server.target),
         config=AnnouncerConfig(upload_chunk=64 * 1024),
+        scheduler_id=7,
     )
     n_download_files = len(storage.open_download())
     response = announcer.train()
@@ -135,6 +137,9 @@ class TestMLLoop:
         assert types == {"gnn", "mlp"}
         for m in models.values():
             assert m["host_id"] == "sched-host-1"
+            # The announcer's manager-assigned id must reach the registry —
+            # it keys the single-active invariant per cluster.
+            assert m["scheduler_id"] == 7
             assert "metadata.json" in m["files"] and "tree" in m["files"]
             if m["type"] == "gnn":
                 assert set(m["evaluation"]) == {"precision", "recall", "f1", "n_samples"}
