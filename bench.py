@@ -1,27 +1,152 @@
-"""Benchmark entry point — prints ONE JSON line for the driver.
+"""Benchmark entry point — prints ONE JSON line for the driver, always.
 
 Headline metric (BASELINE.json north star): GraphSAGE topology-model
-training throughput in samples(edges)/sec/chip. Extras carry the second
-tracked number — scheduler parent-selection p50 latency through the
-TPU-backed ML scorer (<1 ms target) — plus MLP training stats.
+training throughput in samples(edges)/sec/chip, steady-state (compile
+excluded). Extras carry the second tracked number — scheduler
+parent-selection p50 latency through the TPU-backed ML scorer (<1 ms
+target) — plus MLP training stats and pipeline diagnostics.
 
-``vs_baseline`` is measured/target against the self-established round-1
-target (the reference publishes no numbers and its training path is a stub;
-see BASELINE.md): 100k samples/sec/chip for GraphSAGE training.
+Un-killability contract (the round-1 failure was a silent rc=124):
+- TPU availability is probed in a SUBPROCESS with a hard timeout — a
+  hanging backend init (observed: ``jax.devices()`` on this machine's
+  ``axon`` platform can stall for minutes) falls back to CPU instead of
+  stalling the bench, flagged as ``extras.platform: "cpu_fallback"``.
+- Every stage is wall-clock budgeted (``max_seconds`` step loops measure
+  throughput from steps actually run, not fixed epoch counts).
+- A watchdog thread force-emits whatever has been measured and exits
+  before the driver's kill; the JSON line is also emitted from a
+  ``finally`` path on any exception.
+
+``vs_baseline`` is measured/target against the self-established target
+(the reference publishes no numbers and its training path is a stub; see
+BASELINE.md): 100k samples/sec/chip for GraphSAGE training.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import threading
+import time
 
 TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP = 100_000.0
 TARGET_P50_MS = 1.0
 
+# Total wall budget. The driver's observed kill horizon is >240 s; leave
+# margin so the watchdog always wins the race against SIGKILL.
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "200"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT_S", "60"))
+
+_t0 = time.perf_counter()
+# Reentrant: every mutation of ``result`` and the final dumps hold this
+# lock, so the watchdog can never serialize a dict mid-mutation (which
+# would raise inside json.dumps AFTER latching the emitted flag and lose
+# the line forever).
+_emit_lock = threading.RLock()
+_emitted = False
+
+result = {
+    "metric": "graphsage_train_samples_per_sec_per_chip",
+    "value": 0,
+    "unit": "samples/sec/chip",
+    "vs_baseline": 0.0,
+    "extras": {"stages_completed": [], "platform": "unknown"},
+}
+
+
+def record(**extras) -> None:
+    with _emit_lock:
+        result["extras"].update(extras)
+
+
+def stage_done(name: str) -> None:
+    with _emit_lock:
+        result["extras"]["stages_completed"].append(name)
+
+
+def set_headline(value: float) -> None:
+    with _emit_lock:
+        result["value"] = int(value)
+        result["vs_baseline"] = round(
+            value / TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP, 3)
+
+
+def emit() -> None:
+    global _emitted
+    with _emit_lock:
+        if _emitted:
+            return
+        result["extras"]["wall_seconds"] = round(time.perf_counter() - _t0, 1)
+        line = json.dumps(result)
+        _emitted = True
+        print(line, flush=True)
+
+
+def remaining() -> float:
+    return BUDGET_S - (time.perf_counter() - _t0)
+
+
+def _watchdog() -> None:
+    # Sleep in small slices so a fast successful run exits normally.
+    while remaining() > 0:
+        if _emitted:
+            return
+        time.sleep(min(1.0, max(remaining(), 0.01)))
+    stage_done("watchdog_fired")
+    emit()
+    os._exit(0)
+
+
+def probe_tpu() -> bool:
+    """Check — in a throwaway subprocess — that backend init completes.
+
+    The subprocess inherits the environment (this machine's sitecustomize
+    selects the TPU platform); if it can't enumerate an accelerator
+    within the timeout, the main process must not try.
+    """
+    code = ("import jax; ds = jax.devices(); "
+            "print(ds[0].platform, len(ds))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True,
+            timeout=min(PROBE_TIMEOUT_S, max(remaining() - 90, 5)),
+        )
+    except subprocess.TimeoutExpired:
+        record(tpu_probe="timeout")
+        return False
+    if proc.returncode != 0:
+        record(tpu_probe=f"rc={proc.returncode}")
+        return False
+    out = proc.stdout.strip().split()
+    record(tpu_probe=" ".join(out))
+    return bool(out) and out[0] not in ("cpu",)
+
 
 def main() -> None:
+    threading.Thread(target=_watchdog, daemon=True, name="bench-watchdog").start()
+    try:
+        run_stages()
+    finally:
+        emit()
+
+
+def run_stages() -> None:
+    probe_t0 = time.perf_counter()
+    on_tpu = probe_tpu()
+    record(tpu_probe_seconds=round(time.perf_counter() - probe_t0, 1))
+    if not on_tpu:
+        # Must happen before ANY backend use; the env var alone is
+        # overridden by this machine's sitecustomize.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        record(platform="cpu_fallback")
+    import jax
+
     from dragonfly2_tpu.data import SyntheticCluster
-    from dragonfly2_tpu.inference import ParentScorer
     from dragonfly2_tpu.parallel import data_parallel_mesh
     from dragonfly2_tpu.train import (
         GNNTrainConfig,
@@ -31,48 +156,67 @@ def main() -> None:
     )
 
     mesh = data_parallel_mesh()
+    if on_tpu:
+        record(platform=jax.devices()[0].platform)
+    record(n_devices=mesh.n_data)
+    stage_done("init")
+
     cluster = SyntheticCluster(n_hosts=2000, seed=0)
 
-    # Headline: GraphSAGE on 2M probe edges (bench-scale slice of the 10M
-    # north-star corpus; wall-clock bounded for the driver).
+    # Stage 1 (headline): GraphSAGE on a 2M-edge probe graph, step loop
+    # time-boxed to ~half the remaining budget; throughput = steps
+    # actually completed after the compiled first step.
     graph = cluster.probe_graph(2_000_000)
+    gnn_budget = max(min(remaining() * 0.45, 75.0), 5.0)
     gnn = train_gnn(
-        graph, GNNTrainConfig(batch_size=8192, epochs=2), mesh
+        graph,
+        GNNTrainConfig(batch_size=8192, epochs=1000, eval_fraction=0.02,
+                       max_seconds=gnn_budget),
+        mesh,
     )
-
-    # Second track: MLP + parent-select latency.
-    X, y = cluster.pair_example_columns(500_000)
-    mlp = train_mlp(X, y, MLPTrainConfig(epochs=3, batch_size=16384), mesh)
-    scorer = ParentScorer(mlp.model, mlp.params, mlp.normalizer, mlp.target_norm)
-    latency = scorer.benchmark(batch=16, iters=500)
-
     per_chip = gnn.samples_per_sec / mesh.n_data
-    print(
-        json.dumps(
-            {
-                "metric": "graphsage_train_samples_per_sec_per_chip",
-                "value": int(per_chip),
-                "unit": "samples/sec/chip",
-                "vs_baseline": round(per_chip / TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP, 3),
-                "extras": {
-                    "gnn_f1": round(gnn.f1, 4),
-                    "gnn_precision": round(gnn.precision, 4),
-                    "gnn_recall": round(gnn.recall, 4),
-                    "parent_select_p50_ms": round(latency["p50_ms"], 4),
-                    "parent_select_p99_ms": round(latency["p99_ms"], 4),
-                    "parent_select_vs_1ms_target": round(
-                        TARGET_P50_MS / latency["p50_ms"], 3
-                    ),
-                    "mlp_train_samples_per_sec_per_chip": int(
-                        mlp.samples_per_sec / mesh.n_data
-                    ),
-                    "mlp_eval_mae_mbps": round(mlp.mae, 3),
-                    "n_devices": mesh.n_data,
-                },
-            }
-        )
+    set_headline(per_chip)
+    record(
+        gnn_f1=round(gnn.f1, 4),
+        gnn_precision=round(gnn.precision, 4),
+        gnn_recall=round(gnn.recall, 4),
+        gnn_steps=gnn.steps,
+        gnn_compile_seconds=round(gnn.compile_seconds, 1),
+        gnn_step_seconds_budget=round(gnn_budget, 1),
     )
+    stage_done("gnn")
+
+    # Stage 2: parent-selection latency through the jitted scorer. Uses a
+    # quickly-trained MLP (latency is weight-independent, but train a real
+    # one so mae is reportable).
+    X, y = cluster.pair_example_columns(300_000)
+    mlp = train_mlp(
+        X, y,
+        MLPTrainConfig(epochs=100, batch_size=16384,
+                       max_seconds=max(min(remaining() * 0.4, 30.0), 2.0)),
+        mesh,
+    )
+    record(
+        mlp_train_samples_per_sec_per_chip=int(
+            mlp.samples_per_sec / mesh.n_data),
+        mlp_eval_mae_mbps=round(mlp.mae, 3),
+    )
+    stage_done("mlp")
+
+    from dragonfly2_tpu.inference import ParentScorer
+
+    scorer = ParentScorer(mlp.model, mlp.params, mlp.normalizer,
+                          mlp.target_norm)
+    iters = 500 if remaining() > 30 else 100
+    latency = scorer.benchmark(batch=16, iters=iters)
+    record(
+        parent_select_p50_ms=round(latency["p50_ms"], 4),
+        parent_select_p99_ms=round(latency["p99_ms"], 4),
+        parent_select_vs_1ms_target=round(
+            TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
+    )
+    stage_done("scorer")
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    main()

@@ -53,6 +53,10 @@ class DaemonConfig:
     traffic_shaper_type: str = "plain"
     task_options: PeerTaskOptions = field(default_factory=PeerTaskOptions)
     keep_storage: bool = True
+    # Probe ticker (client/daemon/networktopology): 0 disables. Each tick
+    # asks the scheduler for candidates, TCP-pings them, reports RTTs.
+    probe_interval: float = 0.0
+    probe_timeout: float = 1.0
 
 
 class Daemon:
@@ -73,6 +77,7 @@ class Daemon:
             config.traffic_shaper_type, config.total_download_rate_bps
         )
         self.host_id = idgen.host_id_v1(config.hostname, self.upload.port)
+        self.prober = None
         self._started = False
         self._conductors_lock = threading.Lock()
         self._conductors: Dict[str, PeerTaskConductor] = {}
@@ -88,9 +93,33 @@ class Daemon:
         # recompute now that the listener exists.
         self.host_id = idgen.host_id_v1(self.config.hostname, self.upload.port)
         self.announce()
+        if self.config.probe_interval > 0:
+            self.prober = self._build_prober()
+            self.prober.serve()
         self._started = True
 
+    def _build_prober(self):
+        """Probe loop against whichever scheduler flavor we hold: the
+        in-process service (direct calls) or a remote one (SyncProbes
+        stream via the client's probe_sync hook)."""
+        from dragonfly2_tpu.client.networktopology import (
+            InProcessProbeSync,
+            ProbeConfig,
+            Prober,
+        )
+
+        if hasattr(self.scheduler, "probe_sync"):
+            sync = self.scheduler.probe_sync()
+        else:
+            sync = InProcessProbeSync(self.scheduler)
+        return Prober(self.host_id, sync, ProbeConfig(
+            interval=self.config.probe_interval,
+            probe_timeout=self.config.probe_timeout,
+        ))
+
     def stop(self) -> None:
+        if self.prober is not None:
+            self.prober.stop()
         self.shaper.stop()
         self.upload.stop()
         self.storage.persist_all()

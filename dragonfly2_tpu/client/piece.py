@@ -75,8 +75,11 @@ def parse_http_range(header: str, total: int) -> Range:
         if not end_s.isdigit():  # catches 'bytes=--5', 'bytes=-', 'bytes=-x'
             raise ValueError(f"malformed range {header!r}")
         n = int(end_s)
-        if n <= 0:
-            raise RangeNotSatisfiable(f"zero suffix length in {header!r}")
+        if n <= 0 or total <= 0:
+            # Zero suffix, or any suffix of an empty representation: no
+            # byte satisfies it (RFC 9110 §14.1.2).
+            raise RangeNotSatisfiable(
+                f"suffix {header!r} unsatisfiable for length {total}")
         start = max(0, total - n)
         return Range(start, total - start)
     if not start_s.isdigit() or (end_s and not end_s.isdigit()):

@@ -238,7 +238,14 @@ class ProxyServer(ThreadedHTTPService):
         else:
             req.send_response(200)
             length = total
-        req.send_header("Content-Length", str(max(length, 0)))
+        if length >= 0:
+            req.send_header("Content-Length", str(length))
+        else:
+            # Length never learned from the source (close-delimited
+            # origin): close-delimit our response too — a fabricated
+            # Content-Length would desynchronize keep-alive framing.
+            req.send_header("Connection", "close")
+            req.close_connection = True
         req.send_header(HEADER_TASK_ID, result.task_id)
         req.send_header(HEADER_PEER_ID, result.peer_id)
         req.end_headers()

@@ -30,6 +30,7 @@ def build_daemon(args):
         total_download_rate_bps=args.download_rate or INF,
         upload_rate_bps=args.upload_rate or INF,
         traffic_shaper_type=args.traffic_shaper,
+        probe_interval=args.probe_interval,
     ))
     daemon.start()
     return daemon
@@ -52,6 +53,9 @@ def main(argv=None) -> int:
     parser.add_argument("--upload-rate", type=float, default=0)
     parser.add_argument("--traffic-shaper", default="plain",
                         choices=["plain", "sampling"])
+    parser.add_argument("--probe-interval", type=float, default=0.0,
+                        help="network-topology probe ticker seconds "
+                             "(0 = disabled)")
     parser.add_argument("--proxy-port", type=int, default=0,
                         help="enable the HTTP proxy on this port")
     parser.add_argument("--proxy-rule", action="append", default=[],

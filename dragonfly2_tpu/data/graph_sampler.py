@@ -80,13 +80,12 @@ class CSRGraph:
 
 @dataclass
 class EdgeBatch:
-    """One static-shape GraphSAGE minibatch over B target edges.
+    """One static-shape GraphSAGE minibatch over B target edges,
+    feature-materialized (host-side gather). Kept for host-only consumers
+    and equivalence tests; the training path ships IndexEdgeBatch instead.
 
     Every array's shape is a pure function of (B, fanouts, F) — XLA
-    compiles the training step exactly once. Node features are gathered
-    host-side (F is ~9 floats; shipping features instead of indices keeps
-    the device graph pure dense math with no sharded-gather ambiguity and
-    no replicated node table in HBM).
+    compiles the training step exactly once.
     """
 
     center_feat: np.ndarray  # [B, 2, F] float32 — (src, dst) features
@@ -102,6 +101,46 @@ class EdgeBatch:
         return (
             self.center_feat, self.nbr1_feat, self.nbr1_rtt, self.nbr1_mask,
             self.nbr2_feat, self.nbr2_rtt, self.nbr2_mask, self.labels,
+        )
+
+
+@dataclass
+class IndexEdgeBatch:
+    """The wire format of the input pipeline: int32 node indices instead of
+    gathered float features.
+
+    The 2-hop feature tensor in feature mode is [B, 2, f1, f2, F] float32 —
+    ~F× the bytes of the [B, 2, f1, f2] int32 index array. Shipping indices
+    and gathering from a replicated on-device node-feature table cuts
+    host→device transfer ~4× at F=9 and moves the gather onto the chip,
+    where it fuses into the first layer's matmul input.
+    """
+
+    center_idx: np.ndarray   # [B, 2] int32
+    nbr1_idx: np.ndarray     # [B, 2, f1] int32
+    nbr1_rtt: np.ndarray     # [B, 2, f1] float32
+    nbr1_mask: np.ndarray    # [B, 2, f1] float32
+    nbr2_idx: np.ndarray     # [B, 2, f1, f2] int32
+    nbr2_rtt: np.ndarray     # [B, 2, f1, f2] float32
+    nbr2_mask: np.ndarray    # [B, 2, f1, f2] float32
+    labels: np.ndarray       # [B] float32
+
+    def astuple(self) -> tuple:
+        return (
+            self.center_idx, self.nbr1_idx, self.nbr1_rtt, self.nbr1_mask,
+            self.nbr2_idx, self.nbr2_rtt, self.nbr2_mask, self.labels,
+        )
+
+    def to_features(self, node_features: np.ndarray) -> EdgeBatch:
+        """Host-side gather — the exact arrays the device-side gather
+        produces (equivalence-tested)."""
+        return EdgeBatch(
+            center_feat=node_features[self.center_idx],
+            nbr1_feat=node_features[self.nbr1_idx],
+            nbr1_rtt=self.nbr1_rtt, nbr1_mask=self.nbr1_mask,
+            nbr2_feat=node_features[self.nbr2_idx],
+            nbr2_rtt=self.nbr2_rtt, nbr2_mask=self.nbr2_mask,
+            labels=self.labels,
         )
 
 
@@ -132,7 +171,10 @@ class EdgeBatchSampler:
     def n_edges(self) -> int:
         return len(self.edge_src)
 
-    def sample(self, edge_ids: np.ndarray, rng: np.random.Generator) -> EdgeBatch:
+    def sample_indices(self, edge_ids: np.ndarray,
+                       rng: np.random.Generator) -> IndexEdgeBatch:
+        """The pipeline's native output: indices + edge signals, no feature
+        materialization."""
         f1, f2 = self.fanouts
         centers = np.stack(
             [self.edge_src[edge_ids], self.edge_dst[edge_ids]], axis=1
@@ -141,13 +183,16 @@ class EdgeBatchSampler:
         nbr2, rtt2, mask2 = self.csr.sample_neighbors(nbr1, f2, rng)
         # Mask out 2-hop samples hanging off padded 1-hop slots.
         mask2 = mask2 * mask1[..., None]
-        nf = self.csr.node_features
-        return EdgeBatch(
-            center_feat=nf[centers],
-            nbr1_feat=nf[nbr1], nbr1_rtt=rtt1, nbr1_mask=mask1,
-            nbr2_feat=nf[nbr2], nbr2_rtt=rtt2 * mask2, nbr2_mask=mask2,
+        return IndexEdgeBatch(
+            center_idx=centers,
+            nbr1_idx=nbr1, nbr1_rtt=rtt1, nbr1_mask=mask1,
+            nbr2_idx=nbr2, nbr2_rtt=rtt2 * mask2, nbr2_mask=mask2,
             labels=self.labels[edge_ids],
         )
+
+    def sample(self, edge_ids: np.ndarray, rng: np.random.Generator) -> EdgeBatch:
+        return self.sample_indices(edge_ids, rng).to_features(
+            self.csr.node_features)
 
     def epoch_batches(self, batch_size: int, *, seed: int = 0, epoch: int = 0):
         """Deterministic-shuffle epoch of static-size batches (remainder

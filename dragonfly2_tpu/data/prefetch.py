@@ -1,0 +1,50 @@
+"""Background batch prefetching for device input pipelines.
+
+The VERDICT-identified stall: sample-on-host → device_put → step, serially,
+leaves the device idle during host work every step. This module overlaps
+them: worker threads build (and device-place) up to ``depth`` batches ahead
+of the consumer, so the next batch's host sampling and H2D transfer run
+while the current step executes on device.
+
+Ordering is preserved (results yield in task order), and determinism is the
+caller's job: pass per-task seeds into ``fn`` instead of sharing one RNG
+across workers.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+def prefetch(
+    tasks: Iterable[T],
+    fn: Callable[[T], U],
+    depth: int = 2,
+    workers: int = 2,
+) -> Iterator[U]:
+    """Yield ``fn(task)`` in task order with up to ``depth`` results built
+    ahead by ``workers`` threads.
+
+    numpy sampling and jax.device_put both release the GIL for their bulk
+    work, so 2 workers genuinely overlap sampling with transfer. Closing
+    the generator (consumer break / exception) cancels outstanding work.
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    executor = ThreadPoolExecutor(max_workers=workers,
+                                  thread_name_prefix="prefetch")
+    pending: deque = deque()
+    try:
+        for task in tasks:
+            pending.append(executor.submit(fn, task))
+            if len(pending) > depth:
+                yield pending.popleft().result()
+        while pending:
+            yield pending.popleft().result()
+    finally:
+        executor.shutdown(wait=False, cancel_futures=True)

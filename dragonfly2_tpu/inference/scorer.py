@@ -125,7 +125,8 @@ class MLEvaluator:
         self._scorer = scorer
         self._fallback = BaseEvaluator()
         # Operators must be able to tell "model live" from "model silently
-        # failing": count fallbacks and log the first failure loudly.
+        # failing": count scores and fallbacks, log the first failure loudly.
+        self.scored_count = 0
         self.fallback_count = 0
         self._logged_failure = False
 
@@ -154,6 +155,7 @@ class MLEvaluator:
                     "evaluation (further failures counted, not logged)"
                 )
             return self._fallback.evaluate_parents(parents, child, total_piece_count)
+        self.scored_count += 1
         order = np.argsort(-scores, kind="stable")
         return [parents[i] for i in order]
 

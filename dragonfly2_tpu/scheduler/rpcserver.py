@@ -471,9 +471,16 @@ class GrpcSchedulerClient:
     def __init__(self, target: str):
         from dragonfly2_tpu.rpc.client import ServiceClient
 
+        self.target = target
         self._client = ServiceClient(target, SCHEDULER_SPEC)
         self._sessions: Dict[str, _AnnounceSession] = {}
         self._lock = threading.Lock()
+
+    def probe_sync(self):
+        """Probe-loop adapter for the daemon's Prober (SyncProbes stream)."""
+        from dragonfly2_tpu.client.networktopology import GrpcProbeSync
+
+        return GrpcProbeSync(self.target)
 
     # -- host lifecycle --------------------------------------------------
 

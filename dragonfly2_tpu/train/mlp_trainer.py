@@ -8,7 +8,6 @@ chooses for the mesh — ICI allreduce on a slice, nothing on one chip.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -21,6 +20,7 @@ from flax.training import train_state
 from dragonfly2_tpu.data.pipeline import ArrayDataset
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 from dragonfly2_tpu.parallel import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.train.step_budget import StepBudget
 
 
 @dataclass(frozen=True)
@@ -33,6 +33,9 @@ class MLPTrainConfig:
     seed: int = 0
     eval_fraction: float = 0.1
     warmup_steps: int = 100
+    # Wall-clock budget for the step loop (compile excluded); None = run
+    # all epochs (see GNNTrainConfig.max_seconds).
+    max_seconds: float | None = None
 
 
 @dataclass
@@ -137,17 +140,22 @@ def train_mlp(
     eval_step = _make_eval_step(model, mesh, t_mean, t_std)
 
     history = []
-    n_samples = 0
-    start = time.perf_counter()
+    budget = StepBudget(config.max_seconds)
+    stop = False
     for epoch in range(config.epochs):
         losses = []
         for bx, by in train_ds.batches(batch_size, seed=config.seed, epoch=epoch):
             state, loss = train_step(state, mesh.put_batch(bx), mesh.put_batch(by))
             losses.append(loss)
-            n_samples += len(bx)
-        history.append(float(jnp.mean(jnp.stack(losses))))
+            if budget.tick(len(bx), loss):
+                stop = True
+                break
+        if losses:
+            history.append(float(jnp.mean(jnp.stack(losses))))
+        if stop:
+            break
     jax.block_until_ready(state.params)
-    elapsed = time.perf_counter() - start
+    budget.finish()
 
     # Eval in fixed-size chunks (pad the tail by wrapping — metrics are
     # sums, so we mask instead: just iterate full batches + remainder on
@@ -184,6 +192,6 @@ def train_mlp(
         config=config,
         mse=mse,
         mae=mae,
-        samples_per_sec=n_samples / elapsed,
+        samples_per_sec=budget.samples_per_sec(batch_size),
         history=history,
     )

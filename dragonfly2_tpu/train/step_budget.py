@@ -1,0 +1,57 @@
+"""Shared step-loop accounting: compile exclusion + wall-clock budget.
+
+Both trainers measure steady-state throughput the same way — block on the
+first step to capture XLA compile time, restart the clock, then count
+samples until the optional deadline. This helper holds that logic once so
+the accounting can't drift between models.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+
+class StepBudget:
+    def __init__(self, max_seconds: Optional[float] = None):
+        self.max_seconds = max_seconds
+        self.steps = 0
+        self.samples = 0
+        self.compile_seconds = 0.0
+        self._start = time.perf_counter()
+        self._deadline: Optional[float] = None
+        self._elapsed: Optional[float] = None
+
+    def tick(self, n_samples: int, first_step_output) -> bool:
+        """Account one completed step dispatch; returns True when the
+        budget is exhausted and the loop should stop.
+
+        On the first step, blocks on ``first_step_output`` so compile time
+        is captured and excluded from the throughput window.
+        """
+        if self.steps == 0:
+            jax.block_until_ready(first_step_output)
+            now = time.perf_counter()
+            self.compile_seconds = now - self._start
+            self._start = now
+            if self.max_seconds is not None:
+                self._deadline = now + self.max_seconds
+        else:
+            self.samples += n_samples
+        self.steps += 1
+        return (self._deadline is not None
+                and time.perf_counter() >= self._deadline)
+
+    def finish(self) -> None:
+        """Freeze the throughput window (call after the final block)."""
+        self._elapsed = max(time.perf_counter() - self._start, 1e-9)
+
+    def samples_per_sec(self, batch_size: int) -> float:
+        """Steady-state throughput; single-step runs have no post-compile
+        window, so the whole run (compile included) is the best estimate."""
+        elapsed = self._elapsed or max(time.perf_counter() - self._start, 1e-9)
+        if self.samples:
+            return self.samples / elapsed
+        return batch_size * self.steps / max(self.compile_seconds, 1e-9)

@@ -1,0 +1,116 @@
+"""Client probe-sender unit tests (the daemon half of SyncProbes).
+
+Reference counterpart: client/daemon/networktopology/network_topology_test.go.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from dragonfly2_tpu.client.networktopology import (
+    InProcessProbeSync,
+    ProbeConfig,
+    Prober,
+    ProbeTarget,
+)
+from dragonfly2_tpu.utils.netping import ping_hosts, tcp_rtt
+
+
+def _listener():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(8)
+    return s
+
+
+class TestNetPing:
+    def test_rtt_to_live_listener(self):
+        s = _listener()
+        try:
+            rtt = tcp_rtt("127.0.0.1", s.getsockname()[1], timeout=2)
+            assert rtt is not None and 0 < rtt < 2
+        finally:
+            s.close()
+
+    def test_unreachable_is_none(self):
+        # Port 1 on localhost: immediate RST → None, quickly.
+        assert tcp_rtt("127.0.0.1", 1, timeout=0.5) is None
+
+    def test_ping_hosts_mixed(self):
+        s = _listener()
+        try:
+            out = ping_hosts([
+                ("up", "127.0.0.1", s.getsockname()[1]),
+                ("down", "127.0.0.1", 1),
+            ], timeout=0.5)
+            assert out["up"] is not None and out["down"] is None
+        finally:
+            s.close()
+
+
+class FakeService:
+    """SchedulerService probe surface."""
+
+    def __init__(self, targets):
+        self.targets = targets
+        self.finished = []
+        self.failed = []
+
+    def probe_started(self, host_id):
+        class H:  # duck Host
+            def __init__(self, t):
+                self.id, self.ip, self.port = t.host_id, t.ip, t.port
+
+        return [H(t) for t in self.targets]
+
+    def probe_finished(self, host_id, results):
+        self.finished.extend(results)
+
+    def probe_failed(self, host_id, results):
+        self.failed.extend(results)
+
+
+class TestProber:
+    def test_probe_once_reports_ok_and_failed(self):
+        s = _listener()
+        try:
+            service = FakeService([
+                ProbeTarget("host-up", "127.0.0.1", s.getsockname()[1]),
+                ProbeTarget("host-down", "127.0.0.1", 1),
+            ])
+            prober = Prober("me", InProcessProbeSync(service),
+                            ProbeConfig(probe_timeout=0.5))
+            n = prober.probe_once()
+            assert n == 2
+            assert [r.dest_host_id for r in service.finished] == ["host-up"]
+            assert service.finished[0].rtt_seconds > 0
+            assert [r.dest_host_id for r in service.failed] == ["host-down"]
+        finally:
+            s.close()
+
+    def test_ticker_survives_sync_errors(self):
+        class Exploding:
+            calls = 0
+
+            def probe_started(self, host_id):
+                Exploding.calls += 1
+                raise RuntimeError("scheduler down")
+
+        done = threading.Event()
+
+        class CountingProber(Prober):
+            def probe_once(self):
+                try:
+                    return super().probe_once()
+                finally:
+                    if Exploding.calls >= 2:
+                        done.set()
+
+        prober = CountingProber("me", Exploding(),
+                                ProbeConfig(interval=0.01))
+        prober.serve()
+        try:
+            assert done.wait(timeout=5)
+        finally:
+            prober.stop()

@@ -95,6 +95,50 @@ class TestSampler:
         np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
         assert not np.array_equal(np.concatenate(a), np.concatenate(c))
 
+    def test_index_and_feature_modes_agree(self, graph, csr):
+        """IndexEdgeBatch.to_features must reproduce the feature-mode
+        arrays exactly — it's what proves the on-device gather computes
+        the same batch the host gather did."""
+        labels = graph.edge_labels()
+        s = EdgeBatchSampler(csr, graph.edge_src, graph.edge_dst, labels, (4, 3))
+        idx_batch = s.sample_indices(np.arange(32), np.random.default_rng(7))
+        feat_batch = s.sample(np.arange(32), np.random.default_rng(7))
+        from_idx = idx_batch.to_features(csr.node_features)
+        for a, b in zip(from_idx.astuple(), feat_batch.astuple()):
+            np.testing.assert_array_equal(a, b)
+
+
+class TestPrefetch:
+    def test_order_preserved_and_all_yielded(self):
+        from dragonfly2_tpu.data.prefetch import prefetch
+
+        out = list(prefetch(range(50), lambda i: i * i, depth=3, workers=4))
+        assert out == [i * i for i in range(50)]
+
+    def test_consumer_break_stops_cleanly(self):
+        from dragonfly2_tpu.data.prefetch import prefetch
+
+        seen = []
+        stream = prefetch(range(1000), lambda i: seen.append(i) or i,
+                          depth=2, workers=2)
+        for v in stream:
+            if v >= 5:
+                stream.close()
+                break
+        # Bounded lookahead: at most depth+workers extra tasks started.
+        assert len(seen) < 20
+
+    def test_worker_exception_propagates(self):
+        from dragonfly2_tpu.data.prefetch import prefetch
+
+        def boom(i):
+            if i == 3:
+                raise RuntimeError("sampler died")
+            return i
+
+        with pytest.raises(RuntimeError, match="sampler died"):
+            list(prefetch(range(10), boom, depth=2, workers=2))
+
 
 class TestTrainGNN:
     def test_learns_topology(self, graph):
@@ -156,3 +200,18 @@ class TestTrainGNN:
         g = SyntheticCluster(n_hosts=10, seed=0).probe_graph(4)
         with pytest.raises(ValueError, match="can't fill"):
             train_gnn(g, GNNTrainConfig(batch_size=4096))
+
+    def test_time_budget_stops_early(self, graph):
+        """max_seconds caps the step loop but still returns a complete,
+        evaluated result (the bench's un-killability contract)."""
+        res = train_gnn(
+            graph,
+            GNNTrainConfig(hidden=16, embed=8, batch_size=256, epochs=50,
+                           max_seconds=1.0),
+            data_parallel_mesh(),
+        )
+        full_steps = 50 * (len(graph.edge_src) * 8 // 10 // 256)
+        assert 1 <= res.steps < full_steps
+        assert res.compile_seconds > 0
+        assert res.samples_per_sec > 0
+        assert 0.0 <= res.f1 <= 1.0
