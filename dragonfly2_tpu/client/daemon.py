@@ -57,6 +57,9 @@ class DaemonConfig:
     # asks the scheduler for candidates, TCP-pings them, reports RTTs.
     probe_interval: float = 0.0
     probe_timeout: float = 1.0
+    # Re-announce ticker (announcer.go AnnounceHost loop): refreshes the
+    # host telemetry snapshot at the scheduler. 0 = announce once only.
+    announce_interval: float = 0.0
 
 
 class Daemon:
@@ -65,13 +68,18 @@ class Daemon:
     def __init__(self, scheduler: SchedulerAPI, config: DaemonConfig):
         if not config.storage_root:
             raise ValueError("storage_root required")
+        from dragonfly2_tpu import __version__
+        from dragonfly2_tpu.client.metrics import DaemonMetrics
+
         self.scheduler = scheduler
         self.config = config
+        self.metrics = DaemonMetrics(version=__version__)
         self.storage = StorageManager(StorageOptions(
             root=config.storage_root, keep_storage=config.keep_storage,
         ))
         self.upload = UploadServer(
-            self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps
+            self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
+            metrics=self.metrics,
         )
         self.shaper: TrafficShaper = new_traffic_shaper(
             config.traffic_shaper_type, config.total_download_rate_bps
@@ -96,7 +104,19 @@ class Daemon:
         if self.config.probe_interval > 0:
             self.prober = self._build_prober()
             self.prober.serve()
+        if self.config.announce_interval > 0:
+            self._announce_stop = threading.Event()
+            self._announce_thread = threading.Thread(
+                target=self._announce_loop, name="announce-host", daemon=True)
+            self._announce_thread.start()
         self._started = True
+
+    def _announce_loop(self) -> None:
+        while not self._announce_stop.wait(self.config.announce_interval):
+            try:
+                self.announce()
+            except Exception:  # noqa: BLE001 — announcing must not die
+                logger.exception("host re-announce failed")
 
     def _build_prober(self):
         """Probe loop against whichever scheduler flavor we hold: the
@@ -115,9 +135,13 @@ class Daemon:
         return Prober(self.host_id, sync, ProbeConfig(
             interval=self.config.probe_interval,
             probe_timeout=self.config.probe_timeout,
-        ))
+        ), metrics=self.metrics)
 
     def stop(self) -> None:
+        if getattr(self, "_announce_thread", None) is not None:
+            self._announce_stop.set()
+            self._announce_thread.join(timeout=5)
+            self._announce_thread = None
         if self.prober is not None:
             self.prober.stop()
         self.shaper.stop()
@@ -131,7 +155,9 @@ class Daemon:
         self.scheduler.announce_host(host)
 
     def build_host(self) -> Host:
-        from dragonfly2_tpu.schema import records
+        """Identity + live psutil telemetry (announcer.go:45-158), so the
+        scheduler's dataset export carries real machine features."""
+        from dragonfly2_tpu.client import telemetry
 
         return Host(
             id=self.host_id,
@@ -140,9 +166,15 @@ class Daemon:
             port=self.upload.port,
             download_port=self.upload.port,
             type=self.config.host_type,
-            network=records.Network(
+            cpu=telemetry.collect_cpu(),
+            memory=telemetry.collect_memory(),
+            disk=telemetry.collect_disk(self.config.storage_root),
+            network=telemetry.collect_network(
                 idc=self.config.idc, location=self.config.location,
+                upload_port=self.upload.port,
             ),
+            build=telemetry.collect_build(),
+            **telemetry.platform_info(),
         )
 
     # -- task frontends (peertask_manager.go StartFileTask) ----------------
@@ -161,6 +193,8 @@ class Daemon:
         done = self.storage.find_completed_task(task_id)
         if done is not None:
             logger.info("task %s reused from storage", task_id[:16])
+            self.metrics.download_traffic.labels(type="reuse").inc(
+                max(done.meta.content_length, 0))
             result = PeerTaskResult(
                 task_id, done.meta.peer_id, True,
                 content_length=done.meta.content_length, storage=done,
@@ -175,6 +209,8 @@ class Daemon:
             else idgen.peer_id_v1(self.config.ip)
         ) + "-" + uuid.uuid4().hex[:8]
         self.shaper.add_task(task_id)
+        self.metrics.download_task_count.inc()
+        self.metrics.concurrent_tasks.inc()
         try:
             conductor = PeerTaskConductor(
                 self.scheduler, self.storage,
@@ -183,11 +219,19 @@ class Daemon:
                 options=self.config.task_options,
                 is_seed=self.config.host_type.is_seed,
                 piece_sink=piece_sink,
+                metrics=self.metrics,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
-            result = conductor.run()
+            try:
+                result = conductor.run()
+            except Exception:
+                self.metrics.download_task_failure.inc()
+                raise
+            if not result.success:
+                self.metrics.download_task_failure.inc()
         finally:
+            self.metrics.concurrent_tasks.dec()
             self.shaper.remove_task(task_id)
             with self._conductors_lock:
                 self._conductors.pop(peer_id, None)

@@ -141,12 +141,14 @@ class ProbeConfig:
 class Prober:
     """The daemon's probe ticker."""
 
-    def __init__(self, host_id: str, sync, config: ProbeConfig | None = None):
+    def __init__(self, host_id: str, sync, config: ProbeConfig | None = None,
+                 metrics=None):
         """``sync`` is either a ProbeSync (three-method protocol) or a
         GrpcProbeSync (single ``sync`` method driving the stream)."""
         self.host_id = host_id
         self.sync = sync
         self.config = config or ProbeConfig()
+        self.metrics = metrics  # DaemonMetrics or None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -182,6 +184,9 @@ class Prober:
               if rtt is not None]
         failed = [ProbeResult(hid, 0.0) for hid, rtt in rtts.items()
                   if rtt is None]
+        if self.metrics:
+            self.metrics.probe_count.labels(outcome="ok").inc(len(ok))
+            self.metrics.probe_count.labels(outcome="failed").inc(len(failed))
         return ok, failed
 
     def probe_once(self) -> int:

@@ -201,6 +201,7 @@ class PeerTaskConductor:
         options: PeerTaskOptions | None = None,
         is_seed: bool = False,
         piece_sink=None,
+        metrics=None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -212,6 +213,8 @@ class PeerTaskConductor:
         self.shaper = shaper or PlainTrafficShaper()
         self.opts = options or PeerTaskOptions()
         self.is_seed = is_seed
+        # DaemonMetrics or None — piece-level traffic accounting.
+        self.metrics = metrics
         # Optional hook called (store, PieceMetadata) after each verified
         # piece write — feeds the HBM sink (client/hbm_sink.py) without
         # bypassing storage.
@@ -446,6 +449,8 @@ class PeerTaskConductor:
             self._written.add(piece.num)
         self._notify_piece_sink(piece.num)
         self.shaper.record(self.task_id, piece.length)
+        if self.metrics:
+            self.metrics.download_traffic.labels(type="p2p").inc(piece.length)
         try:
             self.scheduler.download_piece_finished(PieceFinished(
                 peer_id=self.peer_id, piece_number=piece.num,
@@ -610,6 +615,9 @@ class PeerTaskConductor:
             self.store.set_piece_digest(num, reader.hexdigest(), cost)
             self._notify_piece_sink(num)
             self.shaper.record(self.task_id, rng.length)
+            if self.metrics:
+                self.metrics.download_traffic.labels(
+                    type="back_to_source").inc(rng.length)
             try:
                 self.scheduler.download_piece_finished(PieceFinished(
                     peer_id=self.peer_id, piece_number=num, parent_id="",
@@ -662,6 +670,9 @@ class PeerTaskConductor:
                 ),
                 io.BytesIO(data),
             )
+            if self.metrics:
+                self.metrics.download_traffic.labels(
+                    type="back_to_source").inc(len(data))
             try:
                 self.scheduler.download_piece_finished(PieceFinished(
                     peer_id=self.peer_id, piece_number=num, parent_id="",

@@ -178,6 +178,10 @@ class ProxyServer(ThreadedHTTPService):
         try:
             url = self._target_url(req)
             use_p2p, url = self._should_use_p2p(req, url)
+            metrics = getattr(self.daemon, "metrics", None)
+            if metrics:
+                metrics.proxy_request_count.labels(
+                    via="mesh" if use_p2p else "direct").inc()
             if use_p2p:
                 self._serve_p2p(req, url)
             else:

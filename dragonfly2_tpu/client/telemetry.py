@@ -1,0 +1,144 @@
+"""Host telemetry collection for AnnounceHost.
+
+Reference counterpart: client/daemon/announcer/announcer.go:45-158 — the
+daemon fills the Host schema's CPU/memory/network/disk/build sections from
+gopsutil before announcing. Here psutil backs the same fields
+(schema/records.py CPU/Memory/Network/Disk/Build), so the scheduler's
+dataset export carries real machine features for MLP training instead of
+zeros.
+
+Every collector degrades to defaults on error — telemetry must never stop
+a daemon from announcing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import platform as _platform
+
+import psutil
+
+from dragonfly2_tpu.schema import records
+
+logger = logging.getLogger(__name__)
+
+# cpu_percent(interval=None) measures since the PREVIOUS call — the first
+# call always returns 0.0. Prime both meters at import so even a daemon's
+# startup announce carries a real (since-import) reading.
+try:
+    psutil.cpu_percent(interval=None)
+    psutil.Process().cpu_percent(interval=None)
+except Exception:  # noqa: BLE001
+    pass
+
+
+def collect_cpu() -> records.CPU:
+    try:
+        times = psutil.cpu_times()
+        return records.CPU(
+            logical_count=psutil.cpu_count(logical=True) or 0,
+            physical_count=psutil.cpu_count(logical=False) or 0,
+            percent=psutil.cpu_percent(interval=None),
+            process_percent=psutil.Process().cpu_percent(interval=None),
+            times=records.CPUTimes(
+                user=times.user,
+                system=times.system,
+                idle=times.idle,
+                nice=getattr(times, "nice", 0.0),
+                iowait=getattr(times, "iowait", 0.0),
+                irq=getattr(times, "irq", 0.0),
+                softirq=getattr(times, "softirq", 0.0),
+                steal=getattr(times, "steal", 0.0),
+                guest=getattr(times, "guest", 0.0),
+                guest_nice=getattr(times, "guest_nice", 0.0),
+            ),
+        )
+    except Exception:  # noqa: BLE001
+        logger.debug("cpu telemetry failed", exc_info=True)
+        return records.CPU()
+
+
+def collect_memory() -> records.Memory:
+    try:
+        vm = psutil.virtual_memory()
+        return records.Memory(
+            total=vm.total,
+            available=vm.available,
+            used=vm.used,
+            used_percent=vm.percent,
+            process_used_percent=psutil.Process().memory_percent(),
+            free=vm.free,
+        )
+    except Exception:  # noqa: BLE001
+        logger.debug("memory telemetry failed", exc_info=True)
+        return records.Memory()
+
+
+def collect_disk(path: str) -> records.Disk:
+    try:
+        du = psutil.disk_usage(path or "/")
+        disk = records.Disk(
+            total=du.total, free=du.free, used=du.used,
+            used_percent=du.percent,
+        )
+    except Exception:  # noqa: BLE001
+        logger.debug("disk telemetry failed", exc_info=True)
+        return records.Disk()
+    try:
+        st = os.statvfs(path or "/")
+        disk.inodes_total = st.f_files
+        disk.inodes_free = st.f_ffree
+        disk.inodes_used = st.f_files - st.f_ffree
+        if st.f_files:
+            disk.inodes_used_percent = disk.inodes_used / st.f_files * 100.0
+    except Exception:  # noqa: BLE001
+        pass
+    return disk
+
+
+def collect_network(idc: str = "", location: str = "",
+                    upload_port: int = 0) -> records.Network:
+    net = records.Network(idc=idc, location=location)
+    try:
+        conns = [c for c in psutil.Process().net_connections(kind="tcp")
+                 if c.status == psutil.CONN_ESTABLISHED]
+        net.tcp_connection_count = len(conns)
+        if upload_port:
+            # Established only — the upload listener's own LISTEN socket
+            # must not bias the announced load feature by +1.
+            net.upload_tcp_connection_count = sum(
+                1 for c in conns
+                if c.laddr and c.laddr.port == upload_port
+            )
+    except Exception:  # noqa: BLE001
+        # net_connections can need elevated privileges on some platforms.
+        logger.debug("network telemetry failed", exc_info=True)
+    return net
+
+
+def platform_info() -> dict:
+    """os/platform/kernel fields of the Host schema (host.go InfoStat)."""
+    try:
+        uname = _platform.uname()
+        return {
+            "os": uname.system.lower(),
+            "platform": uname.machine,
+            "platform_family": uname.system.lower(),
+            "platform_version": _platform.platform(),
+            "kernel_version": uname.release,
+        }
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def collect_build() -> records.Build:
+    try:
+        import dragonfly2_tpu
+
+        return records.Build(
+            git_version=getattr(dragonfly2_tpu, "__version__", "dev"),
+            platform=f"{_platform.system()}/{_platform.machine()}".lower(),
+        )
+    except Exception:  # noqa: BLE001
+        return records.Build()

@@ -30,8 +30,9 @@ class UploadServer(ThreadedHTTPService):
     """Serves stored piece bytes to child peers."""
 
     def __init__(self, storage: StorageManager, host: str = "127.0.0.1",
-                 port: int = 0, rate_limit_bps: float = INF):
+                 port: int = 0, rate_limit_bps: float = INF, metrics=None):
         self.storage = storage
+        self.metrics = metrics  # DaemonMetrics or None
         self.limiter = Limiter(rate_limit_bps, burst=int(rate_limit_bps)
                                if rate_limit_bps != INF else None)
         manager = self
@@ -94,6 +95,9 @@ class UploadServer(ThreadedHTTPService):
             req.send_error(416, "range past end of stored content")
             return
         self.limiter.wait_n(min(len(data), self.limiter.burst))
+        if self.metrics:
+            self.metrics.upload_piece_count.inc()
+            self.metrics.upload_traffic.inc(len(data))
         req.send_response(206)
         req.send_header("Content-Length", str(len(data)))
         req.send_header(

@@ -9,9 +9,15 @@ import signal
 import threading
 
 
-def init_logging(verbose: bool) -> None:
+def init_logging(verbose: bool, log_dir: str = "") -> None:
+    level = logging.DEBUG if verbose else logging.INFO
+    if log_dir:
+        from dragonfly2_tpu.utils.dflog import init_file_logging
+
+        init_file_logging(log_dir, level=level)
+        return
     logging.basicConfig(
-        level=logging.DEBUG if verbose else logging.INFO,
+        level=level,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
 
@@ -19,6 +25,27 @@ def init_logging(verbose: bool) -> None:
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--verbose", action="store_true",
                         help="debug logging")
+    parser.add_argument("--log-dir", default="",
+                        help="rotated per-concern log files here "
+                             "(default: console only)")
+    parser.add_argument("--metrics-port", type=int, default=-1,
+                        help="serve Prometheus /metrics on this port "
+                             "(0 = ephemeral, -1 = disabled)")
+
+
+def start_metrics_server(args, registry):
+    """Start the /metrics endpoint when --metrics-port was given.
+
+    Returns the MetricsServer or None; callers print its address.
+    """
+    if getattr(args, "metrics_port", -1) < 0 or registry is None:
+        return None
+    from dragonfly2_tpu.utils.metricsserver import MetricsServer
+
+    server = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
+    server.start()
+    print(f"metrics on {server.address}/metrics", flush=True)
+    return server
 
 
 def wait_for_shutdown() -> None:

@@ -10,7 +10,12 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    start_metrics_server,
+    wait_for_shutdown,
+)
 
 
 def build_daemon(args):
@@ -31,6 +36,7 @@ def build_daemon(args):
         upload_rate_bps=args.upload_rate or INF,
         traffic_shaper_type=args.traffic_shaper,
         probe_interval=args.probe_interval,
+        announce_interval=args.announce_interval,
     ))
     daemon.start()
     return daemon
@@ -56,6 +62,9 @@ def main(argv=None) -> int:
     parser.add_argument("--probe-interval", type=float, default=0.0,
                         help="network-topology probe ticker seconds "
                              "(0 = disabled)")
+    parser.add_argument("--announce-interval", type=float, default=30.0,
+                        help="host telemetry re-announce seconds "
+                             "(0 = announce once at startup)")
     parser.add_argument("--proxy-port", type=int, default=0,
                         help="enable the HTTP proxy on this port")
     parser.add_argument("--proxy-rule", action="append", default=[],
@@ -68,11 +77,12 @@ def main(argv=None) -> int:
                         help="filesystem object-store root for the gateway")
     add_common_flags(parser)
     args = parser.parse_args(argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir)
 
     daemon = build_daemon(args)
     print(f"daemon {daemon.host_id} upload on {daemon.upload.address}",
           flush=True)
+    metrics_server = start_metrics_server(args, daemon.metrics.registry)
 
     proxy = None
     if args.proxy_port or args.proxy_rule or args.registry_mirror:
@@ -106,6 +116,8 @@ def main(argv=None) -> int:
         print(f"object gateway on 127.0.0.1:{gateway.port}", flush=True)
 
     wait_for_shutdown()
+    if metrics_server:
+        metrics_server.stop()
     if gateway:
         gateway.stop()
     if proxy:

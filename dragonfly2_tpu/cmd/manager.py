@@ -35,6 +35,10 @@ class ManagerHTTPServer(ThreadedHTTPService):
                 pass
 
             def _json(self, code: int, payload) -> None:
+                metrics = getattr(api.service, "metrics", None)
+                if metrics:
+                    metrics.request_count.labels(
+                        method=self.command, status=str(code)).inc()
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -122,22 +126,28 @@ def main(argv=None) -> int:
     parser.add_argument("--object-store-dir", default="./manager-objects")
     add_common_flags(parser)
     args = parser.parse_args(argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir)
 
+    from dragonfly2_tpu import __version__
+    from dragonfly2_tpu.cmd.common import start_metrics_server
     from dragonfly2_tpu.manager import (
         Database,
         FilesystemObjectStore,
         ManagerService,
     )
     from dragonfly2_tpu.manager.jobs import JobBus, PreheatService
+    from dragonfly2_tpu.manager.metrics import ManagerMetrics
 
+    metrics = ManagerMetrics(version=__version__)
     service = ManagerService(
-        Database(args.db), FilesystemObjectStore(args.object_store_dir))
+        Database(args.db), FilesystemObjectStore(args.object_store_dir),
+        metrics=metrics)
     bus = JobBus()
     server = ManagerHTTPServer(
         service, PreheatService(bus, service), host=args.host, port=args.port)
     server.start()
     print(f"manager serving on {args.host}:{server.port}", flush=True)
+    metrics_server = start_metrics_server(args, metrics.registry)
 
     import time
 
@@ -148,6 +158,8 @@ def main(argv=None) -> int:
 
     threading.Thread(target=sweep, daemon=True, name="keepalive-sweep").start()
     wait_for_shutdown()
+    if metrics_server:
+        metrics_server.stop()
     server.stop()
     return 0
 

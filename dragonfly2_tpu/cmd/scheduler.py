@@ -11,7 +11,12 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    start_metrics_server,
+    wait_for_shutdown,
+)
 
 
 def build_scheduler(args):
@@ -30,6 +35,9 @@ def build_scheduler(args):
     from dragonfly2_tpu.scheduler.service import SchedulerService
     from dragonfly2_tpu.scheduler.storage.storage import Storage
 
+    from dragonfly2_tpu import __version__
+    from dragonfly2_tpu.scheduler.metrics import SchedulerMetrics
+
     resource = Resource()
     storage = Storage(args.data_dir)
     evaluator = new_evaluator(
@@ -42,6 +50,7 @@ def build_scheduler(args):
         storage=storage,
         network_topology=NetworkTopologyStore(
             NetworkTopologyConfig(), resource=resource, storage=storage),
+        metrics=SchedulerMetrics(resource=resource, version=__version__),
     )
     resource.serve()
     service.network_topology.serve()
@@ -70,10 +79,11 @@ def main(argv=None) -> int:
                              "model uploads per cluster")
     add_common_flags(parser)
     args = parser.parse_args(argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir)
 
     service, server = build_scheduler(args)
     print(f"scheduler serving on {server.target}", flush=True)
+    metrics_server = start_metrics_server(args, service.metrics.registry)
 
     announcer = None
     if args.trainer:
@@ -117,6 +127,8 @@ def main(argv=None) -> int:
                          name="announce-train").start()
 
     wait_for_shutdown()
+    if metrics_server:
+        metrics_server.stop()
     server.stop()
     return 0
 

@@ -9,7 +9,12 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    start_metrics_server,
+    wait_for_shutdown,
+)
 
 
 def main(argv=None) -> int:
@@ -23,8 +28,9 @@ def main(argv=None) -> int:
     parser.add_argument("--object-store-dir", default="./manager-objects")
     add_common_flags(parser)
     args = parser.parse_args(argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir)
 
+    from dragonfly2_tpu import __version__
     from dragonfly2_tpu.rpc import serve
     from dragonfly2_tpu.trainer import (
         TRAINER_SPEC,
@@ -32,6 +38,7 @@ def main(argv=None) -> int:
         TrainerStorage,
         Training,
     )
+    from dragonfly2_tpu.trainer.metrics import TrainerMetrics
 
     registry = None
     if args.manager_db:
@@ -45,10 +52,16 @@ def main(argv=None) -> int:
             Database(args.manager_db),
             FilesystemObjectStore(args.object_store_dir))
     storage = TrainerStorage(args.data_dir)
-    service = TrainerService(storage, Training(storage, registry))
+    metrics = TrainerMetrics(version=__version__)
+    service = TrainerService(
+        storage, Training(storage, registry, metrics=metrics),
+        metrics=metrics)
     server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
     print(f"trainer serving on {server.target}", flush=True)
+    metrics_server = start_metrics_server(args, metrics.registry)
     wait_for_shutdown()
+    if metrics_server:
+        metrics_server.stop()
     server.stop()
     return 0
 

@@ -65,11 +65,12 @@ class ActiveModel:
 
 class ManagerService:
     def __init__(self, database: Database, object_store: ObjectStore,
-                 keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL):
+                 keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL, metrics=None):
         self.db = database
         self.store = object_store
         self.searcher = Searcher()
         self.keepalive_ttl = keepalive_ttl
+        self.metrics = metrics  # ManagerMetrics or None
         self.store.create_bucket(MODELS_BUCKET)
 
     # ------------------------------------------------------------------
@@ -156,6 +157,8 @@ class ManagerService:
         )
         if row is None:
             raise ManagerError(f"{source_type} {hostname}/{ip} not registered")
+        if self.metrics:
+            self.metrics.keepalive_count.inc()
         self.db.update(table, row.id, state=STATE_ACTIVE,
                        last_keepalive=time.time())
 
@@ -189,6 +192,8 @@ class ManagerService:
                 [STATE_ACTIVE],
             )
         }
+        if self.metrics:
+            self.metrics.search_scheduler_cluster_count.inc()
         ranked = self.searcher.find_scheduler_clusters(
             clusters, ip, hostname, conditions,
             has_active_schedulers=lambda c: counts.get(c.id, 0) > 0,
@@ -278,6 +283,8 @@ class ManagerService:
                  file_key, now, now],
             )
             row_id = int(cur.lastrowid)
+        if self.metrics:
+            self.metrics.model_created_count.labels(type=model_type).inc()
         logger.info("model %s type=%s version=%s activated",
                     model_id, model_type, version)
         return self.db.get("models", row_id)

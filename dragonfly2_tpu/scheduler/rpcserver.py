@@ -57,22 +57,58 @@ class AnnounceHostRequest:
 
     @classmethod
     def from_host(cls, host: Host) -> "AnnounceHostRequest":
+        import dataclasses
+
         return cls(
             id=host.id, hostname=host.hostname, ip=host.ip, port=host.port,
             download_port=host.download_port, type=host.type.type_name,
             idc=host.network.idc, location=host.network.location,
             concurrent_upload_limit=host.concurrent_upload_limit,
+            # psutil snapshot + platform identity (announcer.go:45-158) —
+            # the MLP's machine features must survive the wire.
+            telemetry={
+                "cpu": dataclasses.asdict(host.cpu),
+                "memory": dataclasses.asdict(host.memory),
+                "disk": dataclasses.asdict(host.disk),
+                "build": dataclasses.asdict(host.build),
+                "network_counts": {
+                    "tcp_connection_count":
+                        host.network.tcp_connection_count,
+                    "upload_tcp_connection_count":
+                        host.network.upload_tcp_connection_count,
+                },
+                "platform": {
+                    "os": host.os,
+                    "platform": host.platform,
+                    "platform_family": host.platform_family,
+                    "platform_version": host.platform_version,
+                    "kernel_version": host.kernel_version,
+                },
+            },
         )
 
     def to_host(self) -> Host:
         from dragonfly2_tpu.schema import records
 
+        t = self.telemetry or {}
+        cpu_kw = dict(t.get("cpu", {}))
+        if "times" in cpu_kw:
+            cpu_kw["times"] = records.CPUTimes(**cpu_kw["times"])
+        network = records.Network(
+            idc=self.idc, location=self.location,
+            **t.get("network_counts", {}),
+        )
         return Host(
             id=self.id, hostname=self.hostname, ip=self.ip, port=self.port,
             download_port=self.download_port,
             type=HostType.from_name(self.type),
             concurrent_upload_limit=self.concurrent_upload_limit,
-            network=records.Network(idc=self.idc, location=self.location),
+            network=network,
+            cpu=records.CPU(**cpu_kw),
+            memory=records.Memory(**t.get("memory", {})),
+            disk=records.Disk(**t.get("disk", {})),
+            build=records.Build(**t.get("build", {})),
+            **t.get("platform", {}),
         )
 
 
@@ -322,6 +358,8 @@ class SchedulerRpcService:
         def pump() -> None:
             try:
                 for req in request_iterator:
+                    if self.service.metrics:
+                        self.service.metrics.announce_peer_count.inc()
                     self._dispatch(req, channel, outbound)
             except Exception as exc:
                 logger.debug("announce stream pump ended: %s", exc)

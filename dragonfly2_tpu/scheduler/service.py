@@ -108,6 +108,7 @@ class SchedulerService:
         storage: Optional[Storage] = None,
         network_topology: Optional[NetworkTopologyStore] = None,
         seed_peer_client=None,
+        metrics=None,
     ):
         self.resource = resource
         self.scheduling = scheduling
@@ -116,23 +117,32 @@ class SchedulerService:
         # SeedPeerClient protocol: trigger_task(task, url_meta) — implemented
         # by the daemon's seeder binding (resource/seed_peer.go:101).
         self.seed_peer_client = seed_peer_client
+        # SchedulerMetrics (scheduler/metrics.py) or None — instrumentation
+        # is optional so unit tests and embedded uses stay dependency-free.
+        self.metrics = metrics
 
     # ------------------------------------------------------------------
     # Host lifecycle (service_v2.go:AnnounceHost at 594, LeaveHost at 658)
     # ------------------------------------------------------------------
 
     def announce_host(self, host: Host) -> None:
+        if self.metrics:
+            self.metrics.announce_host_count.inc()
         existing = self.resource.host_manager.load(host.id)
         if existing is None:
             self.resource.host_manager.store(host)
             return
         # Refresh telemetry in place — identity fields are immutable.
         for attr in ("ip", "port", "download_port", "cpu", "memory",
-                     "network", "disk", "build", "concurrent_upload_limit"):
+                     "network", "disk", "build", "concurrent_upload_limit",
+                     "os", "platform", "platform_family", "platform_version",
+                     "kernel_version"):
             setattr(existing, attr, getattr(host, attr))
         existing.touch()
 
     def leave_host(self, host_id: str) -> None:
+        if self.metrics:
+            self.metrics.leave_host_count.inc()
         host = self.resource.host_manager.load(host_id)
         if host is None:
             raise ServiceError(NOT_FOUND, f"host {host_id} not found")
@@ -147,8 +157,12 @@ class SchedulerService:
 
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:
+        if self.metrics:
+            self.metrics.register_peer_count.inc()
         host = self.resource.host_manager.load(req.host_id)
         if host is None:
+            if self.metrics:
+                self.metrics.register_peer_failure.inc()
             raise ServiceError(NOT_FOUND, f"host {req.host_id} not announced")
         task = self.resource.task_manager.load_or_store(
             Task(req.task_id, url=req.url, tag=req.tag,
@@ -247,7 +261,7 @@ class SchedulerService:
         if peer.task.fsm.can(TaskEvent.DOWNLOAD):
             peer.task.fsm.fire(TaskEvent.DOWNLOAD)
         peer.fsm.fire(PeerEvent.DOWNLOAD)
-        self.scheduling.schedule_candidate_parents(peer, set(peer.block_parents))
+        self._schedule_timed(peer)
 
     def download_peer_back_to_source_started(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
@@ -282,12 +296,27 @@ class SchedulerService:
         peer = self._peer(peer_id)
         if parent_id:
             peer.block_parents.add(parent_id)
-        self.scheduling.schedule_candidate_parents(peer, set(peer.block_parents))
+        self._schedule_timed(peer)
+
+    def _schedule_timed(self, peer: Peer) -> None:
+        start = time.perf_counter()
+        try:
+            self.scheduling.schedule_candidate_parents(
+                peer, set(peer.block_parents))
+        finally:
+            if self.metrics:
+                self.metrics.schedule_duration.observe(
+                    time.perf_counter() - start)
 
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
         peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        if self.metrics:
+            self.metrics.download_peer_finished.inc()
+            self.metrics.download_peer_duration.observe(cost_seconds * 1e3)
+            self.metrics.traffic.labels(type="p2p").inc(
+                max(peer.task.content_length, 0))
         self._create_download_record(peer)
 
     def download_peer_back_to_source_finished(
@@ -301,17 +330,26 @@ class SchedulerService:
         task.report_success(content_length, total_piece_count)
         if task.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
             task.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
+        if self.metrics:
+            self.metrics.download_peer_finished.inc()
+            self.metrics.download_peer_duration.observe(cost_seconds * 1e3)
+            self.metrics.traffic.labels(type="back_to_source").inc(
+                max(content_length, 0))
         self._create_download_record(peer)
 
     def download_peer_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
         peer.task.peer_failed_count += 1
+        if self.metrics:
+            self.metrics.download_peer_failure.inc()
         self._create_download_record(peer)
 
     def download_peer_back_to_source_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
+        if self.metrics:
+            self.metrics.download_peer_failure.inc()
         task = peer.task
         task.back_to_source_peers.discard(peer.id)
         if task.fsm.can(TaskEvent.DOWNLOAD_FAILED):
@@ -353,6 +391,8 @@ class SchedulerService:
     def probe_started(self, host_id: str) -> List[Host]:
         """Candidates for the prober to ICMP-ping (FindProbedHosts:
         networktopology/network_topology.go:166-223)."""
+        if self.metrics:
+            self.metrics.sync_probes_count.inc()
         if self.network_topology is None:
             raise ServiceError(FAILED_PRECONDITION, "network topology disabled")
         if self.resource.host_manager.load(host_id) is None:
@@ -373,6 +413,9 @@ class SchedulerService:
                       rtt=result.rtt_seconds, created_at=result.created_at),
             )
             stored += 1
+        if self.metrics:
+            self.metrics.sync_probes_count.inc()
+            self.metrics.probes_stored.inc(stored)
         return stored
 
     def probe_failed(self, host_id: str,

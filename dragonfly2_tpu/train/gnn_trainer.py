@@ -58,6 +58,9 @@ class GNNTrainConfig:
     max_seconds: Optional[float] = None
     prefetch_depth: int = 2
     prefetch_workers: int = 2
+    # When set, the step loop runs under jax.profiler.trace writing an
+    # XPlane dump here (the reference's pprof/jaeger flag equivalent).
+    profile_dir: str = ""
 
 
 @dataclass
@@ -241,6 +244,8 @@ def train_gnn(
         rng = np.random.default_rng((config.seed, epoch, step, 3))
         return epoch, place(train_sampler.sample_indices(ids, rng))
 
+    import contextlib
+
     history: list = []
     epoch_losses: list = []
     current_epoch = 0
@@ -248,20 +253,23 @@ def train_gnn(
     stream = prefetch(train_tasks(), build,
                       depth=config.prefetch_depth,
                       workers=config.prefetch_workers)
-    for epoch, arrays in stream:
-        if epoch != current_epoch:
-            if epoch_losses:
-                history.append(float(jnp.mean(jnp.stack(epoch_losses))))
-            epoch_losses = []
-            current_epoch = epoch
-        state, loss = train_step(state, nf_dev, *arrays)
-        epoch_losses.append(loss)
-        if budget.tick(batch_size, loss):
-            stream.close()
-            break
-    if epoch_losses:
-        history.append(float(jnp.mean(jnp.stack(epoch_losses))))
-    jax.block_until_ready(state.params)
+    profiler = (jax.profiler.trace(config.profile_dir)
+                if config.profile_dir else contextlib.nullcontext())
+    with profiler:
+        for epoch, arrays in stream:
+            if epoch != current_epoch:
+                if epoch_losses:
+                    history.append(float(jnp.mean(jnp.stack(epoch_losses))))
+                epoch_losses = []
+                current_epoch = epoch
+            state, loss = train_step(state, nf_dev, *arrays)
+            epoch_losses.append(loss)
+            if budget.tick(batch_size, loss):
+                stream.close()
+                break
+        if epoch_losses:
+            history.append(float(jnp.mean(jnp.stack(epoch_losses))))
+        jax.block_until_ready(state.params)
     budget.finish()
 
     # Exact eval: fixed-size chunks with a zero-weighted padded tail, so

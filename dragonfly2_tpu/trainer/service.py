@@ -76,10 +76,12 @@ class TrainerService:
         storage: TrainerStorage,
         training: Training,
         train_async: bool = True,
+        metrics=None,
     ) -> None:
         self.storage = storage
         self.training = training
         self.train_async = train_async
+        self.metrics = metrics  # TrainerMetrics or None
         self._jobs: list[threading.Thread] = []
 
     def Train(self, request_iterator, context) -> TrainResponse:
@@ -103,6 +105,9 @@ class TrainerService:
                         )
                     )
                     accepted += len(req.gnn.dataset)
+                    if self.metrics:
+                        self.metrics.dataset_bytes.labels(type="gnn").inc(
+                            len(req.gnn.dataset))
                 if req.mlp is not None:
                     written.append(
                         self.storage.append(
@@ -111,7 +116,12 @@ class TrainerService:
                         )
                     )
                     accepted += len(req.mlp.dataset)
+                    if self.metrics:
+                        self.metrics.dataset_bytes.labels(type="mlp").inc(
+                            len(req.mlp.dataset))
         except Exception:
+            if self.metrics:
+                self.metrics.train_request_failure.inc()
             # A stream that dies mid-upload rolls back its segments: the
             # announcer retries with the FULL dataset next tick, so keeping
             # partial (possibly row-truncated) files would duplicate every
@@ -127,6 +137,8 @@ class TrainerService:
         if first is None:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty Train stream")
 
+        if self.metrics:
+            self.metrics.train_request_count.inc()
         if self.train_async:
             self._jobs = [j for j in self._jobs if j.is_alive()]
             job = threading.Thread(

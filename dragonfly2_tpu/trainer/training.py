@@ -16,6 +16,7 @@ import os
 import shutil
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
 
@@ -87,13 +88,22 @@ class Training:
         registry: Optional[ModelRegistry] = None,
         config: Optional[TrainingConfig] = None,
         mesh=None,
+        metrics=None,
     ) -> None:
         self.storage = storage
         self.registry = registry
         self.config = config or TrainingConfig()
         self.mesh = mesh
+        self.metrics = metrics  # TrainerMetrics or None
         # One training job at a time: the device mesh is not re-entrant.
         self._train_lock = threading.Lock()
+
+    def _observe_job(self, model: str, seconds: float,
+                     samples_per_sec: float) -> None:
+        if self.metrics:
+            self.metrics.training_duration.labels(model=model).observe(seconds)
+            self.metrics.train_samples_per_sec.labels(model=model).set(
+                samples_per_sec)
 
     def train(self, ip: str, hostname: str, host_id: str,
               scheduler_id: int = 0) -> TrainOutcome:
@@ -136,7 +146,10 @@ class Training:
             )
             return
         graph = graph_from_table(records_to_table(NetworkTopology, records))
+        job_start = time.monotonic()
         result = train_gnn(graph, self.config.gnn, self.mesh)
+        self._observe_job("gnn", time.monotonic() - job_start,
+                          result.samples_per_sec)
         evaluation = {
             "precision": result.precision,
             "recall": result.recall,
@@ -169,7 +182,10 @@ class Training:
         if len(X) < self.config.min_mlp_records:
             logger.info("skip MLP for %s: %d pair examples", host_id, len(X))
             return
+        job_start = time.monotonic()
         result = train_mlp(X, y, self.config.mlp, self.mesh)
+        self._observe_job("mlp", time.monotonic() - job_start,
+                          result.samples_per_sec)
         evaluation = {"mse": result.mse, "mae": result.mae,
                       "n_samples": len(X)}
         model_id = mlp_model_id_v1(ip, hostname)

@@ -1,0 +1,146 @@
+"""Observability: Prometheus metrics + /metrics endpoints + rotated logs.
+
+Reference counterparts: scheduler/metrics/metrics.go:46-273,
+client/daemon/metrics/metrics.go, internal/dflog/logger.go:367.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import urllib.request
+
+from prometheus_client import generate_latest
+
+from dragonfly2_tpu import __version__
+from dragonfly2_tpu.client.metrics import DaemonMetrics
+from dragonfly2_tpu.scheduler.metrics import SchedulerMetrics
+from dragonfly2_tpu.utils.metricsserver import MetricsServer
+
+
+def scrape(registry) -> str:
+    return generate_latest(registry).decode()
+
+
+class TestMetricsFlow:
+    def test_download_increments_scheduler_and_daemon_metrics(self, tmp_path):
+        """One real P2P exchange moves every core counter."""
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from tests.fileserver import FileServer
+        from tests.test_p2p_e2e import make_scheduler
+
+        scheduler = make_scheduler(tmp_path)
+        scheduler.metrics = SchedulerMetrics(
+            resource=scheduler.resource, version=__version__)
+        seeder = Daemon(scheduler, DaemonConfig(
+            storage_root=str(tmp_path / "s"), hostname="seeder"))
+        seeder.start()
+        child = Daemon(scheduler, DaemonConfig(
+            storage_root=str(tmp_path / "c"), hostname="child"))
+        child.start()
+        try:
+            (tmp_path / "origin").mkdir()
+            (tmp_path / "origin" / "f.bin").write_bytes(os.urandom(300_000))
+            with FileServer(str(tmp_path / "origin")) as fs:
+                assert seeder.download_file(fs.url("f.bin")).success
+                assert child.download_file(fs.url("f.bin")).success
+                # reuse path
+                assert child.download_file(fs.url("f.bin")).success
+
+            sched_text = scrape(scheduler.metrics.registry)
+            assert "dragonfly_scheduler_register_peer_total 2.0" in sched_text
+            assert ("dragonfly_scheduler_download_peer_finished_total 2.0"
+                    in sched_text)
+            assert ('dragonfly_scheduler_traffic_bytes_total'
+                    '{type="back_to_source"} 300000.0') in sched_text
+            assert ('dragonfly_scheduler_traffic_bytes_total{type="p2p"} '
+                    '300000.0') in sched_text
+            assert "dragonfly_scheduler_schedule_duration_seconds_count" \
+                in sched_text
+            assert "dragonfly_scheduler_resource_hosts 2.0" in sched_text
+
+            seed_text = scrape(seeder.metrics.registry)
+            assert ('dragonfly_dfdaemon_download_traffic_bytes_total'
+                    '{type="back_to_source"} 300000.0') in seed_text
+            assert ("dragonfly_dfdaemon_upload_traffic_bytes_total 300000.0"
+                    in seed_text)
+
+            child_text = scrape(child.metrics.registry)
+            assert ('dragonfly_dfdaemon_download_traffic_bytes_total'
+                    '{type="p2p"} 300000.0') in child_text
+            assert ('dragonfly_dfdaemon_download_traffic_bytes_total'
+                    '{type="reuse"} 300000.0') in child_text
+            assert "dragonfly_dfdaemon_concurrent_tasks 0.0" in child_text
+            assert f'version{{version="{__version__}"}} 1.0' in child_text
+        finally:
+            child.stop()
+            seeder.stop()
+
+    def test_metrics_endpoint_scrapes_over_http(self):
+        metrics = DaemonMetrics(version=__version__)
+        metrics.download_task_count.inc()
+        server = MetricsServer(metrics.registry)
+        server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{server.address}/metrics", timeout=10) as resp:
+                body = resp.read().decode()
+            assert resp.status == 200
+            assert "dragonfly_dfdaemon_download_task_total 1.0" in body
+            with urllib.request.urlopen(
+                    f"http://{server.address}/healthy", timeout=10) as resp:
+                assert resp.read() == b"ok"
+        finally:
+            server.stop()
+
+
+class TestTrainerManagerMetrics:
+    def test_trainer_and_manager_counters(self, tmp_path):
+        from dragonfly2_tpu.manager import (
+            Database,
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.metrics import ManagerMetrics
+
+        m_metrics = ManagerMetrics(version=__version__)
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(tmp_path / "obj")),
+            metrics=m_metrics)
+        cluster = manager.create_scheduler_cluster("c1")
+        manager.update_scheduler(hostname="h", ip="1.1.1.1", port=8002,
+                                 scheduler_cluster_id=cluster.id)
+        manager.keepalive(source_type="scheduler", hostname="h",
+                          ip="1.1.1.1", cluster_id=cluster.id)
+        text = scrape(m_metrics.registry)
+        assert "dragonfly_manager_keepalive_total 1.0" in text
+
+
+class TestDflog:
+    def test_per_concern_rotated_files(self, tmp_path):
+        from dragonfly2_tpu.utils.dflog import init_file_logging
+
+        log_dir = str(tmp_path / "logs")
+        files = init_file_logging(log_dir, console=False)
+        try:
+            logging.getLogger("dragonfly2_tpu.rpc.client").info("grpc line")
+            logging.getLogger("dragonfly2_tpu.scheduler.service").info(
+                "core line")
+            logging.getLogger("dragonfly2_tpu.client.storage").info(
+                "storage line")
+            for handler in logging.getLogger().handlers:
+                handler.flush()
+            grpc_log = open(files["grpc"]).read()
+            core_log = open(files["core"]).read()
+            storage_log = open(files["storage"]).read()
+            assert "grpc line" in grpc_log and "core line" not in grpc_log
+            assert "core line" in core_log and "grpc line" not in core_log
+            assert "storage line" in storage_log
+        finally:
+            # Remove the handlers so later tests' logging isn't captured.
+            root = logging.getLogger()
+            for handler in list(root.handlers):
+                base = getattr(handler, "baseFilename", "")
+                if base and base.startswith(os.path.abspath(log_dir)):
+                    root.removeHandler(handler)
+                    handler.close()

@@ -1,0 +1,99 @@
+"""Host telemetry: psutil collectors + announce round-trip over gRPC.
+
+Reference counterpart: client/daemon/announcer/announcer_test.go — the
+announced Host must carry real CPU/memory/disk/build numbers so download
+records feed the MLP real machine features.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.client import telemetry
+
+
+class TestCollectors:
+    def test_cpu(self):
+        cpu = telemetry.collect_cpu()
+        assert cpu.logical_count >= 1
+        assert cpu.times.user > 0
+
+    def test_memory(self):
+        mem = telemetry.collect_memory()
+        assert mem.total > 0
+        assert 0 <= mem.used_percent <= 100
+
+    def test_disk(self, tmp_path):
+        disk = telemetry.collect_disk(str(tmp_path))
+        assert disk.total > 0
+        assert disk.free > 0
+        assert disk.inodes_total > 0
+
+    def test_platform_and_build(self):
+        info = telemetry.platform_info()
+        assert info["os"] and info["kernel_version"]
+        build = telemetry.collect_build()
+        assert build.git_version
+
+
+class TestAnnounceRoundTrip:
+    def test_telemetry_survives_the_wire(self, tmp_path):
+        """Daemon announces over gRPC → scheduler's resource Host carries
+        the psutil snapshot → download records export it."""
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.rpc import serve
+        from dragonfly2_tpu.scheduler.rpcserver import (
+            SCHEDULER_SPEC,
+            GrpcSchedulerClient,
+            SchedulerRpcService,
+        )
+        from tests.test_p2p_e2e import make_scheduler
+
+        service = make_scheduler(tmp_path)
+        server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))])
+        daemon = Daemon(
+            GrpcSchedulerClient(server.target),
+            DaemonConfig(storage_root=str(tmp_path / "d"), hostname="telly"),
+        )
+        daemon.start()
+        try:
+            host = service.resource.host_manager.load(daemon.host_id)
+            assert host is not None
+            assert host.cpu.logical_count >= 1
+            assert host.memory.total > 0
+            assert host.disk.total > 0
+            assert host.build.git_version
+            assert host.os and host.kernel_version
+            # Dataset export sees the same numbers.
+            from dragonfly2_tpu.scheduler.service import host_record
+
+            rec = host_record(host)
+            assert rec.cpu.logical_count == host.cpu.logical_count
+            assert rec.memory.total == host.memory.total
+        finally:
+            daemon.stop()
+            server.stop()
+
+    def test_reannounce_ticker_refreshes(self, tmp_path):
+        import time
+
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from tests.test_p2p_e2e import make_scheduler
+
+        service = make_scheduler(tmp_path)
+        daemon = Daemon(service, DaemonConfig(
+            storage_root=str(tmp_path / "d2"), hostname="ticker",
+            announce_interval=0.05,
+        ))
+        daemon.start()
+        try:
+            host = service.resource.host_manager.load(daemon.host_id)
+            first = host.updated_at
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if service.resource.host_manager.load(
+                        daemon.host_id).updated_at > first:
+                    break
+                time.sleep(0.02)
+            assert service.resource.host_manager.load(
+                daemon.host_id).updated_at > first
+        finally:
+            daemon.stop()
