@@ -6,13 +6,25 @@ excluded). Extras carry the second tracked number — scheduler
 parent-selection p50 latency through the TPU-backed ML scorer (<1 ms
 target) — plus MLP training stats and pipeline diagnostics.
 
+Round-3 accounting rules (the round-2 failure was value=0 with the number
+existing — watchdog fired before train_gnn returned and nothing had
+published partial throughput):
+- The scorer p50 stage runs FIRST (latency is weight-independent — a
+  synthetically initialized MLP measures the same dispatch path), so the
+  <1 ms target is validated before the GNN stage can starve it.
+- The GNN trainer publishes throughput incrementally (StepBudget
+  on_progress → set_headline every ~10 steps) so a watchdog fire emits
+  the latest steady-state rate, never zero.
+- Budgets are per-STAGE: the GNN step loop gets what remains after
+  observed init/compile costs, and the eval pass has its own wall cap.
+- A persistent XLA compilation cache (utils/compilecache.py) amortizes
+  the ~25 s train-step compile across runs.
+- Sub-stage timestamps (t_*) are recorded as they happen so a watchdog
+  fire is diagnosable from the JSON alone.
+
 Un-killability contract (the round-1 failure was a silent rc=124):
-- TPU availability is probed in a SUBPROCESS with a hard timeout — a
-  hanging backend init (observed: ``jax.devices()`` on this machine's
-  ``axon`` platform can stall for minutes) falls back to CPU instead of
-  stalling the bench, flagged as ``extras.platform: "cpu_fallback"``.
-- Every stage is wall-clock budgeted (``max_seconds`` step loops measure
-  throughput from steps actually run, not fixed epoch counts).
+- TPU availability is probed in a SUBPROCESS with a hard timeout; a
+  hanging backend init falls back to CPU, flagged in extras.
 - A watchdog thread force-emits whatever has been measured and exits
   before the driver's kill; the JSON line is also emitted from a
   ``finally`` path on any exception.
@@ -61,9 +73,15 @@ def record(**extras) -> None:
         result["extras"].update(extras)
 
 
+def stamp(name: str) -> None:
+    """Record a sub-stage timestamp (seconds since process start)."""
+    record(**{f"t_{name}": round(time.perf_counter() - _t0, 1)})
+
+
 def stage_done(name: str) -> None:
     with _emit_lock:
         result["extras"]["stages_completed"].append(name)
+    stamp(name)
 
 
 def set_headline(value: float) -> None:
@@ -144,16 +162,16 @@ def run_stages() -> None:
 
         jax.config.update("jax_platforms", "cpu")
         record(platform="cpu_fallback")
+
+    from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
+
+    record(compile_cache_dir=enable_compilation_cache())
+
     import jax
 
     from dragonfly2_tpu.data import SyntheticCluster
     from dragonfly2_tpu.parallel import data_parallel_mesh
-    from dragonfly2_tpu.train import (
-        GNNTrainConfig,
-        MLPTrainConfig,
-        train_gnn,
-        train_mlp,
-    )
+    from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
 
     mesh = data_parallel_mesh()
     if on_tpu:
@@ -161,17 +179,100 @@ def run_stages() -> None:
     record(n_devices=mesh.n_data)
     stage_done("init")
 
-    cluster = SyntheticCluster(n_hosts=2000, seed=0)
+    # Stage 1: parent-selection p50 through the jitted scorer, FIRST —
+    # latency is weight-independent, so a synthetically initialized MLP
+    # measures the same compiled dispatch path a trained one would, and
+    # the <1 ms target gets validated before the GNN stage can starve it.
+    # The stage is wall-capped (a degraded tunnel must not eat the GNN
+    # budget), and the raw number is decomposed: a no-op jit call
+    # measures the platform dispatch floor (the tunneled axon TPU pays a
+    # network round trip per blocking call — observed ~68 ms even for
+    # the "cpu" device, the whole backend is remote), and
+    # parent_select_model_ms reports p50 minus that floor — an estimate
+    # of what a scheduler colocated with its TPU sidecar would observe.
+    import jax.numpy as jnp
 
-    # Stage 1 (headline): GraphSAGE on a 2M-edge probe graph, step loop
-    # time-boxed to ~half the remaining budget; throughput = steps
-    # actually completed after the compiled first step.
+    from dragonfly2_tpu.inference import ParentScorer
+    from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
+    from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+    scorer_budget = max(min(remaining() * 0.15, 20.0), 3.0)
+    scorer_t0 = time.perf_counter()
+
+    mlp_model = MLPBandwidthPredictor()
+    mlp_params = mlp_model.init(jax.random.key(0),
+                                jnp.zeros((1, FEATURE_DIM)))
+    scorer = ParentScorer(mlp_model, mlp_params,
+                          Normalizer.identity(FEATURE_DIM),
+                          Normalizer.identity(1), max_batch=16)
+
+    # Dispatch floor: p50 of a blocking no-op jit round trip. On the
+    # tunneled axon platform this IS the p50 (observed ~68 ms RTT even
+    # for the "cpu" device — the whole backend is remote); the
+    # hardware-independent model cost is p50 - floor.
+    noop = jax.jit(lambda x: x + 1)
+    x0 = jnp.zeros(8)
+    noop(x0).block_until_ready()
+    floor = []
+    for _ in range(15):
+        t = time.perf_counter()
+        noop(x0).block_until_ready()
+        floor.append((time.perf_counter() - t) * 1e3)
+    floor_p50 = sorted(floor)[len(floor) // 2]
+    record(dispatch_floor_p50_ms=round(floor_p50, 4))
+
+    # Adaptive iteration count: probe, then fill the stage's remaining
+    # wall budget (never fewer than 20, never more than 300 iters).
+    probe = scorer.benchmark(batch=16, iters=10)
+    stage_left = scorer_budget - (time.perf_counter() - scorer_t0)
+    iters = int(max(20, min(300, stage_left * 1e3 / max(probe["p50_ms"], 1e-3))))
+    latency = scorer.benchmark(batch=16, iters=iters)
+    record(
+        parent_select_p50_ms=round(latency["p50_ms"], 4),
+        parent_select_p99_ms=round(latency["p99_ms"], 4),
+        parent_select_iters=iters,
+        # Model-only cost with the platform round trip subtracted — what a
+        # scheduler colocated with its TPU sidecar would observe.
+        parent_select_model_ms=round(
+            max(latency["p50_ms"] - floor_p50, 0.0), 4),
+        parent_select_vs_1ms_target=round(
+            TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
+    )
+    stage_done("scorer")
+
+    # Stage 2 (headline): GraphSAGE on a 2M-edge probe graph. The step
+    # loop gets the remaining budget minus reserves for eval + emit, and
+    # publishes throughput incrementally so the watchdog always has the
+    # latest steady-state rate.
+    cluster = SyntheticCluster(n_hosts=2000, seed=0)
     graph = cluster.probe_graph(2_000_000)
-    gnn_budget = max(min(remaining() * 0.45, 75.0), 5.0)
+    stamp("graph_built")
+
+    def on_progress(steps: int, rate: float) -> None:
+        set_headline(rate / mesh.n_data)
+        record(gnn_steps=steps)
+
+    def on_compile(seconds: float) -> None:
+        record(gnn_compile_seconds=round(seconds, 1))
+        stamp("gnn_compile_done")
+
+    # Reserves: the eval pass compiles its own (second) program on a cold
+    # cache, so its cap is kept under the reserve and the emit margin is
+    # generous — a watchdog fire mid-eval still emits the incrementally
+    # published headline; only f1 would be lost.
+    eval_reserve = max(min(remaining() * 0.2, 30.0), 5.0)
+    emit_reserve = 15.0
+    compile_reserve = 30.0  # uncached train-step compile; ~0 when cache hits
+    gnn_budget = max(
+        remaining() - eval_reserve - emit_reserve - compile_reserve, 5.0)
+    record(gnn_step_seconds_budget=round(gnn_budget, 1))
     gnn = train_gnn(
         graph,
         GNNTrainConfig(batch_size=8192, epochs=1000, eval_fraction=0.02,
-                       max_seconds=gnn_budget),
+                       max_seconds=gnn_budget,
+                       progress_callback=on_progress,
+                       compile_callback=on_compile,
+                       eval_max_seconds=min(eval_reserve, 25.0)),
         mesh,
     )
     per_chip = gnn.samples_per_sec / mesh.n_data
@@ -182,40 +283,30 @@ def run_stages() -> None:
         gnn_recall=round(gnn.recall, 4),
         gnn_steps=gnn.steps,
         gnn_compile_seconds=round(gnn.compile_seconds, 1),
-        gnn_step_seconds_budget=round(gnn_budget, 1),
     )
     stage_done("gnn")
 
-    # Stage 2: parent-selection latency through the jitted scorer. Uses a
-    # quickly-trained MLP (latency is weight-independent, but train a real
-    # one so mae is reportable).
-    X, y = cluster.pair_example_columns(300_000)
-    mlp = train_mlp(
-        X, y,
-        MLPTrainConfig(epochs=100, batch_size=16384,
-                       max_seconds=max(min(remaining() * 0.4, 30.0), 2.0)),
-        mesh,
-    )
-    record(
-        mlp_train_samples_per_sec_per_chip=int(
-            mlp.samples_per_sec / mesh.n_data),
-        mlp_eval_mae_mbps=round(mlp.mae, 3),
-    )
-    stage_done("mlp")
+    # Stage 3 (only if budget allows): MLP training throughput + honest
+    # registry mae from a really-trained model. Needs headroom for its
+    # own two compiles (train + eval) on a cold cache, so the entry bar
+    # is high and the step budget leaves the emit margin alone.
+    if remaining() > 45.0:
+        from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
 
-    from dragonfly2_tpu.inference import ParentScorer
-
-    scorer = ParentScorer(mlp.model, mlp.params, mlp.normalizer,
-                          mlp.target_norm)
-    iters = 500 if remaining() > 30 else 100
-    latency = scorer.benchmark(batch=16, iters=iters)
-    record(
-        parent_select_p50_ms=round(latency["p50_ms"], 4),
-        parent_select_p99_ms=round(latency["p99_ms"], 4),
-        parent_select_vs_1ms_target=round(
-            TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
-    )
-    stage_done("scorer")
+        X, y = cluster.pair_example_columns(300_000)
+        mlp = train_mlp(
+            X, y,
+            MLPTrainConfig(epochs=100, batch_size=16384,
+                           max_seconds=max(
+                               min(remaining() - 30.0, 25.0), 2.0)),
+            mesh,
+        )
+        record(
+            mlp_train_samples_per_sec_per_chip=int(
+                mlp.samples_per_sec / mesh.n_data),
+            mlp_eval_mae_mbps=round(mlp.mae, 3),
+        )
+        stage_done("mlp")
 
 
 if __name__ == "__main__":

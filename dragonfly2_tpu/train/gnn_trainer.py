@@ -20,7 +20,7 @@ precision/recall/f1 — the registry schema for GNN models
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -56,6 +56,21 @@ class GNNTrainConfig:
     # all epochs. The bench uses this so throughput comes from steps
     # actually completed instead of a fixed epoch count.
     max_seconds: Optional[float] = None
+    # Incremental throughput publishing (bench watchdog honesty): called
+    # every ~progress_every steps with (steps, samples_per_sec); the
+    # compile callback fires once with measured compile seconds.
+    progress_callback: Optional[Callable[[int, float], None]] = None
+    compile_callback: Optional[Callable[[float], None]] = None
+    # Wall-clock cap for the eval pass (None = run it all). When exceeded,
+    # metrics come from the chunks actually scored — still exact per-edge
+    # accounting over a prefix of the (arbitrary-order) eval split.
+    eval_max_seconds: Optional[float] = None
+    # On-device fanout sampling (train/fused_sampling.py): the CSR tables
+    # live in HBM and sampling fuses into the jitted step; the host ships
+    # only [B] edge-id slices. ~2 orders of magnitude less host work and
+    # H2D traffic than host-side sampling; False keeps the host path
+    # (equivalence tests, and graphs too large for replicated HBM tables).
+    device_sample: bool = True
     prefetch_depth: int = 2
     prefetch_workers: int = 2
     # When set, the step loop runs under jax.profiler.trace writing an
@@ -209,7 +224,10 @@ def train_gnn(
     )
 
     model = GraphSAGE(hidden=config.hidden, embed=config.embed)
-    nf_dev = jax.device_put(csr.node_features, mesh.replicated)
+    # Host-sampling path only; the fused path keeps features inside its
+    # replicated GraphTables instead (no second HBM copy).
+    nf_dev = (None if config.device_sample
+              else jax.device_put(csr.node_features, mesh.replicated))
     dummy = train_sampler.sample(np.zeros(2, np.int64), np.random.default_rng(0))
     params = model.init(
         jax.random.key(config.seed), *map(jnp.asarray, dummy.astuple()[:-1])
@@ -223,8 +241,32 @@ def train_gnn(
     state = train_state.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
     state = mesh.put_replicated(state)
 
-    train_step = make_train_step(model, mesh)
-    eval_step = make_eval_step(model, mesh)
+    if config.device_sample:
+        from dragonfly2_tpu.train.fused_sampling import (
+            make_fused_eval_step,
+            make_fused_train_step,
+            put_edge_tables,
+            put_graph_tables,
+        )
+
+        graph_tables = put_graph_tables(csr, mesh)
+        # The samplers already hold the sliced/cast split arrays — reuse
+        # them instead of re-slicing ~2M-element fancy indexes.
+        train_edges = put_edge_tables(
+            train_sampler.edge_src, train_sampler.edge_dst,
+            train_sampler.labels, mesh)
+        fused_step = make_fused_train_step(model, mesh, config.fanouts)
+        base_key = mesh.put_replicated(jax.random.key(config.seed + 1))
+        train_step = None
+        # The fused step has near-zero host work, so async dispatch stacks
+        # many in-flight launches. XLA:CPU's in-process collectives
+        # deadlock under that (rendezvous starves the shared thread pool —
+        # observed on the 8-device virtual mesh); real TPU collectives
+        # pipeline fine. Serialize launches on CPU only.
+        serialize_steps = (
+            mesh.mesh.devices.flat[0].platform == "cpu" and mesh.n_data > 1)
+    else:
+        train_step = make_train_step(model, mesh)
 
     def place(batch) -> tuple:
         return tuple(mesh.put_batch(a) for a in batch.astuple())
@@ -241,6 +283,9 @@ def train_gnn(
     def build(task):
         # Per-task RNG: deterministic regardless of worker interleaving.
         epoch, step, ids = task
+        if config.device_sample:
+            # Device path ships only the id slice; sampling runs on chip.
+            return epoch, mesh.put_batch(ids.astype(np.int32))
         rng = np.random.default_rng((config.seed, epoch, step, 3))
         return epoch, place(train_sampler.sample_indices(ids, rng))
 
@@ -249,7 +294,9 @@ def train_gnn(
     history: list = []
     epoch_losses: list = []
     current_epoch = 0
-    budget = StepBudget(config.max_seconds)
+    budget = StepBudget(config.max_seconds,
+                        on_compile=config.compile_callback,
+                        on_progress=config.progress_callback)
     stream = prefetch(train_tasks(), build,
                       depth=config.prefetch_depth,
                       workers=config.prefetch_workers)
@@ -262,7 +309,13 @@ def train_gnn(
                     history.append(float(jnp.mean(jnp.stack(epoch_losses))))
                 epoch_losses = []
                 current_epoch = epoch
-            state, loss = train_step(state, nf_dev, *arrays)
+            if config.device_sample:
+                state, loss = fused_step(
+                    state, graph_tables, train_edges, arrays, base_key)
+                if serialize_steps:
+                    jax.block_until_ready(loss)
+            else:
+                state, loss = train_step(state, nf_dev, *arrays)
             epoch_losses.append(loss)
             if budget.tick(batch_size, loss):
                 stream.close()
@@ -277,21 +330,51 @@ def train_gnn(
     from dragonfly2_tpu.train.metrics import metrics_from_confusion, padded_chunks
 
     cm = np.zeros(4)
+    import time as _time
 
-    def eval_build(task):
-        ids, weights = task
-        rng = np.random.default_rng((config.seed, 2, ids[0] if len(ids) else 0))
-        return place(eval_sampler.sample_indices(ids, rng)), weights
+    eval_deadline = (
+        _time.perf_counter() + config.eval_max_seconds
+        if config.eval_max_seconds is not None else None)
 
-    eval_stream = prefetch(
-        padded_chunks(np.arange(eval_sampler.n_edges), batch_size),
-        eval_build, depth=config.prefetch_depth,
-        workers=config.prefetch_workers,
-    )
-    for arrays, weights in eval_stream:
-        cm += np.asarray(
-            eval_step(state.params, nf_dev, *arrays, mesh.put_batch(weights))
+    if config.device_sample:
+        eval_edges = put_edge_tables(
+            eval_sampler.edge_src, eval_sampler.edge_dst,
+            eval_sampler.labels, mesh)
+        fused_eval = make_fused_eval_step(model, mesh, config.fanouts)
+        for chunk_i, (ids, weights) in enumerate(padded_chunks(
+                np.arange(eval_sampler.n_edges), batch_size)):
+            chunk_key = mesh.put_replicated(
+                jax.random.fold_in(base_key, chunk_i))
+            cm += np.asarray(fused_eval(
+                state.params, graph_tables, eval_edges,
+                mesh.put_batch(ids.astype(np.int32)),
+                mesh.put_batch(weights), chunk_key))
+            if (eval_deadline is not None
+                    and _time.perf_counter() >= eval_deadline):
+                break
+    else:
+        eval_step = make_eval_step(model, mesh)
+
+        def eval_build(task):
+            ids, weights = task
+            rng = np.random.default_rng(
+                (config.seed, 2, ids[0] if len(ids) else 0))
+            return place(eval_sampler.sample_indices(ids, rng)), weights
+
+        eval_stream = prefetch(
+            padded_chunks(np.arange(eval_sampler.n_edges), batch_size),
+            eval_build, depth=config.prefetch_depth,
+            workers=config.prefetch_workers,
         )
+        for arrays, weights in eval_stream:
+            cm += np.asarray(
+                eval_step(state.params, nf_dev, *arrays,
+                          mesh.put_batch(weights))
+            )
+            if (eval_deadline is not None
+                    and _time.perf_counter() >= eval_deadline):
+                eval_stream.close()
+                break
     metrics = metrics_from_confusion(cm)
 
     return GNNTrainResult(

@@ -4,22 +4,37 @@ Both trainers measure steady-state throughput the same way — block on the
 first step to capture XLA compile time, restart the clock, then count
 samples until the optional deadline. This helper holds that logic once so
 the accounting can't drift between models.
+
+Progress hooks (the round-2 verdict's "publish throughput incrementally"):
+``on_compile`` fires once when the first step completes (compile captured),
+``on_progress`` fires every ``progress_every`` steps with the current
+steady-state rate — the bench uses these to keep its headline current so a
+watchdog fire emits the latest measured rate instead of zero.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 
 
 class StepBudget:
-    def __init__(self, max_seconds: Optional[float] = None):
+    def __init__(
+        self,
+        max_seconds: Optional[float] = None,
+        on_compile: Optional[Callable[[float], None]] = None,
+        on_progress: Optional[Callable[[int, float], None]] = None,
+        progress_every: int = 25,
+    ):
         self.max_seconds = max_seconds
         self.steps = 0
         self.samples = 0
         self.compile_seconds = 0.0
+        self._on_compile = on_compile
+        self._on_progress = on_progress
+        self._progress_every = max(progress_every, 1)
         self._start = time.perf_counter()
         self._deadline: Optional[float] = None
         self._elapsed: Optional[float] = None
@@ -38,9 +53,22 @@ class StepBudget:
             self._start = now
             if self.max_seconds is not None:
                 self._deadline = now + self.max_seconds
+            if self._on_compile is not None:
+                self._on_compile(self.compile_seconds)
         else:
             self.samples += n_samples
         self.steps += 1
+        if (self._on_progress is not None and self.samples
+                and self.steps % self._progress_every == 0):
+            # Block on the CURRENT step so the published rate counts
+            # completed device work — without this, async dispatch lets
+            # the host run tens of steps ahead and the rate would be the
+            # dispatch rate, not throughput. The sync bubble costs one
+            # device round trip per progress_every steps (~2-3 ms/step at
+            # the tunneled-TPU worst case of 70 ms RTT / 25 steps).
+            jax.block_until_ready(first_step_output)
+            elapsed = max(time.perf_counter() - self._start, 1e-9)
+            self._on_progress(self.steps, self.samples / elapsed)
         return (self._deadline is not None
                 and time.perf_counter() >= self._deadline)
 
