@@ -129,7 +129,7 @@ class Daemon:
         )
 
         if hasattr(self.scheduler, "probe_sync"):
-            sync = self.scheduler.probe_sync()
+            sync = self.scheduler.probe_sync(self.host_id)
         else:
             sync = InProcessProbeSync(self.scheduler)
         return Prober(self.host_id, sync, ProbeConfig(
@@ -198,6 +198,7 @@ class Daemon:
             result = PeerTaskResult(
                 task_id, done.meta.peer_id, True,
                 content_length=done.meta.content_length, storage=done,
+                reused=True,
             )
             if output_path:
                 result.save_to(output_path)

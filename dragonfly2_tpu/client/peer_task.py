@@ -163,6 +163,9 @@ class PeerTaskResult:
     direct_bytes: bytes | None = None  # EMPTY/TINY fast-path payload
     storage: Optional[TaskStorage] = None
     error: str = ""
+    # True when served from completed local storage without a new
+    # conductor run (peertask_reuse.go fast path).
+    reused: bool = False
 
     def read_all(self) -> bytes:
         if self.direct_bytes is not None:

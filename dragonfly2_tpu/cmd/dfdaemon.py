@@ -20,11 +20,13 @@ from dragonfly2_tpu.cmd.common import (
 
 def build_daemon(args):
     from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
-    from dragonfly2_tpu.scheduler.rpcserver import GrpcSchedulerClient
+    from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
     from dragonfly2_tpu.utils.hosttypes import HostType
     from dragonfly2_tpu.utils.ratelimit import INF
 
-    scheduler = GrpcSchedulerClient(args.scheduler)
+    # Task-affine multi-scheduler routing; a single --scheduler is the
+    # one-replica degenerate ring.
+    scheduler = BalancedSchedulerClient(args.scheduler)
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=args.storage_dir,
         ip=args.ip,
@@ -46,7 +48,13 @@ def main(argv=None) -> int:
     import socket
 
     parser = argparse.ArgumentParser("df2-daemon")
-    parser.add_argument("--scheduler", required=True, help="host:port")
+    parser.add_argument("--scheduler", required=True, action="append",
+                        help="host:port (repeat for replicas; tasks route "
+                             "by consistent hash)")
+    parser.add_argument("--rpc-port", type=int, default=-1,
+                        help="serve the dfdaemon.Daemon gRPC surface "
+                             "(Download/Stat/Import/Export/Delete) on this "
+                             "port (0 = ephemeral, -1 = disabled)")
     parser.add_argument("--storage-dir", default="./daemon-data")
     parser.add_argument("--ip", default="127.0.0.1")
     parser.add_argument("--hostname", default=socket.gethostname())
@@ -84,6 +92,14 @@ def main(argv=None) -> int:
           flush=True)
     metrics_server = start_metrics_server(args, daemon.metrics.registry)
 
+    rpc_server = None
+    if args.rpc_port >= 0:
+        from dragonfly2_tpu.client.rpcserver import serve_daemon_rpc
+
+        rpc_server = serve_daemon_rpc(daemon, host="0.0.0.0",
+                                      port=args.rpc_port)
+        print(f"daemon rpc on {rpc_server.target}", flush=True)
+
     proxy = None
     if args.proxy_port or args.proxy_rule or args.registry_mirror:
         from dragonfly2_tpu.client.proxy import (
@@ -118,6 +134,8 @@ def main(argv=None) -> int:
     wait_for_shutdown()
     if metrics_server:
         metrics_server.stop()
+    if rpc_server:
+        rpc_server.stop()
     if gateway:
         gateway.stop()
     if proxy:

@@ -1,9 +1,13 @@
 """``df2-get`` — download one URL through the mesh.
 
-Reference counterpart: cmd/dfget + client/dfget/dfget.go:47-397. Spins an
-ephemeral peer (with its own storage) against the given scheduler, falls
-back to a direct source fetch when the scheduler is unreachable — the same
-daemon-first-then-source ladder dfget implements.
+Reference counterpart: cmd/dfget + client/dfget/dfget.go:47-397. Ladder:
+1. ``--daemon`` (or both flags): drive a long-running daemon over its gRPC
+   surface — invocations share that daemon's cache (dfget's daemon-first
+   path, root.go:102 runDfget); falls through on daemon failure when a
+   scheduler is also configured.
+2. ``--scheduler`` (repeatable): spin an ephemeral in-process peer against
+   the scheduler replicas (consistent-hash routed).
+3. neither: direct back-to-source fetch.
 """
 
 from __future__ import annotations
@@ -19,8 +23,12 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("df2-get")
     parser.add_argument("url")
     parser.add_argument("-O", "--output", required=True)
-    parser.add_argument("--scheduler", default="",
-                        help="host:port; omit for direct back-to-source")
+    parser.add_argument("--daemon", default="",
+                        help="host:port of a running df2-daemon rpc "
+                             "surface; invocations share its cache")
+    parser.add_argument("--scheduler", default=[], action="append",
+                        help="host:port (repeatable); omit for direct "
+                             "back-to-source")
     parser.add_argument("--storage-dir", default="",
                         help="persistent peer storage (default: ephemeral)")
     parser.add_argument("--tag", default="")
@@ -39,14 +47,23 @@ def main(argv=None) -> int:
         k, _, v = item.partition(":")
         headers[k.strip()] = v.strip()
 
+    if args.daemon:
+        rc = _daemon_download(args, headers)
+        if rc is not None:
+            return rc
+        if not args.scheduler:
+            return 1
+        print("daemon unreachable; falling back to ephemeral peer",
+              file=sys.stderr)
+
     from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
 
     ephemeral = not args.storage_dir
     storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
     if args.scheduler:
-        from dragonfly2_tpu.scheduler.rpcserver import GrpcSchedulerClient
+        from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
 
-        scheduler = GrpcSchedulerClient(args.scheduler)
+        scheduler = BalancedSchedulerClient(args.scheduler)
     else:
         scheduler = _DirectScheduler()
     daemon = Daemon(scheduler, DaemonConfig(
@@ -71,6 +88,33 @@ def main(argv=None) -> int:
         print(f"download failed: {result.error}", file=sys.stderr)
         return 1
     print(f"{args.output}: {result.content_length} bytes "
+          f"(task {result.task_id[:16]}…)")
+    return 0
+
+
+def _daemon_download(args, headers):
+    """Remote-daemon path; returns an exit code, or None when the daemon
+    is unreachable (caller decides whether a fallback exists)."""
+    from dragonfly2_tpu.client.rpcserver import RemoteDaemonClient
+
+    client = RemoteDaemonClient(args.daemon)
+    try:
+        result = client.download(
+            args.url, output_path=args.output, request_header=headers,
+            tag=args.tag, application=args.application,
+            filtered_query_params=(args.filter.split("&")
+                                   if args.filter else None),
+        )
+    except Exception as exc:  # noqa: BLE001 — daemon down is a soft error
+        print(f"daemon {args.daemon} failed: {exc}", file=sys.stderr)
+        return None
+    finally:
+        client.close()
+    if not result.success:
+        print(f"download failed: {result.error}", file=sys.stderr)
+        return 1
+    via = "cache" if result.reused else "mesh"
+    print(f"{args.output}: {result.content_length} bytes via daemon {via} "
           f"(task {result.task_id[:16]}…)")
     return 0
 

@@ -514,8 +514,12 @@ class GrpcSchedulerClient:
         self._sessions: Dict[str, _AnnounceSession] = {}
         self._lock = threading.Lock()
 
-    def probe_sync(self):
-        """Probe-loop adapter for the daemon's Prober (SyncProbes stream)."""
+    def probe_sync(self, host_id: str = ""):
+        """Probe-loop adapter for the daemon's Prober (SyncProbes stream).
+
+        ``host_id`` is unused for a single target; the balanced client
+        hashes it so probe streams spread across replicas.
+        """
         from dragonfly2_tpu.client.networktopology import GrpcProbeSync
 
         return GrpcProbeSync(self.target)
@@ -702,3 +706,220 @@ class GrpcSchedulerClient:
         for s in sessions:
             s.close()
         self._client.close()
+
+
+class BalancedSchedulerClient:
+    """Multi-scheduler SchedulerAPI: task-affine routing over a hash ring.
+
+    Fills the round-2 gap "the consistent-hash ring exists but nothing uses
+    it": daemons and CLIs take N ``--scheduler`` targets; ``register_peer``
+    picks the task's owner via the ring (every peer of a task lands on the
+    same scheduler replica, pkg/balancer/consistent_hashing.go:51-124 /
+    scheduler client_v1.go:171 hash key = TaskId) and walks the ring on
+    UNAVAILABLE, so losing a replica only moves its tasks. Peer-keyed calls
+    follow the session created at registration; host announce/leave fan out
+    to every replica (each replica keeps its own resource view).
+
+    ``update_targets`` is the dynconfig observer hook.
+    """
+
+    def __init__(self, targets, client_factory=None):
+        from dragonfly2_tpu.rpc.client import HashRing
+
+        self._factory = client_factory or GrpcSchedulerClient
+        self.ring = HashRing(targets)
+        self._clients: Dict[str, GrpcSchedulerClient] = {}
+        self._peer_owner: Dict[str, GrpcSchedulerClient] = {}
+        # Clients removed from the ring but still owning in-flight peers;
+        # closed when their last peer finalizes.
+        self._retired: set = set()
+        self._lock = threading.Lock()
+
+    # -- target management (dynconfig observer) ------------------------
+
+    def update_targets(self, targets) -> None:
+        desired = set(targets)
+        for t in desired - self.ring.targets:
+            self.ring.add(t)
+        for t in self.ring.targets - desired:
+            self.ring.remove(t)
+            with self._lock:
+                old = self._clients.pop(t, None)
+                if old is None:
+                    continue
+                if old in self._peer_owner.values():
+                    # In-flight peers still report through this client;
+                    # close when the last one finalizes, not mid-download.
+                    self._retired.add(old)
+                    old = None
+            if old is not None:
+                old.close()
+
+    def _client_at(self, target: str) -> GrpcSchedulerClient:
+        with self._lock:
+            cli = self._clients.get(target)
+            if cli is None:
+                cli = self._factory(target)
+                self._clients[target] = cli
+        return cli
+
+    # -- host lifecycle: fan out to every replica ----------------------
+
+    def announce_host(self, host: Host) -> None:
+        """Best-effort fan-out; succeeds if at least one replica took it."""
+        errors = []
+        for target in sorted(self.ring.targets):
+            try:
+                self._client_at(target).announce_host(host)
+            except Exception as exc:  # noqa: BLE001 — per-replica
+                errors.append((target, exc))
+        if errors and len(errors) == len(self.ring.targets):
+            raise ConnectionError(f"announce_host failed everywhere: {errors}")
+        for target, exc in errors:
+            logger.warning("announce_host to %s failed: %s", target, exc)
+
+    def leave_host(self, host_id: str) -> None:
+        for target in sorted(self.ring.targets):
+            try:
+                self._client_at(target).leave_host(host_id)
+            except Exception:  # noqa: BLE001
+                logger.warning("leave_host to %s failed", target)
+
+    def stat_task(self, task_id: str):
+        last: Optional[Exception] = None
+        for target in self.ring.walk(task_id):
+            try:
+                return self._client_at(target).stat_task(task_id)
+            except (ConnectionError, OSError) as exc:
+                last = exc
+            except Exception as exc:  # noqa: BLE001 — grpc UNAVAILABLE etc.
+                import grpc
+
+                if (isinstance(exc, grpc.RpcError)
+                        and exc.code() == grpc.StatusCode.UNAVAILABLE):
+                    last = exc
+                    continue
+                raise
+        raise last if last is not None else ConnectionError("no schedulers")
+
+    def probe_sync(self, host_id: str = ""):
+        """Probe stream to this host's ring-stable replica — hashing the
+        daemon's host_id spreads the fleet's probe load across replicas
+        while keeping each daemon's stream sticky."""
+        for target in self.ring.walk(host_id or "probes"):
+            return self._client_at(target).probe_sync(host_id)
+        raise ConnectionError("no schedulers")
+
+    # -- SchedulerAPI ---------------------------------------------------
+
+    def register_peer(self, req: RegisterPeerRequest,
+                      channel=None) -> RegisterPeerResponse:
+        last: Optional[Exception] = None
+        for target in self.ring.walk(req.task_id):
+            cli = self._client_at(target)
+            try:
+                resp = cli.register_peer(req, channel=channel)
+            except (ConnectionError, OSError, ServiceError) as exc:
+                # ServiceError from a dead stream (DeadlineExceeded) walks
+                # on; scheduler-rejected registrations (e.g. invalid URL)
+                # re-raise below via non-retryable codes.
+                if (isinstance(exc, ServiceError)
+                        and exc.code not in ("DeadlineExceeded", "Unavailable")):
+                    raise
+                last = exc
+                continue
+            except Exception as exc:  # noqa: BLE001
+                import grpc
+
+                if (isinstance(exc, grpc.RpcError)
+                        and exc.code() == grpc.StatusCode.UNAVAILABLE):
+                    last = exc
+                    continue
+                raise
+            with self._lock:
+                self._peer_owner[req.peer_id] = cli
+            return resp
+        raise last if last is not None else ConnectionError("no schedulers")
+
+    def leave_peer(self, peer_id: str) -> None:
+        """Peers may leave after their terminal report finalized the owner
+        mapping — fall back to asking every replica (NotFound tolerated)."""
+        with self._lock:
+            owner = self._peer_owner.get(peer_id)
+        if owner is not None:
+            owner.leave_peer(peer_id)
+            return
+        for target in sorted(self.ring.targets):
+            try:
+                self._client_at(target).leave_peer(peer_id)
+            except Exception:  # noqa: BLE001 — replica may not know the peer
+                continue
+
+    def _owner(self, peer_id: str) -> GrpcSchedulerClient:
+        with self._lock:
+            owner = self._peer_owner.get(peer_id)
+        if owner is None:
+            raise ServiceError("NotFound", f"no scheduler owns peer {peer_id}")
+        return owner
+
+    def _finalize(self, peer_id: str) -> None:
+        close_me = None
+        with self._lock:
+            owner = self._peer_owner.pop(peer_id, None)
+            if (owner is not None and owner in self._retired
+                    and owner not in self._peer_owner.values()):
+                self._retired.discard(owner)
+                close_me = owner
+        if close_me is not None:
+            close_me.close()
+
+    def download_peer_started(self, peer_id: str) -> None:
+        self._owner(peer_id).download_peer_started(peer_id)
+
+    def download_peer_back_to_source_started(self, peer_id: str) -> None:
+        self._owner(peer_id).download_peer_back_to_source_started(peer_id)
+
+    def download_piece_finished(self, report: PieceFinished) -> None:
+        self._owner(report.peer_id).download_piece_finished(report)
+
+    def download_piece_failed(self, peer_id: str, parent_id: str,
+                              piece_number: int) -> None:
+        self._owner(peer_id).download_piece_failed(
+            peer_id, parent_id, piece_number)
+
+    def download_peer_finished(self, peer_id: str,
+                               cost_seconds: float = 0.0) -> None:
+        try:
+            self._owner(peer_id).download_peer_finished(peer_id, cost_seconds)
+        finally:
+            self._finalize(peer_id)
+
+    def download_peer_back_to_source_finished(
+        self, peer_id: str, content_length: int, total_piece_count: int,
+        cost_seconds: float = 0.0,
+    ) -> None:
+        try:
+            self._owner(peer_id).download_peer_back_to_source_finished(
+                peer_id, content_length, total_piece_count, cost_seconds)
+        finally:
+            self._finalize(peer_id)
+
+    def download_peer_failed(self, peer_id: str) -> None:
+        try:
+            self._owner(peer_id).download_peer_failed(peer_id)
+        finally:
+            self._finalize(peer_id)
+
+    def download_peer_back_to_source_failed(self, peer_id: str) -> None:
+        try:
+            self._owner(peer_id).download_peer_back_to_source_failed(peer_id)
+        finally:
+            self._finalize(peer_id)
+
+    def close(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            self._peer_owner.clear()
+        for cli in clients:
+            cli.close()

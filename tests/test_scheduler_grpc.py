@@ -135,7 +135,16 @@ class TestGrpcP2P:
         try:
             result = peer.download_file(url)
             assert result.success
-            stat = peer.scheduler.stat_task(result.task_id)
+            # The finished event rides the async announce stream; poll
+            # briefly instead of racing it.
+            import time
+
+            deadline = time.monotonic() + 5.0
+            while True:
+                stat = peer.scheduler.stat_task(result.task_id)
+                if stat.state == "Succeeded" or time.monotonic() > deadline:
+                    break
+                time.sleep(0.05)
             assert stat.state == "Succeeded"
             assert stat.content_length == len(content)
             peer.scheduler.leave_peer(result.peer_id)
