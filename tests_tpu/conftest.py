@@ -1,0 +1,39 @@
+"""TPU smoke-tier harness (round-3 verdict weak item 5).
+
+Unlike tests/conftest.py this does NOT pin jax_platforms=cpu — these tests
+run on whatever accelerator the machine registers (the axon-tunneled TPU
+here). Run manually with a timeout:
+
+    python -m pytest tests_tpu -m tpu -q
+
+Keep the tier under 5 minutes: one train step per model family, one scorer
+call, one HBM device_put — enough that chip-only breakage (backend-init
+pathologies, dtype/layout surprises, tunnel dispatch) surfaces outside
+bench runs.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_collection_modifyitems(config, items):
+    # Everything in this directory is implicitly tpu-marked.
+    for item in items:
+        item.add_marker(pytest.mark.tpu)
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    import jax
+
+    from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
+
+    enable_compilation_cache()
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        pytest.skip("no accelerator registered; smoke tier needs the chip")
+    return dev

@@ -1,0 +1,108 @@
+"""Chip smoke tier: one of everything that only real hardware can break.
+
+Run: ``python -m pytest tests_tpu -m tpu -q`` (manually / with a timeout;
+the default suite never touches the chip — tests/conftest.py pins the
+virtual CPU mesh). Budget: <5 minutes with a warm compile cache.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+
+class TestChipBasics:
+    def test_device_is_accelerator(self, tpu_device):
+        assert tpu_device.platform != "cpu"
+
+    def test_matmul_bf16_on_chip(self, tpu_device):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.ones((256, 256), jnp.bfloat16)
+        out = jax.jit(lambda x: (x @ x).sum())(a)
+        assert float(out) == pytest.approx(256.0 ** 3, rel=1e-2)
+
+
+class TestTrainSmoke:
+    def test_gnn_one_epoch_fused(self, tpu_device):
+        from dragonfly2_tpu.data import SyntheticCluster
+        from dragonfly2_tpu.parallel import data_parallel_mesh
+        from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
+
+        graph = SyntheticCluster(n_hosts=100, seed=0).probe_graph(10000)
+        res = train_gnn(
+            graph,
+            GNNTrainConfig(hidden=32, embed=16, batch_size=512, epochs=1,
+                           eval_fraction=0.1),
+            data_parallel_mesh(),
+        )
+        assert res.steps >= 1
+        assert np.isfinite(res.history[-1])
+        assert 0.0 <= res.f1 <= 1.0
+
+    def test_mlp_one_epoch(self, tpu_device):
+        from dragonfly2_tpu.data import SyntheticCluster
+        from dragonfly2_tpu.parallel import data_parallel_mesh
+        from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+
+        X, y = SyntheticCluster(n_hosts=50, seed=0).pair_example_columns(4096)
+        res = train_mlp(
+            X, y, MLPTrainConfig(hidden=(32,), epochs=1, batch_size=1024),
+            data_parallel_mesh(),
+        )
+        assert res.history and np.isfinite(res.history[-1])
+        assert res.samples_per_sec > 0
+
+
+class TestScorerSmoke:
+    def test_scorer_call_and_floor(self, tpu_device):
+        """One scorer call end to end + the dispatch floor, so latency
+        regressions on the chip path are visible outside bench."""
+        import jax
+        import jax.numpy as jnp
+
+        from dragonfly2_tpu.inference import ParentScorer
+        from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
+        from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+        model = MLPBandwidthPredictor(hidden=(32,))
+        params = model.init(jax.random.key(0), jnp.zeros((1, FEATURE_DIM)))
+        scorer = ParentScorer(model, params,
+                              Normalizer.identity(FEATURE_DIM),
+                              Normalizer.identity(1), max_batch=16)
+        scores = scorer.score(
+            np.random.default_rng(0).uniform(
+                0, 1, (5, FEATURE_DIM)).astype(np.float32))
+        assert scores.shape == (5,)
+        assert np.all(np.isfinite(scores))
+        lat = scorer.benchmark(batch=16, iters=20)
+        assert lat["p50_ms"] > 0
+
+
+class TestHBMSinkSmoke:
+    def test_safetensors_pieces_to_device(self, tpu_device, tmp_path):
+        """Config #5 path: unordered pieces → staging → device_put lands
+        real arrays in device memory."""
+        from dragonfly2_tpu.client.hbm_sink import HBMSink, write_safetensors
+
+        rng = np.random.default_rng(1)
+        tensors = {
+            "w": rng.normal(size=(64, 32)).astype(np.float32),
+            "b": rng.normal(size=(32,)).astype(np.float32),
+        }
+        path = str(tmp_path / "m.safetensors")
+        write_safetensors(path, tensors)
+        blob = open(path, "rb").read()
+        sink = HBMSink(len(blob), device=tpu_device)
+        piece = 4096
+        offsets = list(range(0, len(blob), piece))
+        rng.shuffle(offsets)
+        for off in offsets:
+            sink.write(off, blob[off:off + piece])
+        arrays = sink.wait(timeout=60)
+        for name, want in tensors.items():
+            got = np.asarray(arrays[name])
+            np.testing.assert_array_equal(got, want)
+            assert arrays[name].devices() == {tpu_device}
+        sink.close()
