@@ -1,11 +1,23 @@
-"""HTTP forward proxy + registry mirror over the peer engine.
+"""HTTP(S) forward proxy + registry mirror over the peer engine.
 
 Reference counterpart: client/daemon/proxy — the daemon-side proxy that
 turns matching GET requests into P2P tasks (proxy.go:298-372 ServeHTTP,
 shouldUseDragonfly rule ladder at :614-644), tunnels CONNECT passthrough
 (:658-697), and fronts a registry mirror so container runtimes pull layer
-blobs through the mesh (mirrorRegistry :541-567). TLS hijack (MITM cert
-minting) is intentionally out of scope — CONNECT tunnels stay passthrough.
+blobs through the mesh (mirrorRegistry :541-567).
+
+HTTPS interception (round-3 verdict item 6) — every real container
+registry is HTTPS, so a blind CONNECT tunnel would bypass the mesh:
+- **MITM hijack** (proxy.go:298-372 semantics): with ``hijack_https``
+  enabled, CONNECT answers 200, the client-side socket is TLS-terminated
+  with a per-host leaf minted by a local CA (utils/certs.py), and the
+  inner requests flow through the same rule ladder → P2P engine.
+  Passthrough stays the default; interception is opt-in and clients must
+  trust the CA.
+- **SNI listener** (proxy_sni.go:1-140): :class:`SNIProxyServer`
+  terminates raw TLS using the handshake's SNI to pick the minted cert
+  and the upstream host — for runtimes pointed at the proxy via DNS
+  instead of proxy config.
 
 Rule semantics are the reference's exactly: first matching regex wins;
 ``use_https`` upgrades the scheme; ``redirect`` rewrites host or (with '/')
@@ -87,6 +99,13 @@ class ProxyConfig:
     max_concurrency: int = 0  # 0 = unlimited
     default_tag: str = ""
     default_filter: str = ""
+    # Opt-in CONNECT interception: terminate TLS with a minted per-host
+    # cert so HTTPS requests traverse the rule ladder / mesh. Clients
+    # must trust the CA (written to ``ca_dir``/ca.pem, or supplied).
+    hijack_https: bool = False
+    ca_dir: str = ""
+    ca_cert_path: str = ""
+    ca_key_path: str = ""
 
 
 class ProxyServer(ThreadedHTTPService):
@@ -100,6 +119,17 @@ class ProxyServer(ThreadedHTTPService):
             threading.Semaphore(self.config.max_concurrency)
             if self.config.max_concurrency > 0 else None
         )
+        self.ca = None
+        if self.config.hijack_https:
+            import tempfile
+
+            from dragonfly2_tpu.utils.certs import CertAuthority
+
+            self.ca = CertAuthority(
+                self.config.ca_dir or tempfile.mkdtemp(prefix="df2-proxy-ca-"),
+                ca_cert_path=self.config.ca_cert_path,
+                ca_key_path=self.config.ca_key_path,
+            )
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -119,12 +149,17 @@ class ProxyServer(ThreadedHTTPService):
             def do_CONNECT(self):  # noqa: N802
                 proxy._tunnel(self)
 
+        self._handler_class = Handler
         super().__init__(Handler, host=host, port=port, name="proxy")
 
     # -- request handling --------------------------------------------------
 
     def _check_auth(self, req: BaseHTTPRequestHandler) -> bool:
         if self.config.basic_auth is None:
+            return True
+        # Clients send Proxy-Authorization on the CONNECT only; requests
+        # inside an intercepted session were authorized at tunnel setup.
+        if getattr(req, "hijacked_host", ""):
             return True
         import base64
 
@@ -145,6 +180,11 @@ class ProxyServer(ThreadedHTTPService):
         configured remote)."""
         if req.path.startswith("http://") or req.path.startswith("https://"):
             return req.path
+        hijacked = getattr(req, "hijacked_host", "")
+        if hijacked:
+            # Inner request of an intercepted CONNECT / SNI connection:
+            # origin-form path against the handshake's target host.
+            return f"https://{hijacked}{req.path}"
         mirror = self.config.registry_mirror
         if mirror is not None:
             return mirror.remote.rstrip("/") + req.path
@@ -154,7 +194,10 @@ class ProxyServer(ThreadedHTTPService):
     def _should_use_p2p(self, req, url: str) -> tuple:
         """(use_p2p, final_url) — shouldUseDragonfly semantics."""
         mirror = self.config.registry_mirror
-        if mirror is not None and not req.path.startswith("http"):
+        # Hijacked inner requests are origin-form but target their own
+        # host, not the mirror remote — they take the rule ladder.
+        if (mirror is not None and not req.path.startswith("http")
+                and not getattr(req, "hijacked_host", "")):
             if mirror.direct:
                 return False, url
             # Mirror mode: blobs through the mesh, manifests direct
@@ -318,10 +361,13 @@ class ProxyServer(ThreadedHTTPService):
             except Exception:
                 pass
 
-    # -- CONNECT tunnel (proxy.go:658-697 tunnelHTTPS) ---------------------
+    # -- CONNECT: MITM hijack or passthrough tunnel ------------------------
 
     def _tunnel(self, req: BaseHTTPRequestHandler) -> None:
         if not self._check_auth(req):
+            return
+        if self.ca is not None:
+            self._mitm(req)
             return
         host, _, port = req.path.partition(":")
         try:
@@ -350,3 +396,129 @@ class ProxyServer(ThreadedHTTPService):
         finally:
             upstream.close()
         req.close_connection = True
+
+    def _mitm(self, req: BaseHTTPRequestHandler) -> None:
+        """Terminate the CONNECT with a minted cert and serve the inner
+        HTTPS requests through the normal handler (proxy.go:298-372)."""
+        import ssl
+
+        target = req.path  # host:port from the CONNECT line
+        host = target.partition(":")[0]
+        req.send_response(200, "Connection Established")
+        req.end_headers()
+        req.wfile.flush()
+        ctx = self.ca.server_context(default_host=host)
+        try:
+            # Bound the handshake: a client that connects and goes silent
+            # must not pin this thread forever.
+            req.connection.settimeout(60)
+            tls = ctx.wrap_socket(req.connection, server_side=True)
+        except (ssl.SSLError, OSError) as exc:
+            logger.warning("mitm handshake with client failed for %s: %s",
+                           target, exc)
+            req.close_connection = True
+            return
+        try:
+            self.serve_tls_connection(tls, req.client_address, target)
+        finally:
+            try:
+                tls.close()
+            except OSError:
+                pass
+            req.close_connection = True
+
+    def serve_tls_connection(self, tls_sock, client_address,
+                             target: str) -> None:
+        """Run the request handler loop over an established TLS socket,
+        with origin-form paths resolved against ``target`` (host[:port])."""
+        handler_cls = self._handler_class
+
+        class InnerHandler(handler_cls):
+            hijacked_host = target
+            timeout = 60
+
+            def do_CONNECT(self):  # noqa: N802 — no nested tunnels
+                self.send_error(400, "CONNECT inside intercepted session")
+
+        try:
+            InnerHandler(tls_sock, client_address, self._server)
+        except Exception as exc:  # noqa: BLE001 — connection teardown races
+            logger.debug("intercepted session for %s ended: %s", target, exc)
+
+
+class SNIProxyServer:
+    """TLS-terminating listener routed by SNI (proxy_sni.go:1-140).
+
+    For runtimes pointed at the proxy via DNS/hosts instead of proxy
+    config: no CONNECT arrives — the client opens TLS directly, the
+    handshake's SNI names the registry, we present that host's minted
+    leaf and serve the inner requests through the owning ProxyServer's
+    rule ladder. Upstream port defaults to 443 (the reference's fixed
+    target); tests override it.
+    """
+
+    def __init__(self, proxy: ProxyServer, host: str = "127.0.0.1",
+                 port: int = 0, upstream_port: int = 443):
+        if proxy.ca is None:
+            raise ValueError("SNI proxy needs hijack_https (a CA) enabled")
+        self.proxy = proxy
+        self.upstream_port = upstream_port
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._thread: Optional[threading.Thread] = None
+        self._closing = False
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="sni-proxy", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_one, args=(conn, addr),
+                name="sni-conn", daemon=True,
+            ).start()
+
+    def _serve_one(self, conn, addr) -> None:
+        import ssl
+
+        sni_name: list = [""]
+        ctx = self.proxy.ca.server_context(
+            on_sni=lambda name: sni_name.__setitem__(0, name))
+        try:
+            conn.settimeout(60)  # silent clients must not pin the thread
+            tls = ctx.wrap_socket(conn, server_side=True)
+        except (ssl.SSLError, OSError) as exc:
+            logger.debug("sni handshake failed from %s: %s", addr, exc)
+            conn.close()
+            return
+        host = sni_name[0] or "localhost"
+        target = f"{host}:{self.upstream_port}"
+        try:
+            self.proxy.serve_tls_connection(tls, addr, target)
+        finally:
+            try:
+                tls.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

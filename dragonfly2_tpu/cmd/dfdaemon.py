@@ -79,6 +79,17 @@ def main(argv=None) -> int:
                         help="regex of URLs routed through the mesh")
     parser.add_argument("--registry-mirror", default="",
                         help="remote registry base for mirror mode")
+    parser.add_argument("--proxy-hijack-https", action="store_true",
+                        help="terminate CONNECT TLS with minted per-host "
+                             "certs so HTTPS pulls traverse the mesh "
+                             "(clients must trust the CA)")
+    parser.add_argument("--proxy-ca-dir", default="",
+                        help="CA workdir (ca.pem/ca.key created if absent)")
+    parser.add_argument("--sni-port", type=int, default=-1,
+                        help="TLS-terminating SNI listener port "
+                             "(needs --proxy-hijack-https; -1 = disabled)")
+    parser.add_argument("--sni-upstream-port", type=int, default=443,
+                        help="origin port SNI-routed requests target")
     parser.add_argument("--object-storage-port", type=int, default=-1,
                         help="enable the object gateway (>=0)")
     parser.add_argument("--object-storage-dir", default="",
@@ -86,6 +97,9 @@ def main(argv=None) -> int:
     add_common_flags(parser)
     args = parser.parse_args(argv)
     init_logging(args.verbose, args.log_dir)
+    if args.sni_port >= 0 and not args.proxy_hijack_https:
+        parser.error("--sni-port requires --proxy-hijack-https "
+                     "(the SNI listener terminates TLS with minted certs)")
 
     daemon = build_daemon(args)
     print(f"daemon {daemon.host_id} upload on {daemon.upload.address}",
@@ -101,21 +115,33 @@ def main(argv=None) -> int:
         print(f"daemon rpc on {rpc_server.target}", flush=True)
 
     proxy = None
-    if args.proxy_port or args.proxy_rule or args.registry_mirror:
+    sni = None
+    if (args.proxy_port or args.proxy_rule or args.registry_mirror
+            or args.proxy_hijack_https):
         from dragonfly2_tpu.client.proxy import (
             ProxyConfig,
             ProxyRule,
             ProxyServer,
             RegistryMirror,
+            SNIProxyServer,
         )
 
         proxy = ProxyServer(daemon, ProxyConfig(
             rules=[ProxyRule(regx=r) for r in args.proxy_rule],
             registry_mirror=(RegistryMirror(remote=args.registry_mirror)
                              if args.registry_mirror else None),
+            hijack_https=args.proxy_hijack_https,
+            ca_dir=args.proxy_ca_dir,
         ), port=args.proxy_port)
         proxy.start()
         print(f"proxy on {proxy.address}", flush=True)
+        if proxy.ca is not None:
+            print(f"proxy CA at {proxy.ca.ca_cert_path}", flush=True)
+        if args.sni_port >= 0:
+            sni = SNIProxyServer(proxy, host="0.0.0.0", port=args.sni_port,
+                                 upstream_port=args.sni_upstream_port)
+            sni.start()
+            print(f"sni listener on 0.0.0.0:{sni.port}", flush=True)
 
     gateway = None
     if args.object_storage_port >= 0:
@@ -138,6 +164,8 @@ def main(argv=None) -> int:
         rpc_server.stop()
     if gateway:
         gateway.stop()
+    if sni:
+        sni.stop()
     if proxy:
         proxy.stop()
     daemon.stop()

@@ -16,10 +16,12 @@ from dragonfly2_tpu.client.piece import parse_http_range
 
 class FileServer:
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
-                 support_range: bool = True, send_content_length: bool = True):
+                 support_range: bool = True, send_content_length: bool = True,
+                 tls_context=None):
         self.root = root
         self.support_range = support_range
         self.send_content_length = send_content_length
+        self.tls = tls_context is not None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,6 +62,9 @@ class FileServer:
             do_HEAD = do_GET
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        if tls_context is not None:
+            self._server.socket = tls_context.wrap_socket(
+                self._server.socket, server_side=True)
         self._thread: threading.Thread | None = None
 
     @property
@@ -67,7 +72,8 @@ class FileServer:
         return self._server.server_address[1]
 
     def url(self, name: str) -> str:
-        return f"http://127.0.0.1:{self.port}/{name}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://127.0.0.1:{self.port}/{name}"
 
     def __enter__(self) -> "FileServer":
         self._thread = threading.Thread(
