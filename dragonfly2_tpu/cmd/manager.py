@@ -1,152 +1,93 @@
 """``df2-manager`` — run the manager (registry control plane).
 
-Reference counterpart: cmd/manager + manager/manager.go. Serves a minimal
-JSON/HTTP API over ManagerService: cluster CRUD, scheduler listing
-(dynconfig), model listing, preheat job creation and status.
+Reference counterpart: cmd/manager + manager/manager.go. Serves the
+JWT/PAT-authenticated REST API (manager/rest.py — router.go's role) over
+ManagerService: user/RBAC management, cluster/scheduler/seed-peer/
+application/model CRUD, preheat and sync-peers jobs, dynconfig answers.
+Auth is on by default (a ``root``/``dragonfly`` account is seeded like the
+reference's database seed — change the password immediately); ``--no-auth``
+runs the older unauthenticated internal mode.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import threading
-import urllib.parse
-from http.server import BaseHTTPRequestHandler
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
-from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
-
-
-class ManagerHTTPServer(ThreadedHTTPService):
-    """REST shell over ManagerService (manager/router/router.go role,
-    trimmed to the operative endpoints)."""
-
-    def __init__(self, service, preheat=None, host="127.0.0.1", port=0):
-        self.service = service
-        self.preheat = preheat
-        self._groups = {}
-        api = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):
-                pass
-
-            def _json(self, code: int, payload) -> None:
-                metrics = getattr(api.service, "metrics", None)
-                if metrics:
-                    metrics.request_count.labels(
-                        method=self.command, status=str(code)).inc()
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):  # noqa: N802
-                api._get(self)
-
-            def do_POST(self):  # noqa: N802
-                api._post(self)
-
-        super().__init__(Handler, host=host, port=port, name="manager-http")
-
-    # -- routes ------------------------------------------------------------
-
-    def _get(self, req) -> None:
-        parsed = urllib.parse.urlparse(req.path)
-        query = {k: v[0] for k, v in
-                 urllib.parse.parse_qs(parsed.query).items()}
-        if parsed.path == "/healthy":
-            req._json(200, "OK")
-        elif parsed.path == "/api/v1/scheduler-clusters":
-            req._json(200, [dict(c.data) for c in
-                            self.service.list_scheduler_clusters()])
-        elif parsed.path == "/api/v1/schedulers":
-            rows = self.service.list_schedulers(
-                ip=query.get("ip", ""), hostname=query.get("hostname", ""))
-            req._json(200, [dict(r.data) for r in rows])
-        elif parsed.path == "/api/v1/models":
-            req._json(200, [dict(r.data) for r in self.service.list_models()])
-        elif parsed.path.startswith("/api/v1/jobs/"):
-            group_id = parsed.path.rsplit("/", 1)[1]
-            status = self._groups.get(group_id)
-            if status is None:
-                req._json(404, {"error": "unknown job"})
-            else:
-                req._json(200, {"id": group_id, "state": status.state,
-                                "succeeded": status.succeeded,
-                                "failed": status.failed,
-                                "errors": status.errors})
-        else:
-            req._json(404, {"error": "unknown route"})
-
-    def _post(self, req) -> None:
-        parsed = urllib.parse.urlparse(req.path)
-        length = int(req.headers.get("Content-Length", 0))
-        try:
-            payload = json.loads(req.rfile.read(length) or b"{}")
-            if parsed.path == "/api/v1/scheduler-clusters":
-                row = self.service.create_scheduler_cluster(
-                    payload["name"],
-                    scopes=payload.get("scopes"),
-                    is_default=payload.get("is_default", False),
-                )
-                req._json(200, dict(row.data))
-            elif parsed.path == "/api/v1/jobs" and self.preheat is not None:
-                if payload.get("type") != "preheat":
-                    req._json(400, {"error": "only preheat jobs supported"})
-                    return
-                preheat_args = payload.get("args", {})
-                if "url" in preheat_args and "/manifests/" in preheat_args["url"]:
-                    groups = self.preheat.preheat_image(
-                        preheat_args["url"],
-                        scheduler_ids=payload.get("scheduler_ids"))
-                else:
-                    groups = self.preheat.preheat_urls(
-                        [preheat_args["url"]],
-                        scheduler_ids=payload.get("scheduler_ids"))
-                for g in groups:
-                    self._groups[g.group_id] = g
-                req._json(200, {"ids": [g.group_id for g in groups]})
-            else:
-                req._json(404, {"error": "unknown route"})
-        except (KeyError, ValueError) as exc:
-            req._json(400, {"error": str(exc)})
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    start_metrics_server,
+    wait_for_shutdown,
+)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("df2-manager")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--internal-port", type=int, default=65003,
+                        help="instance surface (registration/keepalive/"
+                             "dynconfig; unauthenticated — firewall it); "
+                             "-1 disables")
     parser.add_argument("--db", default="./manager.db")
+    parser.add_argument("--object-store", default="fs", choices=["fs", "s3"],
+                        help="artifact backend; s3 reads AWS_* env vars "
+                             "(AWS_ENDPOINT_URL for MinIO-compatibles)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
+    parser.add_argument("--no-auth", action="store_true",
+                        help="disable JWT/RBAC (internal single-box mode)")
+    parser.add_argument("--jwt-secret", default="",
+                        help="HMAC secret for session tokens (default: "
+                             "$DF2_MANAGER_JWT_SECRET or random per boot)")
     add_common_flags(parser)
     args = parser.parse_args(argv)
     init_logging(args.verbose, args.log_dir)
 
     from dragonfly2_tpu import __version__
-    from dragonfly2_tpu.cmd.common import start_metrics_server
     from dragonfly2_tpu.manager import (
         Database,
         FilesystemObjectStore,
         ManagerService,
     )
-    from dragonfly2_tpu.manager.jobs import JobBus, PreheatService
+    from dragonfly2_tpu.manager.auth import AuthService
+    from dragonfly2_tpu.manager.jobs import (
+        JobBus,
+        PreheatService,
+        SyncPeersService,
+    )
     from dragonfly2_tpu.manager.metrics import ManagerMetrics
+    from dragonfly2_tpu.manager.rest import ManagerHTTPServer, RestApi
 
     metrics = ManagerMetrics(version=__version__)
-    service = ManagerService(
-        Database(args.db), FilesystemObjectStore(args.object_store_dir),
-        metrics=metrics)
+    db = Database(args.db)
+    if args.object_store == "s3":
+        from dragonfly2_tpu.manager.objectstore import S3ObjectStore
+
+        object_store = S3ObjectStore()
+    else:
+        object_store = FilesystemObjectStore(args.object_store_dir)
+    service = ManagerService(db, object_store, metrics=metrics)
+    auth = None if args.no_auth else AuthService(db, secret=args.jwt_secret)
     bus = JobBus()
-    server = ManagerHTTPServer(
-        service, PreheatService(bus, service), host=args.host, port=args.port)
+    api = RestApi(service, auth=auth,
+                  preheat=PreheatService(bus, service),
+                  # rpc mode: pulls ListHosts from each registered
+                  # scheduler directly — works across processes.
+                  sync_peers=SyncPeersService(bus, service, mode="rpc"))
+    server = ManagerHTTPServer(api, host=args.host, port=args.port)
     server.start()
-    print(f"manager serving on {args.host}:{server.port}", flush=True)
+    print(f"manager serving on {args.host}:{server.port} "
+          f"(auth {'off' if args.no_auth else 'on'})", flush=True)
+    internal_server = None
+    if args.internal_port >= 0:
+        internal_server = ManagerHTTPServer(
+            api, host=args.host, port=args.internal_port,
+            surface="internal")
+        internal_server.start()
+        print(f"manager internal surface on "
+              f"{args.host}:{internal_server.port}", flush=True)
     metrics_server = start_metrics_server(args, metrics.registry)
 
     import time
@@ -160,6 +101,8 @@ def main(argv=None) -> int:
     wait_for_shutdown()
     if metrics_server:
         metrics_server.stop()
+    if internal_server:
+        internal_server.stop()
     server.stop()
     return 0
 

@@ -56,6 +56,9 @@ class GroupStatus:
     succeeded: int = 0
     failed: int = 0
     errors: List[str] = field(default_factory=list)
+    # Non-None handler return values (the machinery result backend role:
+    # sync_peers workers return their peer lists through here).
+    results: List = field(default_factory=list)
 
     @property
     def done(self) -> bool:
@@ -100,7 +103,8 @@ class JobBus:
             self.post(name, job)
         return status
 
-    def report(self, job: Job, ok: bool, error: str = "") -> None:
+    def report(self, job: Job, ok: bool, error: str = "",
+               result=None) -> None:
         if not job.group_id:
             return
         with self._lock:
@@ -109,6 +113,8 @@ class JobBus:
                 return
             if ok:
                 status.succeeded += 1
+                if result is not None:
+                    status.results.append(result)
             else:
                 status.failed += 1
                 status.errors.append(error)
@@ -130,12 +136,12 @@ class JobBus:
                 except queue.Empty:
                     continue
                 try:
-                    handler(job)
+                    result = handler(job)
                 except Exception as exc:
                     logger.exception("job %s failed", job.id)
                     self.report(job, ok=False, error=str(exc))
                 else:
-                    self.report(job, ok=True)
+                    self.report(job, ok=True, result=result)
 
         t = threading.Thread(target=loop, name=f"job-{queue_name}",
                              daemon=True)
@@ -296,10 +302,131 @@ class SchedulerJobWorker:
                      scheduler_queue(self.scheduler_id)):
             self.bus.serve_worker(name, self._handle)
 
-    def _handle(self, job: Job) -> None:
-        if job.type != "preheat":
-            raise ValueError(f"unknown job type {job.type!r}")
-        req: PreheatRequest = job.payload
-        self.service.preheat(req.url, tag=req.tag,
-                             filtered_query_params=req.filtered_query_params,
-                             request_header=req.headers)
+    def _handle(self, job: Job):
+        if job.type == "preheat":
+            req: PreheatRequest = job.payload
+            self.service.preheat(
+                req.url, tag=req.tag,
+                filtered_query_params=req.filtered_query_params,
+                request_header=req.headers)
+            return None
+        if job.type == "sync_peers":
+            return self._sync_peers()
+        raise ValueError(f"unknown job type {job.type!r}")
+
+    def _sync_peers(self) -> dict:
+        """Snapshot this scheduler's host view for the manager's merge
+        (scheduler/job/job.go:224 syncPeers). Duck-typed: anything with
+        ``list_host_snapshot`` (SchedulerService) or a bare resource."""
+        if hasattr(self.service, "list_host_snapshot"):
+            hosts = self.service.list_host_snapshot()
+        else:
+            hosts = [{
+                "host_id": h.id, "hostname": h.hostname, "ip": h.ip,
+                "port": h.port, "download_port": h.download_port,
+                "type": getattr(h.type, "value", str(h.type)),
+                "idc": h.network.idc if getattr(h, "network", None) else "",
+                "location": (h.network.location
+                             if getattr(h, "network", None) else ""),
+            } for h in self.service.resource.host_manager]
+        return {"scheduler_id": self.scheduler_id, "hosts": hosts}
+
+
+class SyncPeersService:
+    """Manager-initiated peer-list reconciliation
+    (manager/job/sync_peers.go:40-176): pull each scheduler's host
+    snapshot, merge into the peers table, drop rows the scheduler no
+    longer reports.
+
+    Two transports: ``mode="rpc"`` (default for df2-manager) calls each
+    registered scheduler's ``ListHosts`` gRPC directly — works across
+    processes with no shared broker; ``mode="bus"`` rides the in-process
+    JobBus (single-process deployments and tests)."""
+
+    def __init__(self, bus: Optional[JobBus], manager, mode: str = "bus"):
+        self.bus = bus
+        self.manager = manager  # ManagerService
+        self.mode = mode
+
+    def _active_rows(self, scheduler_ids: List[int] | None):
+        from dragonfly2_tpu.manager.database import STATE_ACTIVE
+
+        rows = self.manager.db.find("schedulers", state=STATE_ACTIVE)
+        if scheduler_ids is not None:
+            rows = [r for r in rows if r.id in set(scheduler_ids)]
+        return rows
+
+    def sync(self, scheduler_ids: List[int] | None = None,
+             timeout: float = 60.0) -> dict:
+        if self.mode == "rpc":
+            return self._sync_rpc(scheduler_ids, timeout)
+        return self._sync_bus(scheduler_ids, timeout)
+
+    def _sync_rpc(self, scheduler_ids, timeout: float) -> dict:
+        from dragonfly2_tpu.rpc.client import ServiceClient
+        from dragonfly2_tpu.scheduler.rpcserver import SCHEDULER_SPEC
+
+        rows = self._active_rows(scheduler_ids)
+        if not rows:
+            raise ValueError("no active schedulers to sync")
+        merged, errors = 0, []
+        for row in rows:
+            cli = ServiceClient(f"{row.ip}:{row.port}", SCHEDULER_SPEC)
+            try:
+                from dragonfly2_tpu.scheduler.rpcserver import Empty
+
+                resp = cli.ListHosts(Empty(),
+                                     timeout=min(timeout, 10.0))
+                merged += self._merge(
+                    {"scheduler_id": row.id, "hosts": resp.hosts})
+            except Exception as exc:  # noqa: BLE001 — per-replica
+                errors.append(f"{row.ip}:{row.port}: {exc}")
+            finally:
+                cli.close()
+        return {"group_id": uuid.uuid4().hex,
+                "state": "SUCCESS" if not errors else "PARTIAL",
+                "schedulers": len(rows), "merged_peers": merged,
+                "errors": errors}
+
+    def _sync_bus(self, scheduler_ids, timeout: float) -> dict:
+        if scheduler_ids is None:
+            scheduler_ids = [r.id for r in self._active_rows(None)]
+        if not scheduler_ids:
+            raise ValueError("no active schedulers to sync")
+        group = self.bus.post_group(
+            [scheduler_queue(i) for i in scheduler_ids],
+            lambda: Job(id=uuid.uuid4().hex, type="sync_peers", payload={}),
+        )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and not group.done:
+            time.sleep(0.05)
+        merged = 0
+        for snapshot in list(group.results):
+            merged += self._merge(snapshot)
+        return {"group_id": group.group_id, "state": group.state,
+                "schedulers": len(scheduler_ids), "merged_peers": merged,
+                "errors": list(group.errors)}
+
+    def _merge(self, snapshot: dict) -> int:
+        db = self.manager.db
+        scheduler_id = snapshot["scheduler_id"]
+        seen = set()
+        for h in snapshot["hosts"]:
+            seen.add(h["host_id"])
+            existing = db.find_one("peers", host_id=h["host_id"],
+                                   scheduler_id=scheduler_id)
+            fields = dict(
+                hostname=h["hostname"], ip=h["ip"], port=h["port"],
+                download_port=h["download_port"], type=h["type"],
+                idc=h["idc"], location=h["location"], state="active",
+            )
+            if existing is None:
+                db.insert("peers", host_id=h["host_id"],
+                          scheduler_id=scheduler_id, **fields)
+            else:
+                db.update("peers", existing.id, **fields)
+        # Full reconciliation: rows this scheduler stopped reporting go.
+        for row in db.find("peers", scheduler_id=scheduler_id):
+            if row.host_id not in seen:
+                db.delete("peers", row.id)
+        return len(seen)

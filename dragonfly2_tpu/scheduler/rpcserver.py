@@ -267,6 +267,15 @@ class WireProbeFinished:
     results: List[WireProbeResult] = field(default_factory=list)
 
 
+@message("scheduler.HostListResponse")
+@dataclass
+class HostListResponse:
+    """Host snapshot for the manager's sync-peers reconciliation
+    (scheduler/job/job.go:224 syncPeers result payload)."""
+
+    hosts: list = field(default_factory=list)  # list of plain dicts
+
+
 SCHEDULER_SPEC = ServiceSpec(
     name="df2.scheduler.Scheduler",
     methods={
@@ -274,6 +283,7 @@ SCHEDULER_SPEC = ServiceSpec(
         "LeaveHost": MethodKind.UNARY_UNARY,
         "LeavePeer": MethodKind.UNARY_UNARY,
         "StatTask": MethodKind.UNARY_UNARY,
+        "ListHosts": MethodKind.UNARY_UNARY,
         "AnnouncePeer": MethodKind.STREAM_STREAM,
         "SyncProbes": MethodKind.STREAM_STREAM,
     },
@@ -336,6 +346,9 @@ class SchedulerRpcService:
             total_piece_count=task.total_piece_count,
             peer_count=task.peer_count(),
         )
+
+    def ListHosts(self, request: Empty, context) -> HostListResponse:  # noqa: N802
+        return HostListResponse(hosts=self.service.list_host_snapshot())
 
     @staticmethod
     def _guard(context, fn, *args):

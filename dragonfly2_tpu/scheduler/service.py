@@ -151,6 +151,23 @@ class SchedulerService:
             self.network_topology.delete_host(host_id)
         self.resource.host_manager.delete(host_id)
 
+    def list_host_snapshot(self) -> list:
+        """Plain-dict host list for sync-peers reconciliation
+        (scheduler/job/job.go:224 syncPeers result)."""
+        out = []
+        for host in self.resource.host_manager:
+            out.append({
+                "host_id": host.id,
+                "hostname": host.hostname,
+                "ip": host.ip,
+                "port": host.port,
+                "download_port": host.download_port,
+                "type": getattr(host.type, "value", str(host.type)),
+                "idc": host.network.idc if host.network else "",
+                "location": host.network.location if host.network else "",
+            })
+        return out
+
     # ------------------------------------------------------------------
     # Peer registration (service_v2.go:829-982 handleRegisterPeerRequest)
     # ------------------------------------------------------------------
