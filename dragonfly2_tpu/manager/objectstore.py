@@ -2,7 +2,9 @@
 
 Reference counterpart: pkg/objectstorage (S3/OSS/OBS behind one interface,
 objectstorage.go:215 factory). The filesystem backend is the hermetic
-default; cloud backends slot in behind the same interface.
+default; :class:`S3ObjectStore` (pkg/objectstorage/s3.go:304) speaks
+SigV4-signed S3 REST to AWS or S3-compatibles (MinIO). OSS/OBS are the
+same wire shape behind different signers and are not implemented.
 """
 
 from __future__ import annotations
@@ -114,3 +116,122 @@ class FilesystemObjectStore(ObjectStore):
                 if key.startswith(prefix):
                     out.append(key)
         return sorted(out)
+
+
+class S3ObjectStore(ObjectStore):
+    """S3 REST backend (pkg/objectstorage/s3.go:304) — SigV4-signed
+    stdlib HTTP, path-style against ``endpoint_url`` (MinIO/Ceph) or
+    virtual-hosted AWS when no endpoint is set."""
+
+    def __init__(self, access_key: str = "", secret_key: str = "",
+                 region: str = "us-east-1", endpoint_url: str = "",
+                 timeout: float = 30.0):
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY", "")
+        self.region = region
+        self.endpoint_url = (endpoint_url
+                             or os.environ.get("AWS_ENDPOINT_URL", ""))
+        self.timeout = timeout
+
+    def _url(self, bucket: str, key: str = "", query: str = "") -> str:
+        import urllib.parse
+
+        if self.endpoint_url:
+            base = f"{self.endpoint_url.rstrip('/')}/{bucket}"
+        else:
+            base = f"https://{bucket}.s3.{self.region}.amazonaws.com"
+        url = base + ("/" + urllib.parse.quote(key) if key else "/")
+        return url + (("?" + query) if query else "")
+
+    def _call(self, method: str, bucket: str, key: str = "",
+              query: str = "", data: bytes = b"",
+              ok: tuple = (200,), tolerate: tuple = ()):
+        import hashlib
+        import urllib.error
+        import urllib.request
+
+        from dragonfly2_tpu.utils.awssig import EMPTY_SHA256, sign_request
+
+        url = self._url(bucket, key, query)
+        payload_hash = (hashlib.sha256(data).hexdigest() if data
+                        else EMPTY_SHA256)
+        headers = sign_request(method, url, region=self.region,
+                               access_key=self.access_key,
+                               secret_key=self.secret_key,
+                               payload_hash=payload_hash)
+        req = urllib.request.Request(url, data=data or None, headers=headers,
+                                     method=method)
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as exc:
+            if exc.code in tolerate:
+                return exc
+            raise ObjectStoreError(
+                f"s3 {method} {bucket}/{key}: HTTP {exc.code}") from exc
+        except urllib.error.URLError as exc:
+            raise ObjectStoreError(
+                f"s3 {method} {bucket}/{key}: {exc.reason}") from exc
+        if resp.status not in ok:
+            raise ObjectStoreError(
+                f"s3 {method} {bucket}/{key}: HTTP {resp.status}")
+        return resp
+
+    def create_bucket(self, bucket: str) -> None:
+        # 409 BucketAlreadyOwnedByYou is the idempotent-create answer.
+        self._call("PUT", bucket, ok=(200,), tolerate=(409,))
+
+    def is_bucket_exist(self, bucket: str) -> bool:
+        try:
+            self._call("HEAD", bucket)
+            return True
+        except ObjectStoreError:
+            return False
+
+    def put_object(self, bucket: str, key: str, data: bytes) -> None:
+        self._call("PUT", bucket, key, data=data)
+
+    def get_object(self, bucket: str, key: str) -> bytes:
+        resp = self._call("GET", bucket, key)
+        try:
+            return resp.read()
+        finally:
+            resp.close()
+
+    def is_object_exist(self, bucket: str, key: str) -> bool:
+        try:
+            self._call("HEAD", bucket, key)
+            return True
+        except ObjectStoreError:
+            return False
+
+    def object_size(self, bucket: str, key: str) -> int:
+        resp = self._call("HEAD", bucket, key)
+        try:
+            return int(resp.headers.get("Content-Length", -1))
+        finally:
+            resp.close()
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._call("DELETE", bucket, key, ok=(200, 204), tolerate=(404,))
+
+    def list_objects(self, bucket: str, prefix: str = "") -> List[str]:
+        import urllib.parse
+        import xml.etree.ElementTree as ET
+
+        keys: List[str] = []
+        token = ""
+        while True:
+            query = "list-type=2"
+            if prefix:
+                query += "&prefix=" + urllib.parse.quote(prefix, safe="")
+            if token:
+                query += ("&continuation-token="
+                          + urllib.parse.quote(token, safe=""))
+            resp = self._call("GET", bucket, query=query)
+            root = ET.fromstring(resp.read())
+            ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+            keys.extend(e.text for e in root.iter(f"{ns}Key"))
+            truncated = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken") or ""
+            if not truncated or not token:
+                return sorted(keys)

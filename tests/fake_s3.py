@@ -1,0 +1,193 @@
+"""In-memory S3-compatible server for hermetic tests.
+
+Stands in for the reference e2e suite's minio pod (test/testdata/k8s):
+bucket/object CRUD, Range GETs, ListObjectsV2 with pagination, and SigV4
+verification — every request's signature is recomputed from the raw
+request and rejected with 403 on mismatch, so client canonicalization
+bugs fail loudly instead of silently passing.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+from dragonfly2_tpu.utils.awssig import parse_authorization, sign_request
+
+
+class FakeS3:
+    def __init__(self, access_key: str = "AK", secret_key: str = "SK",
+                 region: str = "us-east-1", list_page_size: int = 2):
+        self.buckets: Dict[str, Dict[str, bytes]] = {}
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.list_page_size = list_page_size
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _verify_signature(self, payload: bytes) -> bool:
+                auth = self.headers.get("Authorization", "")
+                try:
+                    access_key, scope, signature = parse_authorization(auth)
+                except (ValueError, KeyError):
+                    return False
+                if access_key != fake.access_key:
+                    return False
+                amz_date = self.headers.get("x-amz-date", "")
+                try:
+                    now = datetime.datetime.strptime(
+                        amz_date, "%Y%m%dT%H%M%SZ"
+                    ).replace(tzinfo=datetime.timezone.utc)
+                except ValueError:
+                    return False
+                # Re-sign with the headers the client claims it signed.
+                signed_names = ""
+                for part in auth.split(","):
+                    part = part.strip()
+                    if part.startswith("SignedHeaders="):
+                        signed_names = part[len("SignedHeaders="):]
+                headers = {}
+                for name in signed_names.split(";"):
+                    if name in ("host",):
+                        headers["Host"] = self.headers.get("Host", "")
+                    elif name not in ("x-amz-date",):
+                        value = self.headers.get(name)
+                        if value is not None:
+                            headers[name] = value
+                url = f"http://{self.headers.get('Host')}{self.path}"
+                expected = sign_request(
+                    self.command, url, region=fake.region,
+                    access_key=fake.access_key, secret_key=fake.secret_key,
+                    headers={k: v for k, v in headers.items()
+                             if k.lower() not in ("host",
+                                                  "x-amz-content-sha256")},
+                    payload_hash=self.headers.get("x-amz-content-sha256", ""),
+                    now=now,
+                )
+                _, _, expected_sig = parse_authorization(
+                    expected["Authorization"])
+                return expected_sig == signature
+
+            def _respond(self, code: int, body: bytes = b"",
+                         headers: Dict[str, str] | None = None):
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _route(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = urllib.parse.unquote(parts[0])
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                query = {k: v[0] for k, v in
+                         urllib.parse.parse_qs(parsed.query).items()}
+                return bucket, key, query
+
+            def _handle(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(length) if length else b""
+                if not self._verify_signature(payload):
+                    self._respond(403, b"SignatureDoesNotMatch")
+                    return
+                bucket, key, query = self._route()
+                method = self.command
+                store = fake.buckets
+                if method == "PUT" and not key:
+                    if bucket in store:
+                        self._respond(409)
+                    else:
+                        store[bucket] = {}
+                        self._respond(200)
+                elif method == "HEAD" and not key:
+                    self._respond(200 if bucket in store else 404)
+                elif method == "GET" and not key and "list-type" in query:
+                    self._list(bucket, query)
+                elif bucket not in store:
+                    self._respond(404)
+                elif method == "PUT":
+                    store[bucket][key] = payload
+                    self._respond(200)
+                elif method in ("GET", "HEAD"):
+                    data = store[bucket].get(key)
+                    if data is None:
+                        self._respond(404)
+                        return
+                    rng = self.headers.get("Range")
+                    if rng and method == "GET":
+                        spec = rng.split("=", 1)[1]
+                        start_s, _, end_s = spec.partition("-")
+                        start = int(start_s)
+                        end = int(end_s) if end_s else len(data) - 1
+                        chunk = data[start:end + 1]
+                        self._respond(206, chunk, {
+                            "Content-Range":
+                                f"bytes {start}-{end}/{len(data)}"})
+                    else:
+                        self._respond(200, data, {
+                            "ETag": f'"{hash(data) & 0xffffffff:x}"',
+                            "Last-Modified":
+                                "Mon, 01 Jan 2024 00:00:00 GMT"})
+                elif method == "DELETE":
+                    store[bucket].pop(key, None)
+                    self._respond(204)
+                else:
+                    self._respond(400)
+
+            def _list(self, bucket, query):
+                objs = sorted(fake.buckets.get(bucket, {}))
+                prefix = query.get("prefix", "")
+                objs = [k for k in objs if k.startswith(prefix)]
+                start = 0
+                token = query.get("continuation-token", "")
+                if token:
+                    start = int(token)
+                page = objs[start:start + fake.list_page_size]
+                truncated = start + fake.list_page_size < len(objs)
+                items = "".join(f"<Contents><Key>{k}</Key></Contents>"
+                                for k in page)
+                nxt = (f"<NextContinuationToken>"
+                       f"{start + fake.list_page_size}"
+                       f"</NextContinuationToken>" if truncated else "")
+                body = (
+                    '<?xml version="1.0"?>'
+                    '<ListBucketResult xmlns='
+                    '"http://s3.amazonaws.com/doc/2006-03-01/">'
+                    f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"
+                    f"{nxt}{items}</ListBucketResult>"
+                ).encode()
+                self._respond(200, body,
+                              {"Content-Type": "application/xml"})
+
+            do_GET = do_PUT = do_HEAD = do_DELETE = _handle
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def __enter__(self) -> "FakeS3":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

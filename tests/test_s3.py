@@ -1,0 +1,144 @@
+"""S3 source client + S3 object store (round-3 verdict item 8).
+
+Runs against the in-process SigV4-verifying fake (tests/fake_s3.py — the
+minio-pod stand-in); a wrong secret must be rejected, proving signatures
+are actually checked.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.client.source import Request, SourceError
+from dragonfly2_tpu.client.source_s3 import S3Config, S3SourceClient
+from dragonfly2_tpu.client.piece import Range
+from dragonfly2_tpu.manager.objectstore import ObjectStoreError, S3ObjectStore
+from tests.fake_s3 import FakeS3
+
+
+@pytest.fixture()
+def s3():
+    with FakeS3(access_key="AK", secret_key="SK") as fake:
+        yield fake
+
+
+def make_store(s3, secret="SK") -> S3ObjectStore:
+    return S3ObjectStore(access_key="AK", secret_key=secret,
+                         endpoint_url=s3.endpoint)
+
+
+class TestS3ObjectStore:
+    def test_bucket_and_object_lifecycle(self, s3):
+        store = make_store(s3)
+        assert not store.is_bucket_exist("b1")
+        store.create_bucket("b1")
+        store.create_bucket("b1")  # idempotent (409 tolerated)
+        assert store.is_bucket_exist("b1")
+
+        payload = os.urandom(10_000)
+        store.put_object("b1", "models/m1/model.tar", payload)
+        assert store.is_object_exist("b1", "models/m1/model.tar")
+        assert store.get_object("b1", "models/m1/model.tar") == payload
+        assert store.object_size("b1", "models/m1/model.tar") == len(payload)
+        store.delete_object("b1", "models/m1/model.tar")
+        assert not store.is_object_exist("b1", "models/m1/model.tar")
+
+    def test_list_paginates(self, s3):
+        store = make_store(s3)
+        store.create_bucket("b2")
+        for i in range(5):
+            store.put_object("b2", f"k/{i}", b"x")
+        store.put_object("b2", "other", b"y")
+        # fake pages at 2 entries → 3 pages traversed
+        assert store.list_objects("b2", prefix="k/") == [
+            f"k/{i}" for i in range(5)]
+
+    def test_bad_signature_rejected(self, s3):
+        bad = make_store(s3, secret="WRONG")
+        with pytest.raises(ObjectStoreError, match="403"):
+            bad.create_bucket("b3")
+
+    def test_manager_model_registry_over_s3(self, s3, tmp_path):
+        """The registry path (create_model → artifact → activation) works
+        unchanged over the S3 backend."""
+        from dragonfly2_tpu.manager import Database, ManagerService
+
+        service = ManagerService(Database(":memory:"), make_store(s3))
+        art = tmp_path / "artifact"
+        art.mkdir()
+        (art / "model.bin").write_bytes(b"model-bytes")
+        row = service.create_model("m-1", "gnn", "h", "1.1.1.1", "host",
+                                   {"f1": 0.93}, str(art), scheduler_id=1)
+        active = service.get_active_model("gnn", scheduler_id=1)
+        assert active is not None and active.version == row.version
+        assert b"model-bytes" in active.artifact
+
+
+class TestS3SourceClient:
+    def _client(self, s3, **kw) -> S3SourceClient:
+        return S3SourceClient(S3Config(access_key="AK", secret_key="SK",
+                                       endpoint_url=s3.endpoint, **kw))
+
+    def test_download_and_metadata(self, s3):
+        store = make_store(s3)
+        store.create_bucket("src")
+        payload = os.urandom(64 * 1024)
+        store.put_object("src", "data/blob.bin", payload)
+        client = self._client(s3)
+        req = Request("s3://src/data/blob.bin")
+        assert client.get_content_length(req) == len(payload)
+        assert client.is_support_range(req)
+        resp = client.download(req)
+        assert resp.body.read() == payload
+        resp.close()
+        assert client.get_last_modified(req) > 0
+
+    def test_range_download(self, s3):
+        store = make_store(s3)
+        store.create_bucket("src")
+        payload = bytes(range(256)) * 10
+        store.put_object("src", "r.bin", payload)
+        client = self._client(s3)
+        resp = client.download(Request("s3://src/r.bin",
+                                       rng=Range(start=100, length=100)))
+        assert resp.status == 206
+        assert resp.body.read() == payload[100:200]
+        resp.close()
+
+    def test_missing_object_raises(self, s3):
+        client = self._client(s3)
+        with pytest.raises(SourceError, match="404"):
+            client.download(Request("s3://nope/missing"))
+
+    def test_registry_scheme_end_to_end(self, s3, tmp_path):
+        """s3:// through the REGISTRY into a daemon back-source download —
+        the reference's source_client.go:267 pluggability claim."""
+        from dragonfly2_tpu.client import source
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.client.source_s3 import register_s3
+        from tests.test_p2p_e2e import make_scheduler
+
+        store = make_store(s3)
+        store.create_bucket("artifacts")
+        payload = os.urandom(2 * 1024 * 1024 + 7)
+        store.put_object("artifacts", "big/model.safetensors", payload)
+
+        register_s3(S3Config(access_key="AK", secret_key="SK",
+                             endpoint_url=s3.endpoint))
+        try:
+            daemon = Daemon(make_scheduler(tmp_path), DaemonConfig(
+                storage_root=str(tmp_path / "daemon"), hostname="s3-peer"))
+            daemon.start()
+            try:
+                out = tmp_path / "out.bin"
+                result = daemon.download_file(
+                    "s3://artifacts/big/model.safetensors",
+                    output_path=str(out))
+                assert result.success, result.error
+                assert out.read_bytes() == payload
+            finally:
+                daemon.stop()
+        finally:
+            source.unregister("s3")
