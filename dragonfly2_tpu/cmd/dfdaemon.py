@@ -24,6 +24,16 @@ def build_daemon(args):
     from dragonfly2_tpu.utils.hosttypes import HostType
     from dragonfly2_tpu.utils.ratelimit import INF
 
+    import os
+
+    if os.environ.get("AWS_ACCESS_KEY_ID"):
+        # s3:// back-to-source (pkg/source/clients/s3protocol): configured
+        # purely from the standard AWS env vars (incl. AWS_ENDPOINT_URL
+        # for MinIO-style compatibles) — secrets never ride argv.
+        from dragonfly2_tpu.client.source_s3 import register_s3
+
+        register_s3()
+
     # Task-affine multi-scheduler routing; a single --scheduler is the
     # one-replica degenerate ring.
     scheduler = BalancedSchedulerClient(args.scheduler)
@@ -48,9 +58,14 @@ def main(argv=None) -> int:
     import socket
 
     parser = argparse.ArgumentParser("df2-daemon")
-    parser.add_argument("--scheduler", required=True, action="append",
+    parser.add_argument("--scheduler", default=None, action="append",
                         help="host:port (repeat for replicas; tasks route "
                              "by consistent hash)")
+    parser.add_argument("--manager", default="",
+                        help="manager host:port — scheduler targets and "
+                             "client limits refresh from its dynconfig "
+                             "(with local cache fallback)")
+    parser.add_argument("--dynconfig-interval", type=float, default=60.0)
     parser.add_argument("--rpc-port", type=int, default=-1,
                         help="serve the dfdaemon.Daemon gRPC surface "
                              "(Download/Stat/Import/Export/Delete) on this "
@@ -100,8 +115,54 @@ def main(argv=None) -> int:
     if args.sni_port >= 0 and not args.proxy_hijack_https:
         parser.error("--sni-port requires --proxy-hijack-https "
                      "(the SNI listener terminates TLS with minted certs)")
+    if not args.scheduler and not args.manager:
+        parser.error("at least one of --scheduler / --manager is required")
+
+    dynconfig = None
+    cli_targets = list(args.scheduler or [])
+    if args.manager:
+        # Scheduler targets come from the manager's dynconfig answer
+        # (client/config/dynconfig_manager.go), cached on disk so the
+        # daemon still boots when the manager is down — and explicit
+        # --scheduler targets are pinned: dynconfig adds/removes only the
+        # manager-reported replicas around them.
+        from dragonfly2_tpu.manager.client import ManagerHTTPClient
+        from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+        mgr = ManagerHTTPClient(args.manager)
+        dynconfig = Dynconfig(
+            lambda: mgr.daemon_dynconfig(ip=args.ip,
+                                         hostname=args.hostname),
+            cache_path=f"{args.storage_dir}/dynconfig.json",
+            refresh_interval=args.dynconfig_interval,
+            name="daemon-dynconfig")
+        try:
+            initial = dynconfig.get()
+        except ConnectionError as exc:
+            if not cli_targets:
+                parser.error(f"manager unreachable and no --scheduler "
+                             f"fallback: {exc}")
+            print(f"manager unreachable ({exc}); starting with "
+                  f"--scheduler targets only", flush=True)
+            initial = {}
+        args.scheduler = cli_targets + [
+            t for t in initial.get("schedulers", [])
+            if t not in cli_targets]
+        if not args.scheduler:
+            parser.error(f"manager {args.manager} reports no active "
+                         "schedulers and none were given via --scheduler")
 
     daemon = build_daemon(args)
+    if dynconfig is not None:
+        def _retarget(cfg):
+            reported = cfg.get("schedulers", [])
+            if reported or cli_targets:
+                daemon.scheduler.update_targets(
+                    cli_targets + [t for t in reported
+                                   if t not in cli_targets])
+
+        dynconfig.subscribe(_retarget)
+        dynconfig.serve()
     print(f"daemon {daemon.host_id} upload on {daemon.upload.address}",
           flush=True)
     metrics_server = start_metrics_server(args, daemon.metrics.registry)
@@ -158,6 +219,8 @@ def main(argv=None) -> int:
         print(f"object gateway on 127.0.0.1:{gateway.port}", flush=True)
 
     wait_for_shutdown()
+    if dynconfig is not None:
+        dynconfig.stop()
     if metrics_server:
         metrics_server.stop()
     if rpc_server:

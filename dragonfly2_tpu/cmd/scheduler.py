@@ -76,7 +76,18 @@ def main(argv=None) -> int:
     parser.add_argument("--train-interval", type=float, default=600.0)
     parser.add_argument("--scheduler-id", type=int, default=0,
                         help="manager-assigned scheduler instance id; keys "
-                             "model uploads per cluster")
+                             "model uploads per cluster (auto-assigned "
+                             "when --manager is set)")
+    parser.add_argument("--manager", default="",
+                        help="manager internal-surface host:port — "
+                             "registers this instance, keeps it alive, "
+                             "refreshes cluster dynconfig")
+    parser.add_argument("--advertise-ip", default="",
+                        help="IP daemons should dial (default: resolved "
+                             "hostname; NEVER the 0.0.0.0 bind address)")
+    parser.add_argument("--cluster-id", type=int, default=0,
+                        help="scheduler cluster id at the manager "
+                             "(0 = manager default cluster)")
     add_common_flags(parser)
     args = parser.parse_args(argv)
     init_logging(args.verbose, args.log_dir)
@@ -84,6 +95,77 @@ def main(argv=None) -> int:
     service, server = build_scheduler(args)
     print(f"scheduler serving on {server.target}", flush=True)
     metrics_server = start_metrics_server(args, service.metrics.registry)
+
+    manager_adapter = None
+    dynconfig = None
+    if args.manager:
+        import socket as _socket
+        import threading as _threading
+
+        from dragonfly2_tpu.manager.client import ManagerHTTPClient
+        from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+        mgr = ManagerHTTPClient(args.manager)
+        hostname = _socket.gethostname()
+        # Advertise a routable address, never the bind address — daemons
+        # receive this via dynconfig and 0.0.0.0 would point them at
+        # their own loopback.
+        advertise_ip = args.advertise_ip or (
+            args.host if args.host not in ("0.0.0.0", "::") else "")
+        if not advertise_ip:
+            try:
+                advertise_ip = _socket.gethostbyname(hostname)
+            except OSError:
+                advertise_ip = "127.0.0.1"
+        row = mgr.update_scheduler_instance(
+            hostname=hostname, ip=advertise_ip, port=args.port,
+            cluster_id=args.cluster_id)
+        if not args.scheduler_id:
+            args.scheduler_id = int(row["id"])
+        cluster_id = int(row["scheduler_cluster_id"])
+        print(f"registered with manager as scheduler {args.scheduler_id} "
+              f"(cluster {cluster_id})", flush=True)
+
+        class _ManagerAdapter:
+            """Announcer's ManagerAnnounceClient over the HTTP client.
+            Always speaks the advertised identity — keepalive must match
+            the registered (hostname, ip) row exactly."""
+
+            def update_scheduler(self, host_id, ip, hostname_, port):
+                mgr.update_scheduler_instance(
+                    hostname=hostname, ip=advertise_ip, port=port,
+                    cluster_id=cluster_id)
+
+            def keepalive(self, host_id):
+                mgr.keepalive_scheduler(hostname=hostname, ip=advertise_ip,
+                                        cluster_id=cluster_id)
+
+        manager_adapter = _ManagerAdapter()
+        # First keepalive immediately: registration alone leaves the row
+        # inactive, and daemons' dynconfig only lists active instances.
+        manager_adapter.keepalive("")
+
+        def keepalive_loop():
+            import logging as _logging
+            import time as _time
+
+            while True:
+                _time.sleep(5.0)
+                try:
+                    manager_adapter.keepalive("")
+                except Exception:  # noqa: BLE001 — keepalive must not die
+                    _logging.getLogger(__name__).exception(
+                        "manager keepalive failed")
+
+        _threading.Thread(target=keepalive_loop, daemon=True,
+                          name="manager-keepalive").start()
+        dynconfig = Dynconfig(
+            lambda: mgr.scheduler_cluster_config(cluster_id),
+            cache_path=f"{args.data_dir}/dynconfig.json",
+            name="scheduler-dynconfig")
+        dynconfig.subscribe(service.scheduling.apply_dynconfig)
+        dynconfig.refresh()
+        dynconfig.serve()
 
     announcer = None
     if args.trainer:

@@ -60,6 +60,15 @@ class Scheduling:
         self.evaluator = evaluator
         self.config = config or SchedulingConfig()
 
+    def apply_dynconfig(self, cfg: dict) -> None:
+        """Manager-pushed overrides for the dynconfig-tunable limits
+        (scheduler/config/constants.go:33-37: filterParentLimit and
+        candidateParentLimit are cluster-config overridable)."""
+        for key in ("filter_parent_limit", "candidate_parent_limit",
+                    "retry_limit", "retry_back_to_source_limit"):
+            if key in cfg:
+                setattr(self.config, key, int(cfg[key]))
+
     # -- v2 entry point -------------------------------------------------------
 
     def schedule_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> None:

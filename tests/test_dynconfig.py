@@ -1,0 +1,168 @@
+"""Dynconfig refresh loop + manager internal surface (verdict item 9).
+
+Covers internal/dynconfig/dynconfig.go semantics (cache fallback, observer
+notifications on change only) and the instance endpoints that feed it
+(register/keepalive/daemon-dynconfig), ending with the BalancedClient
+retargeting hook.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dragonfly2_tpu.manager import (
+    Database,
+    FilesystemObjectStore,
+    ManagerService,
+)
+from dragonfly2_tpu.manager.auth import AuthService
+from dragonfly2_tpu.manager.client import ManagerClientError, ManagerHTTPClient
+from dragonfly2_tpu.manager.rest import ManagerHTTPServer, RestApi
+from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+
+class TestDynconfig:
+    def test_get_fetches_then_caches(self, tmp_path):
+        calls = []
+
+        def fetch():
+            calls.append(1)
+            return {"v": 1}
+
+        d = Dynconfig(fetch, cache_path=str(tmp_path / "c.json"))
+        assert d.get() == {"v": 1}
+        assert d.get() == {"v": 1}
+        assert len(calls) == 1
+        # Snapshot persisted atomically for offline boots.
+        assert json.load(open(tmp_path / "c.json")) == {"v": 1}
+
+    def test_disk_fallback_when_remote_down(self, tmp_path):
+        path = tmp_path / "c.json"
+        path.write_text('{"schedulers": ["a:1"]}')
+
+        def fetch():
+            raise ConnectionError("manager down")
+
+        d = Dynconfig(fetch, cache_path=str(path))
+        assert d.get() == {"schedulers": ["a:1"]}
+
+    def test_no_cache_no_remote_raises(self, tmp_path):
+        d = Dynconfig(lambda: (_ for _ in ()).throw(OSError("down")),
+                      cache_path=str(tmp_path / "missing.json"))
+        with pytest.raises(ConnectionError):
+            d.get()
+
+    def test_observers_fire_on_change_only(self, tmp_path):
+        state = {"v": 1}
+        seen = []
+        d = Dynconfig(lambda: dict(state), cache_path="")
+        d.subscribe(seen.append)
+        d.refresh()
+        d.refresh()          # unchanged → no notification
+        state["v"] = 2
+        d.refresh()
+        assert seen == [{"v": 1}, {"v": 2}]
+
+    def test_refresh_failure_keeps_serving(self, tmp_path):
+        ok = [True]
+
+        def fetch():
+            if not ok[0]:
+                raise OSError("down")
+            return {"v": 1}
+
+        d = Dynconfig(fetch, cache_path="")
+        assert d.get() == {"v": 1}
+        ok[0] = False
+        assert d.refresh() is False
+        assert d.get() == {"v": 1}
+
+
+@pytest.fixture()
+def manager(tmp_path):
+    """Both listeners, like df2-manager: public (JWT'd user API) and
+    internal (instance surface)."""
+    service = ManagerService(
+        Database(":memory:"),
+        FilesystemObjectStore(str(tmp_path / "objects")))
+    api = RestApi(service, auth=AuthService(service.db, secret="s"))
+    public = ManagerHTTPServer(api)
+    public.start()
+    internal = ManagerHTTPServer(api, surface="internal")
+    internal.start()
+    yield {"service": service, "server": public, "internal": internal}
+    internal.stop()
+    public.stop()
+
+
+class TestInternalSurface:
+    def test_register_keepalive_dynconfig_flow(self, manager):
+        mgr = ManagerHTTPClient(f"127.0.0.1:{manager['internal'].port}")
+        row = mgr.update_scheduler_instance(
+            hostname="s1", ip="10.0.0.5", port=8002)
+        assert row["id"] >= 1
+        cluster_id = row["scheduler_cluster_id"]
+        # Inactive until keepalive → dynconfig answers empty.
+        assert mgr.daemon_dynconfig(ip="1.2.3.4")["schedulers"] == []
+        mgr.keepalive_scheduler(hostname="s1", ip="10.0.0.5",
+                                cluster_id=cluster_id)
+        cfg = mgr.daemon_dynconfig(ip="1.2.3.4")
+        assert cfg["schedulers"] == ["10.0.0.5:8002"]
+        # Cluster scheduling config comes through too.
+        manager["service"].db.update(
+            "scheduler_clusters", cluster_id,
+            config={"filter_parent_limit": 7})
+        assert mgr.scheduler_cluster_config(cluster_id) == {
+            "filter_parent_limit": 7}
+
+    def test_surfaces_are_isolated(self, manager):
+        internal = ManagerHTTPClient(f"127.0.0.1:{manager['internal'].port}")
+        public = ManagerHTTPClient(f"127.0.0.1:{manager['server'].port}")
+        # Internal listener serves instance endpoints without user auth...
+        assert internal.daemon_dynconfig()["schedulers"] == []
+        # ...but NOT the user API (auth-free user access would be a hole).
+        with pytest.raises(ManagerClientError, match="404"):
+            internal._call("GET", "/api/v1/models")
+        # Public listener: user API needs auth, internal paths don't exist.
+        with pytest.raises(ManagerClientError, match="401"):
+            public._call("GET", "/api/v1/models")
+        with pytest.raises(ManagerClientError, match="404"):
+            public.daemon_dynconfig()
+
+    def test_scheduling_applies_dynconfig(self):
+        from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+        from dragonfly2_tpu.scheduler.scheduling.core import Scheduling
+
+        s = Scheduling(BaseEvaluator())
+        s.apply_dynconfig({"filter_parent_limit": 5,
+                           "candidate_parent_limit": 2,
+                           "unknown_key": "ignored"})
+        assert s.config.filter_parent_limit == 5
+        assert s.config.candidate_parent_limit == 2
+
+    def test_balanced_client_retargets_from_dynconfig(self, manager, tmp_path):
+        """The resolver path: dynconfig update → BalancedSchedulerClient
+        ring follows."""
+        from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
+
+        mgr = ManagerHTTPClient(f"127.0.0.1:{manager['internal'].port}")
+        row = mgr.update_scheduler_instance(hostname="s1", ip="10.0.0.5",
+                                            port=8002)
+        mgr.keepalive_scheduler(hostname="s1", ip="10.0.0.5",
+                                cluster_id=row["scheduler_cluster_id"])
+        balanced = BalancedSchedulerClient([])
+        d = Dynconfig(lambda: mgr.daemon_dynconfig(),
+                      cache_path=str(tmp_path / "dc.json"))
+        d.subscribe(lambda cfg: balanced.update_targets(cfg["schedulers"]))
+        d.refresh()
+        assert balanced.ring.targets == {"10.0.0.5:8002"}
+        # Second scheduler appears → ring grows on the next tick.
+        row2 = mgr.update_scheduler_instance(hostname="s2", ip="10.0.0.6",
+                                             port=8002)
+        mgr.keepalive_scheduler(hostname="s2", ip="10.0.0.6",
+                                cluster_id=row2["scheduler_cluster_id"])
+        d.refresh()
+        assert balanced.ring.targets == {"10.0.0.5:8002", "10.0.0.6:8002"}
+        balanced.close()
