@@ -158,8 +158,10 @@ class ProxyServer(ThreadedHTTPService):
         if self.config.basic_auth is None:
             return True
         # Clients send Proxy-Authorization on the CONNECT only; requests
-        # inside an intercepted session were authorized at tunnel setup.
-        if getattr(req, "hijacked_host", ""):
+        # inside an intercepted MITM session were authorized at tunnel
+        # setup (the SNI listener never sees a CONNECT, so its sessions
+        # are NOT pre-authorized — it refuses to start under basic_auth).
+        if getattr(req, "session_preauthorized", False):
             return True
         import base64
 
@@ -419,7 +421,8 @@ class ProxyServer(ThreadedHTTPService):
             req.close_connection = True
             return
         try:
-            self.serve_tls_connection(tls, req.client_address, target)
+            self.serve_tls_connection(tls, req.client_address, target,
+                                      preauthorized=True)
         finally:
             try:
                 tls.close()
@@ -427,14 +430,17 @@ class ProxyServer(ThreadedHTTPService):
                 pass
             req.close_connection = True
 
-    def serve_tls_connection(self, tls_sock, client_address,
-                             target: str) -> None:
+    def serve_tls_connection(self, tls_sock, client_address, target: str,
+                             preauthorized: bool = False) -> None:
         """Run the request handler loop over an established TLS socket,
-        with origin-form paths resolved against ``target`` (host[:port])."""
+        with origin-form paths resolved against ``target`` (host[:port]).
+        ``preauthorized`` marks sessions whose CONNECT already passed
+        proxy basic auth."""
         handler_cls = self._handler_class
 
         class InnerHandler(handler_cls):
             hijacked_host = target
+            session_preauthorized = preauthorized
             timeout = 60
 
             def do_CONNECT(self):  # noqa: N802 — no nested tunnels
@@ -461,6 +467,12 @@ class SNIProxyServer:
                  port: int = 0, upstream_port: int = 443):
         if proxy.ca is None:
             raise ValueError("SNI proxy needs hijack_https (a CA) enabled")
+        if proxy.config.basic_auth is not None:
+            # Raw-TLS clients have no CONNECT to carry Proxy-Authorization;
+            # serving them would silently bypass the configured auth.
+            raise ValueError(
+                "SNI listener cannot enforce proxy basic_auth; disable "
+                "one of them")
         self.proxy = proxy
         self.upstream_port = upstream_port
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -111,18 +111,43 @@ class CertAuthority:
     # -- leaves ------------------------------------------------------------
 
     def cert_for(self, host: str) -> Tuple[str, str]:
-        """(cert_path, key_path) for ``host``, minted once and cached."""
+        """(cert_path, key_path) for ``host``, minted once and cached.
+
+        A cached/on-disk leaf is only reused while it is still valid AND
+        issued by the current CA — a reused work_dir must never serve
+        expired leaves or leaves from a replaced CA."""
         with self._lock:
             cached = self._leaf_paths.get(host)
-            if cached is not None:
+            if cached is not None and self._leaf_usable(cached[0]):
                 return cached
             safe = host.replace(":", "_").replace("/", "_")
             cert_path = os.path.join(self.work_dir, f"leaf-{safe}.pem")
             key_path = os.path.join(self.work_dir, f"leaf-{safe}.key")
-            if not (os.path.exists(cert_path) and os.path.exists(key_path)):
+            if not (os.path.exists(cert_path) and os.path.exists(key_path)
+                    and self._leaf_usable(cert_path)):
                 self._mint(host, cert_path, key_path)
             self._leaf_paths[host] = (cert_path, key_path)
             return cert_path, key_path
+
+    def _leaf_usable(self, cert_path: str) -> bool:
+        try:
+            with open(cert_path, "rb") as f:
+                leaf = x509.load_pem_x509_certificate(f.read())
+        except (OSError, ValueError):
+            return False
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if not (leaf.not_valid_before_utc <= now
+                < leaf.not_valid_after_utc - _ONE_DAY):
+            return False
+        if leaf.issuer != self._ca_cert.subject:
+            return False
+        try:
+            self._ca_cert.public_key().verify(
+                leaf.signature, leaf.tbs_certificate_bytes,
+                ec.ECDSA(hashes.SHA256()))
+        except Exception:  # noqa: BLE001 — any verify failure → re-mint
+            return False
+        return True
 
     def _mint(self, host: str, cert_path: str, key_path: str) -> None:
         key = ec.generate_private_key(ec.SECP256R1())
