@@ -1,0 +1,56 @@
+"""Batch-size sweep for the fused GNN step on the real chip.
+
+Measures steady-state samples/sec/chip per batch size (compile excluded)
+so bench.py's batch choice is evidence, not a guess. Artifacts from runs
+of this script are checked in as artifacts/sweep_gnn_*.json.
+"""
+
+import json
+import sys
+import time
+
+from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+
+from dragonfly2_tpu.data import SyntheticCluster  # noqa: E402
+from dragonfly2_tpu.parallel import data_parallel_mesh  # noqa: E402
+from dragonfly2_tpu.train import GNNTrainConfig, train_gnn  # noqa: E402
+
+mesh = data_parallel_mesh()
+print(json.dumps({"platform": jax.devices()[0].platform,
+                  "devices": mesh.n_data}), flush=True)
+
+cluster = SyntheticCluster(n_hosts=2000, seed=0)
+t0 = time.perf_counter()
+graph = cluster.probe_graph(2_000_000)
+print(json.dumps({"graph_built_s": round(time.perf_counter() - t0, 1)}),
+      flush=True)
+
+results = []
+for batch in (8192, 32768, 131072):
+    rates = []
+    res = train_gnn(
+        graph,
+        GNNTrainConfig(batch_size=batch, epochs=1000, eval_fraction=0.02,
+                       max_seconds=12.0, eval_max_seconds=0.0,
+                       progress_callback=lambda s, r: rates.append(r)),
+        mesh,
+    )
+    row = {
+        "batch": batch,
+        "samples_per_sec_per_chip": int(res.samples_per_sec / mesh.n_data),
+        "steps": res.steps,
+        "compile_s": round(res.compile_seconds, 1),
+        "last_progress_rate": int(rates[-1]) if rates else 0,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+best = max(results, key=lambda r: r["samples_per_sec_per_chip"])
+print(json.dumps({"best": best}), flush=True)
+if len(sys.argv) > 1:
+    with open(sys.argv[1], "w") as f:
+        json.dump(results, f, indent=1)

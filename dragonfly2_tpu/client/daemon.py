@@ -305,7 +305,13 @@ class Daemon:
     # -- seeder surface (scheduler → seed daemon) --------------------------
 
     def seed_client(self) -> "SeedPeerDaemonClient":
-        return SeedPeerDaemonClient(self)
+        """One instance per daemon — its per-task in-flight dedup only
+        works when every trigger path (in-proc binding AND the ObtainSeeds
+        wire) shares the same map."""
+        client = getattr(self, "_seed_client", None)
+        if client is None:
+            client = self._seed_client = SeedPeerDaemonClient(self)
+        return client
 
 
 class SeedPeerDaemonClient:

@@ -245,6 +245,16 @@ class PeerTaskConductor:
     # -- public entry ------------------------------------------------------
 
     def run(self) -> PeerTaskResult:
+        # The conductor's task-level span (peertask_conductor.go:255
+        # SpanRegisterTask): child rpc.client spans hang off it, so one
+        # trace covers register → schedule → pieces → finish.
+        from dragonfly2_tpu.utils.tracing import default_tracer
+
+        with default_tracer().span("peer_task.run", task_id=self.task_id,
+                                   peer_id=self.peer_id):
+            return self._run()
+
+    def _run(self) -> PeerTaskResult:
         self._started_at = time.monotonic()
         try:
             register = RegisterPeerRequest(

@@ -130,6 +130,26 @@ class DeleteResponse:
     deleted_bytes: int = 0
 
 
+@message("dfdaemon.ObtainSeedsRequest")
+@dataclass
+class ObtainSeedsRequest:
+    """Scheduler → seed daemon back-source trigger
+    (client/daemon/rpcserver/seeder.go:53 ObtainSeeds)."""
+
+    task_id: str = ""
+    url: str = ""
+    tag: str = ""
+    filtered_query_params: list = field(default_factory=list)
+    request_header: dict = field(default_factory=dict)
+
+
+@message("dfdaemon.ObtainSeedsResponse")
+@dataclass
+class ObtainSeedsResponse:
+    success: bool = False
+    error: str = ""
+
+
 @message("dfdaemon.VersionRequest")
 @dataclass
 class VersionRequest:
@@ -151,6 +171,7 @@ DAEMON_SPEC = ServiceSpec(
         "ImportTask": MethodKind.STREAM_UNARY,
         "ExportTask": MethodKind.UNARY_STREAM,
         "DeleteTask": MethodKind.UNARY_UNARY,
+        "ObtainSeeds": MethodKind.UNARY_UNARY,
         "Version": MethodKind.UNARY_UNARY,
     },
 )
@@ -260,6 +281,32 @@ class DaemonRpcService:
     def DeleteTask(self, request: DeleteRequest, context) -> DeleteResponse:
         return DeleteResponse(
             deleted_bytes=self.daemon.delete_cache(request.cid, request.tag))
+
+    def ObtainSeeds(self, request: ObtainSeedsRequest, context) -> ObtainSeedsResponse:  # noqa: N802
+        """Seeder surface: the wire form of SeedPeerDaemonClient — a
+        remote scheduler triggers this daemon's back-source download so
+        its pieces become the task's origin in the mesh."""
+        from dataclasses import dataclass as _dc
+        from dataclasses import field as _field
+
+        @_dc
+        class _TaskShim:
+            id: str
+            url: str
+            tag: str = ""
+            filtered_query_params: list = _field(default_factory=list)
+            request_header: dict = _field(default_factory=dict)
+
+        try:
+            ok = self.daemon.seed_client().trigger_task(_TaskShim(
+                id=request.task_id, url=request.url, tag=request.tag,
+                filtered_query_params=list(request.filtered_query_params),
+                request_header=dict(request.request_header)))
+        except Exception as exc:  # noqa: BLE001 — report, don't abort
+            return ObtainSeedsResponse(success=False,
+                                       error=f"{type(exc).__name__}: {exc}")
+        return ObtainSeedsResponse(success=bool(ok),
+                                   error="" if ok else "seed trigger failed")
 
     def Version(self, request: VersionRequest, context) -> VersionResponse:
         from dragonfly2_tpu import __version__
@@ -396,3 +443,54 @@ class RemoteDaemonClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+class GrpcSeedPeerClient:
+    """Scheduler-side SeedPeerClient over the wire — multi-address like the
+    reference's refreshed seed-peer client (scheduler/resource/
+    seed_peer_client.go:206). Thin shell over :class:`BalancedClient`
+    (task-hashed routing, thread-safe client cache, UNAVAILABLE ring-walk
+    — seed triggers run on per-task threads, so thread safety matters)."""
+
+    def __init__(self, targets, timeout: float = 600.0):
+        from dragonfly2_tpu.rpc.client import BalancedClient
+
+        self.timeout = timeout
+        self._balanced = BalancedClient(DAEMON_SPEC, targets)
+
+    def update_targets(self, targets) -> None:
+        self._balanced.update_targets(targets)
+
+    def trigger_task(self, task) -> bool:
+        from dragonfly2_tpu.rpc.client import RpcRetryError
+
+        try:
+            resp = self._balanced.call(
+                task.id, "ObtainSeeds",
+                ObtainSeedsRequest(
+                    task_id=task.id, url=task.url,
+                    tag=getattr(task, "tag", ""),
+                    filtered_query_params=list(
+                        getattr(task, "filtered_query_params", []) or []),
+                    request_header=dict(
+                        getattr(task, "request_header", {}) or {})),
+                timeout=self.timeout)
+        except RpcRetryError as exc:
+            logger.warning("seed trigger for %s: %s", task.id, exc)
+            return False
+        except Exception as exc:  # noqa: BLE001 — UNAVAILABLE everywhere
+            import grpc
+
+            if (isinstance(exc, grpc.RpcError)
+                    and exc.code() == grpc.StatusCode.UNAVAILABLE):
+                logger.warning("seed trigger for %s: all seeds unavailable",
+                               task.id)
+                return False
+            raise
+        if not resp.success:
+            logger.warning("seed trigger for %s failed: %s",
+                           task.id, resp.error)
+        return resp.success
+
+    def close(self) -> None:
+        self._balanced.close()

@@ -9,8 +9,14 @@ import signal
 import threading
 
 
-def init_logging(verbose: bool, log_dir: str = "") -> None:
+def init_logging(verbose: bool, log_dir: str = "",
+                 service: str = "df2") -> None:
     level = logging.DEBUG if verbose else logging.INFO
+    if log_dir == "auto":
+        # Standard per-service layout (pkg/dfpath role).
+        from dragonfly2_tpu.utils.dfpath import for_service
+
+        log_dir = for_service(service).ensure().log_dir
     if log_dir:
         from dragonfly2_tpu.utils.dflog import init_file_logging
 
@@ -23,14 +29,85 @@ def init_logging(verbose: bool, log_dir: str = "") -> None:
 
 
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--config", default="",
+                        help="YAML config file; keys mirror the flag names "
+                             "(dashes or underscores). Flags given on the "
+                             "command line override the file.")
     parser.add_argument("--verbose", action="store_true",
                         help="debug logging")
     parser.add_argument("--log-dir", default="",
-                        help="rotated per-concern log files here "
-                             "(default: console only)")
+                        help="rotated per-concern log files here; the "
+                             "literal value 'auto' uses the standard "
+                             "layout under $DF2_HOME (default: console "
+                             "only)")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help="serve Prometheus /metrics on this port "
                              "(0 = ephemeral, -1 = disabled)")
+    parser.add_argument("--trace-dir", default="",
+                        help="write JSONL span traces here (rotated); "
+                             "trace ids propagate across services via "
+                             "gRPC metadata (default: tracing off)")
+
+
+def init_tracing(args, service_name: str) -> None:
+    """Install the process-wide tracer when --trace-dir was given (the
+    reference's jaeger bootstrap, cmd/dependency/dependency.go:263-295)."""
+    if getattr(args, "trace_dir", ""):
+        from dragonfly2_tpu.utils.tracing import Tracer, set_default_tracer
+
+        set_default_tracer(Tracer(service_name, out_dir=args.trace_dir))
+
+
+def parse_with_config(parser: argparse.ArgumentParser, argv=None):
+    """Two-pass parse implementing the reference's cobra+viper layering
+    (cmd/dependency: config file < env-ish defaults < explicit flags).
+
+    Pass 1 finds --config; the YAML's keys become parser DEFAULTS, so any
+    flag actually present on the command line still wins. Unknown YAML
+    keys are rejected loudly — a typo'd option silently ignored is the
+    worst config bug to debug.
+    """
+    import sys as _sys
+
+    argv = list(_sys.argv[1:] if argv is None else argv)
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default="")
+    known, _ = pre.parse_known_args(argv)
+    if known.config:
+        import yaml
+
+        with open(known.config) as f:
+            data = yaml.safe_load(f) or {}
+        if not isinstance(data, dict):
+            parser.error(f"{known.config}: top level must be a mapping")
+        actions = {a.dest: a for a in parser._actions}
+        # Dests whose flags appear on the command line: the flag wins
+        # outright, so the file value must not even become a default —
+        # append actions EXTEND defaults, which would merge instead of
+        # override.
+        given = set()
+        for a in parser._actions:
+            if any(opt in argv for opt in a.option_strings):
+                given.add(a.dest)
+        defaults = {}
+        for key, value in data.items():
+            dest = key.replace("-", "_")
+            action = actions.get(dest)
+            if action is None:
+                parser.error(f"{known.config}: unknown option {key!r}")
+            if dest in given:
+                continue
+            if isinstance(action, argparse._AppendAction):
+                value = value if isinstance(value, list) else [value]
+                value = [action.type(v) if action.type and isinstance(v, str)
+                         else v for v in value]
+            elif action.type is not None and isinstance(value, str):
+                # argparse applies type= to command-line strings, not to
+                # objects injected as defaults — mirror it for quoted YAML.
+                value = action.type(value)
+            defaults[dest] = value
+        parser.set_defaults(**defaults)
+    return parser.parse_args(argv)
 
 
 def start_metrics_server(args, registry):

@@ -13,7 +13,7 @@ import argparse
 import json
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
 
 
 def _daemon(storage_dir: str):
@@ -37,7 +37,7 @@ def main(argv=None) -> int:
                         help="input file (import) / output file (export)")
     parser.add_argument("--tag", default="")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose)
 
     if bool(args.daemon) == bool(args.storage_dir):

@@ -11,6 +11,8 @@ import argparse
 import sys
 
 from dragonfly2_tpu.cmd.common import (
+    init_tracing,
+    parse_with_config,
     add_common_flags,
     init_logging,
     start_metrics_server,
@@ -110,8 +112,9 @@ def main(argv=None) -> int:
     parser.add_argument("--object-storage-dir", default="",
                         help="filesystem object-store root for the gateway")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir)
+    init_tracing(args, "dfdaemon")
     if args.sni_port >= 0 and not args.proxy_hijack_https:
         parser.error("--sni-port requires --proxy-hijack-https "
                      "(the SNI listener terminates TLS with minted certs)")

@@ -16,7 +16,7 @@ import argparse
 import sys
 import tempfile
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
 
 
 def main(argv=None) -> int:
@@ -39,7 +39,7 @@ def main(argv=None) -> int:
                         help="'&'-separated query params excluded from the "
                              "task id")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose)
 
     headers = {}

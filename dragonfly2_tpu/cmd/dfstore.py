@@ -9,7 +9,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging
+from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
 
 
 def main(argv=None) -> int:
@@ -22,7 +22,7 @@ def main(argv=None) -> int:
     parser.add_argument("--path", default="",
                         help="local file (put source / get destination)")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose)
 
     from dragonfly2_tpu.client.objectstorage_gateway import DfstoreClient

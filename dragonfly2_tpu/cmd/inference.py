@@ -9,7 +9,13 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, init_logging, wait_for_shutdown
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    init_tracing,
+    parse_with_config,
+    wait_for_shutdown,
+)
 
 
 def main(argv=None) -> int:
@@ -21,8 +27,9 @@ def main(argv=None) -> int:
     parser.add_argument("--object-store-dir", default="./manager-objects")
     parser.add_argument("--reload-interval", type=float, default=30.0)
     add_common_flags(parser)
-    args = parser.parse_args(argv)
-    init_logging(args.verbose)
+    args = parse_with_config(parser, argv)
+    init_logging(args.verbose, args.log_dir)
+    init_tracing(args, "inference")
 
     from dragonfly2_tpu.inference.sidecar import (
         INFERENCE_SPEC,

@@ -16,6 +16,8 @@ import sys
 import threading
 
 from dragonfly2_tpu.cmd.common import (
+    init_tracing,
+    parse_with_config,
     add_common_flags,
     init_logging,
     start_metrics_server,
@@ -42,8 +44,9 @@ def main(argv=None) -> int:
                         help="HMAC secret for session tokens (default: "
                              "$DF2_MANAGER_JWT_SECRET or random per boot)")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir)
+    init_tracing(args, "manager")
 
     from dragonfly2_tpu import __version__
     from dragonfly2_tpu.manager import (

@@ -12,6 +12,8 @@ import argparse
 import sys
 
 from dragonfly2_tpu.cmd.common import (
+    init_tracing,
+    parse_with_config,
     add_common_flags,
     init_logging,
     start_metrics_server,
@@ -44,6 +46,13 @@ def build_scheduler(args):
         args.algorithm,
         sidecar_target=args.inference_sidecar or None,
     )
+    seed_peer_client = None
+    if args.seed_peer:
+        # Remote seed daemons over the wire (resource/seed_peer_client.go
+        # multi-addr client; cdnsystem.Seeder ObtainSeeds).
+        from dragonfly2_tpu.client.rpcserver import GrpcSeedPeerClient
+
+        seed_peer_client = GrpcSeedPeerClient(args.seed_peer)
     service = SchedulerService(
         resource=resource,
         scheduling=Scheduling(evaluator),
@@ -51,6 +60,7 @@ def build_scheduler(args):
         network_topology=NetworkTopologyStore(
             NetworkTopologyConfig(), resource=resource, storage=storage),
         metrics=SchedulerMetrics(resource=resource, version=__version__),
+        seed_peer_client=seed_peer_client,
     )
     resource.serve()
     service.network_topology.serve()
@@ -70,6 +80,10 @@ def main(argv=None) -> int:
     parser.add_argument("--inference-sidecar", default="",
                         help="host:port of the TPU inference sidecar "
                              "(with --algorithm ml)")
+    parser.add_argument("--seed-peer", default=None, action="append",
+                        help="host:port of a seed daemon's rpc surface "
+                             "(repeatable); first download of a task "
+                             "triggers its back-source there")
     parser.add_argument("--trainer", default="",
                         help="host:port of the trainer service; enables "
                              "periodic dataset upload")
@@ -89,8 +103,9 @@ def main(argv=None) -> int:
                         help="scheduler cluster id at the manager "
                              "(0 = manager default cluster)")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir)
+    init_tracing(args, "scheduler")
 
     service, server = build_scheduler(args)
     print(f"scheduler serving on {server.target}", flush=True)

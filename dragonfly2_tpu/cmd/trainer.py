@@ -10,6 +10,8 @@ import argparse
 import sys
 
 from dragonfly2_tpu.cmd.common import (
+    init_tracing,
+    parse_with_config,
     add_common_flags,
     init_logging,
     start_metrics_server,
@@ -27,8 +29,9 @@ def main(argv=None) -> int:
                              "(co-located deployment)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
     add_common_flags(parser)
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir)
+    init_tracing(args, "trainer")
 
     from dragonfly2_tpu import __version__
     from dragonfly2_tpu.rpc import serve

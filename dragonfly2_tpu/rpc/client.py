@@ -82,6 +82,12 @@ class ServiceClient:
             kind = self._kinds[method]
         except KeyError:
             raise AttributeError(method) from None
+        from dragonfly2_tpu.utils.tracing import (
+            default_tracer,
+            inject_metadata,
+        )
+
+        full = self.spec.full_method(method)
         if kind in (MethodKind.UNARY_UNARY, MethodKind.UNARY_STREAM):
             # unary_stream returns a lazy iterator that raises only at the
             # first next(); prefetch inside the retry loop so UNAVAILABLE is
@@ -89,11 +95,18 @@ class ServiceClient:
             prefetch = kind == MethodKind.UNARY_STREAM
 
             def invoke(request, timeout: Optional[float] = None, **kw):
-                return self._retrying(
-                    call, request, timeout=timeout, prefetch=prefetch, **kw
-                )
+                with default_tracer().span(f"rpc.client{full}",
+                                           target=self.target):
+                    # Inject INSIDE the span so the server's remote
+                    # parent is this client span, not its parent.
+                    kw.setdefault("metadata", inject_metadata([]))
+                    return self._retrying(
+                        call, request, timeout=timeout, prefetch=prefetch,
+                        **kw
+                    )
         else:
             def invoke(request_iterator, timeout: Optional[float] = None, **kw):
+                kw.setdefault("metadata", inject_metadata([]))
                 return call(request_iterator, timeout=timeout, **kw)
         invoke.__name__ = method
         return invoke

@@ -57,29 +57,40 @@ def _already_aborted(context) -> bool:
 
 
 def _wrap(fn: Callable, name: str) -> Callable:
-    """Log + convert uncaught impl errors to INTERNAL with a message."""
+    """Log + convert uncaught impl errors to INTERNAL; open a server span
+    continuing the caller's trace context (the otelgrpc stats-handler
+    role, cmd/dependency/dependency.go:263-295)."""
+    from dragonfly2_tpu.utils.tracing import default_tracer, extract_metadata
 
     def call(request_or_iterator, context):
-        try:
-            return fn(request_or_iterator, context)
-        except grpc.RpcError:
-            raise
-        except Exception as exc:  # noqa: BLE001 — service boundary
-            if _already_aborted(context):
+        remote = extract_metadata(context.invocation_metadata())
+        with default_tracer().span(f"rpc.server{name}",
+                                   remote_parent=remote):
+            try:
+                return fn(request_or_iterator, context)
+            except grpc.RpcError:
                 raise
-            logger.exception("rpc %s failed", name)
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+            except Exception as exc:  # noqa: BLE001 — service boundary
+                if _already_aborted(context):
+                    raise
+                logger.exception("rpc %s failed", name)
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(exc).__name__}: {exc}")
 
     def call_gen(request_or_iterator, context):
-        try:
-            yield from fn(request_or_iterator, context)
-        except grpc.RpcError:
-            raise
-        except Exception as exc:  # noqa: BLE001
-            if _already_aborted(context):
+        remote = extract_metadata(context.invocation_metadata())
+        with default_tracer().span(f"rpc.server{name}",
+                                   remote_parent=remote):
+            try:
+                yield from fn(request_or_iterator, context)
+            except grpc.RpcError:
                 raise
-            logger.exception("rpc %s failed", name)
-            context.abort(grpc.StatusCode.INTERNAL, f"{type(exc).__name__}: {exc}")
+            except Exception as exc:  # noqa: BLE001
+                if _already_aborted(context):
+                    raise
+                logger.exception("rpc %s failed", name)
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"{type(exc).__name__}: {exc}")
 
     import inspect
 

@@ -61,9 +61,10 @@ class GNNTrainConfig:
     # compile callback fires once with measured compile seconds.
     progress_callback: Optional[Callable[[int, float], None]] = None
     compile_callback: Optional[Callable[[float], None]] = None
-    # Wall-clock cap for the eval pass (None = run it all). When exceeded,
-    # metrics come from the chunks actually scored — still exact per-edge
-    # accounting over a prefix of the (arbitrary-order) eval split.
+    # Wall-clock cap for the eval pass (None = run it all; 0 = skip eval
+    # entirely, metrics report 0/nan). When exceeded, metrics come from
+    # the chunks actually scored — still exact per-edge accounting over a
+    # prefix of the (arbitrary-order) eval split.
     eval_max_seconds: Optional[float] = None
     # On-device fanout sampling (train/fused_sampling.py): the CSR tables
     # live in HBM and sampling fuses into the jitted step; the host ships
@@ -336,7 +337,12 @@ def train_gnn(
         _time.perf_counter() + config.eval_max_seconds
         if config.eval_max_seconds is not None else None)
 
-    if config.device_sample:
+    if config.eval_max_seconds == 0.0:
+        # Explicit skip: not even one chunk (its compile alone can cost
+        # more than a sweep iteration's whole budget); metrics come from
+        # the shared zero-cm computation below.
+        pass
+    elif config.device_sample:
         eval_edges = put_edge_tables(
             eval_sampler.edge_src, eval_sampler.edge_dst,
             eval_sampler.labels, mesh)

@@ -47,11 +47,17 @@ class CertAuthority:
 
     def __init__(self, work_dir: str, ca_cert_path: str = "",
                  ca_key_path: str = "", valid_days: int = 365):
+        from dragonfly2_tpu.utils.ttlcache import TTLCache
+
         self.work_dir = work_dir
         self.valid_days = valid_days
         os.makedirs(work_dir, exist_ok=True)
         self._lock = threading.Lock()
         self._leaf_paths: Dict[str, Tuple[str, str]] = {}
+        # Leaf revalidation (parse + ECDSA verify) is file I/O on the TLS
+        # handshake path — remember a positive verdict for a while
+        # instead of re-verifying per CONNECT.
+        self._validated = TTLCache(default_ttl=600.0)
         if ca_cert_path and ca_key_path:
             with open(ca_key_path, "rb") as f:
                 self._ca_key = serialization.load_pem_private_key(
@@ -118,7 +124,7 @@ class CertAuthority:
         expired leaves or leaves from a replaced CA."""
         with self._lock:
             cached = self._leaf_paths.get(host)
-            if cached is not None and self._leaf_usable(cached[0]):
+            if cached is not None and host in self._validated:
                 return cached
             safe = host.replace(":", "_").replace("/", "_")
             cert_path = os.path.join(self.work_dir, f"leaf-{safe}.pem")
@@ -127,6 +133,7 @@ class CertAuthority:
                     and self._leaf_usable(cert_path)):
                 self._mint(host, cert_path, key_path)
             self._leaf_paths[host] = (cert_path, key_path)
+            self._validated.set(host, True)
             return cert_path, key_path
 
     def _leaf_usable(self, cert_path: str) -> bool:
@@ -136,8 +143,12 @@ class CertAuthority:
         except (OSError, ValueError):
             return False
         now = datetime.datetime.now(datetime.timezone.utc)
+        # Freshness margin: re-mint a leaf nearing expiry, but never so
+        # aggressively that short valid_days re-mint on every handshake.
+        lifetime = _ONE_DAY * self.valid_days
+        margin = min(_ONE_DAY, lifetime / 4)
         if not (leaf.not_valid_before_utc <= now
-                < leaf.not_valid_after_utc - _ONE_DAY):
+                < leaf.not_valid_after_utc - margin):
             return False
         if leaf.issuer != self._ca_cert.subject:
             return False

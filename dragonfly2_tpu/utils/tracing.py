@@ -1,0 +1,138 @@
+"""Distributed span tracing for the control plane.
+
+Reference counterpart: the otel/jaeger plumbing in
+cmd/dependency/dependency.go:263-295 (tracer init), the otelgrpc stats
+handlers on every pkg/rpc client, and explicit spans in the peer engine
+(peertask_conductor.go:255 SpanRegisterTask). TPU-native rebuild keeps the
+shape but not the dependency: spans are JSONL records written through a
+size-rotated file (jaeger has no collector in this image; the records
+carry the same trace/span/parent ids so any OTLP shipper can forward
+them), and trace context propagates across processes in gRPC invocation
+metadata (``df2-trace``), mirroring W3C traceparent.
+
+Usage::
+
+    tracer = Tracer("scheduler", out_dir="/var/log/df2")
+    with tracer.span("schedule", peer_id=pid):
+        ...
+
+A disabled tracer (no out_dir) costs one contextvar lookup per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from typing import Iterator, Optional, Tuple
+
+_current: contextvars.ContextVar[Optional[Tuple[str, str]]] = \
+    contextvars.ContextVar("df2_trace", default=None)
+
+TRACE_METADATA_KEY = "df2-trace"
+
+
+def current_trace_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span, if any."""
+    return _current.get()
+
+
+def inject_metadata(metadata: list) -> list:
+    """Append the active trace context as gRPC invocation metadata."""
+    ctx = _current.get()
+    if ctx is not None:
+        metadata = list(metadata) + [(TRACE_METADATA_KEY,
+                                      f"{ctx[0]}-{ctx[1]}")]
+    return metadata
+
+
+def extract_metadata(invocation_metadata) -> Optional[Tuple[str, str]]:
+    for key, value in invocation_metadata or ():
+        if key == TRACE_METADATA_KEY and "-" in value:
+            trace_id, _, span_id = value.partition("-")
+            return trace_id, span_id
+    return None
+
+
+class Tracer:
+    """Per-service span recorder with rotated JSONL output."""
+
+    def __init__(self, service: str, out_dir: str = "",
+                 max_bytes: int = 32 * 1024 * 1024, backups: int = 2):
+        self.service = service
+        self.enabled = bool(out_dir)
+        self._lock = threading.Lock()
+        self._path = (os.path.join(out_dir, f"trace-{service}.jsonl")
+                      if out_dir else "")
+        self.max_bytes = max_bytes
+        self.backups = backups
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, remote_parent: Tuple[str, str] | None = None,
+             **attrs) -> Iterator[dict]:
+        if not self.enabled:
+            yield {}
+            return
+        parent = remote_parent or _current.get()
+        trace_id = parent[0] if parent else secrets.token_hex(8)
+        span_id = secrets.token_hex(4)
+        record = {
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent[1] if parent else "",
+            "service": self.service,
+            "name": name,
+            "start": time.time(),
+            "attrs": attrs,
+            "status": "ok",
+        }
+        token = _current.set((trace_id, span_id))
+        t0 = time.perf_counter()
+        try:
+            yield record
+        except BaseException as exc:
+            record["status"] = f"error: {type(exc).__name__}"
+            raise
+        finally:
+            _current.reset(token)
+            record["duration_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3)
+            self._write(record)
+
+    def _write(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        with self._lock:
+            try:
+                if (os.path.exists(self._path)
+                        and os.path.getsize(self._path) > self.max_bytes):
+                    self._rotate()
+                with open(self._path, "a") as f:
+                    f.write(line)
+            except OSError:
+                pass  # tracing must never take the service down
+
+    def _rotate(self) -> None:
+        for i in range(self.backups - 1, 0, -1):
+            src = f"{self._path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self._path}.{i + 1}")
+        os.replace(self._path, f"{self._path}.1")
+
+
+_NOOP = Tracer("noop")
+_default = _NOOP
+
+
+def set_default_tracer(tracer: Tracer) -> None:
+    global _default
+    _default = tracer
+
+
+def default_tracer() -> Tracer:
+    return _default

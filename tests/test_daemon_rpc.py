@@ -160,6 +160,67 @@ class TestDaemonRpcSurface:
             client.close()
 
 
+class TestRemoteSeedPeer:
+    def test_scheduler_triggers_seed_over_wire(self, tmp_path, origin):
+        """Full cross-process topology over real gRPC: scheduler with a
+        GrpcSeedPeerClient, a seed daemon serving ObtainSeeds, and a
+        normal peer — the first download triggers the seed's back-source
+        and the peer pulls pieces from the seed, not the origin."""
+        from dragonfly2_tpu.client.rpcserver import GrpcSeedPeerClient
+        from dragonfly2_tpu.scheduler.scheduling.core import (
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.utils.hosttypes import HostType
+
+        # Seed daemon + its rpc surface (registered against the scheduler
+        # service we're about to build — wire client, so build order is:
+        # service without seed client, then bind).
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(
+                BaseEvaluator(),
+                SchedulingConfig(retry_interval=0.01,
+                                 retry_back_to_source_limit=2)),
+            storage=Storage(str(tmp_path / "datasets")),
+        )
+        sched_server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))])
+        seed = Daemon(
+            BalancedSchedulerClient([sched_server.target]),
+            DaemonConfig(storage_root=str(tmp_path / "seed"),
+                         hostname="seed-a", host_type=HostType.SUPER_SEED))
+        seed.start()
+        seed_rpc = serve_daemon_rpc(seed)
+        service.seed_peer_client = GrpcSeedPeerClient([seed_rpc.target])
+
+        peer = Daemon(
+            BalancedSchedulerClient([sched_server.target]),
+            DaemonConfig(storage_root=str(tmp_path / "peer"),
+                         hostname="peer-a"))
+        peer.start()
+        try:
+            content = os.urandom(4 * 1024 * 1024 + 11)
+            (origin.root_dir / "seeded.bin").write_bytes(content)
+            out = tmp_path / "out.bin"
+            result = peer.download_file(origin.url("seeded.bin"),
+                                        output_path=str(out))
+            assert result.success, result.error
+            assert out.read_bytes() == content
+            # The seed holds the task too — its back-source ran.
+            assert wait_for(lambda: any(
+                r.task.content_length == len(content)
+                for r in service.storage.list_download()))
+            from dragonfly2_tpu.utils import idgen
+
+            task_id = idgen.task_id_v1(origin.url("seeded.bin"))
+            assert seed.storage.find_completed_task(task_id) is not None
+        finally:
+            peer.stop()
+            seed_rpc.stop()
+            seed.stop()
+            sched_server.stop()
+
+
 class TestBalancedSchedulers:
     def test_task_affinity_routes_by_ring(self, tmp_path, origin):
         """Tasks spread across replicas by hash, and each task's download

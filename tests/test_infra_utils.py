@@ -1,0 +1,147 @@
+"""Shared-lib additions: TTL cache, dfpath layout, YAML config layering,
+stress harness (pkg/cache, pkg/dfpath, viper config, test/tools/stress)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils.ttlcache import NO_EXPIRATION, TTLCache
+
+
+class TestTTLCache:
+    def test_set_get_expire(self):
+        c = TTLCache(default_ttl=0.05)
+        c.set("a", 1)
+        assert c.get("a") == 1
+        time.sleep(0.07)
+        assert c.get("a") is None
+        assert c.hits == 1 and c.misses == 1
+
+    def test_no_expiration_sentinel(self):
+        c = TTLCache(default_ttl=0.01)
+        c.set("k", "v", ttl=NO_EXPIRATION)
+        time.sleep(0.03)
+        assert c.get("k") == "v"
+
+    def test_get_or_set_and_len(self):
+        c = TTLCache(default_ttl=10)
+        calls = []
+        assert c.get_or_set("x", lambda: calls.append(1) or 42) == 42
+        assert c.get_or_set("x", lambda: calls.append(1) or 43) == 42
+        assert len(calls) == 1
+        assert len(c) == 1 and "x" in c
+
+    def test_sweep_removes_expired(self):
+        c = TTLCache(default_ttl=0.01)
+        for i in range(5):
+            c.set(i, i)
+        c.set("keep", 1, ttl=10)
+        time.sleep(0.03)
+        assert c.sweep() == 5
+        assert len(c) == 1
+
+
+class TestDfPath:
+    def test_layout_and_ensure(self, tmp_path):
+        from dragonfly2_tpu.utils.dfpath import for_service
+
+        p = for_service("scheduler", home=str(tmp_path)).ensure()
+        for d in (p.data_dir, p.cache_dir, p.log_dir, p.run_dir,
+                  p.plugin_dir):
+            assert os.path.isdir(d)
+            assert d.startswith(str(tmp_path))
+        assert "scheduler" in p.data_dir
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        from dragonfly2_tpu.utils import dfpath
+
+        monkeypatch.setenv("DF2_HOME", str(tmp_path / "custom"))
+        assert dfpath.for_service("x").home == str(tmp_path / "custom")
+
+
+class TestYamlConfig:
+    def _parser(self):
+        from dragonfly2_tpu.cmd.common import add_common_flags
+
+        parser = argparse.ArgumentParser("t")
+        parser.add_argument("--port", type=int, default=1)
+        parser.add_argument("--name", default="d")
+        parser.add_argument("--scheduler", action="append", default=None)
+        add_common_flags(parser)
+        return parser
+
+    def test_yaml_sets_defaults_flags_override(self, tmp_path):
+        from dragonfly2_tpu.cmd.common import parse_with_config
+
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("port: 9\nname: from-file\n"
+                       "scheduler: [a:1, b:2]\nverbose: true\n")
+        args = parse_with_config(
+            self._parser(), ["--config", str(cfg), "--name", "from-flag"])
+        assert args.port == 9
+        assert args.name == "from-flag"      # explicit flag wins
+        assert args.scheduler == ["a:1", "b:2"]
+        assert args.verbose is True
+
+    def test_dashed_keys_and_scalar_to_append(self, tmp_path):
+        from dragonfly2_tpu.cmd.common import parse_with_config
+
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("log-dir: /tmp/x\nscheduler: solo:1\n")
+        args = parse_with_config(self._parser(), ["--config", str(cfg)])
+        assert args.log_dir == "/tmp/x"
+        assert args.scheduler == ["solo:1"]
+
+    def test_unknown_key_rejected(self, tmp_path):
+        from dragonfly2_tpu.cmd.common import parse_with_config
+
+        cfg = tmp_path / "c.yaml"
+        cfg.write_text("no_such_option: 1\n")
+        with pytest.raises(SystemExit):
+            parse_with_config(self._parser(), ["--config", str(cfg)])
+
+
+class TestStressHarness:
+    def test_distribution_over_fileserver(self, tmp_path):
+        from dragonfly2_tpu.cmd.stress import run_stress
+        from tests.fileserver import FileServer
+
+        root = tmp_path / "www"
+        root.mkdir()
+        (root / "f.bin").write_bytes(os.urandom(100_000))
+        with FileServer(str(root)) as fs:
+            out = run_stress(fs.url("f.bin"), concurrency=4, requests=20)
+        assert out["succeeded"] == 20 and out["failed"] == 0
+        assert out["latency_ms"]["p50"] > 0
+        assert out["latency_ms"]["p99"] >= out["latency_ms"]["p50"]
+        assert out["throughput_mbps"] > 0
+
+    def test_error_taxonomy(self, tmp_path):
+        from dragonfly2_tpu.cmd.stress import run_stress
+        from tests.fileserver import FileServer
+
+        root = tmp_path / "www"
+        root.mkdir()
+        with FileServer(str(root)) as fs:
+            out = run_stress(fs.url("missing.bin"), concurrency=2,
+                             requests=6)
+        assert out["failed"] == 6
+        assert out["errors"] == {"HTTP 404": 6}
+
+    def test_cli_prints_one_json_line(self, tmp_path, capsys):
+        from dragonfly2_tpu.cmd.stress import main
+        from tests.fileserver import FileServer
+
+        root = tmp_path / "www"
+        root.mkdir()
+        (root / "f.bin").write_bytes(b"x" * 1000)
+        with FileServer(str(root)) as fs:
+            rc = main([fs.url("f.bin"), "-c", "2", "-n", "4"])
+        assert rc == 0
+        line = capsys.readouterr().out.strip()
+        assert json.loads(line)["succeeded"] == 4

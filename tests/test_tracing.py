@@ -1,0 +1,105 @@
+"""Span tracing: local spans, rotation, and cross-process propagation
+through the real gRPC layer (otelgrpc stats-handler role)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dragonfly2_tpu.utils.tracing import (
+    Tracer,
+    current_trace_context,
+    default_tracer,
+    extract_metadata,
+    inject_metadata,
+    set_default_tracer,
+)
+
+
+def read_spans(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+class TestTracer:
+    def test_nested_spans_share_trace(self, tmp_path):
+        t = Tracer("svc", out_dir=str(tmp_path))
+        with t.span("outer", a=1):
+            with t.span("inner"):
+                pass
+        spans = read_spans(tmp_path / "trace-svc.jsonl")
+        inner, outer = spans  # inner closes first
+        assert inner["trace_id"] == outer["trace_id"]
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["parent_id"] == ""
+        assert outer["attrs"] == {"a": 1}
+        assert inner["duration_ms"] >= 0
+
+    def test_error_status_recorded(self, tmp_path):
+        t = Tracer("svc", out_dir=str(tmp_path))
+        with pytest.raises(ValueError):
+            with t.span("boom"):
+                raise ValueError("x")
+        spans = read_spans(tmp_path / "trace-svc.jsonl")
+        assert spans[0]["status"] == "error: ValueError"
+
+    def test_disabled_tracer_is_noop(self):
+        t = Tracer("off")
+        with t.span("anything"):
+            assert current_trace_context() is None
+
+    def test_metadata_roundtrip(self, tmp_path):
+        t = Tracer("svc", out_dir=str(tmp_path))
+        with t.span("client-side"):
+            md = inject_metadata([("other", "kv")])
+        parsed = extract_metadata(md)
+        assert parsed is not None
+        spans = read_spans(tmp_path / "trace-svc.jsonl")
+        assert parsed == (spans[0]["trace_id"], spans[0]["span_id"])
+
+    def test_rotation(self, tmp_path):
+        t = Tracer("svc", out_dir=str(tmp_path), max_bytes=500, backups=2)
+        for i in range(50):
+            with t.span(f"s{i}", filler="x" * 50):
+                pass
+        assert (tmp_path / "trace-svc.jsonl.1").exists()
+
+
+class TestCrossProcessPropagation:
+    def test_grpc_server_continues_client_trace(self, tmp_path):
+        """client span → metadata → server span: one trace id across the
+        wire, parent chain intact."""
+        from dragonfly2_tpu.rpc import ServiceClient, serve
+        from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
+        from dragonfly2_tpu.scheduler.rpcserver import Empty
+
+        spec = ServiceSpec("df2.test.Echo",
+                           {"Ping": MethodKind.UNARY_UNARY})
+
+        class Impl:
+            def Ping(self, request, context):  # noqa: N802
+                return Empty()
+
+        tracer = Tracer("both-sides", out_dir=str(tmp_path))
+        set_default_tracer(tracer)
+        try:
+            server = serve([(spec, Impl())])
+            cli = ServiceClient(server.target, spec)
+            with tracer.span("root"):
+                cli.Ping(Empty(), timeout=10)
+            cli.close()
+            server.stop()
+        finally:
+            set_default_tracer(Tracer("noop"))
+        spans = read_spans(tmp_path / "trace-both-sides.jsonl")
+        by_name = {s["name"]: s for s in spans}
+        root = by_name["root"]
+        client = by_name["rpc.client/df2.test.Echo/Ping"]
+        srv = by_name["rpc.server/df2.test.Echo/Ping"]
+        assert client["trace_id"] == root["trace_id"] == srv["trace_id"]
+        assert client["parent_id"] == root["span_id"]
+        assert srv["parent_id"] == client["span_id"]
+
+    def test_default_tracer_off_by_default(self):
+        assert default_tracer().enabled is False
