@@ -194,6 +194,47 @@ def make_fused_train_step(model: GraphSAGE, mesh: MeshContext,
     )
 
 
+def make_fused_multi_step(model: GraphSAGE, mesh: MeshContext,
+                          fanouts: tuple, steps_per_call: int):
+    """jit: (state, graph, edges, edge_ids[K, B], key) → (state, losses[K]).
+
+    K fused steps under one ``lax.scan`` — one dispatch amortizes the
+    host→device round trip across K optimizer updates. On a remote/
+    tunneled accelerator (or any host-bound pipeline) per-step dispatch
+    is the throughput ceiling; scan moves the loop onto the device the
+    XLA-idiomatic way (no Python control flow in the compiled program).
+    """
+    b = mesh.batch_sharding
+    ids_sharding = mesh.shard_spec(None, "data")  # [K, B]: B over data
+
+    def multi_step(state, graph, edges, edge_ids_k, key):
+        def body(carry, edge_ids):
+            state = carry
+            step_key = jax.random.fold_in(key, state.step)
+            src = _gather(edges.src, edge_ids, b)
+            dst = _gather(edges.dst, edge_ids, b)
+            labels = _gather(edges.labels, edge_ids, b)
+
+            def loss_fn(params):
+                logits = sample_and_apply(
+                    model, params, graph, src, dst, step_key, fanouts, b)
+                return optax.sigmoid_binary_cross_entropy(
+                    logits, labels).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        state, losses = jax.lax.scan(body, state, edge_ids_k)
+        return state, losses
+
+    return jax.jit(
+        multi_step,
+        in_shardings=(None, mesh.replicated, mesh.replicated, ids_sharding,
+                      mesh.replicated),
+        donate_argnums=(0,),
+    )
+
+
 def make_fused_eval_step(model: GraphSAGE, mesh: MeshContext,
                          fanouts: tuple):
     """jit: (params, graph, edges, edge_ids[B], weights[B], key) →

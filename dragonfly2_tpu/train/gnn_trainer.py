@@ -72,6 +72,11 @@ class GNNTrainConfig:
     # H2D traffic than host-side sampling; False keeps the host path
     # (equivalence tests, and graphs too large for replicated HBM tables).
     device_sample: bool = True
+    # >1 runs this many optimizer steps per dispatch under lax.scan
+    # (device_sample only): amortizes host→device round trips when
+    # dispatch latency bounds throughput (remote/tunneled accelerators).
+    # Budget checks and progress publishing then happen per dispatch.
+    steps_per_call: int = 1
     prefetch_depth: int = 2
     prefetch_workers: int = 2
     # When set, the step loop runs under jax.profiler.trace writing an
@@ -256,7 +261,16 @@ def train_gnn(
         train_edges = put_edge_tables(
             train_sampler.edge_src, train_sampler.edge_dst,
             train_sampler.labels, mesh)
-        fused_step = make_fused_train_step(model, mesh, config.fanouts)
+        k = max(int(config.steps_per_call), 1)
+        if k > 1:
+            from dragonfly2_tpu.train.fused_sampling import (
+                make_fused_multi_step,
+            )
+
+            fused_step = make_fused_multi_step(model, mesh, config.fanouts, k)
+            ids_sharding = mesh.shard_spec(None, "data")
+        else:
+            fused_step = make_fused_train_step(model, mesh, config.fanouts)
         base_key = mesh.put_replicated(jax.random.key(config.seed + 1))
         train_step = None
         # The fused step has near-zero host work, so async dispatch stacks
@@ -272,21 +286,35 @@ def train_gnn(
     def place(batch) -> tuple:
         return tuple(mesh.put_batch(a) for a in batch.astuple())
 
+    group = max(int(config.steps_per_call), 1) if config.device_sample else 1
+
     def train_tasks():
         for epoch in range(config.epochs):
             order = np.random.default_rng((config.seed, epoch)).permutation(
                 train_sampler.n_edges)
-            for step, start in enumerate(
-                    range(0, train_sampler.n_edges - batch_size + 1,
-                          batch_size)):
-                yield epoch, step, order[start:start + batch_size]
+            starts = range(0, train_sampler.n_edges - batch_size + 1,
+                           batch_size)
+            if group == 1:
+                for step, start in enumerate(starts):
+                    yield epoch, step, order[start:start + batch_size]
+            else:
+                # K-step groups for one scan dispatch; the within-epoch
+                # remainder is dropped like remainder batches are.
+                starts = list(starts)
+                for gi in range(len(starts) // group):
+                    chunk = starts[gi * group:(gi + 1) * group]
+                    yield epoch, gi, np.stack(
+                        [order[s:s + batch_size] for s in chunk])
 
     def build(task):
         # Per-task RNG: deterministic regardless of worker interleaving.
         epoch, step, ids = task
         if config.device_sample:
-            # Device path ships only the id slice; sampling runs on chip.
-            return epoch, mesh.put_batch(ids.astype(np.int32))
+            # Device path ships only the id slice(s); sampling runs on chip.
+            ids = ids.astype(np.int32)
+            if group > 1:
+                return epoch, jax.device_put(ids, ids_sharding)
+            return epoch, mesh.put_batch(ids)
         rng = np.random.default_rng((config.seed, epoch, step, 3))
         return epoch, place(train_sampler.sample_indices(ids, rng))
 
@@ -317,8 +345,8 @@ def train_gnn(
                     jax.block_until_ready(loss)
             else:
                 state, loss = train_step(state, nf_dev, *arrays)
-            epoch_losses.append(loss)
-            if budget.tick(batch_size, loss):
+            epoch_losses.append(jnp.mean(loss) if group > 1 else loss)
+            if budget.tick(batch_size * group, loss):
                 stream.close()
                 break
         if epoch_losses:
@@ -391,7 +419,7 @@ def train_gnn(
         recall=metrics["recall"],
         f1=metrics["f1"],
         accuracy=metrics["accuracy"],
-        samples_per_sec=budget.samples_per_sec(batch_size),
+        samples_per_sec=budget.samples_per_sec(batch_size * group),
         history=history,
         steps=budget.steps,
         compile_seconds=budget.compile_seconds,

@@ -36,6 +36,11 @@ class MLPTrainConfig:
     # Wall-clock budget for the step loop (compile excluded); None = run
     # all epochs (see GNNTrainConfig.max_seconds).
     max_seconds: float | None = None
+    # Incremental publishing hooks (see GNNTrainConfig): progress fires
+    # every ~25 completed steps with (steps, samples_per_sec); compile
+    # fires once with the first-step compile seconds.
+    progress_callback: object = None
+    compile_callback: object = None
 
 
 @dataclass
@@ -140,7 +145,9 @@ def train_mlp(
     eval_step = _make_eval_step(model, mesh, t_mean, t_std)
 
     history = []
-    budget = StepBudget(config.max_seconds)
+    budget = StepBudget(config.max_seconds,
+                        on_compile=config.compile_callback,
+                        on_progress=config.progress_callback)
     stop = False
     for epoch in range(config.epochs):
         losses = []
