@@ -86,6 +86,10 @@ class Daemon:
         )
         self.host_id = idgen.host_id_v1(config.hostname, self.upload.port)
         self.prober = None
+        # Constructed eagerly: its per-task in-flight dedup only works as
+        # a singleton, and a lazy check-then-set would race concurrent
+        # first triggers.
+        self._seed_client = SeedPeerDaemonClient(self)
         self._started = False
         self._conductors_lock = threading.Lock()
         self._conductors: Dict[str, PeerTaskConductor] = {}
@@ -305,13 +309,13 @@ class Daemon:
     # -- seeder surface (scheduler → seed daemon) --------------------------
 
     def seed_client(self) -> "SeedPeerDaemonClient":
-        """One instance per daemon — its per-task in-flight dedup only
-        works when every trigger path (in-proc binding AND the ObtainSeeds
-        wire) shares the same map."""
-        client = getattr(self, "_seed_client", None)
-        if client is None:
-            client = self._seed_client = SeedPeerDaemonClient(self)
-        return client
+        """The daemon's singleton seeder binding — every trigger path
+        (in-proc AND the ObtainSeeds wire) shares one in-flight map."""
+        return self._seed_client
+
+
+class SeedBusyError(RuntimeError):
+    """All owner trigger slots are in flight; the caller retries later."""
 
 
 class SeedPeerDaemonClient:
@@ -319,23 +323,53 @@ class SeedPeerDaemonClient:
     ObtainSeeds semantics (seeder.go:53): trigger a back-source download on
     the seed so its pieces become the task's origin in the mesh."""
 
+    # Concurrent back-source downloads are disk+network heavy; cap the
+    # OWNERS only (duplicates just wait on an event and must not consume
+    # slots — 8 re-triggers of one slow task would otherwise starve every
+    # other task, the reverse of what a cap is for).
+    MAX_CONCURRENT_TRIGGERS = 8
+
+    class _Run:
+        """One trigger attempt: outcome lives ON the run object, so a
+        waiter always reads the outcome of the run it waited for — a
+        later re-trigger can neither erase nor replace it. Runs die with
+        their last reference (no unbounded per-task map)."""
+
+        __slots__ = ("event", "outcome")
+
+        def __init__(self):
+            self.event = threading.Event()
+            self.outcome = False
+
     def __init__(self, daemon: Daemon):
         self.daemon = daemon
         self._inflight_lock = threading.Lock()
-        self._inflight: Dict[str, threading.Event] = {}
-        self._outcomes: Dict[str, bool] = {}
+        self._inflight: Dict[str, "SeedPeerDaemonClient._Run"] = {}
+        self._slots = threading.Semaphore(self.MAX_CONCURRENT_TRIGGERS)
 
     def trigger_task(self, task) -> bool:
         """Returns whether the seed holds the task. A duplicate concurrent
         trigger WAITS for the in-flight one and reports its real outcome —
-        preheat's synchronous contract must never claim warm-before-done."""
+        preheat's synchronous contract must never claim warm-before-done.
+        Raises :class:`SeedBusyError` when all owner slots are taken."""
         with self._inflight_lock:
             existing = self._inflight.get(task.id)
             if existing is None:
-                self._inflight[task.id] = threading.Event()
+                if not self._slots.acquire(blocking=False):
+                    raise SeedBusyError(
+                        f"{self.MAX_CONCURRENT_TRIGGERS} seed triggers "
+                        "already in flight")
+                run = self._inflight[task.id] = self._Run()
         if existing is not None:
-            existing.wait(timeout=self.daemon.config.task_options.timeout)
-            return self._outcomes.get(task.id, False)
+            existing.event.wait(
+                timeout=self.daemon.config.task_options.timeout)
+            return existing.outcome if existing.event.is_set() else False
+        try:
+            return self._run_trigger(task, run)
+        finally:
+            self._slots.release()
+
+    def _run_trigger(self, task, run: "SeedPeerDaemonClient._Run") -> bool:
         try:
             daemon = self.daemon
             peer_id = (
@@ -374,10 +408,9 @@ class SeedPeerDaemonClient:
             if not result.success:
                 logger.warning("seed trigger for %s failed: %s",
                                task.id, result.error)
-            self._outcomes[task.id] = result.success
+            run.outcome = result.success
             return result.success
         finally:
             with self._inflight_lock:
-                done = self._inflight.pop(task.id, None)
-            if done is not None:
-                done.set()
+                self._inflight.pop(task.id, None)
+            run.event.set()

@@ -182,6 +182,18 @@ DAEMON_SPEC = ServiceSpec(
 # ----------------------------------------------------------------------
 
 
+@dataclass
+class _SeedTask:
+    """Task-shaped argument for SeedPeerDaemonClient.trigger_task (the
+    wire request carries the same data under different field names)."""
+
+    id: str
+    url: str
+    tag: str = ""
+    filtered_query_params: list = field(default_factory=list)
+    request_header: dict = field(default_factory=dict)
+
+
 class DaemonRpcService:
     """gRPC method impls over a running :class:`client.daemon.Daemon`."""
 
@@ -285,23 +297,19 @@ class DaemonRpcService:
     def ObtainSeeds(self, request: ObtainSeedsRequest, context) -> ObtainSeedsResponse:  # noqa: N802
         """Seeder surface: the wire form of SeedPeerDaemonClient — a
         remote scheduler triggers this daemon's back-source download so
-        its pieces become the task's origin in the mesh."""
-        from dataclasses import dataclass as _dc
-        from dataclasses import field as _field
-
-        @_dc
-        class _TaskShim:
-            id: str
-            url: str
-            tag: str = ""
-            filtered_query_params: list = _field(default_factory=list)
-            request_header: dict = _field(default_factory=dict)
+        its pieces become the task's origin in the mesh. Concurrency is
+        capped inside the seed client (OWNERS only — duplicate triggers
+        of an in-flight task wait without consuming a slot); beyond the
+        cap callers get a fast 'busy' failure to retry."""
+        from dragonfly2_tpu.client.daemon import SeedBusyError
 
         try:
-            ok = self.daemon.seed_client().trigger_task(_TaskShim(
+            ok = self.daemon.seed_client().trigger_task(_SeedTask(
                 id=request.task_id, url=request.url, tag=request.tag,
                 filtered_query_params=list(request.filtered_query_params),
                 request_header=dict(request.request_header)))
+        except SeedBusyError as exc:
+            return ObtainSeedsResponse(success=False, error=f"busy: {exc}")
         except Exception as exc:  # noqa: BLE001 — report, don't abort
             return ObtainSeedsResponse(success=False,
                                        error=f"{type(exc).__name__}: {exc}")

@@ -17,6 +17,7 @@ import os
 import shutil
 import threading
 import time
+import uuid
 from dataclasses import asdict, dataclass, field
 from typing import BinaryIO, Dict, Iterable, List, Optional, Tuple
 
@@ -187,10 +188,17 @@ class TaskStorage:
     def persist(self) -> None:
         tmp = os.path.join(self.directory, METADATA_FILE + ".tmp")
         with self._lock:
+            if self._invalid:
+                return  # deleted underneath us; nothing to persist
             raw = self.meta.to_json()
-        with open(tmp, "w") as f:
-            f.write(raw)
-        os.replace(tmp, os.path.join(self.directory, METADATA_FILE))
+        try:
+            with open(tmp, "w") as f:
+                f.write(raw)
+            os.replace(tmp, os.path.join(self.directory, METADATA_FILE))
+        except FileNotFoundError:
+            # Directory raced away (concurrent delete_task/GC): a store
+            # that lost its directory is dead weight, not a crash.
+            self.invalidate()
 
     # -- read path ---------------------------------------------------------
 
@@ -373,28 +381,66 @@ class StorageManager:
             )
         return store.read_piece(num=num, rng=rng)
 
+    # A not-yet-done registration touched within this window is a live
+    # writer; rmtree under it turns its next piece write into ENOENT and
+    # fails the download (observed under churn). Abandoned (failed) tasks
+    # stop touching and become reclaimable once the grace passes.
+    ACTIVE_WRITER_GRACE_SECONDS = 60.0
+
     def delete_task(self, task_id: str, peer_id: str | None = None) -> int:
-        """Remove task storage (all peers when peer_id is None)."""
+        """Remove task storage (all peers when peer_id is None), skipping
+        registrations that look actively written (not ``done`` and touched
+        within ACTIVE_WRITER_GRACE_SECONDS) — callers retry later; GC
+        sweeps them once they idle out."""
         removed = 0
+        now = time.monotonic()
+        tombstones = []
+        task_dir = os.path.join(self.opts.root, task_id)
         with self._lock:
             keys = [
                 k for k in self._tasks
                 if k[0] == task_id and (peer_id is None or k[1] == peer_id)
+                and (self._tasks[k].meta.done
+                     or now - self._tasks[k].last_access
+                     >= self.ACTIVE_WRITER_GRACE_SECONDS)
             ]
             for k in keys:
                 store = self._tasks.pop(k)
                 store.invalidate()
-                shutil.rmtree(store.directory, ignore_errors=True)
+                tombstones.append(self._tombstone(store.directory))
                 removed += 1
-        task_dir = os.path.join(self.opts.root, task_id)
-        if peer_id is None:
-            shutil.rmtree(task_dir, ignore_errors=True)
-        else:
-            try:  # reap the parent dir once its last peer is gone
-                os.rmdir(task_dir)
-            except OSError:
-                pass
+            # Task-dir decision under the SAME lock as the registration
+            # map (a check-then-delete outside it would raze a directory
+            # a concurrent register_task just created) — but the actual
+            # rmtree happens outside via tombstone rename, so a multi-GB
+            # delete never stalls every other registration/lookup.
+            live = any(k[0] == task_id for k in self._tasks)
+            if peer_id is None and not live:
+                tombstones.append(self._tombstone(task_dir))
+            else:
+                try:  # reap the parent dir once its last peer is gone
+                    os.rmdir(task_dir)
+                except OSError:
+                    pass
+        for tomb in tombstones:
+            if tomb:
+                shutil.rmtree(tomb, ignore_errors=True)
         return removed
+
+    def _tombstone(self, directory: str) -> str:
+        """Atomically rename a dir out of the namespace (cheap, under the
+        caller's lock); returns the tombstone path to rmtree lock-free.
+        Tombstones live in ``<root>/.trash`` — NOT beside the original —
+        so a per-peer delete leaves its parent task dir empty and the
+        os.rmdir reap actually succeeds."""
+        trash = os.path.join(self.opts.root, ".trash")
+        os.makedirs(trash, exist_ok=True)
+        tomb = os.path.join(trash, uuid.uuid4().hex)
+        try:
+            os.rename(directory, tomb)
+        except OSError:
+            return ""
+        return tomb
 
     def total_usage(self) -> int:
         with self._lock:
@@ -409,8 +455,7 @@ class StorageManager:
             items = sorted(self._tasks.items(), key=lambda kv: kv[1].last_access)
         for key, store in items:
             if now - store.last_access >= self.opts.task_expire_seconds:
-                self.delete_task(*key)
-                removed += 1
+                removed += self.delete_task(*key)
         if self.opts.disk_gc_threshold_bytes > 0:
             with self._lock:
                 items = sorted(
@@ -419,8 +464,9 @@ class StorageManager:
             for key, _ in items:
                 if self.total_usage() <= self.opts.disk_gc_threshold_bytes:
                     break
-                self.delete_task(*key)
-                removed += 1
+                # Count what delete_task actually reclaimed (it skips
+                # active writers under the grace window).
+                removed += self.delete_task(*key)
         return removed
 
     def persist_all(self) -> None:
