@@ -38,10 +38,22 @@ def run_stress(url: str, *, proxy: str = "", daemon: str = "",
     remaining = [requests]
 
     if daemon:
+        import threading as _threading
+
         from dragonfly2_tpu.client.rpcserver import RemoteDaemonClient
 
+        # One channel per worker thread, reused across its requests —
+        # per-request channel setup would measure gRPC connection churn,
+        # not the daemon.
+        tls = _threading.local()
+        clients: list = []
+
         def one() -> None:
-            client = RemoteDaemonClient(daemon)
+            client = getattr(tls, "client", None)
+            if client is None:
+                client = tls.client = RemoteDaemonClient(daemon)
+                with lock:
+                    clients.append(client)
             try:
                 t0 = time.perf_counter()
                 result = client.download(url, None, timeout=timeout)
@@ -55,8 +67,6 @@ def run_stress(url: str, *, proxy: str = "", daemon: str = "",
             except Exception as exc:  # noqa: BLE001 — taxonomy, not crash
                 with lock:
                     errors[type(exc).__name__] += 1
-            finally:
-                client.close()
     else:
         handlers = []
         if proxy:
@@ -96,6 +106,9 @@ def run_stress(url: str, *, proxy: str = "", daemon: str = "",
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
+    if daemon:
+        for c in clients:
+            c.close()
 
     latencies.sort()
     return {
@@ -136,7 +149,7 @@ def main(argv=None) -> int:
                         help="append the JSON result to this file")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir, service="stress")
 
     result = run_stress(
         args.url, proxy=args.proxy, daemon=args.daemon,

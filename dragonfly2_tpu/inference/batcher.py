@@ -1,0 +1,139 @@
+"""Request micro-batching for the inference sidecar.
+
+SURVEY §7 hard part: "<1 ms p50 inference in the scheduling loop …
+micro-batch requests". Each ParentScorer.score call pays one device
+dispatch; under concurrent scheduler load, per-request dispatch makes
+latency scale with queue depth. The batcher coalesces requests that
+arrive while a dispatch is in flight into ONE padded device call, so N
+concurrent requests share a single round trip — the worst-case extra
+latency is one in-flight dispatch, and throughput scales to
+``max_batch`` rows per dispatch.
+
+No timer: the worker blocks for the first request, then drains whatever
+queued while the previous dispatch ran (natural batching under load,
+zero added latency when idle).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Pending:
+    __slots__ = ("features", "event", "result", "error")
+
+    def __init__(self, features: np.ndarray):
+        self.features = features
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[Exception] = None
+
+
+class MicroBatcher:
+    """Thread-safe coalescing front for a :class:`ParentScorer`."""
+
+    def __init__(self, scorer, max_rows: Optional[int] = None):
+        self.scorer = scorer
+        self.max_rows = max_rows or scorer.max_batch
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="infer-microbatch")
+        self.dispatches = 0
+        self.coalesced_requests = 0
+        self._worker.start()
+
+    def score(self, features: np.ndarray, timeout: float = 30.0) -> np.ndarray:
+        """Blocking; same contract as ParentScorer.score."""
+        if self._closed:
+            raise RuntimeError("micro-batcher is closed (model reloaded)")
+        if len(features) == 0:
+            return np.zeros(0, np.float32)
+        if len(features) > self.max_rows:
+            raise ValueError(
+                f"batch {len(features)} exceeds max {self.max_rows}")
+        pending = _Pending(np.asarray(features, np.float32))
+        self._queue.put(pending)
+        if not pending.event.wait(timeout=timeout):
+            raise TimeoutError("micro-batched scoring timed out")
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def _loop(self) -> None:
+        carry: Optional[_Pending] = None
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                first = self._queue.get()
+                if first is None:
+                    # close(): serve everything already queued, then exit
+                    # — callers racing a model reload must never hang.
+                    self._drain_remaining()
+                    return
+            group: List[_Pending] = [first]
+            rows = len(first.features)
+            saw_sentinel = False
+            # Drain whatever is already queued, up to the device batch.
+            while rows < self.max_rows:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    saw_sentinel = True
+                    break
+                if rows + len(nxt.features) > self.max_rows:
+                    # Doesn't fit this dispatch — it LEADS the next group
+                    # (re-queueing to the back would let a stream of small
+                    # requests starve a large one past its timeout).
+                    carry = nxt
+                    break
+                group.append(nxt)
+                rows += len(nxt.features)
+            self._dispatch(group)
+            if saw_sentinel:
+                if carry is not None:
+                    self._dispatch([carry])
+                self._drain_remaining()
+                return
+
+    def _drain_remaining(self) -> None:
+        while True:
+            try:
+                pending = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if pending is not None:
+                self._dispatch([pending])
+
+    def _dispatch(self, group: List[_Pending]) -> None:
+        self.dispatches += 1
+        self.coalesced_requests += len(group)
+        try:
+            stacked = np.concatenate([p.features for p in group], axis=0)
+            scores = self.scorer.score(stacked)
+            off = 0
+            for p in group:
+                n = len(p.features)
+                p.result = scores[off:off + n]
+                off += n
+        except Exception as exc:  # noqa: BLE001 — fan the error out
+            for p in group:
+                p.error = exc
+        finally:
+            for p in group:
+                p.event.set()
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.put(None)
+        self._worker.join(timeout=5)
+        # A request that passed the closed check but enqueued after the
+        # worker's final drain would hang forever — sweep once more.
+        self._drain_remaining()

@@ -104,16 +104,26 @@ INFERENCE_SPEC = ServiceSpec(
 class _LoadedModel:
     version: str
     scorer: ParentScorer
+    batcher: object = None  # MicroBatcher when micro_batch enabled
+
+    def score(self, inputs):
+        return (self.batcher.score(inputs) if self.batcher is not None
+                else self.scorer.score(inputs))
 
 
 class InferenceService:
-    """Serves jit-compiled scorers reloaded from the manager registry."""
+    """Serves jit-compiled scorers reloaded from the manager registry.
+
+    ``micro_batch`` (default on) coalesces concurrent ModelInfer calls
+    into one padded device dispatch (SURVEY §7: micro-batch requests so
+    latency doesn't scale with scheduler concurrency)."""
 
     def __init__(self, manager=None, scheduler_id: int = 0,
-                 reload_interval: float = 30.0):
+                 reload_interval: float = 30.0, micro_batch: bool = True):
         self.manager = manager  # ManagerService or None (push-only mode)
         self.scheduler_id = scheduler_id
         self.reload_interval = reload_interval
+        self.micro_batch = micro_batch
         self._models: Dict[str, _LoadedModel] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -124,8 +134,20 @@ class InferenceService:
     def install_scorer(self, name: str, scorer: ParentScorer,
                        version: str = "local") -> None:
         """Direct install (tests / in-process trainer handoff)."""
+        batcher = None
+        if self.micro_batch:
+            from dragonfly2_tpu.inference.batcher import MicroBatcher
+
+            batcher = MicroBatcher(scorer)
         with self._lock:
-            self._models[name] = _LoadedModel(version, scorer)
+            old = self._models.get(name)
+            self._models[name] = _LoadedModel(version, scorer, batcher)
+        if old is not None and old.batcher is not None:
+            # Grace-close: a ModelInfer thread may have grabbed the old
+            # model just before the swap; keep its batcher serving until
+            # any such in-flight request has comfortably finished, like
+            # the pre-batcher code kept serving on the old scorer.
+            threading.Timer(35.0, old.batcher.close).start()
 
     def reload_from_manager(self) -> bool:
         """Pull the active MLP model if its version changed. Returns True
@@ -148,8 +170,9 @@ class InferenceService:
         if active is None:
             return False
         scorer = _scorer_from_artifact(active.artifact)
-        with self._lock:
-            self._models[MODEL_NAME_MLP] = _LoadedModel(active.version, scorer)
+        # Through install_scorer so the micro-batcher front is (re)built
+        # and the old one drained.
+        self.install_scorer(MODEL_NAME_MLP, scorer, version=active.version)
         logger.info("inference sidecar loaded mlp version %s", active.version)
         return True
 
@@ -212,7 +235,7 @@ class InferenceService:
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"batch {inputs.shape[0]} exceeds max {model.scorer.max_batch}",
             )
-        scores = model.scorer.score(inputs)
+        scores = model.score(inputs)
         return ModelInferResponse(
             model_name=request.model_name, model_version=model.version,
             outputs=np.asarray(scores),

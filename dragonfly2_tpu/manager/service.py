@@ -65,12 +65,18 @@ class ActiveModel:
 
 class ManagerService:
     def __init__(self, database: Database, object_store: ObjectStore,
-                 keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL, metrics=None):
+                 keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL, metrics=None,
+                 cache_ttl: float = 5.0):
+        from dragonfly2_tpu.manager.cache import ReadThroughCache
+
         self.db = database
         self.store = object_store
         self.searcher = Searcher()
         self.keepalive_ttl = keepalive_ttl
         self.metrics = metrics  # ManagerMetrics or None
+        # Read-through cache for fleet-polled dynconfig answers
+        # (manager/cache two-tier role; single tier — sqlite is local).
+        self.cache = ReadThroughCache(ttl=cache_ttl)
         self.store.create_bucket(MODELS_BUCKET)
 
     # ------------------------------------------------------------------
@@ -112,12 +118,16 @@ class ManagerService:
         if existing is not None:
             self.db.update("schedulers", existing.id, port=port,
                            features=features or [])
+            # Invalidate AFTER the write: before it, a concurrent reader
+            # could re-cache the pre-write rows for a full TTL.
+            self.cache.invalidate_prefix("list_schedulers")
             return self.db.get("schedulers", existing.id)
         row_id = self.db.insert(
             "schedulers", hostname=hostname, ip=ip, port=port,
             scheduler_cluster_id=scheduler_cluster_id,
             features=features or [], state=STATE_INACTIVE,
         )
+        self.cache.invalidate_prefix("list_schedulers")
         return self.db.get("schedulers", row_id)
 
     def update_seed_peer(self, *, hostname: str, ip: str, port: int,
@@ -161,6 +171,10 @@ class ManagerService:
             self.metrics.keepalive_count.inc()
         self.db.update(table, row.id, state=STATE_ACTIVE,
                        last_keepalive=time.time())
+        # Invalidate AFTER the write and only on a state flip —
+        # steady-state keepalives would otherwise defeat the cache.
+        if row.state != STATE_ACTIVE:
+            self.cache.invalidate_prefix("list_schedulers")
 
     def sweep_keepalive(self) -> int:
         """Expire silent instances (the stream-drop path of KeepAlive)."""
@@ -173,6 +187,8 @@ class ManagerService:
             ):
                 self.db.update(table, row.id, state=STATE_INACTIVE)
                 flipped += 1
+        if flipped:
+            self.cache.invalidate_prefix("list_schedulers")
         return flipped
 
     # ------------------------------------------------------------------
@@ -182,7 +198,16 @@ class ManagerService:
     def list_schedulers(self, *, ip: str = "", hostname: str = "",
                         conditions: Dict[str, str] | None = None) -> List[Row]:
         """Active schedulers of the best-matching cluster for this daemon —
-        the searcher path of ListSchedulers (manager_server_v2.go:500-560)."""
+        the searcher path of ListSchedulers (manager_server_v2.go:500-560).
+        Cached a few seconds: every daemon polls this on its dynconfig
+        ticker."""
+        key = f"list_schedulers:{ip}|{hostname}|{sorted((conditions or {}).items())}"
+        return self.cache.get(
+            key, lambda: self._list_schedulers(
+                ip=ip, hostname=hostname, conditions=conditions))
+
+    def _list_schedulers(self, *, ip: str, hostname: str,
+                         conditions: Dict[str, str] | None) -> List[Row]:
         clusters = self.db.find("scheduler_clusters")
         counts = {
             r.scheduler_cluster_id: r.n

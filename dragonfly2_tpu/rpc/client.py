@@ -30,6 +30,37 @@ class RpcRetryError(RuntimeError):
     pass
 
 
+class ClientTLS:
+    """Client-side TLS (pkg/rpc/credential.go): trust roots + optional
+    client cert/key for mTLS. ``server_name_override`` lets tests dial
+    127.0.0.1 with a hostname-SAN cert."""
+
+    def __init__(self, ca_path: str, cert_path: str = "",
+                 key_path: str = "", server_name_override: str = ""):
+        self.ca_path = ca_path
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.server_name_override = server_name_override
+
+    def credentials(self) -> grpc.ChannelCredentials:
+        with open(self.ca_path, "rb") as f:
+            ca = f.read()
+        cert = key = None
+        if self.cert_path and self.key_path:
+            with open(self.cert_path, "rb") as f:
+                cert = f.read()
+            with open(self.key_path, "rb") as f:
+                key = f.read()
+        return grpc.ssl_channel_credentials(
+            root_certificates=ca, private_key=key, certificate_chain=cert)
+
+    def channel_options(self) -> list:
+        if self.server_name_override:
+            return [("grpc.ssl_target_name_override",
+                     self.server_name_override)]
+        return []
+
+
 class ServiceClient:
     """One target, one channel; methods appear as attributes.
 
@@ -45,21 +76,25 @@ class ServiceClient:
         retries: int = 3,
         backoff: float = 0.05,
         options: Optional[Iterable[tuple[str, Any]]] = None,
+        tls: Optional["ClientTLS"] = None,
     ) -> None:
         self.target = target
         self.spec = spec
         self.retries = retries
         self.backoff = backoff
-        self._channel = grpc.insecure_channel(
-            target,
-            options=list(
-                options
-                or [
-                    ("grpc.max_send_message_length", 256 * 1024 * 1024),
-                    ("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                ]
-            ),
+        opts = list(
+            options
+            or [
+                ("grpc.max_send_message_length", 256 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 256 * 1024 * 1024),
+            ]
         )
+        if tls is not None:
+            self._channel = grpc.secure_channel(
+                target, tls.credentials(),
+                options=opts + tls.channel_options())
+        else:
+            self._channel = grpc.insecure_channel(target, options=opts)
         ctor = {
             MethodKind.UNARY_UNARY: self._channel.unary_unary,
             MethodKind.UNARY_STREAM: self._channel.unary_stream,

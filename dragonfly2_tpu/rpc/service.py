@@ -110,6 +110,31 @@ def generic_handler(spec: ServiceSpec, impl: Any) -> grpc.GenericRpcHandler:
 
 
 @dataclass
+class ServerTLS:
+    """Server-side TLS material (pkg/rpc/credential.go's role).
+
+    ``client_ca_path`` set ⇒ mutual TLS: clients must present a cert
+    signed by that CA (the reference's mTLS security mode)."""
+
+    cert_path: str
+    key_path: str
+    client_ca_path: str = ""
+
+    def credentials(self) -> grpc.ServerCredentials:
+        with open(self.key_path, "rb") as f:
+            key = f.read()
+        with open(self.cert_path, "rb") as f:
+            cert = f.read()
+        if self.client_ca_path:
+            with open(self.client_ca_path, "rb") as f:
+                ca = f.read()
+            return grpc.ssl_server_credentials(
+                [(key, cert)], root_certificates=ca,
+                require_client_auth=True)
+        return grpc.ssl_server_credentials([(key, cert)])
+
+
+@dataclass
 class RpcServer:
     server: grpc.Server
     port: int
@@ -128,6 +153,7 @@ def serve(
     port: int = 0,
     max_workers: int = 16,
     options: Optional[Iterable[tuple[str, Any]]] = None,
+    tls: Optional[ServerTLS] = None,
 ) -> RpcServer:
     """Bind and start a server hosting the given (spec, impl) pairs."""
     opts = list(
@@ -147,7 +173,10 @@ def serve(
         server.add_generic_rpc_handlers((generic_handler(spec, impl),))
         if spec is not HEALTH_SPEC:
             health.set_status(spec.name, "SERVING")
-    bound = server.add_insecure_port(f"{host}:{port}")
+    if tls is not None:
+        bound = server.add_secure_port(f"{host}:{port}", tls.credentials())
+    else:
+        bound = server.add_insecure_port(f"{host}:{port}")
     if bound == 0:
         raise OSError(f"cannot bind {host}:{port}")
     server.start()

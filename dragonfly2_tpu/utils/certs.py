@@ -124,7 +124,12 @@ class CertAuthority:
         expired leaves or leaves from a replaced CA."""
         with self._lock:
             cached = self._leaf_paths.get(host)
-            if cached is not None and host in self._validated:
+            if (cached is not None and host in self._validated
+                    and os.path.exists(cached[0])
+                    and os.path.exists(cached[1])):
+                # Existence stays on the fast path (cheap) so externally
+                # removed leaves self-heal immediately; the expensive
+                # parse+verify rides the TTL verdict.
                 return cached
             safe = host.replace(":", "_").replace("/", "_")
             cert_path = os.path.join(self.work_dir, f"leaf-{safe}.pem")
@@ -160,9 +165,24 @@ class CertAuthority:
             return False
         return True
 
-    def _mint(self, host: str, cert_path: str, key_path: str) -> None:
+    def client_cert_for(self, name: str) -> Tuple[str, str]:
+        """(cert_path, key_path) of a CLIENT_AUTH leaf for mTLS peers
+        (pkg/rpc/credential.go's client identity role)."""
+        safe = name.replace(":", "_").replace("/", "_")
+        cert_path = os.path.join(self.work_dir, f"client-{safe}.pem")
+        key_path = os.path.join(self.work_dir, f"client-{safe}.key")
+        with self._lock:
+            if not (os.path.exists(cert_path) and os.path.exists(key_path)
+                    and self._leaf_usable(cert_path)):
+                self._mint(name, cert_path, key_path, client=True)
+        return cert_path, key_path
+
+    def _mint(self, host: str, cert_path: str, key_path: str,
+              client: bool = False) -> None:
         key = ec.generate_private_key(ec.SECP256R1())
         now = datetime.datetime.now(datetime.timezone.utc)
+        eku = (x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH if client
+               else x509.oid.ExtendedKeyUsageOID.SERVER_AUTH)
         cert = (
             x509.CertificateBuilder()
             .subject_name(_name(host))
@@ -172,9 +192,7 @@ class CertAuthority:
             .not_valid_before(now - _ONE_DAY)
             .not_valid_after(now + _ONE_DAY * self.valid_days)
             .add_extension(_san(host), critical=False)
-            .add_extension(
-                x509.ExtendedKeyUsage([x509.oid.ExtendedKeyUsageOID.SERVER_AUTH]),
-                critical=False)
+            .add_extension(x509.ExtendedKeyUsage([eku]), critical=False)
             .sign(self._ca_key, hashes.SHA256())
         )
         with open(key_path, "wb") as f:

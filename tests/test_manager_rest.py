@@ -162,6 +162,32 @@ class TestRestAuth:
         assert code == 200 and payload == "OK"
 
 
+class TestReadThroughCache:
+    def test_dynconfig_answers_cached_and_invalidated(self, tmp_path):
+        """list_schedulers (the fleet-polled dynconfig answer) is served
+        from cache between writes and invalidated on state flips."""
+        service = ManagerService(
+            Database(":memory:"),
+            FilesystemObjectStore(str(tmp_path / "objects")))
+        cluster = service.create_scheduler_cluster("c", is_default=True)
+        service.update_scheduler(hostname="s1", ip="10.0.0.1", port=8002,
+                                 scheduler_cluster_id=cluster.id)
+        assert service.list_schedulers(ip="1.2.3.4") == []
+        misses = service.cache.misses
+        service.list_schedulers(ip="1.2.3.4")
+        assert service.cache.misses == misses  # second read was a hit
+        assert service.cache.hits >= 1
+        # keepalive flips inactive→active → cache invalidated → fresh
+        service.keepalive(source_type="scheduler", hostname="s1",
+                          ip="10.0.0.1", cluster_id=cluster.id)
+        rows = service.list_schedulers(ip="1.2.3.4")
+        assert [r.ip for r in rows] == ["10.0.0.1"]
+        # sweep flipping active→inactive invalidates again
+        service.db.update("schedulers", rows[0].id, last_keepalive=0.0)
+        assert service.sweep_keepalive() == 1
+        assert service.list_schedulers(ip="1.2.3.4") == []
+
+
 class _FakeHost:
     def __init__(self, host_id, hostname):
         self.id = host_id
