@@ -132,8 +132,19 @@ class ObjectStorageGateway(ThreadedHTTPService):
                 req.wfile.write(chunk)
 
     def _put(self, req, bucket: str, key: str) -> None:
+        # Server-side copy (dfstore.go CopyObject): a PUT naming a source
+        # key moves bytes inside the backend without a client round trip.
+        copy_source = req.headers.get("X-Df2-Copy-Source", "")
         length = int(req.headers.get("Content-Length", 0))
-        data = req.rfile.read(length)
+        if copy_source:
+            # Drain any body regardless — leaving it unread desyncs the
+            # keep-alive connection for the next request.
+            if length:
+                req.rfile.read(length)
+            data = self.backend.get_object(bucket,
+                                           urllib.parse.unquote(copy_source))
+        else:
+            data = req.rfile.read(length)
         self.backend.create_bucket(bucket)
         self.backend.put_object(bucket, key, data)
         req.send_response(200)
@@ -185,6 +196,15 @@ class DfstoreClient:
             if exc.code == 404:
                 return False
             raise
+
+    def copy_object(self, bucket: str, src_key: str, dst_key: str) -> None:
+        """Server-side copy (dfstore.go CopyObject)."""
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url(bucket, dst_key), data=b"", method="PUT",
+            headers={"X-Df2-Copy-Source": urllib.parse.quote(src_key)})
+        urllib.request.urlopen(req, timeout=self.timeout).close()
 
     def delete_object(self, bucket: str, key: str) -> None:
         import urllib.error

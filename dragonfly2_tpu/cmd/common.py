@@ -87,8 +87,11 @@ def parse_with_config(parser: argparse.ArgumentParser, argv=None):
         # override.
         given = set()
         for a in parser._actions:
-            if any(opt in argv for opt in a.option_strings):
-                given.add(a.dest)
+            for opt in a.option_strings:
+                if any(tok == opt or tok.startswith(opt + "=")
+                       for tok in argv):
+                    given.add(a.dest)
+                    break
         defaults = {}
         for key, value in data.items():
             dest = key.replace("-", "_")

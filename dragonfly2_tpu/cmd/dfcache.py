@@ -38,7 +38,7 @@ def main(argv=None) -> int:
     parser.add_argument("--tag", default="")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir, service="dfcache")
 
     if bool(args.daemon) == bool(args.storage_dir):
         parser.error("exactly one of --daemon / --storage-dir is required")

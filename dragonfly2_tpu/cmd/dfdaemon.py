@@ -113,7 +113,7 @@ def main(argv=None) -> int:
                         help="filesystem object-store root for the gateway")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose, args.log_dir)
+    init_logging(args.verbose, args.log_dir, service="dfdaemon")
     init_tracing(args, "dfdaemon")
     if args.sni_port >= 0 and not args.proxy_hijack_https:
         parser.error("--sni-port requires --proxy-hijack-https "

@@ -40,7 +40,7 @@ def main(argv=None) -> int:
                              "task id")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir, service="dfget")
 
     headers = {}
     for item in args.header:

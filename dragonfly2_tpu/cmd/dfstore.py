@@ -14,16 +14,19 @@ from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("df2-store")
-    parser.add_argument("command", choices=["get", "put", "delete", "exist"])
+    parser.add_argument("command",
+                        choices=["get", "put", "delete", "exist", "copy"])
     parser.add_argument("bucket")
     parser.add_argument("key")
     parser.add_argument("--endpoint", required=True,
                         help="gateway base URL, e.g. http://127.0.0.1:65004")
     parser.add_argument("--path", default="",
                         help="local file (put source / get destination)")
+    parser.add_argument("--dest-key", default="",
+                        help="destination key (copy)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose)
+    init_logging(args.verbose, args.log_dir, service="dfstore")
 
     from dragonfly2_tpu.client.objectstorage_gateway import DfstoreClient
 
@@ -46,6 +49,11 @@ def main(argv=None) -> int:
         exists = client.is_object_exist(args.bucket, args.key)
         print("true" if exists else "false")
         return 0 if exists else 1
+    if args.command == "copy":
+        if not args.dest_key:
+            parser.error("copy requires --dest-key")
+        client.copy_object(args.bucket, args.key, args.dest_key)
+        return 0
     client.delete_object(args.bucket, args.key)
     return 0
 

@@ -28,7 +28,7 @@ def main(argv=None) -> int:
     parser.add_argument("--reload-interval", type=float, default=30.0)
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose, args.log_dir)
+    init_logging(args.verbose, args.log_dir, service="inference")
     init_tracing(args, "inference")
 
     from dragonfly2_tpu.inference.sidecar import (

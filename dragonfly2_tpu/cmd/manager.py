@@ -45,7 +45,7 @@ def main(argv=None) -> int:
                              "$DF2_MANAGER_JWT_SECRET or random per boot)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose, args.log_dir)
+    init_logging(args.verbose, args.log_dir, service="manager")
     init_tracing(args, "manager")
 
     from dragonfly2_tpu import __version__

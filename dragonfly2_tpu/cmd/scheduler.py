@@ -104,7 +104,7 @@ def main(argv=None) -> int:
                              "(0 = manager default cluster)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose, args.log_dir)
+    init_logging(args.verbose, args.log_dir, service="scheduler")
     init_tracing(args, "scheduler")
 
     service, server = build_scheduler(args)

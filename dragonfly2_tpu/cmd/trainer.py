@@ -30,7 +30,7 @@ def main(argv=None) -> int:
     parser.add_argument("--object-store-dir", default="./manager-objects")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
-    init_logging(args.verbose, args.log_dir)
+    init_logging(args.verbose, args.log_dir, service="trainer")
     init_tracing(args, "trainer")
 
     from dragonfly2_tpu import __version__

@@ -41,6 +41,7 @@ class MicroBatcher:
         self.max_rows = max_rows or scorer.max_batch
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._closed = False
+        self._close_lock = threading.Lock()
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="infer-microbatch")
         self.dispatches = 0
@@ -49,15 +50,20 @@ class MicroBatcher:
 
     def score(self, features: np.ndarray, timeout: float = 30.0) -> np.ndarray:
         """Blocking; same contract as ParentScorer.score."""
-        if self._closed:
-            raise RuntimeError("micro-batcher is closed (model reloaded)")
         if len(features) == 0:
             return np.zeros(0, np.float32)
         if len(features) > self.max_rows:
             raise ValueError(
                 f"batch {len(features)} exceeds max {self.max_rows}")
         pending = _Pending(np.asarray(features, np.float32))
-        self._queue.put(pending)
+        # closed-check + enqueue under the same lock close() takes to set
+        # the flag — otherwise a request can slip in after the final
+        # drain and hang until its timeout.
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError(
+                    "micro-batcher is closed (model reloaded)")
+            self._queue.put(pending)
         if not pending.event.wait(timeout=timeout):
             raise TimeoutError("micro-batched scoring timed out")
         if pending.error is not None:
@@ -131,9 +137,10 @@ class MicroBatcher:
                 p.event.set()
 
     def close(self) -> None:
-        self._closed = True
-        self._queue.put(None)
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            # Under the lock: no score() can enqueue after this point.
+            self._queue.put(None)
         self._worker.join(timeout=5)
-        # A request that passed the closed check but enqueued after the
-        # worker's final drain would hang forever — sweep once more.
-        self._drain_remaining()

@@ -128,6 +128,7 @@ class InferenceService:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
+        self._grace_timers: list = []
 
     # -- model management --------------------------------------------------
 
@@ -146,8 +147,13 @@ class InferenceService:
             # Grace-close: a ModelInfer thread may have grabbed the old
             # model just before the swap; keep its batcher serving until
             # any such in-flight request has comfortably finished, like
-            # the pre-batcher code kept serving on the old scorer.
-            threading.Timer(35.0, old.batcher.close).start()
+            # the pre-batcher code kept serving on the old scorer. The
+            # timer is daemonized and tracked so shutdown neither waits
+            # out the grace nor leaks it.
+            timer = threading.Timer(35.0, old.batcher.close)
+            timer.daemon = True
+            self._grace_timers.append(timer)
+            timer.start()
 
     def reload_from_manager(self) -> bool:
         """Pull the active MLP model if its version changed. Returns True
@@ -195,6 +201,14 @@ class InferenceService:
 
     def stop(self) -> None:
         self._stop.set()
+        for timer in self._grace_timers:
+            timer.cancel()
+        self._grace_timers.clear()
+        with self._lock:
+            models = list(self._models.values())
+        for model in models:
+            if model.batcher is not None:
+                model.batcher.close()
         if self._watcher is not None:
             self._watcher.join(timeout=5)
             if not self._watcher.is_alive():

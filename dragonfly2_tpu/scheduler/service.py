@@ -181,6 +181,20 @@ class SchedulerService:
             if self.metrics:
                 self.metrics.register_peer_failure.inc()
             raise ServiceError(NOT_FOUND, f"host {req.host_id} not announced")
+        # Priority gates that REJECT must fire before any resource is
+        # created — a stored-then-rejected peer would pin its task and
+        # host against GC in a zombie initial state.
+        if req.priority == 1:
+            if self.metrics:
+                self.metrics.register_peer_failure.inc()
+            raise ServiceError(FAILED_PRECONDITION,
+                               "LEVEL1 peer is forbidden")
+        if req.priority == 2:
+            if self.metrics:
+                self.metrics.register_peer_failure.inc()
+            raise ServiceError(NOT_FOUND,
+                               "LEVEL2 peer downloads back-to-source "
+                               "without candidates")
         task = self.resource.task_manager.load_or_store(
             Task(req.task_id, url=req.url, tag=req.tag,
                  application=req.application,
@@ -196,7 +210,16 @@ class SchedulerService:
         if channel is not None:
             peer.announce_channel = channel
 
-        self._maybe_trigger_seed_peer(task)
+        # Priority ladder (service_v2.go:1308-1375 downloadTaskBySeedPeer;
+        # the LEVEL1/LEVEL2 rejections fired above, pre-storage): LEVEL3
+        # makes THIS peer back-source first instead of warming a seed;
+        # 0/4/5/6 take the seed-peer warm-up path (host-type nuances
+        # collapsed — one seed role here). Application-table priority
+        # lookup for LEVEL0 is a manager concern the caller resolves.
+        if req.priority == 3:
+            peer.need_back_to_source = True
+        else:
+            self._maybe_trigger_seed_peer(task)
 
         scope = task.size_scope()
         if task.fsm.is_state(TaskState.SUCCEEDED) and scope == SizeScope.EMPTY:

@@ -301,3 +301,32 @@ class TestCLIs:
         assert out.read_bytes() == content
         assert main(["delete", "my-key", "--storage-dir", storage]) == 0
         assert main(["stat", "my-key", "--storage-dir", storage]) == 1
+
+
+class TestGatewayCopy:
+    def test_server_side_copy(self, tmp_path):
+        from dragonfly2_tpu.client.objectstorage_gateway import (
+            DfstoreClient,
+            ObjectStorageGateway,
+        )
+        from dragonfly2_tpu.manager.objectstore import FilesystemObjectStore
+        from tests.test_p2p_e2e import make_scheduler
+
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+
+        daemon = Daemon(make_scheduler(tmp_path), DaemonConfig(
+            storage_root=str(tmp_path / "d"), hostname="gw"))
+        daemon.start()
+        gw = ObjectStorageGateway(
+            daemon, FilesystemObjectStore(str(tmp_path / "objects")))
+        gw.start()
+        try:
+            client = DfstoreClient(f"http://127.0.0.1:{gw.port}")
+            payload = b"copy-me" * 1000
+            client.put_object("b", "src.bin", payload)
+            client.copy_object("b", "src.bin", "dst/copied.bin")
+            assert client.get_object("b", "dst/copied.bin") == payload
+            assert client.is_object_exist("b", "src.bin")
+        finally:
+            gw.stop()
+            daemon.stop()

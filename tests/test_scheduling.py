@@ -198,3 +198,79 @@ class TestFindSuccessParent:
         task.store_peer(running)
         got = scheduling().find_success_parent(child, set())
         assert got is not None and got.id.startswith("parent-")
+
+
+class TestPriorityLadder:
+    """Priority gates the download treatment
+    (service_v2.go:1308-1375 downloadTaskBySeedPeer)."""
+
+    def _service_with_seed_spy(self, tmp_path):
+        from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+        from dragonfly2_tpu.scheduler.resource.resource import Resource
+        from dragonfly2_tpu.scheduler.scheduling.core import Scheduling
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+        from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+        class SeedSpy:
+            def __init__(self):
+                self.triggered = []
+
+            def trigger_task(self, task):
+                self.triggered.append(task.id)
+                return True
+
+        spy = SeedSpy()
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(BaseEvaluator()),
+            storage=Storage(str(tmp_path / "ds")),
+            seed_peer_client=spy,
+        )
+        return service, spy
+
+    def _register(self, service, priority, peer="p1"):
+        import time
+
+        from dragonfly2_tpu.scheduler.resource.host import Host
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        service.announce_host(Host(id="h1", hostname="h", ip="1.2.3.4",
+                                   port=80, download_port=81))
+        resp = service.register_peer(RegisterPeerRequest(
+            host_id="h1", task_id=f"t-{priority}", peer_id=peer,
+            url="http://o/x", priority=priority))
+        # seed triggers run on a spawned thread; give it a beat
+        time.sleep(0.1)
+        return resp
+
+    def test_level1_forbidden(self, tmp_path):
+        import pytest
+
+        from dragonfly2_tpu.scheduler.service import ServiceError
+
+        service, spy = self._service_with_seed_spy(tmp_path)
+        with pytest.raises(ServiceError, match="forbidden"):
+            self._register(service, priority=1)
+        assert spy.triggered == []
+
+    def test_level2_no_candidates(self, tmp_path):
+        import pytest
+
+        from dragonfly2_tpu.scheduler.service import ServiceError
+
+        service, spy = self._service_with_seed_spy(tmp_path)
+        with pytest.raises(ServiceError, match="back-to-source"):
+            self._register(service, priority=2)
+        assert spy.triggered == []
+
+    def test_level3_self_back_to_source_no_seed(self, tmp_path):
+        service, spy = self._service_with_seed_spy(tmp_path)
+        self._register(service, priority=3)
+        assert spy.triggered == []
+        peer = service.resource.peer_manager.load("p1")
+        assert peer.need_back_to_source
+
+    def test_default_triggers_seed(self, tmp_path):
+        service, spy = self._service_with_seed_spy(tmp_path)
+        self._register(service, priority=0)
+        assert spy.triggered == ["t-0"]
