@@ -49,6 +49,11 @@ class GATTrainConfig:
     seed: int = 0
     eval_fraction: float = 0.1
     rtt_threshold_ns: int = 20_000_000
+    # Shared step-loop accounting (see GNNTrainConfig): wall cap for the
+    # step loop plus incremental publishing hooks.
+    max_seconds: float | None = None
+    progress_callback: object = None
+    compile_callback: object = None
 
 
 @dataclass
@@ -150,10 +155,15 @@ def train_gat(
     def rep_put(a):
         return jax.device_put(np.asarray(a), rep)
 
+    from dragonfly2_tpu.train.step_budget import StepBudget
+
     rng = np.random.default_rng((config.seed, 7))
     history = []
     n_samples = 0
-    start = time.perf_counter()
+    budget = StepBudget(config.max_seconds,
+                        on_compile=config.compile_callback,
+                        on_progress=config.progress_callback)
+    stop = False
     # Explicit-sharding mode: the in-model reshard (K/V all-gather) needs
     # the ambient mesh during trace.
     with jax.set_mesh(mesh.mesh):
@@ -172,10 +182,15 @@ def train_gat(
                 )
                 losses.append(loss)
                 n_samples += len(ids)
+                if budget.tick(len(ids), loss):
+                    stop = True
+                    break
             if losses:
                 history.append(float(jnp.mean(jnp.stack(losses))))
+            if stop:
+                break
         jax.block_until_ready(state.params)
-        elapsed = time.perf_counter() - start
+        budget.finish()
 
         # Exact eval in fixed-size chunks with a zero-weighted tail.
         cm = np.zeros(4)
@@ -199,6 +214,6 @@ def train_gat(
         recall=metrics["recall"],
         f1=metrics["f1"],
         accuracy=metrics["accuracy"],
-        samples_per_sec=n_samples / elapsed if elapsed > 0 else 0.0,
+        samples_per_sec=budget.samples_per_sec(batch),
         history=history,
     )
