@@ -73,6 +73,13 @@ class ResourceClient:
     def get_last_modified(self, request: Request) -> int:
         raise NotImplementedError
 
+    def list(self, request: Request) -> list:
+        """Child URLs under a directory-like URL (the reference's
+        recursive-download listing; schemes without a listing concept —
+        plain http — raise)."""
+        raise SourceError(
+            f"scheme {request.scheme!r} does not support listing")
+
 
 class _Registry:
     """Scheme → client map with plugin-style registration
@@ -120,6 +127,10 @@ def is_support_range(request: Request) -> bool:
 
 def download(request: Request) -> Response:
     return client_for(request).download(request)
+
+
+def list_children(request: Request) -> list:
+    return client_for(request).list(request)
 
 
 class HTTPSourceClient(ResourceClient):
@@ -263,6 +274,19 @@ class FileSourceClient(ResourceClient):
             return int(os.path.getmtime(self._path(request)) * 1000)
         except OSError:
             return -1
+
+    def list(self, request: Request) -> list:
+        base = self._path(request)
+        if not os.path.isdir(base):
+            raise SourceError(f"{request.url} is not a directory")
+        out = []
+        for dirpath, _, filenames in os.walk(base):
+            for name in sorted(filenames):
+                path = os.path.join(dirpath, name)
+                out.append(
+                    urllib.parse.urljoin("file:",
+                                         urllib.request.pathname2url(path)))
+        return sorted(out)
 
 
 def register_defaults() -> None:

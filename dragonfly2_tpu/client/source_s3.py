@@ -53,7 +53,10 @@ class S3SourceClient(ResourceClient):
 
     def _http_url(self, request: Request) -> str:
         parsed = urllib.parse.urlparse(request.url)
-        bucket, key = parsed.netloc, parsed.path.lstrip("/")
+        # Unquote before re-quoting: s3 URLs from list() carry encoded
+        # keys, and quoting them again would double-encode.
+        bucket = parsed.netloc
+        key = urllib.parse.unquote(parsed.path.lstrip("/"))
         if not bucket or not key:
             raise SourceError(f"malformed s3 url {request.url!r}")
         cfg = self.config
@@ -129,6 +132,28 @@ class S3SourceClient(ResourceClient):
             return int(email.utils.parsedate_to_datetime(lm).timestamp() * 1000)
         finally:
             resp.close()
+
+    def list(self, request: Request) -> list:
+        """s3://bucket/prefix/ → child object URLs (ListObjectsV2 via the
+        shared S3 REST backend — same signer, same pagination)."""
+        from dragonfly2_tpu.manager.objectstore import S3ObjectStore
+
+        parsed = urllib.parse.urlparse(request.url)
+        bucket = parsed.netloc
+        prefix = urllib.parse.unquote(parsed.path.lstrip("/"))
+        # Directory semantics, not raw prefix match: 'data' must not
+        # sweep in a sibling 'database/'.
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        cfg = self.config
+        store = S3ObjectStore(access_key=cfg.access_key,
+                              secret_key=cfg.secret_key, region=cfg.region,
+                              endpoint_url=cfg.endpoint_url,
+                              timeout=cfg.timeout)
+        # Keys are percent-encoded into the URL (consumers unquote), so
+        # '%'/'#'/'?' in object names survive the round trip.
+        return [f"s3://{bucket}/{urllib.parse.quote(key)}"
+                for key in store.list_objects(bucket, prefix=prefix)]
 
 
 def register_s3(config: S3Config | None = None, replace: bool = True) -> None:

@@ -38,6 +38,11 @@ def main(argv=None) -> int:
     parser.add_argument("--filter", default="",
                         help="'&'-separated query params excluded from the "
                              "task id")
+    parser.add_argument("--recursive", action="store_true",
+                        help="URL names a directory on a listable scheme "
+                             "(file://, s3://): download every child under "
+                             "it into -O as a directory, each through the "
+                             "mesh as its own task")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfget")
@@ -46,6 +51,9 @@ def main(argv=None) -> int:
     for item in args.header:
         k, _, v = item.partition(":")
         headers[k.strip()] = v.strip()
+
+    if args.recursive:
+        return _recursive_download(args, headers)
 
     if args.daemon:
         rc = _daemon_download(args, headers)
@@ -90,6 +98,126 @@ def main(argv=None) -> int:
     print(f"{args.output}: {result.content_length} bytes "
           f"(task {result.task_id[:16]}…)")
     return 0
+
+
+def _recursive_download(args, headers) -> int:
+    """Directory download (the reference dfget --recursive /
+    rpcserver.go:268 recursive path): list children on a listable scheme,
+    then fetch each as its own task into the output DIRECTORY."""
+    import os
+    import urllib.parse
+
+    from dragonfly2_tpu.client.source import Request, SourceError
+    from dragonfly2_tpu.client.source import list_children
+
+    base = args.url if args.url.endswith("/") else args.url + "/"
+    try:
+        children = list_children(Request(args.url, header=dict(headers)))
+    except SourceError as exc:
+        print(f"cannot list {args.url}: {exc}", file=sys.stderr)
+        return 1
+    if not children:
+        print(f"{args.url}: no entries", file=sys.stderr)
+        return 1
+    base_path = urllib.parse.urlparse(base).path
+    entries = []
+    for child in children:
+        child_path = urllib.parse.urlparse(child).path
+        rel = (child_path[len(base_path):] if
+               child_path.startswith(base_path)
+               else child_path.rsplit("/", 1)[-1])
+        entries.append((child, urllib.parse.unquote(rel).lstrip("/")))
+
+    out_root = os.path.abspath(args.output)
+    os.makedirs(out_root, exist_ok=True)
+
+    def out_path(rel: str) -> str:
+        # Resolve against the ABSOLUTE output root before the containment
+        # check; a relative-path compare would flatten every entry.
+        path = os.path.normpath(os.path.join(out_root, rel))
+        if not path.startswith(out_root + os.sep) and path != out_root:
+            path = os.path.join(out_root, os.path.basename(rel))
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return path
+
+    filtered = args.filter.split("&") if args.filter else None
+    use_daemon = bool(args.daemon)
+    if use_daemon:
+        from dragonfly2_tpu.client.rpcserver import RemoteDaemonClient
+
+        # Preflight so an unreachable daemon degrades like the
+        # non-recursive ladder instead of crashing mid-tree.
+        try:
+            probe = RemoteDaemonClient(args.daemon)
+            probe.version()
+        except Exception as exc:  # noqa: BLE001 — daemon down is soft
+            probe.close()
+            print(f"daemon {args.daemon} failed: {exc}", file=sys.stderr)
+            if not args.scheduler:
+                return 1
+            print("daemon unreachable; falling back to ephemeral peer",
+                  file=sys.stderr)
+            use_daemon = False
+        else:
+            probe.close()
+
+    failures = 0
+    if use_daemon:
+        from dragonfly2_tpu.client.rpcserver import RemoteDaemonClient
+
+        client = RemoteDaemonClient(args.daemon)
+        try:
+            for child, rel in entries:
+                try:
+                    result = client.download(
+                        child, out_path(rel), request_header=headers,
+                        tag=args.tag, application=args.application,
+                        filtered_query_params=filtered)
+                except Exception as exc:  # noqa: BLE001 — per-entry
+                    failures += 1
+                    print(f"{child}: {exc}", file=sys.stderr)
+                    continue
+                if not result.success:
+                    failures += 1
+                    print(f"{child}: {result.error}", file=sys.stderr)
+        finally:
+            client.close()
+    else:
+        import tempfile
+
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+
+        storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
+        if args.scheduler:
+            from dragonfly2_tpu.scheduler.rpcserver import (
+                BalancedSchedulerClient,
+            )
+
+            scheduler = BalancedSchedulerClient(args.scheduler)
+        else:
+            scheduler = _DirectScheduler()
+        daemon = Daemon(scheduler, DaemonConfig(
+            storage_root=storage_dir, keep_storage=bool(args.storage_dir)))
+        daemon.start()
+        try:
+            for child, rel in entries:
+                result = daemon.download_file(
+                    child, output_path=out_path(rel),
+                    request_header=headers, tag=args.tag,
+                    application=args.application,
+                    filtered_query_params=filtered)
+                if not result.success:
+                    failures += 1
+                    print(f"{child}: {result.error}", file=sys.stderr)
+        finally:
+            daemon.stop()
+            if not args.storage_dir:
+                import shutil
+
+                shutil.rmtree(storage_dir, ignore_errors=True)
+    done = len(entries) - failures
+    print(f"{args.output}: {done}/{len(entries)} entries downloaded")
+    return 0 if failures == 0 else 1
 
 
 def _daemon_download(args, headers):

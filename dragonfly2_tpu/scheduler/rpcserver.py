@@ -9,6 +9,15 @@ this module adds (1) wire messages, (2) the server stream pump, and (3)
 ``SchedulerAPI`` protocol so daemons run against a remote scheduler
 unchanged (pkg/rpc/scheduler/client role, with per-task scheduler affinity
 left to the caller's consistent-hash ring, client_v1.go:171).
+
+Design decision — ONE protocol, not two: the reference carries a legacy v1
+surface (RegisterPeerTask/ReportPieceResult, service_v1.go:95-1343) purely
+for protobuf backward compatibility with old Go daemons. This framework's
+wire format (DF2 codec) is new, so no deployed client speaks the old
+protobuf — a "v1" shim would have zero possible callers. The v1 protocol's
+BEHAVIORS (size-scope fast paths at registration, piece-result-driven
+rescheduling, per-peer download records) all live in the merged surface and
+are covered by tests; only the duplicate wire shape is dropped.
 """
 
 from __future__ import annotations

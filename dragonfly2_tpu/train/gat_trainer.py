@@ -16,7 +16,6 @@ function of its label) never appears in the message structure.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 import jax
@@ -159,7 +158,6 @@ def train_gat(
 
     rng = np.random.default_rng((config.seed, 7))
     history = []
-    n_samples = 0
     budget = StepBudget(config.max_seconds,
                         on_compile=config.compile_callback,
                         on_progress=config.progress_callback)
@@ -181,7 +179,6 @@ def train_gat(
                     rep_put(labels_all[ids]),
                 )
                 losses.append(loss)
-                n_samples += len(ids)
                 if budget.tick(len(ids), loss):
                     stop = True
                     break

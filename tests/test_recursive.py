@@ -1,0 +1,93 @@
+"""Recursive directory downloads (reference dfget --recursive,
+rpcserver.go:268) over listable schemes."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from dragonfly2_tpu.client.source import Request, SourceError, list_children
+
+
+class TestSourceListing:
+    def test_file_scheme_lists_recursively(self, tmp_path):
+        (tmp_path / "a").mkdir()
+        (tmp_path / "a" / "x.bin").write_bytes(b"x")
+        (tmp_path / "y.bin").write_bytes(b"y")
+        urls = list_children(Request(tmp_path.as_uri()))
+        assert len(urls) == 2
+        assert any(u.endswith("/a/x.bin") for u in urls)
+
+    def test_http_listing_unsupported(self):
+        with pytest.raises(SourceError, match="does not support listing"):
+            list_children(Request("http://example.com/dir/"))
+
+    def test_s3_listing(self, tmp_path):
+        from dragonfly2_tpu.client.source_s3 import S3Config, S3SourceClient
+        from tests.fake_s3 import FakeS3
+        from dragonfly2_tpu.manager.objectstore import S3ObjectStore
+
+        with FakeS3(access_key="AK", secret_key="SK") as fake:
+            store = S3ObjectStore(access_key="AK", secret_key="SK",
+                                  endpoint_url=fake.endpoint)
+            store.create_bucket("b")
+            for key in ("data/1.bin", "data/2.bin", "other.bin"):
+                store.put_object("b", key, b"x")
+            client = S3SourceClient(S3Config(
+                access_key="AK", secret_key="SK",
+                endpoint_url=fake.endpoint))
+            urls = client.list(Request("s3://b/data/"))
+            assert urls == ["s3://b/data/1.bin", "s3://b/data/2.bin"]
+
+
+class TestRecursiveCLI:
+    def test_file_tree_through_ephemeral_peer(self, tmp_path, capsys):
+        from dragonfly2_tpu.cmd.dfget import main
+
+        src = tmp_path / "srcdir"
+        (src / "sub").mkdir(parents=True)
+        (src / "one.bin").write_bytes(os.urandom(10_000))
+        (src / "sub" / "two.bin").write_bytes(os.urandom(20_000))
+        out = tmp_path / "outdir"
+        rc = main([src.as_uri(), "-O", str(out), "--recursive"])
+        assert rc == 0, capsys.readouterr().err
+        assert (out / "one.bin").read_bytes() == \
+            (src / "one.bin").read_bytes()
+        assert (out / "sub" / "two.bin").read_bytes() == \
+            (src / "sub" / "two.bin").read_bytes()
+
+    def test_recursive_via_daemon_rpc(self, tmp_path):
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.client.rpcserver import serve_daemon_rpc
+        from dragonfly2_tpu.cmd.dfget import main
+        from tests.test_p2p_e2e import make_scheduler
+
+        src = tmp_path / "srcdir"
+        src.mkdir()
+        payloads = {}
+        for i in range(3):
+            payloads[f"f{i}.bin"] = os.urandom(5000 + i)
+            (src / f"f{i}.bin").write_bytes(payloads[f"f{i}.bin"])
+        daemon = Daemon(make_scheduler(tmp_path), DaemonConfig(
+            storage_root=str(tmp_path / "d"), hostname="rec"))
+        daemon.start()
+        rpc = serve_daemon_rpc(daemon)
+        try:
+            out = tmp_path / "outdir"
+            rc = main([src.as_uri(), "-O", str(out), "--recursive",
+                       "--daemon", rpc.target])
+            assert rc == 0
+            for name, payload in payloads.items():
+                assert (out / name).read_bytes() == payload
+        finally:
+            rpc.stop()
+            daemon.stop()
+
+    def test_unlistable_scheme_fails_cleanly(self, tmp_path, capsys):
+        from dragonfly2_tpu.cmd.dfget import main
+
+        rc = main(["http://127.0.0.1:1/dir/", "-O", str(tmp_path / "o"),
+                   "--recursive"])
+        assert rc == 1
+        assert "cannot list" in capsys.readouterr().err

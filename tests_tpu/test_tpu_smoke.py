@@ -41,6 +41,24 @@ class TestTrainSmoke:
         assert np.isfinite(res.history[-1])
         assert 0.0 <= res.f1 <= 1.0
 
+    def test_gnn_multi_step_scan(self, tpu_device):
+        """steps_per_call>1 on the real chip: the scan program compiles
+        and the dispatch-amortized path learns."""
+        from dragonfly2_tpu.data import SyntheticCluster
+        from dragonfly2_tpu.parallel import data_parallel_mesh
+        from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
+
+        graph = SyntheticCluster(n_hosts=100, seed=0).probe_graph(10000)
+        res = train_gnn(
+            graph,
+            GNNTrainConfig(hidden=32, embed=16, batch_size=512, epochs=2,
+                           steps_per_call=4, eval_max_seconds=0.0),
+            data_parallel_mesh(),
+        )
+        assert res.steps >= 1
+        assert np.isfinite(res.history[-1])
+        assert res.samples_per_sec > 0
+
     def test_mlp_one_epoch(self, tpu_device):
         from dragonfly2_tpu.data import SyntheticCluster
         from dragonfly2_tpu.parallel import data_parallel_mesh
