@@ -3,31 +3,35 @@
 Headline metric (BASELINE.json north star): GraphSAGE topology-model
 training throughput in samples(edges)/sec/chip, steady-state (compile
 excluded). Extras carry the second tracked number — scheduler
-parent-selection p50 latency through the TPU-backed ML scorer (<1 ms
-target) — plus MLP training stats and pipeline diagnostics.
+parent-selection latency through the TPU-backed ML scorer (<1 ms
+colocated target), now measured end-to-end through the micro-batcher
+under 8-thread concurrent load — plus MLP training stats and pipeline
+diagnostics.
 
-Round-3 accounting rules (the round-2 failure was value=0 with the number
-existing — watchdog fired before train_gnn returned and nothing had
-published partial throughput):
-- The scorer p50 stage runs FIRST (latency is weight-independent — a
-  synthetically initialized MLP measures the same dispatch path), so the
-  <1 ms target is validated before the GNN stage can starve it.
-- The GNN trainer publishes throughput incrementally (StepBudget
-  on_progress → set_headline every ~10 steps) so a watchdog fire emits
-  the latest steady-state rate, never zero.
-- Budgets are per-STAGE: the GNN step loop gets what remains after
-  observed init/compile costs, and the eval pass has its own wall cap.
-- A persistent XLA compilation cache (utils/compilecache.py) amortizes
-  the ~25 s train-step compile across runs.
-- Sub-stage timestamps (t_*) are recorded as they happen so a watchdog
-  fire is diagnosable from the JSON alone.
+Round-4 architecture (the round-3 failure was a one-shot TPU probe that
+hit a tunnel outage and committed the whole run to CPU):
 
-Un-killability contract (the round-1 failure was a silent rc=124):
-- TPU availability is probed in a SUBPROCESS with a hard timeout; a
-  hanging backend init falls back to CPU, flagged in extras.
-- A watchdog thread force-emits whatever has been measured and exits
-  before the driver's kill; the JSON line is also emitted from a
-  ``finally`` path on any exception.
+  orchestrator (this process)
+  ├── CPU insurance worker  (subprocess, small shapes, starts at t=0)
+  ├── TPU probe loop        (retry with backoff THROUGHOUT the budget)
+  └── TPU worker            (subprocess, launched when a probe succeeds,
+                             relaunched after re-probe if it dies early)
+
+Both workers run the same staged benchmark (``--worker`` mode below) and
+persist their full result JSON atomically after EVERY progress update,
+so a mid-run tunnel drop still leaves an on-chip artifact on disk
+(BENCH_STATE_DIR, default artifacts/bench_state/). The orchestrator
+merges continuously: the headline is the TPU worker's number the moment
+it exists, the CPU number only if the chip never materializes. The CPU
+worker is terminated once the TPU worker publishes a nonzero headline
+(its job — insurance against a dead tunnel — is done, and it would
+otherwise contend for host cores the TPU input pipeline needs).
+
+Un-killability contract (round-1 failure: silent rc=124): a watchdog
+thread in the orchestrator force-emits the merged best-so-far before the
+driver's kill horizon; workers carry their own watchdogs (os._exit works
+even when the main thread is blocked inside a hung device call) and
+budget themselves to finish before the orchestrator's margin.
 
 ``vs_baseline`` is measured/target against the self-established target
 (the reference publishes no numbers and its training path is a stub; see
@@ -49,123 +53,107 @@ TARGET_P50_MS = 1.0
 # Total wall budget. The driver's observed kill horizon is >240 s; leave
 # margin so the watchdog always wins the race against SIGKILL.
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "200"))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT_S", "60"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT_S", "25"))
+STATE_DIR = os.environ.get(
+    "BENCH_STATE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "artifacts", "bench_state"))
 
 _t0 = time.perf_counter()
-# Reentrant: every mutation of ``result`` and the final dumps hold this
-# lock, so the watchdog can never serialize a dict mid-mutation (which
-# would raise inside json.dumps AFTER latching the emitted flag and lose
-# the line forever).
-_emit_lock = threading.RLock()
-_emitted = False
-
-result = {
-    "metric": "graphsage_train_samples_per_sec_per_chip",
-    "value": 0,
-    "unit": "samples/sec/chip",
-    "vs_baseline": 0.0,
-    "extras": {"stages_completed": [], "platform": "unknown"},
-}
 
 
-def record(**extras) -> None:
-    with _emit_lock:
-        result["extras"].update(extras)
-
-
-def stamp(name: str) -> None:
-    """Record a sub-stage timestamp (seconds since process start)."""
-    record(**{f"t_{name}": round(time.perf_counter() - _t0, 1)})
-
-
-def stage_done(name: str) -> None:
-    with _emit_lock:
-        result["extras"]["stages_completed"].append(name)
-    stamp(name)
-
-
-def set_headline(value: float) -> None:
-    with _emit_lock:
-        result["value"] = int(value)
-        result["vs_baseline"] = round(
-            value / TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP, 3)
-
-
-def emit() -> None:
-    global _emitted
-    with _emit_lock:
-        if _emitted:
-            return
-        result["extras"]["wall_seconds"] = round(time.perf_counter() - _t0, 1)
-        line = json.dumps(result)
-        _emitted = True
-        print(line, flush=True)
+def elapsed() -> float:
+    return time.perf_counter() - _t0
 
 
 def remaining() -> float:
-    return BUDGET_S - (time.perf_counter() - _t0)
+    return BUDGET_S - elapsed()
 
 
-def _watchdog() -> None:
-    # Sleep in small slices so a fast successful run exits normally.
-    while remaining() > 0:
-        if _emitted:
-            return
-        time.sleep(min(1.0, max(remaining(), 0.01)))
-    stage_done("watchdog_fired")
-    emit()
-    os._exit(0)
+class BenchState:
+    """The result dict + thread-safe mutation + atomic disk persistence.
 
-
-def probe_tpu() -> bool:
-    """Check — in a throwaway subprocess — that backend init completes.
-
-    The subprocess inherits the environment (this machine's sitecustomize
-    selects the TPU platform); if it can't enumerate an accelerator
-    within the timeout, the main process must not try.
+    Every mutation holds a reentrant lock so a watchdog can never
+    serialize a dict mid-mutation; ``flush`` writes tmp+rename so a
+    reader (the orchestrator) never sees a torn file.
     """
-    code = ("import jax; ds = jax.devices(); "
-            "print(ds[0].platform, len(ds))")
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True,
-            timeout=min(PROBE_TIMEOUT_S, max(remaining() - 90, 5)),
-        )
-    except subprocess.TimeoutExpired:
-        record(tpu_probe="timeout")
-        return False
-    if proc.returncode != 0:
-        record(tpu_probe=f"rc={proc.returncode}")
-        return False
-    out = proc.stdout.strip().split()
-    record(tpu_probe=" ".join(out))
-    return bool(out) and out[0] not in ("cpu",)
+
+    def __init__(self, out_path: str | None = None):
+        self.lock = threading.RLock()
+        self.out_path = out_path
+        self.emitted = False
+        self.result = {
+            "metric": "graphsage_train_samples_per_sec_per_chip",
+            "value": 0,
+            "unit": "samples/sec/chip",
+            "vs_baseline": 0.0,
+            "extras": {"stages_completed": [], "platform": "unknown"},
+        }
+
+    def record(self, **extras) -> None:
+        with self.lock:
+            self.result["extras"].update(extras)
+        self.flush()
+
+    def stamp(self, name: str) -> None:
+        self.record(**{f"t_{name}": round(elapsed(), 1)})
+
+    def stage_done(self, name: str) -> None:
+        with self.lock:
+            self.result["extras"]["stages_completed"].append(name)
+        self.stamp(name)
+
+    def set_headline(self, value: float) -> None:
+        with self.lock:
+            self.result["value"] = int(value)
+            self.result["vs_baseline"] = round(
+                value / TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP, 3)
+        self.flush()
+
+    def flush(self) -> None:
+        if not self.out_path:
+            return
+        with self.lock:
+            blob = json.dumps(self.result)
+        tmp = self.out_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, self.out_path)
+        except OSError:
+            pass
+
+    def emit(self) -> None:
+        with self.lock:
+            if self.emitted:
+                return
+            self.result["extras"]["wall_seconds"] = round(elapsed(), 1)
+            line = json.dumps(self.result)
+            self.emitted = True
+        self.flush()
+        print(line, flush=True)
 
 
-def main() -> None:
-    threading.Thread(target=_watchdog, daemon=True, name="bench-watchdog").start()
-    try:
-        run_stages()
-    finally:
-        emit()
+# --------------------------------------------------------------------------
+# Worker: runs the actual staged benchmark on one platform.
+# --------------------------------------------------------------------------
 
+def run_stages(state: BenchState, platform: str, budget: float) -> None:
+    t_start = time.perf_counter()
 
-def run_stages() -> None:
-    probe_t0 = time.perf_counter()
-    on_tpu = probe_tpu()
-    record(tpu_probe_seconds=round(time.perf_counter() - probe_t0, 1))
-    if not on_tpu:
+    def left() -> float:
+        return budget - (time.perf_counter() - t_start)
+
+    if platform != "tpu":
         # Must happen before ANY backend use; the env var alone is
         # overridden by this machine's sitecustomize.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        record(platform="cpu_fallback")
 
     from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
 
-    record(compile_cache_dir=enable_compilation_cache())
+    state.record(compile_cache_dir=enable_compilation_cache())
 
     import jax
 
@@ -174,29 +162,29 @@ def run_stages() -> None:
     from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
 
     mesh = data_parallel_mesh()
-    if on_tpu:
-        record(platform=jax.devices()[0].platform)
-    record(n_devices=mesh.n_data)
-    stage_done("init")
+    state.record(platform=jax.devices()[0].platform, n_devices=mesh.n_data)
+    state.stage_done("init")
 
-    # Stage 1: parent-selection p50 through the jitted scorer, FIRST —
-    # latency is weight-independent, so a synthetically initialized MLP
-    # measures the same compiled dispatch path a trained one would, and
-    # the <1 ms target gets validated before the GNN stage can starve it.
-    # The stage is wall-capped (a degraded tunnel must not eat the GNN
-    # budget), and the raw number is decomposed: a no-op jit call
-    # measures the platform dispatch floor (the tunneled axon TPU pays a
-    # network round trip per blocking call — observed ~68 ms even for
-    # the "cpu" device, the whole backend is remote), and
-    # parent_select_model_ms reports p50 minus that floor — an estimate
-    # of what a scheduler colocated with its TPU sidecar would observe.
+    # Stage 1: parent-selection latency FIRST — it is weight-independent
+    # (a synthetically initialized MLP exercises the same compiled
+    # dispatch path a trained one would), so the <1 ms target gets
+    # validated before the GNN stage can starve it. Two measurements:
+    #   (a) single-threaded ParentScorer loop (the round-3 number), and
+    #   (b) the COLOCATED number the target is actually about — 8
+    #       scheduler threads through the MicroBatcher, end-to-end
+    #       (round-3 verdict item 5).
+    # Both are decomposed against the dispatch floor (a blocking no-op
+    # jit round trip: the tunneled axon TPU pays a network RTT per call
+    # — observed ~68 ms — so raw and floor-corrected are published side
+    # by side, clearly labeled).
     import jax.numpy as jnp
 
     from dragonfly2_tpu.inference import ParentScorer
+    from dragonfly2_tpu.inference.loadgen import measure_colocated
     from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
     from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
 
-    scorer_budget = max(min(remaining() * 0.15, 20.0), 3.0)
+    scorer_budget = max(min(left() * 0.2, 30.0), 4.0)
     scorer_t0 = time.perf_counter()
 
     mlp_model = MLPBandwidthPredictor()
@@ -204,12 +192,8 @@ def run_stages() -> None:
                                 jnp.zeros((1, FEATURE_DIM)))
     scorer = ParentScorer(mlp_model, mlp_params,
                           Normalizer.identity(FEATURE_DIM),
-                          Normalizer.identity(1), max_batch=16)
+                          Normalizer.identity(1), max_batch=128)
 
-    # Dispatch floor: p50 of a blocking no-op jit round trip. On the
-    # tunneled axon platform this IS the p50 (observed ~68 ms RTT even
-    # for the "cpu" device — the whole backend is remote); the
-    # hardware-independent model cost is p50 - floor.
     noop = jax.jit(lambda x: x + 1)
     x0 = jnp.zeros(8)
     noop(x0).block_until_ready()
@@ -219,59 +203,70 @@ def run_stages() -> None:
         noop(x0).block_until_ready()
         floor.append((time.perf_counter() - t) * 1e3)
     floor_p50 = sorted(floor)[len(floor) // 2]
-    record(dispatch_floor_p50_ms=round(floor_p50, 4))
+    state.record(dispatch_floor_p50_ms=round(floor_p50, 4))
 
-    # Adaptive iteration count: probe, then fill the stage's remaining
-    # wall budget (never fewer than 20, never more than 300 iters).
+    # (a) single-threaded loop, adaptive iteration count.
     probe = scorer.benchmark(batch=16, iters=10)
-    stage_left = scorer_budget - (time.perf_counter() - scorer_t0)
-    iters = int(max(20, min(300, stage_left * 1e3 / max(probe["p50_ms"], 1e-3))))
+    solo_budget = (scorer_budget - (time.perf_counter() - scorer_t0)) * 0.4
+    iters = int(max(20, min(300,
+                            solo_budget * 1e3 / max(probe["p50_ms"], 1e-3))))
     latency = scorer.benchmark(batch=16, iters=iters)
-    record(
+    state.record(
         parent_select_p50_ms=round(latency["p50_ms"], 4),
         parent_select_p99_ms=round(latency["p99_ms"], 4),
         parent_select_iters=iters,
-        # Model-only cost with the platform round trip subtracted — what a
-        # scheduler colocated with its TPU sidecar would observe.
         parent_select_model_ms=round(
             max(latency["p50_ms"] - floor_p50, 0.0), 4),
         parent_select_vs_1ms_target=round(
             TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
     )
-    stage_done("scorer")
 
-    # Stage 2 (headline): GraphSAGE on a 2M-edge probe graph. The step
-    # loop gets the remaining budget minus reserves for eval + emit, and
-    # publishes throughput incrementally so the watchdog always has the
-    # latest steady-state rate. The CPU fallback (tunnel outage) shrinks
-    # the problem so every stage COMPLETES — a small honest number
-    # beats a watchdog kill mid-compile.
-    if on_tpu:
+    # (b) colocated: 8 concurrent scheduler threads → MicroBatcher → one
+    # padded dispatch per in-flight window. parent_select_colocated_*
+    # fields are the deliverable named by the round-3 verdict.
+    colo_secs = max(min(scorer_budget - (time.perf_counter() - scorer_t0),
+                        6.0), 1.0)
+    colo = measure_colocated(scorer, threads=8, rows_per_request=16,
+                             duration_s=colo_secs,
+                             dispatch_floor_ms=floor_p50)
+    state.record(
+        parent_select_colocated_p50_ms=colo["p50_ms"],
+        parent_select_colocated_p99_ms=colo["p99_ms"],
+        parent_select_colocated_p50_floor_corrected_ms=colo[
+            "p50_floor_corrected_ms"],
+        parent_select_colocated_requests_per_sec=colo["requests_per_sec"],
+        parent_select_colocated_coalesce_factor=colo["coalesce_factor"],
+        parent_select_colocated_threads=colo["threads"],
+    )
+    state.stage_done("scorer")
+
+    # Stage 2 (headline): GraphSAGE on a probe graph. The step loop gets
+    # the remaining budget minus reserves for eval + emit, and publishes
+    # throughput incrementally so a watchdog fire always has the latest
+    # steady-state rate. CPU insurance shrinks the problem so every
+    # stage COMPLETES — a small honest number beats a kill mid-compile.
+    if platform == "tpu":
         n_edges, batch, steps_per_call = 2_000_000, 8192, 8
     else:
         n_edges, batch, steps_per_call = 200_000, 2048, 1
     cluster = SyntheticCluster(n_hosts=2000, seed=0)
     graph = cluster.probe_graph(n_edges)
-    stamp("graph_built")
+    state.stamp("graph_built")
 
     def on_progress(steps: int, rate: float) -> None:
-        set_headline(rate / mesh.n_data)
-        record(gnn_steps=steps)
+        state.set_headline(rate / mesh.n_data)
+        state.record(gnn_steps=steps)
 
     def on_compile(seconds: float) -> None:
-        record(gnn_compile_seconds=round(seconds, 1))
-        stamp("gnn_compile_done")
+        state.record(gnn_compile_seconds=round(seconds, 1))
+        state.stamp("gnn_compile_done")
 
-    # Reserves: the eval pass compiles its own (second) program on a cold
-    # cache, so its cap is kept under the reserve and the emit margin is
-    # generous — a watchdog fire mid-eval still emits the incrementally
-    # published headline; only f1 would be lost.
-    eval_reserve = max(min(remaining() * 0.2, 30.0), 5.0)
-    emit_reserve = 15.0
-    compile_reserve = 30.0  # uncached train-step compile; ~0 when cache hits
-    gnn_budget = max(
-        remaining() - eval_reserve - emit_reserve - compile_reserve, 5.0)
-    record(gnn_step_seconds_budget=round(gnn_budget, 1))
+    eval_reserve = max(min(left() * 0.2, 30.0), 5.0)
+    emit_reserve = 10.0
+    compile_reserve = 30.0  # uncached train-step compile; ~0 on cache hit
+    gnn_budget = max(left() - eval_reserve - emit_reserve - compile_reserve,
+                     5.0)
+    state.record(gnn_step_seconds_budget=round(gnn_budget, 1))
     gnn = train_gnn(
         graph,
         # steps_per_call=8 on the chip: eight optimizer updates per
@@ -286,44 +281,252 @@ def run_stages() -> None:
                        eval_max_seconds=min(eval_reserve, 25.0)),
         mesh,
     )
-    per_chip = gnn.samples_per_sec / mesh.n_data
-    set_headline(per_chip)
-    record(
+    state.set_headline(gnn.samples_per_sec / mesh.n_data)
+    state.record(
         gnn_f1=round(gnn.f1, 4),
         gnn_precision=round(gnn.precision, 4),
         gnn_recall=round(gnn.recall, 4),
         gnn_steps=gnn.steps,
         gnn_compile_seconds=round(gnn.compile_seconds, 1),
     )
-    stage_done("gnn")
+    state.stage_done("gnn")
 
     # Stage 3 (only if budget allows): MLP training throughput + honest
-    # registry mae from a really-trained model. Needs headroom for its
-    # own two compiles (train + eval) on a cold cache, so the entry bar
-    # is high and the step budget leaves the emit margin alone.
-    if remaining() > 45.0:
+    # registry mae from a really-trained model.
+    if left() > 45.0:
         from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
 
         X, y = cluster.pair_example_columns(300_000)
         mlp = train_mlp(
             X, y,
             MLPTrainConfig(epochs=100, batch_size=16384,
-                           max_seconds=max(
-                               min(remaining() - 30.0, 25.0), 2.0),
-                           progress_callback=lambda s, r: record(
+                           max_seconds=max(min(left() - 25.0, 25.0), 2.0),
+                           progress_callback=lambda s, r: state.record(
                                mlp_train_samples_per_sec_per_chip=int(
                                    r / mesh.n_data)),
-                           compile_callback=lambda c: record(
+                           compile_callback=lambda c: state.record(
                                mlp_compile_seconds=round(c, 1))),
             mesh,
         )
-        record(
+        state.record(
             mlp_train_samples_per_sec_per_chip=int(
                 mlp.samples_per_sec / mesh.n_data),
             mlp_eval_mae_mbps=round(mlp.mae, 3),
         )
-        stage_done("mlp")
+        state.stage_done("mlp")
+
+
+def worker_main(platform: str, out_path: str, budget: float) -> None:
+    state = BenchState(out_path)
+    state.record(platform_requested=platform, worker_pid=os.getpid())
+
+    t_start = time.perf_counter()
+
+    def watchdog() -> None:
+        # os._exit from this thread works even when the main thread is
+        # blocked inside a hung device call (the tunnel-drop mode).
+        while time.perf_counter() - t_start < budget:
+            time.sleep(0.5)
+        state.record(worker_watchdog_fired=True)
+        state.flush()
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True,
+                     name="bench-worker-watchdog").start()
+    try:
+        run_stages(state, platform, budget - 3.0)
+        state.record(worker_done=True)
+    except BaseException as exc:  # noqa: BLE001 — persist, then re-raise
+        state.record(worker_error=f"{type(exc).__name__}: {exc}")
+        state.flush()
+        raise
+    state.flush()
+
+
+# --------------------------------------------------------------------------
+# Orchestrator.
+# --------------------------------------------------------------------------
+
+def probe_tpu(state: BenchState, timeout: float) -> bool:
+    """Check — in a throwaway subprocess — that backend init completes
+    and enumerates an accelerator."""
+    code = ("import jax; ds = jax.devices(); "
+            "print(ds[0].platform, len(ds))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        state.record(tpu_probe="timeout")
+        return False
+    if proc.returncode != 0:
+        state.record(tpu_probe=f"rc={proc.returncode}")
+        return False
+    out = proc.stdout.strip().split()
+    state.record(tpu_probe=" ".join(out))
+    return bool(out) and out[0] not in ("cpu",)
+
+
+def launch_worker(platform: str, out_path: str,
+                  budget: float) -> subprocess.Popen:
+    env = dict(os.environ)
+    if platform != "tpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker", platform,
+         out_path, f"{budget:.1f}"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+
+
+def read_state(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def merge(state: BenchState, cpu_path: str, tpu_path: str) -> None:
+    """Fold worker files into the orchestrator's result. TPU wins the
+    headline the moment it has a nonzero value; CPU is insurance."""
+    tpu = read_state(tpu_path)
+    cpu = read_state(cpu_path)
+    chosen, source = None, None
+    if tpu and tpu.get("value", 0) > 0:
+        chosen, source = tpu, "tpu_worker"
+    elif cpu and cpu.get("value", 0) > 0:
+        chosen, source = cpu, "cpu_worker"
+    elif tpu and tpu.get("extras", {}).get("stages_completed"):
+        chosen, source = tpu, "tpu_worker"
+    elif cpu:
+        chosen, source = cpu, "cpu_worker"
+    with state.lock:
+        probes = {k: v for k, v in state.result["extras"].items()
+                  if k.startswith(("tpu_probe", "tpu_worker", "tpu_launches",
+                                   "cpu_worker", "orchestrator"))}
+        if chosen is not None:
+            state.result["value"] = chosen["value"]
+            state.result["vs_baseline"] = chosen["vs_baseline"]
+            state.result["extras"] = dict(chosen.get("extras", {}))
+            state.result["extras"]["headline_source"] = source
+        state.result["extras"].update(probes)
+        # Carry the non-headline worker's key numbers for the record.
+        other = cpu if source == "tpu_worker" else tpu
+        other_name = "cpu_worker" if source == "tpu_worker" else "tpu_worker"
+        if other:
+            state.result["extras"][other_name] = {
+                "value": other.get("value", 0),
+                "platform": other.get("extras", {}).get("platform"),
+                "stages_completed": other.get("extras", {}).get(
+                    "stages_completed", []),
+            }
+    state.flush()
+
+
+def main() -> None:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    cpu_path = os.path.join(STATE_DIR, "cpu.json")
+    tpu_path = os.path.join(STATE_DIR, "tpu.json")
+    for p in (cpu_path, tpu_path):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
+    state = BenchState(os.path.join(STATE_DIR, "merged.json"))
+
+    def watchdog() -> None:
+        while remaining() > 0:
+            if state.emitted:
+                return
+            time.sleep(min(1.0, max(remaining(), 0.01)))
+        merge(state, cpu_path, tpu_path)
+        state.record(orchestrator_watchdog_fired=True)
+        state.emit()
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True,
+                     name="bench-watchdog").start()
+
+    try:
+        # CPU insurance starts immediately: small shapes, finishes well
+        # inside its slice, guarantees a nonzero artifact if the chip
+        # never shows up.
+        cpu_budget = min(BUDGET_S * 0.5, 110.0)
+        cpu_proc = launch_worker("cpu", cpu_path, cpu_budget)
+        state.record(cpu_worker_budget_s=round(cpu_budget, 1))
+
+        # Probe loop: retry with backoff for as long as a TPU worker
+        # could still do useful work (it needs ~60 s minimum: scorer
+        # stage + one compile + a few step windows).
+        tpu_proc = None
+        probes = 0
+        tpu_launches = 0
+        while remaining() > 55.0:
+            if tpu_proc is None:
+                probes += 1
+                if probe_tpu(state, min(PROBE_TIMEOUT_S,
+                                        remaining() - 40.0)):
+                    tpu_budget = remaining() - 12.0
+                    tpu_proc = launch_worker("tpu", tpu_path, tpu_budget)
+                    tpu_launches += 1
+                    state.record(tpu_worker_budget_s=round(tpu_budget, 1),
+                                 tpu_launches=tpu_launches)
+                else:
+                    time.sleep(min(5.0, max(remaining() - 50.0, 0.5)))
+                    continue
+            rc = tpu_proc.poll()
+            snap = read_state(tpu_path)
+            tpu_value = (snap or {}).get("value", 0)
+            if tpu_value > 0 and cpu_proc.poll() is None:
+                # Insurance no longer needed; stop contending for host
+                # cores the TPU input pipeline wants.
+                cpu_proc.terminate()
+                state.record(cpu_worker_terminated_early=True)
+            if rc is None:
+                time.sleep(1.0)
+                continue
+            # TPU worker exited. Done if it produced the goods;
+            # otherwise (tunnel died mid-run) re-probe and relaunch
+            # with whatever budget is left.
+            done = bool((snap or {}).get("extras", {}).get("worker_done"))
+            if done or tpu_value > 0:
+                break
+            state.record(tpu_worker_rc=rc)
+            tpu_proc = None
+
+        state.record(tpu_probe_count=probes)
+
+        # A live TPU worker runs to its granted budget (only the emit
+        # margin is reserved) — the probe loop above exits early because
+        # RELAUNCHING needs ≥55 s to be useful, not because a worker
+        # already mid-measurement should die.
+        while (tpu_proc is not None and tpu_proc.poll() is None
+               and remaining() > 10.0):
+            snap = read_state(tpu_path)
+            if ((snap or {}).get("value", 0) > 0
+                    and cpu_proc.poll() is None):
+                cpu_proc.terminate()
+                state.record(cpu_worker_terminated_early=True)
+            time.sleep(1.0)
+
+        # If no TPU result, give the CPU worker its remaining slice.
+        snap = read_state(tpu_path)
+        if not (snap and snap.get("value", 0) > 0):
+            while cpu_proc.poll() is None and remaining() > 8.0:
+                time.sleep(0.5)
+        for proc in (cpu_proc, tpu_proc):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        merge(state, cpu_path, tpu_path)
+    finally:
+        merge(state, cpu_path, tpu_path)
+        state.emit()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
+        worker_main(sys.argv[2], sys.argv[3], float(sys.argv[4]))
+    else:
+        main()

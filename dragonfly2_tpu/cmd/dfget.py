@@ -147,11 +147,13 @@ def _recursive_download(args, headers) -> int:
 
         # Preflight so an unreachable daemon degrades like the
         # non-recursive ladder instead of crashing mid-tree.
+        probe = None
         try:
             probe = RemoteDaemonClient(args.daemon)
             probe.version()
         except Exception as exc:  # noqa: BLE001 — daemon down is soft
-            probe.close()
+            if probe is not None:
+                probe.close()
             print(f"daemon {args.daemon} failed: {exc}", file=sys.stderr)
             if not args.scheduler:
                 return 1

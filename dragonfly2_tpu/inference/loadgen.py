@@ -1,0 +1,104 @@
+"""Concurrent-load latency measurement for the colocated scorer path.
+
+Round-3 verdict: the <1 ms parent-select target was "argued, not
+measured" — the published number was a subtraction of the tunnel's
+dispatch floor from a single-threaded loop. This module measures the
+number the target is actually about: a scheduler process colocated with
+its inference sidecar, with N scheduler threads concurrently pushing
+parent-selection requests through the :class:`MicroBatcher` (the serving
+path a real deployment uses — reference integration point
+scheduler/scheduling/evaluator/evaluator.go:48). Raw per-request
+latencies are reported alongside the dispatch-floor-corrected view so
+tunnel-attached runs stay honest.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from dragonfly2_tpu.inference.batcher import MicroBatcher
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def measure_colocated(
+    scorer,
+    *,
+    threads: int = 8,
+    rows_per_request: int = 16,
+    duration_s: float = 3.0,
+    max_rows: int | None = None,
+    dispatch_floor_ms: float = 0.0,
+) -> Dict[str, float]:
+    """Drive ``threads`` concurrent request loops through a MicroBatcher
+    wrapped around ``scorer`` for ``duration_s`` and return latency and
+    throughput stats (milliseconds).
+
+    ``dispatch_floor_ms`` — p50 of a blocking no-op device round trip,
+    measured by the caller — yields the floor-corrected fields: what the
+    same program observes when the device is local instead of tunneled.
+    """
+    from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+    batcher = MicroBatcher(scorer, max_rows=max_rows)
+    feature_dim = FEATURE_DIM
+    rng = np.random.default_rng(0)
+    features = rng.standard_normal(
+        (threads, rows_per_request, feature_dim)).astype(np.float32)
+
+    # Warm every thread once so per-bucket compiles don't pollute timing.
+    batcher.score(features[0])
+
+    latencies: List[List[float]] = [[] for _ in range(threads)]
+    stop = threading.Event()
+    start_barrier = threading.Barrier(threads + 1)
+
+    def loop(tid: int) -> None:
+        mine = features[tid]
+        out = latencies[tid]
+        start_barrier.wait()
+        while not stop.is_set():
+            t = time.perf_counter()
+            batcher.score(mine)
+            out.append((time.perf_counter() - t) * 1e3)
+
+    workers = [threading.Thread(target=loop, args=(i,), daemon=True)
+               for i in range(threads)]
+    for w in workers:
+        w.start()
+    start_barrier.wait()
+    t_start = time.perf_counter()
+    time.sleep(duration_s)
+    stop.set()
+    for w in workers:
+        w.join(timeout=10)
+    wall = time.perf_counter() - t_start
+    batcher.close()
+
+    merged = sorted(x for sub in latencies for x in sub)
+    n = len(merged)
+    coalesce = (batcher.coalesced_requests / batcher.dispatches
+                if batcher.dispatches else 0.0)
+    p50 = _percentile(merged, 0.50)
+    p99 = _percentile(merged, 0.99)
+    return {
+        "threads": threads,
+        "requests": n,
+        "requests_per_sec": round(n / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": round(p50, 4),
+        "p99_ms": round(p99, 4),
+        "p50_floor_corrected_ms": round(max(p50 - dispatch_floor_ms, 0.0), 4),
+        "p99_floor_corrected_ms": round(max(p99 - dispatch_floor_ms, 0.0), 4),
+        "dispatch_floor_ms": round(dispatch_floor_ms, 4),
+        "coalesce_factor": round(coalesce, 2),
+        "dispatches": batcher.dispatches,
+    }

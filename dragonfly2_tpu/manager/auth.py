@@ -79,8 +79,16 @@ class Identity:
     user_id: int
     name: str
     roles: List[str]
+    # Non-None for PAT-authenticated requests with declared scopes: the
+    # objects the token may touch, enforced before role policy (the
+    # reference checks PAT scopes in
+    # manager/middlewares/personal_access_token.go).
+    scopes: Optional[List[str]] = None
 
     def can(self, obj: str, action: str) -> bool:
+        if (self.scopes is not None
+                and obj not in self.scopes and "*" not in self.scopes):
+            return False
         for role in self.roles:
             policy = ROLE_POLICIES.get(role, {})
             for scope in (obj, "*"):
@@ -196,7 +204,11 @@ class AuthService:
         user = self.db.get("users", row.user_id)
         if user is None or user.state != "enable":
             return None
-        return Identity(user.id, user.name, self.roles_of(user.id))
+        # A token created with scopes grants ONLY those objects; an
+        # empty scope list means the owning user's full permissions.
+        scopes = list(row.scopes or []) or None
+        return Identity(user.id, user.name, self.roles_of(user.id),
+                        scopes=scopes)
 
     def revoke_pat(self, pat_id: int) -> None:
         self.db.update("personal_access_tokens", pat_id, state="revoked")

@@ -35,8 +35,12 @@ def _canonical_query(query: str) -> str:
 
 
 def _canonical_uri(path: str) -> str:
-    # S3 style: each path segment uri-encoded, '/' preserved.
-    return urllib.parse.quote(path or "/", safe="/-_.~")
+    # S3 style: the canonical URI is the wire path verbatim — callers
+    # percent-encode keys exactly once when building the URL, and AWS
+    # S3 signs that once-encoded form without re-encoding or
+    # normalizing (re-quoting here would double-encode '%' and produce
+    # SignatureDoesNotMatch on any key with spaces/'+'/unicode).
+    return path or "/"
 
 
 def sign_request(

@@ -93,6 +93,21 @@ class TestAuthService:
         auth.revoke_pat(pat.id)
         assert auth.verify_pat(raw) is None
 
+    def test_pat_scopes_enforced(self, auth):
+        """A scoped token grants ONLY its declared objects even when the
+        owning user is root (round-3 ADVICE item 2; reference
+        manager/middlewares/personal_access_token.go)."""
+        user = auth.db.find_one("users", name=DEFAULT_ROOT_USER)
+        raw = auth.create_pat(user.id, "preheat-only", scopes=["jobs"])
+        ident = auth.verify_pat(raw)
+        assert ident.can("jobs", "write")
+        assert not ident.can("models", "read")
+        assert not ident.can("scheduler-clusters", "write")
+        # Unscoped token keeps the user's full role permissions.
+        ident_full = auth.verify_pat(auth.create_pat(user.id, "full"))
+        assert ident_full.scopes is None
+        assert ident_full.can("models", "write")
+
 
 class TestRestAuth:
     def test_unauthorized_request_rejected(self, api):
