@@ -55,8 +55,8 @@ def main(argv=None) -> int:
         ManagerService,
     )
     from dragonfly2_tpu.manager.auth import AuthService
+    from dragonfly2_tpu.manager.jobplane import DurableJobStore
     from dragonfly2_tpu.manager.jobs import (
-        JobBus,
         PreheatService,
         SyncPeersService,
     )
@@ -73,12 +73,17 @@ def main(argv=None) -> int:
         object_store = FilesystemObjectStore(args.object_store_dir)
     service = ManagerService(db, object_store, metrics=metrics)
     auth = None if args.no_auth else AuthService(db, secret=args.jwt_secret)
-    bus = JobBus()
+    # Durable cross-process job plane: preheat jobs land in the DB and
+    # standalone schedulers lease them over the internal surface
+    # (scheduler/jobworker.py RemoteJobWorker) with machinery-style
+    # retry/dead-letter semantics.
+    jobstore = DurableJobStore(db)
     api = RestApi(service, auth=auth,
-                  preheat=PreheatService(bus, service),
+                  preheat=PreheatService(jobstore, service),
                   # rpc mode: pulls ListHosts from each registered
                   # scheduler directly — works across processes.
-                  sync_peers=SyncPeersService(bus, service, mode="rpc"))
+                  sync_peers=SyncPeersService(None, service, mode="rpc"),
+                  jobstore=jobstore)
     server = ManagerHTTPServer(api, host=args.host, port=args.port)
     server.start()
     print(f"manager serving on {args.host}:{server.port} "

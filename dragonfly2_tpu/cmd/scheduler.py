@@ -102,6 +102,8 @@ def main(argv=None) -> int:
     parser.add_argument("--cluster-id", type=int, default=0,
                         help="scheduler cluster id at the manager "
                              "(0 = manager default cluster)")
+    parser.add_argument("--job-poll-interval", type=float, default=1.0,
+                        help="seconds between job-plane lease polls")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="scheduler")
@@ -181,6 +183,15 @@ def main(argv=None) -> int:
         dynconfig.subscribe(service.scheduling.apply_dynconfig)
         dynconfig.refresh()
         dynconfig.serve()
+
+        # Consume manager-initiated jobs (preheat, sync-peers) from the
+        # durable cross-process plane (scheduler/job/job.go:49 Serve).
+        from dragonfly2_tpu.scheduler.jobworker import RemoteJobWorker
+
+        job_worker = RemoteJobWorker(mgr, service, args.scheduler_id,
+                                     poll_interval=args.job_poll_interval)
+        job_worker.serve()
+        print(f"job worker polling queues {job_worker.queues}", flush=True)
 
     announcer = None
     if args.trainer:

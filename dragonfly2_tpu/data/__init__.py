@@ -13,14 +13,20 @@ from dragonfly2_tpu.data.features import (
     pair_examples_from_table,
 )
 from dragonfly2_tpu.data.pipeline import ArrayDataset, shard_batch
+from dragonfly2_tpu.data.sharded import (
+    ShardedParquetDataset,
+    write_columns_sharded,
+)
 from dragonfly2_tpu.data.synthetic import SyntheticCluster
 
 __all__ = [
     "ArrayDataset",
     "Graph",
     "PAIR_LABEL_SCALE",
+    "ShardedParquetDataset",
     "SyntheticCluster",
     "graph_from_table",
     "pair_examples_from_table",
     "shard_batch",
+    "write_columns_sharded",
 ]

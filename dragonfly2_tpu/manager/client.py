@@ -63,6 +63,33 @@ class ManagerHTTPClient:
             "cluster_id": cluster_id,
         })
 
+    # -- job plane ------------------------------------------------------
+
+    def lease_job(self, *, queues: List[str], worker_id: str,
+                  lease_ttl: float | None = None) -> Optional[Dict]:
+        """Claim the oldest runnable job in any of ``queues`` (None when
+        all are empty)."""
+        resp = self._call("POST", "/internal/v1/jobs/lease", {
+            "queues": queues, "worker_id": worker_id,
+            "lease_ttl": lease_ttl,
+        })
+        return resp.get("job")
+
+    def complete_job(self, job_id: int, *, ok: bool, error: str = "",
+                     result=None, worker_id: str = "") -> Dict:
+        return self._call("POST", f"/internal/v1/jobs/{job_id}/complete", {
+            "ok": ok, "error": error, "result": result,
+            "worker_id": worker_id,
+        })
+
+    def renew_job(self, job_id: int, *, worker_id: str,
+                  lease_ttl: float | None = None) -> bool:
+        """Heartbeat a long-running job's lease; False = lease lost."""
+        resp = self._call("POST", f"/internal/v1/jobs/{job_id}/renew", {
+            "worker_id": worker_id, "lease_ttl": lease_ttl,
+        })
+        return bool(resp.get("renewed"))
+
     # -- dynconfig ------------------------------------------------------
 
     def daemon_dynconfig(self, *, ip: str = "",

@@ -127,6 +127,27 @@ CREATE TABLE IF NOT EXISTS personal_access_tokens (
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS queued_jobs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    queue TEXT NOT NULL,
+    type TEXT NOT NULL,
+    payload TEXT NOT NULL DEFAULT '{}',
+    group_id TEXT NOT NULL DEFAULT '',
+    state TEXT NOT NULL DEFAULT 'pending',
+    attempts INTEGER NOT NULL DEFAULT 0,
+    max_attempts INTEGER NOT NULL DEFAULT 3,
+    not_before REAL NOT NULL DEFAULT 0,
+    lease_expires_at REAL NOT NULL DEFAULT 0,
+    worker_id TEXT NOT NULL DEFAULT '',
+    error TEXT NOT NULL DEFAULT '',
+    result TEXT NOT NULL DEFAULT 'null',
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_queued_jobs_queue_state
+    ON queued_jobs (queue, state);
+CREATE INDEX IF NOT EXISTS idx_queued_jobs_group
+    ON queued_jobs (group_id);
 CREATE TABLE IF NOT EXISTS peers (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     host_id TEXT NOT NULL,
@@ -171,7 +192,7 @@ class Row:
 
 _JSON_COLUMNS = {
     "config", "client_config", "scopes", "features", "priorities",
-    "evaluation", "seed_peer_clusters",
+    "evaluation", "seed_peer_clusters", "payload", "result",
 }
 
 

@@ -10,15 +10,19 @@ can slot behind the same interface for multi-host deployments.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import queue
+import re
 import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -196,14 +200,92 @@ class ImageRef:
         return f"{self.registry}/v2/{self.name}/blobs/{digest}"
 
 
-def resolve_image_layers(image_url: str, *, timeout: float = 30.0,
-                         headers: Dict[str, str] | None = None) -> List[str]:
-    """Manifest (incl. multi-arch index) → layer blob URLs."""
+def _parse_challenge(header: str) -> Tuple[str, Dict[str, str]]:
+    """``WWW-Authenticate: Bearer realm="...",service="...",scope="..."``
+    → ("bearer", params). Also recognizes Basic."""
+    scheme, _, rest = header.strip().partition(" ")
+    params = {}
+    for m in re.finditer(r'(\w+)="([^"]*)"|(\w+)=([^",\s]+)', rest):
+        if m.group(1):
+            params[m.group(1).lower()] = m.group(2)
+        else:
+            params[m.group(3).lower()] = m.group(4)
+    return scheme.lower(), params
+
+
+def fetch_registry_token(challenge: str, *, username: str = "",
+                         password: str = "", timeout: float = 30.0,
+                         repository: str = "") -> str:
+    """The Bearer half of the Docker registry token dance
+    (manager/job/preheat.go:168-246 getManifests → getAuthToken): GET the
+    challenge's realm with service+scope (Basic credentials if given) and
+    return the issued token."""
+    scheme, params = _parse_challenge(challenge)
+    if scheme != "bearer":
+        raise ValueError(f"unsupported auth challenge scheme {scheme!r}")
+    realm = params.get("realm", "")
+    if not realm:
+        raise ValueError("Bearer challenge without realm")
+    query = {}
+    if params.get("service"):
+        query["service"] = params["service"]
+    scope = params.get("scope") or (
+        f"repository:{repository}:pull" if repository else "")
+    if scope:
+        query["scope"] = scope
+    url = realm + ("?" + urllib.parse.urlencode(query) if query else "")
+    req_headers = {}
+    if username or password:
+        cred = base64.b64encode(f"{username}:{password}".encode()).decode()
+        req_headers["Authorization"] = f"Basic {cred}"
+    req = urllib.request.Request(url, headers=req_headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = json.loads(resp.read())
+    token = body.get("token") or body.get("access_token") or ""
+    if not token:
+        raise ValueError(f"token endpoint {realm} returned no token")
+    return token
+
+
+def resolve_image_layers_with_auth(
+        image_url: str, *, timeout: float = 30.0,
+        headers: Dict[str, str] | None = None,
+        username: str = "", password: str = "",
+) -> Tuple[List[str], Dict[str, str]]:
+    """Manifest (incl. multi-arch index) → layer blob URLs, negotiating
+    registry auth on a 401 (WWW-Authenticate Bearer token handshake, or
+    Basic). Returns ``(urls, auth_headers)`` — the auth headers must ride
+    along to the seed peers, which fetch the blobs with the same token
+    (preheat.go builds the layer requests with it)."""
     ref = ImageRef.parse(image_url)
+    auth_headers: Dict[str, str] = {}
 
     def fetch(url: str) -> dict:
+        nonlocal auth_headers
+        merged = {"Accept": MANIFEST_ACCEPT, **(headers or {}),
+                  **auth_headers}
+        req = urllib.request.Request(url, headers=merged)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            if exc.code != 401 or auth_headers:
+                raise
+            challenge = exc.headers.get("WWW-Authenticate", "")
+            scheme = challenge.split(" ", 1)[0].lower()
+            if scheme == "bearer":
+                token = fetch_registry_token(
+                    challenge, username=username, password=password,
+                    timeout=timeout, repository=ref.name)
+                auth_headers = {"Authorization": f"Bearer {token}"}
+            elif scheme == "basic" and (username or password):
+                cred = base64.b64encode(
+                    f"{username}:{password}".encode()).decode()
+                auth_headers = {"Authorization": f"Basic {cred}"}
+            else:
+                raise
         req = urllib.request.Request(
-            url, headers={"Accept": MANIFEST_ACCEPT, **(headers or {})})
+            url, headers={**merged, **auth_headers})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read())
 
@@ -218,6 +300,14 @@ def resolve_image_layers(image_url: str, *, timeout: float = 30.0,
     for m in manifests:
         for layer in m.get("layers", []):
             urls.append(ref.blob_url(layer["digest"]))
+    return urls, auth_headers
+
+
+def resolve_image_layers(image_url: str, *, timeout: float = 30.0,
+                         headers: Dict[str, str] | None = None) -> List[str]:
+    """Manifest (incl. multi-arch index) → layer blob URLs."""
+    urls, _ = resolve_image_layers_with_auth(
+        image_url, timeout=timeout, headers=headers)
     return urls
 
 
@@ -267,11 +357,16 @@ class PreheatService:
 
     def preheat_image(self, image_url: str, *, tag: str = "",
                       headers: Dict[str, str] | None = None,
+                      username: str = "", password: str = "",
                       scheduler_ids: List[int] | None = None) -> List[GroupStatus]:
-        layers = resolve_image_layers(image_url, headers=headers)
+        layers, auth_headers = resolve_image_layers_with_auth(
+            image_url, headers=headers, username=username, password=password)
         if not layers:
             raise ValueError(f"image {image_url} resolved to no layers")
-        return self.preheat_urls(layers, tag=tag, headers=headers,
+        # Seed peers fetch the blobs with the negotiated token
+        # (preheat.go builds layer requests with it).
+        return self.preheat_urls(layers, tag=tag,
+                                 headers={**(headers or {}), **auth_headers},
                                  scheduler_ids=scheduler_ids)
 
     def wait(self, groups: List[GroupStatus], timeout: float = 120.0) -> bool:

@@ -53,11 +53,14 @@ class RestApi:
 
     def __init__(self, service: ManagerService,
                  auth: Optional[AuthService] = None,
-                 preheat=None, sync_peers=None):
+                 preheat=None, sync_peers=None, jobstore=None):
         self.service = service
         self.auth = auth
         self.preheat = preheat
         self.sync_peers = sync_peers
+        # DurableJobStore when the cross-process job plane is wired;
+        # group lookups then survive manager restarts.
+        self.jobstore = jobstore
         self._groups: Dict[str, object] = {}
         # (method, compiled-path-regex) -> handler(identity, match, query, body)
         self.routes: List[Tuple[str, re.Pattern, Callable]] = []
@@ -103,7 +106,9 @@ class RestApi:
         r("GET", r"/api/v1/peers", self._list_peers)
         # jobs (handlers/job.go)
         r("POST", r"/api/v1/jobs", self._create_job)
+        r("GET", r"/api/v1/jobs", self._list_jobs)
         r("GET", r"/api/v1/jobs/(?P<id>\w+)", self._get_job)
+        r("POST", r"/api/v1/jobs/(?P<id>\d+)/requeue", self._requeue_job)
         # configs (handlers/config.go)
         r("POST", r"/api/v1/configs", self._set_config)
         r("GET", r"/api/v1/configs", self._list_configs)
@@ -118,6 +123,13 @@ class RestApi:
         r("GET", r"/internal/v1/dynconfig/daemon", self._internal_daemon_cfg)
         r("GET", r"/internal/v1/dynconfig/scheduler/(?P<id>\d+)",
           self._internal_scheduler_cfg)
+        # job plane: schedulers lease/complete jobs over the internal
+        # surface (the machinery-broker role — internal/job/job.go:33-60)
+        r("POST", r"/internal/v1/jobs/lease", self._internal_lease_job)
+        r("POST", r"/internal/v1/jobs/(?P<id>\d+)/complete",
+          self._internal_complete_job)
+        r("POST", r"/internal/v1/jobs/(?P<id>\d+)/renew",
+          self._internal_renew_job)
 
     def _route(self, method: str, pattern: str, handler: Callable) -> None:
         self.routes.append((method, re.compile(f"^{pattern}$"), handler))
@@ -324,10 +336,14 @@ class RestApi:
             if "/manifests/" in preheat_args["url"]:
                 groups = self.preheat.preheat_image(
                     preheat_args["url"],
+                    headers=preheat_args.get("headers"),
+                    username=preheat_args.get("username", ""),
+                    password=preheat_args.get("password", ""),
                     scheduler_ids=body.get("scheduler_ids"))
             else:
                 groups = self.preheat.preheat_urls(
                     [preheat_args["url"]],
+                    headers=preheat_args.get("headers"),
                     scheduler_ids=body.get("scheduler_ids"))
             for g in groups:
                 self._groups[g.group_id] = g
@@ -342,11 +358,90 @@ class RestApi:
 
     def _get_job(self, identity, m, q, body):
         status = self._groups.get(m.group("id"))
+        if status is not None and not hasattr(status, "snapshot"):
+            # In-process JobBus GroupStatus (plain dataclass fields).
+            return {"id": status.group_id, "state": status.state,
+                    "succeeded": status.succeeded, "failed": status.failed,
+                    "errors": status.errors}
+        if status is None and self.jobstore is not None:
+            # Durable groups survive a manager restart.
+            status = self.jobstore.group_status(m.group("id"))
         if status is None:
             raise HttpError(404, "unknown job")
-        return {"id": status.group_id, "state": status.state,
-                "succeeded": status.succeeded, "failed": status.failed,
-                "errors": status.errors}
+        snap = status.snapshot()  # all fields from one query
+        return {"id": snap["group_id"], "state": snap["state"],
+                "succeeded": snap["succeeded"], "failed": snap["failed"],
+                "errors": snap["errors"]}
+
+    @staticmethod
+    def _redact_job(row) -> dict:
+        """Job rows carry whatever headers the preheat negotiated —
+        registry Bearer tokens / Basic credentials must never reach a
+        read-only API user."""
+        d = _row(row)
+        payload = d.get("payload")
+        if isinstance(payload, dict):
+            payload = dict(payload)
+            headers = payload.get("headers")
+            if isinstance(headers, dict):
+                payload["headers"] = {
+                    k: ("<redacted>" if k.lower() in
+                        ("authorization", "proxy-authorization",
+                         "x-registry-auth") else v)
+                    for k, v in headers.items()}
+            for secret in ("username", "password"):
+                if payload.get(secret):
+                    payload[secret] = "<redacted>"
+            d["payload"] = payload
+        return d
+
+    def _list_jobs(self, identity, m, q, body):
+        """Queue introspection incl. the dead-letter view
+        (``?state=dead``)."""
+        if self.jobstore is None:
+            return []
+        where = {}
+        if "state" in q:
+            where["state"] = q["state"]
+        if "queue" in q:
+            where["queue"] = q["queue"]
+        return [self._redact_job(r)
+                for r in self.jobstore.db.find("queued_jobs", **where)]
+
+    def _requeue_job(self, identity, m, q, body):
+        """Operator escape hatch: fresh attempts for a dead-lettered job."""
+        if self.jobstore is None:
+            raise HttpError(503, "job store not wired")
+        if not self.jobstore.requeue_dead(int(m.group("id"))):
+            raise HttpError(409, "job is not dead-lettered")
+        return {"ok": True}
+
+    def _internal_lease_job(self, identity, m, q, body):
+        if self.jobstore is None:
+            raise HttpError(503, "job store not wired")
+        queues = body.get("queues") or []
+        if not queues:
+            raise HttpError(400, "queues required")
+        job = self.jobstore.lease(
+            queues, body.get("worker_id", ""),
+            lease_ttl=body.get("lease_ttl"))
+        return {"job": job}
+
+    def _internal_complete_job(self, identity, m, q, body):
+        if self.jobstore is None:
+            raise HttpError(503, "job store not wired")
+        return self.jobstore.complete(
+            int(m.group("id")), ok=bool(body.get("ok")),
+            error=body.get("error", ""), result=body.get("result"),
+            worker_id=body.get("worker_id", ""))
+
+    def _internal_renew_job(self, identity, m, q, body):
+        if self.jobstore is None:
+            raise HttpError(503, "job store not wired")
+        renewed = self.jobstore.renew(
+            int(m.group("id")), body.get("worker_id", ""),
+            lease_ttl=body.get("lease_ttl"))
+        return {"renewed": renewed}
 
     # -- configs -----------------------------------------------------------
 

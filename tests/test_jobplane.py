@@ -1,0 +1,628 @@
+"""Cross-process job plane (round-3 verdict item 2) + registry-auth
+preheat (item 4).
+
+Covers: the durable store's machinery semantics (lease, retry with
+backoff, dead-letter, lease-expiry reap, stale-worker rejection), the
+manager's internal lease/complete REST surface, the scheduler's
+RemoteJobWorker polling a real manager HTTP server, the Bearer-token
+handshake against a faked private registry, and the full THREE-PROCESS
+e2e: df2-manager + df2-scheduler + df2-dfdaemon(seed) as real
+processes, `POST /api/v1/jobs` preheating a URL, and a later peer
+downloading it with the origin dead.
+
+Reference counterparts: internal/job/job.go:33-60,
+scheduler/job/job.go:49-222, manager/job/preheat.go:168-246.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dragonfly2_tpu.manager.database import Database
+from dragonfly2_tpu.manager.jobplane import (
+    DurableJobStore,
+    LocalJobStoreWorker,
+    STATE_DEAD,
+    STATE_PENDING,
+)
+from dragonfly2_tpu.manager.jobs import (
+    Job,
+    PreheatRequest,
+    PreheatService,
+    fetch_registry_token,
+    resolve_image_layers_with_auth,
+    scheduler_queue,
+)
+from tests.fileserver import FileServer
+from tests.test_preheat import write_registry
+
+
+def make_job(jtype="preheat", url="http://x/blob") -> Job:
+    return Job(id="j", type=jtype, payload=PreheatRequest(url=url))
+
+
+class TestDurableJobStore:
+    def test_lease_complete_success(self):
+        store = DurableJobStore(Database())
+        group = store.post_group([scheduler_queue(1), scheduler_queue(2)],
+                                 make_job)
+        assert group.total == 2 and not group.done
+        j1 = store.lease([scheduler_queue(1)], "w1")
+        assert j1["type"] == "preheat"
+        assert j1["payload"]["url"] == "http://x/blob"
+        assert j1["attempts"] == 1
+        # Leased jobs are invisible to other workers of the same queue.
+        assert store.lease([scheduler_queue(1)], "w2") is None
+        store.complete(j1["id"], ok=True, result={"n": 3}, worker_id="w1")
+        j2 = store.lease([scheduler_queue(2)], "w2")
+        store.complete(j2["id"], ok=True, worker_id="w2")
+        assert group.done and group.state == "SUCCESS"
+        assert group.results == [{"n": 3}]
+
+    def test_retry_backoff_then_dead_letter(self):
+        store = DurableJobStore(Database(), default_max_attempts=2,
+                                retry_backoff=0.05)
+        group = store.post_group([scheduler_queue(1)], make_job)
+        j = store.lease([scheduler_queue(1)], "w")
+        out = store.complete(j["id"], ok=False, error="boom", worker_id="w")
+        assert out["state"] == STATE_PENDING and out["retry_in_s"] > 0
+        # Backoff: not leasable until not_before passes.
+        assert store.lease([scheduler_queue(1)], "w") is None
+        time.sleep(0.08)
+        j = store.lease([scheduler_queue(1)], "w")
+        assert j["attempts"] == 2
+        out = store.complete(j["id"], ok=False, error="boom2", worker_id="w")
+        assert out["state"] == STATE_DEAD
+        assert group.done and group.state == "FAILURE"
+        assert "boom2" in group.errors[0]
+        dead = store.dead_letters()
+        assert len(dead) == 1
+        # Operator escape hatch: a requeued dead job runs again.
+        store.requeue_dead(dead[0].id)
+        assert not group.done
+        j = store.lease([scheduler_queue(1)], "w")
+        store.complete(j["id"], ok=True, worker_id="w")
+        assert group.state == "SUCCESS"
+
+    def test_lease_expiry_requeues_then_dead_letters(self):
+        """A worker that dies without complete(): lease expiry requeues
+        with the attempt spent; exhausted jobs dead-letter at reap time
+        instead of retrying forever."""
+        store = DurableJobStore(Database(), default_max_attempts=2)
+        store.post(scheduler_queue(1), make_job())
+        assert store.lease([scheduler_queue(1)], "w1",
+                           lease_ttl=0.01) is not None
+        time.sleep(0.03)
+        j = store.lease([scheduler_queue(1)], "w2", lease_ttl=0.01)
+        assert j is not None and j["attempts"] == 2
+        time.sleep(0.03)
+        # attempts exhausted + expired → dead at the next reap, not
+        # re-leased (the poison-job starvation case).
+        assert store.lease([scheduler_queue(1)], "w3") is None
+        dead = store.dead_letters()
+        assert len(dead) == 1 and "lease expired" in dead[0].error
+
+    def test_stale_worker_completion_rejected(self):
+        store = DurableJobStore(Database())
+        store.post(scheduler_queue(1), make_job())
+        j = store.lease([scheduler_queue(1)], "w1", lease_ttl=0.01)
+        time.sleep(0.03)
+        j2 = store.lease([scheduler_queue(1)], "w2")
+        assert j2 is not None
+        out = store.complete(j["id"], ok=True, worker_id="w1")
+        assert not out["ok"] and "lease lost" in out["error"]
+        assert store.complete(j2["id"], ok=True, worker_id="w2")["ok"]
+
+    def test_local_worker_drains_and_survives_bad_result(self):
+        store = DurableJobStore(Database(), default_max_attempts=1)
+        seen = []
+
+        def handler(job):
+            seen.append(job.type)
+            if job.type == "sync_peers":
+                return {"hosts": set()}  # not JSON-serializable
+            return None
+
+        worker = LocalJobStoreWorker(store, handler, [scheduler_queue(1)])
+        worker.serve()
+        try:
+            g1 = store.post_group([scheduler_queue(1)],
+                                  lambda: make_job("sync_peers"))
+            g2 = store.post_group([scheduler_queue(1)], make_job)
+            deadline = time.monotonic() + 5
+            while not (g1.done and g2.done) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # The unserializable result must not kill the worker loop —
+            # the SECOND job still completes.
+            assert g2.state == "SUCCESS"
+            assert g1.state == "SUCCESS"
+        finally:
+            worker.stop()
+
+
+class TestJobPlaneRest:
+    @pytest.fixture()
+    def api(self, tmp_path):
+        from dragonfly2_tpu.manager import (
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        db = Database()
+        service = ManagerService(
+            db, FilesystemObjectStore(str(tmp_path / "obj")))
+        store = DurableJobStore(db, default_max_attempts=1)
+        return RestApi(service, preheat=PreheatService(store, service),
+                       jobstore=store)
+
+    def test_lease_complete_over_internal_surface(self, api):
+        api.jobstore.post(scheduler_queue(1), make_job())
+        code, resp = api.dispatch(
+            "POST", "/internal/v1/jobs/lease", {},
+            {"queues": [scheduler_queue(1)], "worker_id": "w"},
+            surface="internal")
+        assert code == 200 and resp["job"]["type"] == "preheat"
+        job_id = resp["job"]["id"]
+        code, out = api.dispatch(
+            "POST", f"/internal/v1/jobs/{job_id}/complete", {},
+            {"ok": True, "worker_id": "w"}, surface="internal")
+        assert code == 200 and out["state"] == "succeeded"
+        # Empty queues again
+        code, resp = api.dispatch(
+            "POST", "/internal/v1/jobs/lease", {},
+            {"queues": [scheduler_queue(1)], "worker_id": "w"},
+            surface="internal")
+        assert resp["job"] is None
+
+    def test_group_lookup_survives_restart(self, api, tmp_path):
+        """GET /api/v1/jobs/<group> answers from the durable store even
+        when the in-memory group cache is gone (manager restart)."""
+        group = api.jobstore.post_group([scheduler_queue(1)], make_job)
+        j = api.jobstore.lease([scheduler_queue(1)], "w")
+        api.jobstore.complete(j["id"], ok=True, worker_id="w")
+        # Fresh RestApi over the same DB — no in-memory group state.
+        from dragonfly2_tpu.manager import (
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        api2 = RestApi(
+            ManagerService(api.jobstore.db,
+                           FilesystemObjectStore(str(tmp_path / "o2"))),
+            jobstore=DurableJobStore(api.jobstore.db))
+        code, out = api2.dispatch(
+            "GET", f"/api/v1/jobs/{group.group_id}", {}, {})
+        assert code == 200 and out["state"] == "SUCCESS"
+
+    def test_dead_letter_listing_and_requeue(self, api):
+        api.jobstore.post(scheduler_queue(1), make_job())
+        j = api.jobstore.lease([scheduler_queue(1)], "w")
+        api.jobstore.complete(j["id"], ok=False, error="x", worker_id="w")
+        code, rows = api.dispatch("GET", "/api/v1/jobs",
+                                  {"state": "dead"}, {})
+        assert code == 200 and len(rows) == 1
+        code, _ = api.dispatch(
+            "POST", f"/api/v1/jobs/{rows[0]['id']}/requeue", {}, {})
+        assert code == 200
+        assert api.jobstore.lease([scheduler_queue(1)], "w") is not None
+
+    def test_internal_routes_not_on_public_surface(self, api):
+        code, _ = api.dispatch("POST", "/internal/v1/jobs/lease", {},
+                               {"queues": ["q"]}, surface="public")
+        assert code == 404
+
+    def test_job_listing_redacts_credentials(self, api):
+        """Preheat payloads carry negotiated registry tokens; the job
+        listing must never hand them to a read-only user."""
+        api.jobstore.post(scheduler_queue(1), Job(
+            id="j", type="preheat",
+            payload=PreheatRequest(
+                url="http://reg/v2/x/blobs/sha256:aa",
+                headers={"Authorization": "Bearer sekret-token",
+                         "Accept": "application/json"})))
+        code, rows = api.dispatch("GET", "/api/v1/jobs", {}, {})
+        assert code == 200 and len(rows) == 1
+        headers = rows[0]["payload"]["headers"]
+        assert headers["Authorization"] == "<redacted>"
+        assert headers["Accept"] == "application/json"
+        assert "sekret-token" not in json.dumps(rows)
+
+    def test_requeue_non_dead_job_conflicts(self, api):
+        api.jobstore.post(scheduler_queue(1), make_job())
+        j = api.jobstore.lease([scheduler_queue(1)], "w")
+        code, _ = api.dispatch(
+            "POST", f"/api/v1/jobs/{j['id']}/requeue", {}, {})
+        assert code == 409  # leased, not dead — must not double-execute
+
+    def test_renew_extends_live_lease_only(self, api):
+        api.jobstore.post(scheduler_queue(1), make_job())
+        j = api.jobstore.lease([scheduler_queue(1)], "w", lease_ttl=0.2)
+        code, out = api.dispatch(
+            "POST", f"/internal/v1/jobs/{j['id']}/renew", {},
+            {"worker_id": "w", "lease_ttl": 30.0}, surface="internal")
+        assert code == 200 and out["renewed"]
+        # Someone else can't renew it...
+        code, out = api.dispatch(
+            "POST", f"/internal/v1/jobs/{j['id']}/renew", {},
+            {"worker_id": "thief"}, surface="internal")
+        assert not out["renewed"]
+        # ...and after expiry the original holder can't either.
+        api.jobstore.db.update("queued_jobs", j["id"],
+                               lease_expires_at=time.time() - 1)
+        assert not api.jobstore.renew(j["id"], "w")
+
+
+class TestRemoteJobWorker:
+    def test_heartbeat_keeps_long_job_alive(self, tmp_path):
+        """A handler slower than one lease_ttl must still complete
+        exactly once — the worker's renewal thread keeps the lease from
+        being reaped and re-executed."""
+        from dragonfly2_tpu.manager import (
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.client import ManagerHTTPClient
+        from dragonfly2_tpu.manager.rest import ManagerHTTPServer, RestApi
+        from dragonfly2_tpu.scheduler.jobworker import RemoteJobWorker
+
+        db = Database()
+        service = ManagerService(
+            db, FilesystemObjectStore(str(tmp_path / "obj")))
+        store = DurableJobStore(db)
+        api = RestApi(service, jobstore=store)
+        http = ManagerHTTPServer(api, host="127.0.0.1", port=0,
+                                 surface="internal")
+        http.start()
+
+        calls = []
+
+        class SlowService:
+            def preheat(self, url, **kw):
+                calls.append(url)
+                time.sleep(0.9)  # ≫ lease_ttl below
+
+        worker = RemoteJobWorker(
+            ManagerHTTPClient(f"127.0.0.1:{http.port}"), SlowService(),
+            scheduler_id=5, poll_interval=0.05, lease_ttl=0.3)
+        worker.serve()
+        try:
+            group = store.post_group([scheduler_queue(5)], make_job)
+            deadline = time.monotonic() + 15
+            while not group.done and time.monotonic() < deadline:
+                time.sleep(0.05)
+            snap = group.snapshot()
+            assert snap["state"] == "SUCCESS", snap
+            assert len(calls) == 1  # never double-executed
+        finally:
+            worker.stop()
+            http.stop()
+
+    def test_worker_polls_real_manager_and_preheats(self, tmp_path):
+        """RemoteJobWorker against a live manager HTTP server (internal
+        surface): preheat flows manager → HTTP lease → scheduler →
+        seed trigger; a peer then downloads with the origin dead."""
+        from dragonfly2_tpu.manager import (
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.client import ManagerHTTPClient
+        from dragonfly2_tpu.manager.rest import ManagerHTTPServer, RestApi
+        from dragonfly2_tpu.scheduler.jobworker import RemoteJobWorker
+        from dragonfly2_tpu.utils.hosttypes import HostType
+        from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+        db = Database()
+        service = ManagerService(
+            db, FilesystemObjectStore(str(tmp_path / "obj")))
+        store = DurableJobStore(db, retry_backoff=0.05)
+        preheat = PreheatService(store, service)
+        api = RestApi(service, preheat=preheat, jobstore=store)
+        http = ManagerHTTPServer(api, host="127.0.0.1", port=0,
+                                 surface="internal")
+        http.start()
+
+        scheduler = make_scheduler(tmp_path)
+        seed = make_daemon(scheduler, tmp_path, "seed", HostType.SUPER_SEED)
+        scheduler.seed_peer_client = seed.seed_client()
+        peer = make_daemon(scheduler, tmp_path, "peer")
+        worker = RemoteJobWorker(
+            ManagerHTTPClient(f"127.0.0.1:{http.port}"), scheduler,
+            scheduler_id=3, poll_interval=0.05)
+        worker.serve()
+        try:
+            payload = os.urandom(1024 * 1024)
+            blob_dir = tmp_path / "www"
+            blob_dir.mkdir()
+            (blob_dir / "blob.bin").write_bytes(payload)
+            with FileServer(str(blob_dir)) as fs:
+                url = f"http://127.0.0.1:{fs.port}/blob.bin"
+                groups = preheat.preheat_urls([url], scheduler_ids=[3])
+                assert preheat.wait(groups, timeout=30), [
+                    (g.state, g.errors) for g in groups]
+            result = peer.download_file(url)  # origin is DOWN now
+            assert result.success, result.error
+            assert hashlib.sha256(result.read_all()).digest() == \
+                hashlib.sha256(payload).digest()
+        finally:
+            worker.stop()
+            peer.stop()
+            seed.stop()
+            http.stop()
+
+
+# ----------------------------------------------------------------------
+# Registry auth (round-3 verdict item 4)
+# ----------------------------------------------------------------------
+
+
+class PrivateRegistry:
+    """Faked auth-required registry: /v2/* answers 401 with a Bearer
+    challenge until the request carries the token issued by /token (which
+    itself requires Basic credentials) — the docker-distribution flow the
+    reference negotiates in preheat.go:168-246."""
+
+    USER, PASSWORD, TOKEN = "robot", "hunter2", "tok-" + "e" * 16
+
+    def __init__(self, root: str):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/token"):
+                    return self._token()
+                auth = self.headers.get("Authorization", "")
+                if auth != f"Bearer {registry.TOKEN}":
+                    self.send_response(401)
+                    self.send_header(
+                        "WWW-Authenticate",
+                        f'Bearer realm="http://127.0.0.1:{registry.port}'
+                        f'/token",service="fake-registry"')
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                path = os.path.normpath(root + self.path)
+                if not (path.startswith(os.path.abspath(root))
+                        and os.path.isfile(path)):
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                with open(path, "rb") as f:
+                    data = f.read()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _token(self):
+                expect = base64.b64encode(
+                    f"{registry.USER}:{registry.PASSWORD}".encode()).decode()
+                if self.headers.get("Authorization") != f"Basic {expect}":
+                    self.send_response(401)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                registry.token_requests.append(self.path)
+                data = json.dumps({"token": registry.TOKEN}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.token_requests: list = []
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestRegistryAuth:
+    def test_token_handshake_resolves_layers(self, tmp_path):
+        layers = {f"sha256:{i:064x}": os.urandom(64) for i in range(2)}
+        name = write_registry(tmp_path, layers)
+        reg = PrivateRegistry(str(tmp_path))
+        try:
+            url = f"http://127.0.0.1:{reg.port}/v2/{name}/manifests/latest"
+            urls, auth = resolve_image_layers_with_auth(
+                url, username=reg.USER, password=reg.PASSWORD)
+            assert len(urls) == 2
+            assert auth == {"Authorization": f"Bearer {reg.TOKEN}"}
+            # scope handling: the token request carried service+scope
+            assert "service=fake-registry" in reg.token_requests[0]
+            # The negotiated header actually opens the blobs (what seed
+            # peers will send).
+            req = urllib.request.Request(urls[0], headers=auth)
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            reg.close()
+
+    def test_wrong_password_fails(self, tmp_path):
+        name = write_registry(tmp_path, {"sha256:" + "0" * 64: b"x"})
+        reg = PrivateRegistry(str(tmp_path))
+        try:
+            url = f"http://127.0.0.1:{reg.port}/v2/{name}/manifests/latest"
+            with pytest.raises(urllib.error.HTTPError):
+                resolve_image_layers_with_auth(
+                    url, username=reg.USER, password="wrong")
+        finally:
+            reg.close()
+
+    def test_challenge_parse_and_scope_default(self):
+        with pytest.raises(ValueError):
+            fetch_registry_token('Digest realm="x"')
+        with pytest.raises(ValueError):
+            fetch_registry_token("Bearer service=only")
+
+
+# ----------------------------------------------------------------------
+# Three real processes (the round-3 verdict's done-criterion for item 2)
+# ----------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_http(url: str, timeout: float = 20.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1):
+                return
+        except Exception:
+            time.sleep(0.1)
+    raise TimeoutError(f"{url} never came up")
+
+
+class TestThreeProcessPreheat:
+    def test_manager_scheduler_seed_processes(self, tmp_path):
+        """df2-manager, df2-scheduler, df2-dfdaemon(seed) as separate OS
+        processes. POST /api/v1/jobs preheats a blob; with the origin
+        dead, a later peer still downloads it through the warmed seed."""
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        mgr_pub, mgr_int = _free_port(), _free_port()
+        sched_port, seed_rpc = _free_port(), _free_port()
+        procs = []
+
+        def spawn(*argv):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", *argv], env=env,
+                cwd=str(tmp_path),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+            procs.append(proc)
+            return proc
+
+        payload = os.urandom(2 * 1024 * 1024 + 17)
+        www = tmp_path / "www"
+        www.mkdir()
+        (www / "model.bin").write_bytes(payload)
+
+        try:
+            spawn("dragonfly2_tpu.cmd.manager",
+                  "--host", "127.0.0.1", "--port", str(mgr_pub),
+                  "--internal-port", str(mgr_int), "--no-auth",
+                  "--db", str(tmp_path / "manager.db"),
+                  "--object-store-dir", str(tmp_path / "objects"))
+            _wait_http(f"http://127.0.0.1:{mgr_pub}/healthy")
+
+            spawn("dragonfly2_tpu.cmd.dfdaemon",
+                  "--scheduler", f"127.0.0.1:{sched_port}",
+                  "--rpc-port", str(seed_rpc),
+                  "--storage-dir", str(tmp_path / "seed-data"),
+                  "--type", "super", "--hostname", "seed-e2e",
+                  "--ip", "127.0.0.1")
+
+            spawn("dragonfly2_tpu.cmd.scheduler",
+                  "--host", "127.0.0.1", "--port", str(sched_port),
+                  "--data-dir", str(tmp_path / "sched-data"),
+                  "--manager", f"127.0.0.1:{mgr_int}",
+                  "--advertise-ip", "127.0.0.1",
+                  "--seed-peer", f"127.0.0.1:{seed_rpc}",
+                  "--job-poll-interval", "0.1")
+
+            # Scheduler registers itself; wait until the manager lists an
+            # active instance so the preheat fan-out has a target queue.
+            deadline = time.monotonic() + 30
+            scheduler_id = None
+            while time.monotonic() < deadline and scheduler_id is None:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mgr_pub}/api/v1/schedulers",
+                        timeout=2) as resp:
+                    for row in json.loads(resp.read()):
+                        if row["state"] == "active":
+                            scheduler_id = row["id"]
+                time.sleep(0.2)
+            assert scheduler_id is not None, _dump(procs)
+
+            with FileServer(str(www)) as fs:
+                url = f"http://127.0.0.1:{fs.port}/model.bin"
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{mgr_pub}/api/v1/jobs",
+                    data=json.dumps(
+                        {"type": "preheat", "args": {"url": url}}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    group_ids = json.loads(resp.read())["ids"]
+                assert group_ids
+                deadline = time.monotonic() + 60
+                state = "PENDING"
+                while time.monotonic() < deadline and state == "PENDING":
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{mgr_pub}/api/v1/jobs/"
+                            f"{group_ids[0]}", timeout=2) as resp:
+                        status = json.loads(resp.read())
+                    state = status["state"]
+                    time.sleep(0.2)
+                assert state == "SUCCESS", (status, _dump(procs))
+
+            # Origin is DOWN. A fresh peer (in-process, talking to the
+            # scheduler PROCESS over gRPC) must still get the bytes.
+            from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+            from dragonfly2_tpu.scheduler.rpcserver import (
+                GrpcSchedulerClient,
+            )
+
+            peer = Daemon(GrpcSchedulerClient(f"127.0.0.1:{sched_port}"),
+                          DaemonConfig(
+                              storage_root=str(tmp_path / "peer-data"),
+                              hostname="late-peer"))
+            peer.start()
+            try:
+                result = peer.download_file(url)
+                assert result.success, (result.error, _dump(procs))
+                assert hashlib.sha256(result.read_all()).digest() == \
+                    hashlib.sha256(payload).digest()
+            finally:
+                peer.stop()
+        finally:
+            for proc in procs:
+                proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+def _dump(procs) -> str:
+    """Tail of each subprocess's output for assertion messages."""
+    out = []
+    for proc in procs:
+        try:
+            text = proc.stdout.read() if proc.poll() is not None else ""
+        except Exception:
+            text = "<unreadable>"
+        out.append(f"--- pid {proc.pid} rc={proc.poll()} ---\n{text[-2000:]}")
+    return "\n".join(out)

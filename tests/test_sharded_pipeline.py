@@ -1,0 +1,99 @@
+"""Sharded parquet pipeline with deterministic global shuffle
+(round-3 verdict item 3; SURVEY §7 "streaming ingestion at 10M records").
+Unit-scale here; artifacts/scale_proof.py runs the same code at 10M."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.data import (
+    ShardedParquetDataset,
+    SyntheticCluster,
+    write_columns_sharded,
+)
+
+
+def probe_extractor(table):
+    return (table.column("src").to_numpy(),
+            table.column("dst").to_numpy(),
+            table.column("rtt_ns").to_numpy())
+
+
+@pytest.fixture(scope="module")
+def shards(tmp_path_factory):
+    out = tmp_path_factory.mktemp("shards")
+    cluster = SyntheticCluster(n_hosts=200, seed=7)
+    cols = cluster.probe_edge_columns(100_000)
+    paths = write_columns_sharded(cols, str(out), n_shards=4,
+                                  row_group_rows=8192)
+    return cols, paths
+
+
+class TestShardedDataset:
+    def test_index_covers_all_rows(self, shards):
+        cols, paths = shards
+        ds = ShardedParquetDataset(paths, probe_extractor)
+        assert len(ds) == 100_000
+        assert ds.n_tiles >= 4  # ≥1 row group per shard
+
+    def test_every_row_exactly_once_per_epoch(self, shards):
+        """The two-level shuffle is a permutation: concatenating one
+        epoch's batches recovers the full multiset of rows."""
+        cols, paths = shards
+        ds = ShardedParquetDataset(paths, probe_extractor)
+        batch = 1000  # divides 100k: one epoch covers every row
+        seen_rtt = []
+        for b in ds.batches(batch, seed=3, epoch=0):
+            assert len(b[0]) == batch  # fixed shapes, always
+            seen_rtt.append(b[2])
+        got = np.sort(np.concatenate(seen_rtt))
+        np.testing.assert_array_equal(got, np.sort(cols["rtt_ns"]))
+
+    def test_shuffle_is_deterministic_and_epoch_varies(self, shards):
+        _, paths = shards
+        ds = ShardedParquetDataset(paths, probe_extractor)
+        a1 = next(iter(ds.batches(1024, seed=5, epoch=2)))
+        # A RESTARTED reader (fresh dataset object — new process in real
+        # life) reproduces the identical order from (seed, epoch) alone.
+        ds2 = ShardedParquetDataset(paths, probe_extractor)
+        a2 = next(iter(ds2.batches(1024, seed=5, epoch=2)))
+        for x, y in zip(a1, a2):
+            np.testing.assert_array_equal(x, y)
+        b1 = next(iter(ds.batches(1024, seed=5, epoch=3)))
+        assert not np.array_equal(a1[2], b1[2])  # epoch reshuffles
+        c1 = next(iter(ds.batches(1024, seed=6, epoch=2)))
+        assert not np.array_equal(a1[2], c1[2])  # seed reshuffles
+
+    def test_global_not_shardwise_shuffle(self, shards):
+        """Rows from different shards interleave within early batches —
+        the shuffle is global, not per-shard-sequential."""
+        cols, paths = shards
+        ds = ShardedParquetDataset(paths, probe_extractor)
+        first = next(iter(ds.batches(8192, seed=0, epoch=0)))
+        # Shard s holds rows [s*25k, (s+1)*25k); map yielded rtts back is
+        # fiddly, so check the tile permutation directly instead:
+        order = np.random.default_rng((0, 0, 0xD1CE)).permutation(ds.n_tiles)
+        shards_in_first_tiles = {ds._tiles[t][0] for t in order[:4]}
+        assert len(shards_in_first_tiles) > 1
+        assert len(first[0]) == 8192
+
+    def test_column_pruned_ingestion(self, shards):
+        _, paths = shards
+
+        def pruned_extractor(table):
+            assert table.num_columns == 2  # pruning reached the reader
+            return (table.column("src").to_numpy(),
+                    table.column("rtt_ns").to_numpy())
+
+        ds = ShardedParquetDataset(paths, pruned_extractor,
+                                   columns=["src", "rtt_ns"])
+        assert ds.ingest_all() == 100_000
+        pruned = next(iter(ds.batches(1024, shuffle=False)))
+        assert len(pruned) == 2
+
+    def test_unshuffled_order_is_file_order(self, shards):
+        cols, paths = shards
+        ds = ShardedParquetDataset(paths, probe_extractor)
+        first = next(iter(ds.batches(1000, shuffle=False)))
+        np.testing.assert_array_equal(first[2], cols["rtt_ns"][:1000])
