@@ -47,6 +47,11 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
                         help="write JSONL span traces here (rotated); "
                              "trace ids propagate across services via "
                              "gRPC metadata (default: tracing off)")
+    parser.add_argument("--pprof-port", type=int, default=-1,
+                        help="debug monitor on this port (/debug/threads, "
+                             "/debug/profile?seconds=N, /debug/vars — the "
+                             "reference's pprof/statsview role; 0 = "
+                             "ephemeral, -1 = disabled)")
 
 
 def init_tracing(args, service_name: str) -> None:
@@ -111,6 +116,20 @@ def parse_with_config(parser: argparse.ArgumentParser, argv=None):
             defaults[dest] = value
         parser.set_defaults(**defaults)
     return parser.parse_args(argv)
+
+
+def start_debug_monitor(args):
+    """Start the debug monitor when --pprof-port was given (the
+    reference's InitMonitor, cmd/dependency/dependency.go:95-130).
+    Returns the DebugMonitor or None."""
+    if getattr(args, "pprof_port", -1) < 0:
+        return None
+    from dragonfly2_tpu.utils.debugmon import DebugMonitor
+
+    mon = DebugMonitor(host="127.0.0.1", port=args.pprof_port)
+    mon.start()
+    print(f"debug monitor on {mon.address}/debug/threads", flush=True)
+    return mon
 
 
 def start_metrics_server(args, registry):

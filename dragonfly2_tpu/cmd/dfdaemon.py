@@ -15,6 +15,7 @@ from dragonfly2_tpu.cmd.common import (
     parse_with_config,
     add_common_flags,
     init_logging,
+    start_debug_monitor,
     start_metrics_server,
     wait_for_shutdown,
 )
@@ -169,6 +170,7 @@ def main(argv=None) -> int:
     print(f"daemon {daemon.host_id} upload on {daemon.upload.address}",
           flush=True)
     metrics_server = start_metrics_server(args, daemon.metrics.registry)
+    debug_monitor = start_debug_monitor(args)
 
     rpc_server = None
     if args.rpc_port >= 0:

@@ -20,6 +20,7 @@ from dragonfly2_tpu.cmd.common import (
     parse_with_config,
     add_common_flags,
     init_logging,
+    start_debug_monitor,
     start_metrics_server,
     wait_for_shutdown,
 )
@@ -97,6 +98,7 @@ def main(argv=None) -> int:
         print(f"manager internal surface on "
               f"{args.host}:{internal_server.port}", flush=True)
     metrics_server = start_metrics_server(args, metrics.registry)
+    debug_monitor = start_debug_monitor(args)
 
     import time
 

@@ -16,6 +16,7 @@ from dragonfly2_tpu.cmd.common import (
     parse_with_config,
     add_common_flags,
     init_logging,
+    start_debug_monitor,
     start_metrics_server,
     wait_for_shutdown,
 )
@@ -58,7 +59,11 @@ def build_scheduler(args):
         scheduling=Scheduling(evaluator),
         storage=storage,
         network_topology=NetworkTopologyStore(
-            NetworkTopologyConfig(), resource=resource, storage=storage),
+            # persist_path: a restarted replica warm-starts its probe
+            # history instead of silently losing it (verdict item 6).
+            NetworkTopologyConfig(
+                persist_path=f"{args.data_dir}/topology_state.json"),
+            resource=resource, storage=storage),
         metrics=SchedulerMetrics(resource=resource, version=__version__),
         seed_peer_client=seed_peer_client,
     )
@@ -112,6 +117,7 @@ def main(argv=None) -> int:
     service, server = build_scheduler(args)
     print(f"scheduler serving on {server.target}", flush=True)
     metrics_server = start_metrics_server(args, service.metrics.registry)
+    debug_monitor = start_debug_monitor(args)
 
     manager_adapter = None
     dynconfig = None

@@ -14,6 +14,7 @@ from dragonfly2_tpu.cmd.common import (
     parse_with_config,
     add_common_flags,
     init_logging,
+    start_debug_monitor,
     start_metrics_server,
     wait_for_shutdown,
 )
@@ -28,6 +29,10 @@ def main(argv=None) -> int:
                         help="manager sqlite path for model registration "
                              "(co-located deployment)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
+    parser.add_argument("--profile-dir", default="",
+                        help="run train-step loops under "
+                             "jax.profiler.trace; XPlane dumps land here "
+                             "(inspect with tensorboard/xprof)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="trainer")
@@ -56,12 +61,22 @@ def main(argv=None) -> int:
             FilesystemObjectStore(args.object_store_dir))
     storage = TrainerStorage(args.data_dir)
     metrics = TrainerMetrics(version=__version__)
+    training_config = None
+    if args.profile_dir:
+        from dragonfly2_tpu.trainer.training import TrainingConfig
+
+        training_config = TrainingConfig()
+        training_config.gnn.profile_dir = args.profile_dir
+        training_config.mlp.profile_dir = args.profile_dir
     service = TrainerService(
-        storage, Training(storage, registry, metrics=metrics),
+        storage,
+        Training(storage, registry, config=training_config,
+                 metrics=metrics),
         metrics=metrics)
     server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
     print(f"trainer serving on {server.target}", flush=True)
     metrics_server = start_metrics_server(args, metrics.registry)
+    debug_monitor = start_debug_monitor(args)
     wait_for_shutdown()
     if metrics_server:
         metrics_server.stop()

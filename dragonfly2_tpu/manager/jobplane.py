@@ -116,11 +116,17 @@ class DurableJobStore:
     """
 
     def __init__(self, db: Database, *, default_max_attempts: int = 3,
-                 lease_ttl: float = 60.0, retry_backoff: float = 2.0):
+                 lease_ttl: float = 60.0, retry_backoff: float = 2.0,
+                 retention_s: float = 7 * 24 * 3600.0):
         self.db = db
         self.default_max_attempts = default_max_attempts
         self.lease_ttl = lease_ttl
         self.retry_backoff = retry_backoff
+        # Resolved rows older than this are purged (machinery's result
+        # expiry role) — without it a long-lived manager's queued_jobs
+        # table grows without bound.
+        self.retention_s = retention_s
+        self._last_purge = 0.0
 
     # -- producer side ---------------------------------------------------
 
@@ -161,6 +167,7 @@ class DurableJobStore:
         """
         now = time.time()
         ttl = lease_ttl or self.lease_ttl
+        self._maybe_purge(now)
         with self.db.transaction() as txn:
             # Reap expired leases: a worker that died mid-job spent an
             # attempt, so exhausted jobs dead-letter here too — otherwise
@@ -260,6 +267,23 @@ class DurableJobStore:
                 [STATE_PENDING, error, now + backoff, now, job_id])
             return {"ok": True, "state": STATE_PENDING,
                     "retry_in_s": round(backoff, 1)}
+
+    def _maybe_purge(self, now: float) -> None:
+        """Drop resolved rows past retention; piggybacks on lease polls
+        at most once a minute so no dedicated sweeper thread is needed."""
+        if now - self._last_purge < 60.0:
+            return
+        self._last_purge = now
+        self.purge(now=now)
+
+    def purge(self, *, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        with self.db.transaction() as txn:
+            cur = txn.execute(
+                "DELETE FROM queued_jobs WHERE state IN (?, ?) "
+                "AND updated_at < ?",
+                [STATE_SUCCEEDED, STATE_DEAD, now - self.retention_s])
+            return cur.rowcount
 
     # -- introspection ---------------------------------------------------
 

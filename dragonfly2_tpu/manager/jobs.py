@@ -14,7 +14,6 @@ import base64
 import json
 import logging
 import queue
-import re
 import threading
 import time
 import urllib.error
@@ -200,51 +199,12 @@ class ImageRef:
         return f"{self.registry}/v2/{self.name}/blobs/{digest}"
 
 
-def _parse_challenge(header: str) -> Tuple[str, Dict[str, str]]:
-    """``WWW-Authenticate: Bearer realm="...",service="...",scope="..."``
-    → ("bearer", params). Also recognizes Basic."""
-    scheme, _, rest = header.strip().partition(" ")
-    params = {}
-    for m in re.finditer(r'(\w+)="([^"]*)"|(\w+)=([^",\s]+)', rest):
-        if m.group(1):
-            params[m.group(1).lower()] = m.group(2)
-        else:
-            params[m.group(3).lower()] = m.group(4)
-    return scheme.lower(), params
-
-
-def fetch_registry_token(challenge: str, *, username: str = "",
-                         password: str = "", timeout: float = 30.0,
-                         repository: str = "") -> str:
-    """The Bearer half of the Docker registry token dance
-    (manager/job/preheat.go:168-246 getManifests → getAuthToken): GET the
-    challenge's realm with service+scope (Basic credentials if given) and
-    return the issued token."""
-    scheme, params = _parse_challenge(challenge)
-    if scheme != "bearer":
-        raise ValueError(f"unsupported auth challenge scheme {scheme!r}")
-    realm = params.get("realm", "")
-    if not realm:
-        raise ValueError("Bearer challenge without realm")
-    query = {}
-    if params.get("service"):
-        query["service"] = params["service"]
-    scope = params.get("scope") or (
-        f"repository:{repository}:pull" if repository else "")
-    if scope:
-        query["scope"] = scope
-    url = realm + ("?" + urllib.parse.urlencode(query) if query else "")
-    req_headers = {}
-    if username or password:
-        cred = base64.b64encode(f"{username}:{password}".encode()).decode()
-        req_headers["Authorization"] = f"Basic {cred}"
-    req = urllib.request.Request(url, headers=req_headers)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        body = json.loads(resp.read())
-    token = body.get("token") or body.get("access_token") or ""
-    if not token:
-        raise ValueError(f"token endpoint {realm} returned no token")
-    return token
+# The Bearer half of the Docker registry token dance
+# (manager/job/preheat.go:168-246 getManifests → getAuthToken) — shared
+# with the oras:// source client via utils/registryauth.
+from dragonfly2_tpu.utils.registryauth import (  # noqa: E402
+    fetch_registry_token,
+)
 
 
 def resolve_image_layers_with_auth(
@@ -257,36 +217,21 @@ def resolve_image_layers_with_auth(
     Basic). Returns ``(urls, auth_headers)`` — the auth headers must ride
     along to the seed peers, which fetch the blobs with the same token
     (preheat.go builds the layer requests with it)."""
+    from dragonfly2_tpu.utils.registryauth import open_with_registry_auth
+
     ref = ImageRef.parse(image_url)
     auth_headers: Dict[str, str] = {}
+    auth = ""
 
     def fetch(url: str) -> dict:
-        nonlocal auth_headers
-        merged = {"Accept": MANIFEST_ACCEPT, **(headers or {}),
-                  **auth_headers}
-        req = urllib.request.Request(url, headers=merged)
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as exc:
-            if exc.code != 401 or auth_headers:
-                raise
-            challenge = exc.headers.get("WWW-Authenticate", "")
-            scheme = challenge.split(" ", 1)[0].lower()
-            if scheme == "bearer":
-                token = fetch_registry_token(
-                    challenge, username=username, password=password,
-                    timeout=timeout, repository=ref.name)
-                auth_headers = {"Authorization": f"Bearer {token}"}
-            elif scheme == "basic" and (username or password):
-                cred = base64.b64encode(
-                    f"{username}:{password}".encode()).decode()
-                auth_headers = {"Authorization": f"Basic {cred}"}
-            else:
-                raise
-        req = urllib.request.Request(
-            url, headers={**merged, **auth_headers})
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
+        nonlocal auth_headers, auth
+        resp, auth = open_with_registry_auth(
+            url, headers={"Accept": MANIFEST_ACCEPT, **(headers or {})},
+            username=username, password=password, repository=ref.name,
+            auth=auth, timeout=timeout)
+        if auth:
+            auth_headers = {"Authorization": auth}
+        with resp:
             return json.loads(resp.read())
 
     manifest = fetch(ref.manifest_url())
@@ -372,8 +317,14 @@ class PreheatService:
     def wait(self, groups: List[GroupStatus], timeout: float = 120.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if all(g.done for g in groups):
-                return all(g.state == "SUCCESS" for g in groups)
+            # One query per group per poll: durable GroupHandles compute
+            # done AND state from a single snapshot (their per-field
+            # properties would each re-query the shared DB lock).
+            states = [g.snapshot() if hasattr(g, "snapshot")
+                      else {"done": g.done, "state": g.state}
+                      for g in groups]
+            if all(s["done"] for s in states):
+                return all(s["state"] == "SUCCESS" for s in states)
             time.sleep(0.05)
         return False
 

@@ -114,6 +114,23 @@ class TestDurableJobStore:
         dead = store.dead_letters()
         assert len(dead) == 1 and "lease expired" in dead[0].error
 
+    def test_retention_purges_resolved_jobs(self):
+        """Succeeded/dead rows past retention are dropped (machinery's
+        result-expiry role) — pending/leased rows are never touched."""
+        store = DurableJobStore(Database(), default_max_attempts=1,
+                                retention_s=0.05)
+        store.post(scheduler_queue(1), make_job())
+        store.post(scheduler_queue(1), make_job())
+        store.post(scheduler_queue(2), make_job())  # stays pending
+        j = store.lease([scheduler_queue(1)], "w")
+        store.complete(j["id"], ok=True, worker_id="w")
+        j = store.lease([scheduler_queue(1)], "w")
+        store.complete(j["id"], ok=False, error="x", worker_id="w")  # dead
+        time.sleep(0.08)
+        assert store.purge() == 2
+        rows = store.db.find("queued_jobs")
+        assert len(rows) == 1 and rows[0].state == STATE_PENDING
+
     def test_stale_worker_completion_rejected(self):
         store = DurableJobStore(Database())
         store.post(scheduler_queue(1), make_job())
@@ -408,7 +425,16 @@ class PrivateRegistry:
                     return
                 with open(path, "rb") as f:
                     data = f.read()
-                self.send_response(200)
+                status = 200
+                rng = self.headers.get("Range", "")
+                if rng.startswith("bytes=") and registry.support_range:
+                    lo, _, hi = rng[len("bytes="):].partition("-")
+                    start = int(lo)
+                    end = min(int(hi) if hi else len(data) - 1,
+                              len(data) - 1)
+                    data = data[start:end + 1]
+                    status = 206
+                self.send_response(status)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -429,6 +455,7 @@ class PrivateRegistry:
                 self.wfile.write(data)
 
         self.token_requests: list = []
+        self.support_range = True  # real registries serve 206 on blobs
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self.server.server_address[1]
         threading.Thread(target=self.server.serve_forever,

@@ -134,3 +134,71 @@ class TestNetworkTopologyStore:
         store, resource, storage = topo
         store.enqueue_probe("ghost", Probe("h1", 0.01))
         assert store.snapshot() == 0
+
+
+class TestTopologyDurability:
+    """Replica-loss durability (round-3 verdict item 6): probe history
+    survives a scheduler restart via export/import instead of the
+    reference's shared Redis (probes.go:115-186)."""
+
+    def test_export_import_round_trip(self, topo, tmp_path):
+        store, resource, storage = topo
+        for i, rtt in enumerate([0.010, 0.020, 0.030]):
+            store.enqueue_probe("h0", Probe("h1", rtt))
+        store.enqueue_probe("h2", Probe("h3", 0.005))
+        path = str(tmp_path / "state" / "topology.json")
+        assert store.export_state(path) == 2
+
+        # "Restarted replica": a brand-new store warm-starts from disk.
+        fresh = NetworkTopologyStore(
+            NetworkTopologyConfig(probe_count=3), resource, storage)
+        assert fresh.import_state(path) == 2
+        assert fresh.average_rtt("h0", "h1") == pytest.approx(
+            store.average_rtt("h0", "h1"))
+        assert [p.rtt for p in fresh.probes("h0", "h1")] == \
+            [p.rtt for p in store.probes("h0", "h1")]
+        assert fresh.probed_count("h1") == 3
+        # Warm-started state drives probe-target selection exactly as
+        # the original: h1 is now the most-probed host.
+        got = {h.id for h in fresh.find_probed_hosts("h0")}
+        assert "h1" not in got
+
+    def test_import_keeps_fresher_local_edges(self, topo, tmp_path):
+        store, resource, storage = topo
+        store.enqueue_probe("h0", Probe("h1", 0.050))
+        path = str(tmp_path / "topology.json")
+        store.export_state(path)
+        # Local store has since observed a newer probe for the edge.
+        live = NetworkTopologyStore(
+            NetworkTopologyConfig(), resource, storage)
+        live.enqueue_probe("h0", Probe("h1", 0.001))
+        live.import_state(path)
+        # Live (fresher) probe wins; snapshot is not allowed to regress.
+        assert live.average_rtt("h0", "h1") == pytest.approx(0.001)
+        # But counts merge by max (import had 1, local had 1 → still 1).
+        assert live.probed_count("h1") == 1
+
+    def test_missing_or_corrupt_file_is_noop(self, topo, tmp_path):
+        store, *_ = topo
+        assert store.import_state(str(tmp_path / "nope.json")) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert store.import_state(str(bad)) == 0
+
+    def test_serve_warm_starts_and_stop_persists(self, topo, tmp_path):
+        store, resource, storage = topo
+        path = str(tmp_path / "persist.json")
+        store.config.persist_path = path
+        store.config.collect_interval = 3600.0
+        store.enqueue_probe("h0", Probe("h1", 0.015))
+        store.serve()
+        store.stop()  # clean-shutdown export
+        assert os.path.exists(path)
+        replica = NetworkTopologyStore(
+            NetworkTopologyConfig(persist_path=path, collect_interval=3600.0),
+            resource, storage)
+        replica.serve()  # warm-start import
+        try:
+            assert replica.average_rtt("h0", "h1") == pytest.approx(0.015)
+        finally:
+            replica.stop()
