@@ -2,16 +2,22 @@
 
 Sharding layout (the scaling-book recipe — annotate, let XLA insert
 collectives):
-- node features / bias / mask rows shard over ``data`` (each device owns
-  N/d query rows and their outgoing-attention rows);
+- node features / neighbor lists / accumulator rows shard over ``data``
+  (each device owns N/d query rows);
 - params and optimizer state replicate (allreduce gradients over ICI);
 - the per-step edge minibatch replicates (it indexes the full embedding
   table, whose row shards XLA all-gathers exactly once per step where the
   gather needs them).
 
+Scale (round 4): the graph is held as padded neighbor lists, not dense
+[N, N] bias/mask, and attention is chunked with an online softmax
+(`models/graph_transformer.py`) — full-topology graphs of 100k+ hosts
+fit, where the dense layout capped out around a few thousand.
+
 Train-graph/eval-edge leakage discipline matches gnn_trainer: the attention
-bias is built from TRAIN edges only, so an eval edge's RTT (a deterministic
-function of its label) never appears in the message structure.
+structure is built from TRAIN edges only, so an eval edge's RTT (a
+deterministic function of its label) never appears in the message
+structure.
 """
 
 from __future__ import annotations
@@ -27,8 +33,9 @@ from flax.training import train_state
 from dragonfly2_tpu.data.features import Graph
 from dragonfly2_tpu.models.graph_transformer import (
     GraphTransformer,
-    build_bias,
-    pad_graph,
+    build_neighbor_lists,
+    pad_graph_sparse,
+    pad_multiple,
 )
 from dragonfly2_tpu.parallel import MeshContext, data_parallel_mesh
 from dragonfly2_tpu.train.gnn_trainer import edge_split
@@ -48,6 +55,12 @@ class GATTrainConfig:
     seed: int = 0
     eval_fraction: float = 0.1
     rtt_threshold_ns: int = 20_000_000
+    # Key-block width for chunked attention (peak activation memory is
+    # O(rows · heads · chunk)) and per-node neighbor cap (best-K by RTT
+    # bias; self always survives).
+    chunk: int = 1024
+    neighbor_cap: int = 128
+    attention: str = "gather"  # "gather" (O(N·K)) or "blocks" (chunked)
     # Shared step-loop accounting (see GNNTrainConfig): wall cap for the
     # step loop plus incremental publishing hooks.
     max_seconds: float | None = None
@@ -60,8 +73,8 @@ class GATTrainResult:
     params: dict
     config: GATTrainConfig
     node_features: np.ndarray  # padded
-    bias: np.ndarray
-    mask: np.ndarray
+    neighbors: np.ndarray      # [N, K] int32 (PAD_ID padded)
+    neighbor_vals: np.ndarray  # [N, K] float32 RTT biases
     n_real_nodes: int
     precision: float
     recall: float
@@ -75,6 +88,7 @@ class GATTrainResult:
         return GraphTransformer(
             hidden=self.config.hidden, embed=self.config.embed,
             layers=self.config.layers, heads=self.config.heads,
+            chunk=self.config.chunk, attention=self.config.attention,
         )
 
 
@@ -90,20 +104,26 @@ def train_gat(
     train_ids, eval_ids = edge_split(graph, config.eval_fraction, config.seed)
 
     # Attention structure from TRAIN edges only (leakage discipline).
-    bias, mask = build_bias(
+    nbr, val = build_neighbor_lists(
         graph.n_nodes,
         graph.edge_src[train_ids], graph.edge_dst[train_ids],
         graph.edge_rtt_ns[train_ids],
+        cap=config.neighbor_cap,
     )
-    node_features, bias, mask, n_real = pad_graph(
-        graph.node_features, bias, mask, mesh.n_data
+    # The chunk-divisibility constraint (and its up-to-lcm padding cost)
+    # only exists for blocks mode; gather mode needs mesh rows only.
+    multiple = (pad_multiple(mesh.n_data, config.chunk, graph.n_nodes)
+                if config.attention == "blocks" else mesh.n_data)
+    node_features, nbr, val, n_real = pad_graph_sparse(
+        graph.node_features, nbr, val, multiple,
     )
 
     model = GraphTransformer(hidden=config.hidden, embed=config.embed,
-                             layers=config.layers, heads=config.heads)
+                             layers=config.layers, heads=config.heads,
+                             chunk=config.chunk, attention=config.attention)
     params = model.init(
         jax.random.key(config.seed),
-        jnp.asarray(node_features), jnp.asarray(bias), jnp.asarray(mask),
+        jnp.asarray(node_features), jnp.asarray(nbr), jnp.asarray(val),
         jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32),
     )
 
@@ -121,13 +141,13 @@ def train_gat(
     # Graph tensors: rows sharded over data; placed once, reused each step.
     row = mesh.shard_spec("data")
     g_feat = jax.device_put(node_features, row)
-    g_bias = jax.device_put(bias, row)
-    g_mask = jax.device_put(mask, row)
+    g_nbr = jax.device_put(nbr, row)
+    g_val = jax.device_put(val, row)
     rep = mesh.replicated
 
-    def train_step(state, feat, bias_, mask_, src, dst, y):
+    def train_step(state, feat, nbr_, val_, src, dst, y):
         def loss_fn(params):
-            logits = state.apply_fn(params, feat, bias_, mask_, src, dst)
+            logits = state.apply_fn(params, feat, nbr_, val_, src, dst)
             return optax.sigmoid_binary_cross_entropy(logits, y).mean()
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -139,8 +159,8 @@ def train_gat(
         donate_argnums=(0,),
     )
 
-    def eval_step(params, feat, bias_, mask_, src, dst, y, w):
-        logits = model.apply(params, feat, bias_, mask_, src, dst)
+    def eval_step(params, feat, nbr_, val_, src, dst, y, w):
+        logits = model.apply(params, feat, nbr_, val_, src, dst)
         pred = (logits > 0).astype(jnp.float32)
         tp = jnp.sum(w * pred * y)
         fp = jnp.sum(w * pred * (1 - y))
@@ -162,8 +182,8 @@ def train_gat(
                         on_compile=config.compile_callback,
                         on_progress=config.progress_callback)
     stop = False
-    # Explicit-sharding mode: the in-model reshard (K/V all-gather) needs
-    # the ambient mesh during trace.
+    # Explicit-sharding mode: the in-model reshards (K/V + embedding
+    # all-gathers, block-bias scatter) need the ambient mesh during trace.
     with jax.set_mesh(mesh.mesh):
         for _ in range(config.epochs):
             order = rng.permutation(train_ids)
@@ -173,7 +193,7 @@ def train_gat(
                 if len(ids) < batch:
                     break
                 state, loss = train_step(
-                    state, g_feat, g_bias, g_mask,
+                    state, g_feat, g_nbr, g_val,
                     rep_put(graph.edge_src[ids].astype(np.int32)),
                     rep_put(graph.edge_dst[ids].astype(np.int32)),
                     rep_put(labels_all[ids]),
@@ -193,7 +213,7 @@ def train_gat(
         cm = np.zeros(4)
         for ids, weights in padded_chunks(eval_ids, batch):
             cm += np.asarray(eval_step(
-                state.params, g_feat, g_bias, g_mask,
+                state.params, g_feat, g_nbr, g_val,
                 rep_put(graph.edge_src[ids].astype(np.int32)),
                 rep_put(graph.edge_dst[ids].astype(np.int32)),
                 rep_put(labels_all[ids]), rep_put(weights),
@@ -204,8 +224,8 @@ def train_gat(
         params=jax.device_get(state.params),
         config=config,
         node_features=node_features,
-        bias=bias,
-        mask=mask,
+        neighbors=nbr,
+        neighbor_vals=val,
         n_real_nodes=n_real,
         precision=metrics["precision"],
         recall=metrics["recall"],
