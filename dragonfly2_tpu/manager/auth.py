@@ -4,9 +4,10 @@ Reference counterpart: manager/middlewares/jwt.go (appgo/gin-jwt session
 tokens), manager/permission/rbac/rbac.go:182 (casbin model: role → object →
 read/write), manager/models/user.go + personal_access_token.go, and the
 seeded root account (manager/database/database.go seeds user ``root`` with
-password ``dragonfly``). OAuth2 sign-in (google/github) is intentionally
-not implemented — it needs external identity providers; JWT + PAT cover
-the API-surface auth the reference's handlers enforce.
+password ``dragonfly``). OAuth2 sign-in (google/github) lives in
+``manager/oauth.py`` (provider flow) + :meth:`AuthService.oauth_signin` /
+:meth:`AuthService.oauth_signin_callback` below, mirroring
+manager/service/user.go:140-185 (OauthSignin / OauthSigninCallback).
 
 Stdlib only: pbkdf2 for passwords, HMAC-SHA256 JWTs (no external jwt lib).
 """
@@ -105,6 +106,7 @@ class AuthService:
         self.secret = (secret or os.environ.get("DF2_MANAGER_JWT_SECRET", "")
                        or secrets.token_hex(32))
         self.jwt_ttl = jwt_ttl
+        self._oauth_states: Dict[str, float] = {}
         if seed_root and self.db.find_one("users", name=DEFAULT_ROOT_USER) is None:
             self.signup(DEFAULT_ROOT_USER, DEFAULT_ROOT_PASSWORD,
                         roles=[ROLE_ROOT])
@@ -178,6 +180,91 @@ class AuthService:
             return Identity(user.id, user.name, self.roles_of(user.id))
         except (ValueError, KeyError, json.JSONDecodeError):
             return None
+
+    # -- OAuth2 sign-in (user.go:140-185) --------------------------------
+
+    _OAUTH_STATE_TTL = 600.0
+
+    def _oauth_provider(self, name: str):
+        from dragonfly2_tpu.manager.oauth import OAuthError, new_provider
+        row = self.db.find_one("oauths", name=name)
+        if row is None:
+            raise AuthError(f"oauth provider {name!r} not configured")
+        try:
+            return new_provider(
+                row.name, row.client_id, row.client_secret, row.redirect_url,
+                auth_url=row.auth_url, token_url=row.token_url,
+                userinfo_url=row.userinfo_url)
+        except OAuthError as exc:
+            raise AuthError(str(exc)) from exc
+
+    def _issue_oauth_state(self) -> str:
+        now = time.time()
+        for state in [s for s, exp in self._oauth_states.items()
+                      if exp < now]:
+            self._oauth_states.pop(state, None)
+        state = secrets.token_urlsafe(16)
+        self._oauth_states[state] = now + self._OAUTH_STATE_TTL
+        return state
+
+    def _consume_oauth_state(self, state: str) -> bool:
+        """One-time use: present, unexpired, then burned. In-memory — a
+        multi-replica manager needs sticky routing for the two-leg
+        browser flow (same constraint as the reference's session state)."""
+        if not state:
+            return False
+        expiry = self._oauth_states.pop(state, 0)
+        return expiry >= time.time()
+
+    def oauth_signin(self, name: str) -> str:
+        """GET users/signin/{name}: the provider redirect URL carrying a
+        fresh one-time CSRF state (user.go:140 OauthSignin)."""
+        return self._oauth_provider(name).auth_code_url(
+            self._issue_oauth_state())
+
+    def oauth_signin_callback(self, name: str, code: str,
+                              state: str = "") -> str:
+        """GET users/signin/{name}/callback?code=...&state=...: verify
+        the state, exchange the code, fetch the provider identity,
+        find-or-create the local user, and issue a session JWT
+        (user.go:154 OauthSigninCallback).
+
+        Account linking keys on (provider, subject) — the provider's
+        STABLE unique id (github numeric id, google sub) — never on the
+        display name, which is attacker-chosen free text. A display name
+        colliding with an existing local account (e.g. a GitHub profile
+        renamed to ``root``) gets a fresh, uniquified local user instead
+        of the existing one.
+        """
+        from dragonfly2_tpu.manager.oauth import OAuthError
+        if not self._consume_oauth_state(state):
+            raise AuthError("invalid or expired oauth state")
+        provider = self._oauth_provider(name)
+        try:
+            token = provider.exchange(code)
+            oauth_user = provider.get_user(token)
+        except OAuthError as exc:
+            raise AuthError(str(exc)) from exc
+        user = self.db.find_one("users", oauth_provider=name,
+                                oauth_subject=oauth_user.subject)
+        if user is None:
+            local_name = oauth_user.name
+            if self.db.find_one("users", name=local_name) is not None:
+                local_name = f"{local_name} ({name}:{oauth_user.subject})"
+            if self.db.find_one("users", name=local_name) is not None:
+                raise AuthError(f"user {local_name!r} exists")
+            # OAuth accounts have no local password: the stored sentinel
+            # never matches _check_password's salt$digest shape, so
+            # password signin is impossible for them by construction.
+            user_id = self.db.insert(
+                "users", name=local_name, password_hash="!oauth",
+                email=oauth_user.email, oauth_provider=name,
+                oauth_subject=oauth_user.subject)
+            self.db.insert("user_roles", user_id=user_id, role=ROLE_GUEST)
+            user = self.db.get("users", user_id)
+        if user.state != "enable":
+            raise AuthError("user disabled")
+        return self._issue_jwt(user)
 
     # -- personal access tokens -----------------------------------------
 

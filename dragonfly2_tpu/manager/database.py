@@ -99,11 +99,26 @@ CREATE TABLE IF NOT EXISTS configs (
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS oauths (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    name TEXT UNIQUE NOT NULL,
+    bio TEXT NOT NULL DEFAULT '',
+    client_id TEXT NOT NULL,
+    client_secret TEXT NOT NULL,
+    redirect_url TEXT NOT NULL DEFAULT '',
+    auth_url TEXT NOT NULL DEFAULT '',
+    token_url TEXT NOT NULL DEFAULT '',
+    userinfo_url TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS users (
     id INTEGER PRIMARY KEY AUTOINCREMENT,
     name TEXT UNIQUE NOT NULL,
     password_hash TEXT NOT NULL,
     email TEXT NOT NULL DEFAULT '',
+    oauth_provider TEXT NOT NULL DEFAULT '',
+    oauth_subject TEXT NOT NULL DEFAULT '',
     state TEXT NOT NULL DEFAULT 'enable',
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
@@ -205,6 +220,17 @@ class Database:
         self._lock = threading.RLock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            # Additive migrations for DB files created by older builds
+            # (CREATE IF NOT EXISTS can't add columns to existing tables).
+            for table, column, decl in (
+                ("users", "oauth_provider", "TEXT NOT NULL DEFAULT ''"),
+                ("users", "oauth_subject", "TEXT NOT NULL DEFAULT ''"),
+            ):
+                cols = {r["name"] for r in self._conn.execute(
+                    f"PRAGMA table_info({table})")}
+                if column not in cols:
+                    self._conn.execute(
+                        f"ALTER TABLE {table} ADD COLUMN {column} {decl}")
             self._conn.commit()
 
     def close(self) -> None:

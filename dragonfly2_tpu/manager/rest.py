@@ -30,7 +30,16 @@ logger = logging.getLogger(__name__)
 
 _PUBLIC = {("POST", "/api/v1/users/signin"),
            ("POST", "/api/v1/users/signup"),
-           ("GET", "/healthy")}
+           ("GET", "/healthy"),
+           # Embedded console shell (manager.go:68-85): the page itself
+           # is public; every API call it makes carries the JWT.
+           ("GET", "/"),
+           ("GET", "/console")}
+# OAuth2 browser flow: redirect + callback are pre-auth by nature
+# (router.go:104-105 registers them outside the jwt middleware).
+_PUBLIC_PATTERNS = (
+    re.compile(r"^/api/v1/users/signin/[\w-]+(/callback)?$"),
+)
 
 
 class HttpError(Exception):
@@ -40,10 +49,22 @@ class HttpError(Exception):
         self.message = message
 
 
+class RawResponse:
+    """A non-JSON payload (the embedded console's HTML); the HTTP shell
+    writes ``body`` verbatim with ``content_type``."""
+
+    def __init__(self, body: bytes, content_type: str):
+        self.body = body
+        self.content_type = content_type
+
+
 def _row(r) -> dict:
     d = dict(r.data)
     d.pop("password_hash", None)
     d.pop("token_hash", None)
+    # OAuth client secrets never leave the manager (handlers/oauth.go
+    # returns the model, but our API-surface policy is redact-by-default).
+    d.pop("client_secret", None)
     return d
 
 
@@ -66,9 +87,21 @@ class RestApi:
         self.routes: List[Tuple[str, re.Pattern, Callable]] = []
         r = self._route
         r("GET", r"/healthy", lambda i, m, q, b: "OK")
+        # embedded console (manager.go:68-85)
+        r("GET", r"/", self._console)
+        r("GET", r"/console", self._console)
         # users / auth (handlers/user.go, personal_access_token.go)
         r("POST", r"/api/v1/users/signup", self._signup)
         r("POST", r"/api/v1/users/signin", self._signin)
+        # OAuth2 (handlers/oauth.go + router.go:104-105)
+        r("GET", r"/api/v1/users/signin/(?P<name>[\w-]+)", self._oauth_signin)
+        r("GET", r"/api/v1/users/signin/(?P<name>[\w-]+)/callback",
+          self._oauth_callback)
+        r("POST", r"/api/v1/oauth", self._create_oauth)
+        r("GET", r"/api/v1/oauth", self._list_oauth)
+        r("GET", r"/api/v1/oauth/(?P<id>\d+)", self._get_oauth)
+        r("PATCH", r"/api/v1/oauth/(?P<id>\d+)", self._update_oauth)
+        r("DELETE", r"/api/v1/oauth/(?P<id>\d+)", self._delete_in("oauths"))
         r("GET", r"/api/v1/users", self._list_users)
         r("POST", r"/api/v1/users/(?P<id>\d+)/roles", self._assign_role)
         r("DELETE", r"/api/v1/users/(?P<id>\d+)/roles/(?P<role>[\w-]+)",
@@ -151,7 +184,9 @@ class RestApi:
             # separately-bindable (firewallable) internal port.
             return 404, {"error": "internal surface is on --internal-port"}
         identity: Optional[Identity] = None
-        public = (method, path) in _PUBLIC or internal_path
+        public = ((method, path) in _PUBLIC or internal_path
+                  or (method == "GET" and any(
+                      p.match(path) for p in _PUBLIC_PATTERNS)))
         if self.auth is not None and not public:
             identity = self.auth.authenticate(authorization)
             if identity is None:
@@ -232,6 +267,72 @@ class RestApi:
         self._require_auth_configured()
         self.auth.revoke_pat(int(m.group("id")))
         return {"ok": True}
+
+    # -- console -----------------------------------------------------------
+
+    def _console(self, identity, m, q, body):
+        from dragonfly2_tpu.manager.console import console_html
+
+        return RawResponse(console_html(), "text/html; charset=utf-8")
+
+    # -- OAuth2 (handlers/oauth.go, user.go OauthSignin*) ------------------
+
+    def _oauth_signin(self, identity, m, q, body):
+        self._require_auth_configured()
+        try:
+            return {"location": self.auth.oauth_signin(m.group("name"))}
+        except AuthError as exc:
+            raise HttpError(404, str(exc))
+
+    def _oauth_callback(self, identity, m, q, body):
+        self._require_auth_configured()
+        code = q.get("code", "")
+        if not code:
+            raise HttpError(400, "missing code")
+        try:
+            token = self.auth.oauth_signin_callback(
+                m.group("name"), code, state=q.get("state", ""))
+        except AuthError as exc:
+            raise HttpError(401, str(exc))
+        return {"token": token}
+
+    def _create_oauth(self, identity, m, q, body):
+        from dragonfly2_tpu.manager.oauth import OAuthError, new_provider
+        try:  # validate the provider name up front (oauth.go New())
+            new_provider(body["name"], body.get("client_id", ""),
+                         body.get("client_secret", ""),
+                         body.get("redirect_url", ""))
+        except OAuthError as exc:
+            raise HttpError(400, str(exc))
+        if self.service.db.find_one("oauths", name=body["name"]) is not None:
+            raise HttpError(409, f"oauth {body['name']!r} exists")
+        row_id = self.service.db.insert(
+            "oauths", name=body["name"], bio=body.get("bio", ""),
+            client_id=body["client_id"], client_secret=body["client_secret"],
+            redirect_url=body.get("redirect_url", ""),
+            auth_url=body.get("auth_url", ""),
+            token_url=body.get("token_url", ""),
+            userinfo_url=body.get("userinfo_url", ""))
+        return _row(self.service.db.get("oauths", row_id))
+
+    def _list_oauth(self, identity, m, q, body):
+        return [_row(r) for r in self.service.db.find("oauths")]
+
+    def _get_oauth(self, identity, m, q, body):
+        row = self.service.db.get("oauths", int(m.group("id")))
+        if row is None:
+            raise HttpError(404, "oauth not found")
+        return _row(row)
+
+    def _update_oauth(self, identity, m, q, body):
+        allowed = {k: v for k, v in body.items()
+                   if k in ("bio", "client_id", "client_secret",
+                            "redirect_url", "auth_url", "token_url",
+                            "userinfo_url")}
+        if not allowed:
+            raise HttpError(400, "no updatable fields")
+        self.service.db.update("oauths", int(m.group("id")), **allowed)
+        return self._get_oauth(identity, m, q, body)
 
     # -- clusters ----------------------------------------------------------
 
@@ -538,9 +639,13 @@ class ManagerHTTPServer(ThreadedHTTPService):
                 if metrics:
                     metrics.request_count.labels(
                         method=self.command, status=str(code)).inc()
-                data = json.dumps(payload).encode()
+                if isinstance(payload, RawResponse):
+                    data, content_type = payload.body, payload.content_type
+                else:
+                    data, content_type = (json.dumps(payload).encode(),
+                                          "application/json")
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

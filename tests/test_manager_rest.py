@@ -330,3 +330,51 @@ class TestSyncPeers:
         assert code == 200 and len(peers) == 1
         assert peers[0]["host_id"] == "h9"
         bus.stop()
+
+
+class TestEmbeddedConsole:
+    """manager.go:68-85: the console ships inside the manager and is
+    served at the root of the public surface only."""
+
+    def test_console_served_public(self, api):
+        from dragonfly2_tpu.manager.rest import RawResponse
+
+        for path in ("/", "/console"):
+            code, payload = api.dispatch("GET", path, {}, {})
+            assert code == 200
+            assert isinstance(payload, RawResponse)
+            assert payload.content_type.startswith("text/html")
+            html = payload.body.decode()
+            assert "Dragonfly2-TPU Manager" in html
+            # the page drives the real API surface
+            for endpoint in ("/api/v1/users/signin", "/api/v1/jobs",
+                             "/api/v1/scheduler-clusters"):
+                assert endpoint in html
+
+    def test_console_not_on_internal_surface(self, api):
+        code, _ = api.dispatch("GET", "/", {}, {}, surface="internal")
+        assert code == 404
+
+    def test_console_over_http(self, api):
+        import json as _json
+        import urllib.request
+
+        from dragonfly2_tpu.manager.rest import ManagerHTTPServer
+
+        server = ManagerHTTPServer(api, port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/") as resp:
+                assert resp.headers["Content-Type"].startswith("text/html")
+                assert b"Dragonfly2-TPU Manager" in resp.read()
+            # JSON endpoints still answer JSON beside the console
+            req = urllib.request.Request(
+                base + "/api/v1/users/signin", method="POST",
+                data=_json.dumps({"name": "root",
+                                  "password": "dragonfly"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                assert "token" in _json.loads(resp.read())
+        finally:
+            server.stop()

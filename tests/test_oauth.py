@@ -1,0 +1,335 @@
+"""OAuth2 sign-in (round-3 verdict #10) against a faked identity provider.
+
+Done-criteria: the full google/github authorization-code flow — provider
+config CRUD, signin redirect URL, code→token exchange, userinfo fetch,
+find-or-create local user, session JWT — runs end-to-end against a local
+fake provider, exercising the exact production path (only the endpoint
+URLs differ). Reference: manager/auth/oauth/oauth.go + service/user.go:140.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.manager import (
+    Database,
+    FilesystemObjectStore,
+    ManagerService,
+)
+from dragonfly2_tpu.manager.auth import (
+    AuthError,
+    AuthService,
+    DEFAULT_ROOT_PASSWORD,
+    DEFAULT_ROOT_USER,
+)
+from dragonfly2_tpu.manager.oauth import (
+    GithubOAuth,
+    GoogleOAuth,
+    OAuthError,
+    new_provider,
+)
+from dragonfly2_tpu.manager.rest import RestApi
+
+VALID_CODE = "authcode-42"
+VALID_TOKEN = "provider-token-007"
+
+
+class _FakeProvider(BaseHTTPRequestHandler):
+    """Token + userinfo endpoints of a github-shaped identity provider."""
+
+    userinfo = {"id": 583231, "login": "octocat", "name": "Mona Lisa",
+                "email": "mona@example.com",
+                "avatar_url": "https://example.com/a.png"}
+
+    def do_POST(self):
+        if self.path != "/token":
+            return self._json(404, {"error": "not found"})
+        length = int(self.headers.get("Content-Length", 0))
+        form = dict(urllib.parse.parse_qsl(self.rfile.read(length).decode()))
+        if form.get("code") != VALID_CODE:
+            return self._json(200, {"error": "bad_verification_code"})
+        if form.get("client_id") != "cid" or form.get("client_secret") != "sec":
+            return self._json(200, {"error": "incorrect_client_credentials"})
+        self._json(200, {"access_token": VALID_TOKEN, "token_type": "bearer"})
+
+    def do_GET(self):
+        if self.path != "/user":
+            return self._json(404, {"error": "not found"})
+        if self.headers.get("Authorization") != f"Bearer {VALID_TOKEN}":
+            return self._json(401, {"error": "bad token"})
+        self._json(200, self.userinfo)
+
+    def _json(self, code, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def provider_url():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeProvider)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+@pytest.fixture()
+def api(tmp_path):
+    service = ManagerService(Database(":memory:"),
+                             FilesystemObjectStore(str(tmp_path / "objects")))
+    return RestApi(service, auth=AuthService(service.db, secret="s"))
+
+
+def _root(api):
+    code, payload = api.dispatch(
+        "POST", "/api/v1/users/signin", {},
+        {"name": DEFAULT_ROOT_USER, "password": DEFAULT_ROOT_PASSWORD})
+    assert code == 200
+    return "Bearer " + payload["token"]
+
+
+def _configure_github(api, provider_url, auth_header):
+    code, payload = api.dispatch(
+        "POST", "/api/v1/oauth", {},
+        {"name": "github", "client_id": "cid", "client_secret": "sec",
+         "redirect_url": "http://manager/api/v1/users/signin/github/callback",
+         "auth_url": f"{provider_url}/authorize",
+         "token_url": f"{provider_url}/token",
+         "userinfo_url": f"{provider_url}/user"},
+        authorization=auth_header)
+    assert code == 200, payload
+    return payload
+
+
+class TestProviders:
+    def test_new_provider_names(self):
+        assert isinstance(new_provider("google", "a", "b", "c"), GoogleOAuth)
+        assert isinstance(new_provider("github", "a", "b", "c"), GithubOAuth)
+        with pytest.raises(OAuthError):
+            new_provider("gitlab", "a", "b", "c")
+
+    def test_auth_code_url_shape(self):
+        url = GithubOAuth("cid", "sec", "http://cb").auth_code_url("xyz")
+        parsed = urllib.parse.urlparse(url)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        assert parsed.netloc == "github.com"
+        assert q["client_id"] == "cid"
+        assert q["redirect_uri"] == "http://cb"
+        assert q["state"] == "xyz"
+        assert "public_repo" in q["scope"]
+
+    def test_states_are_unique(self):
+        p = GoogleOAuth("cid", "sec", "http://cb")
+        states = {dict(urllib.parse.parse_qsl(
+            urllib.parse.urlparse(p.auth_code_url()).query))["state"]
+            for _ in range(8)}
+        assert len(states) == 8
+
+
+class TestRestFlow:
+    def test_config_crud_redacts_secret(self, api, provider_url):
+        root = _root(api)
+        created = _configure_github(api, provider_url, root)
+        assert "client_secret" not in created
+        code, listed = api.dispatch("GET", "/api/v1/oauth", {}, {},
+                                    authorization=root)
+        assert code == 200 and listed[0]["name"] == "github"
+        assert "client_secret" not in listed[0]
+        code, _ = api.dispatch(
+            "PATCH", f"/api/v1/oauth/{created['id']}", {},
+            {"bio": "corp github"}, authorization=root)
+        assert code == 200
+
+    def test_unknown_provider_name_rejected(self, api):
+        root = _root(api)
+        code, payload = api.dispatch(
+            "POST", "/api/v1/oauth", {},
+            {"name": "gitlab", "client_id": "x", "client_secret": "y"},
+            authorization=root)
+        assert code == 400
+
+    def test_signin_redirect_is_public(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        # no Authorization header — the redirect must still work
+        code, payload = api.dispatch(
+            "GET", "/api/v1/users/signin/github", {}, {})
+        assert code == 200, payload
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlparse(payload["location"]).query))
+        assert q["client_id"] == "cid"
+
+    def test_signin_unconfigured_404(self, api):
+        code, payload = api.dispatch(
+            "GET", "/api/v1/users/signin/google", {}, {})
+        assert code == 404
+
+    def test_callback_creates_user_and_jwt(self, api, provider_url):
+        auth = api.auth
+        _configure_github(api, provider_url, _root(api))
+        code, payload = _oauth_roundtrip(api)
+        assert code == 200, payload
+        ident = auth.verify_jwt(payload["token"])
+        assert ident is not None and ident.name == "Mona Lisa"
+        assert ident.can("models", "read")       # guest role
+        assert not ident.can("models", "write")
+        user = auth.db.find_one("users", name="Mona Lisa")
+        assert user.email == "mona@example.com"
+        assert user.oauth_provider == "github"
+        # password signin is impossible for oauth accounts
+        with pytest.raises(AuthError):
+            auth.signin("Mona Lisa", "!oauth")
+
+    def test_callback_reuses_existing_user(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        for _ in range(2):
+            code, payload = _oauth_roundtrip(api)
+            assert code == 200
+        users = [u for u in api.auth.db.find("users")
+                 if u.name == "Mona Lisa"]
+        assert len(users) == 1
+
+    def test_callback_bad_code_401(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        code, payload = _oauth_roundtrip(api, code="stolen")
+        assert code == 401
+
+    def test_callback_missing_code_400(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        state = _fresh_state(api)
+        code, _ = api.dispatch(
+            "GET", "/api/v1/users/signin/github/callback",
+            {"state": state}, {})
+        assert code == 400
+
+    def test_duplicate_provider_409(self, api, provider_url):
+        root = _root(api)
+        _configure_github(api, provider_url, root)
+        code, payload = api.dispatch(
+            "POST", "/api/v1/oauth", {},
+            {"name": "github", "client_id": "x", "client_secret": "y"},
+            authorization=root)
+        assert code == 409
+
+
+class TestCSRFState:
+    """The authorization-code flow's state is one-time and mandatory —
+    a forged callback (login CSRF) must not produce a session."""
+
+    def test_callback_without_state_401(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        code, payload = api.dispatch(
+            "GET", "/api/v1/users/signin/github/callback",
+            {"code": VALID_CODE}, {})
+        assert code == 401
+        assert "state" in payload["error"]
+
+    def test_callback_forged_state_401(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        code, _ = api.dispatch(
+            "GET", "/api/v1/users/signin/github/callback",
+            {"code": VALID_CODE, "state": "attacker-guess"}, {})
+        assert code == 401
+
+    def test_state_single_use(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        state = _fresh_state(api)
+        code, _ = api.dispatch(
+            "GET", "/api/v1/users/signin/github/callback",
+            {"code": VALID_CODE, "state": state}, {})
+        assert code == 200
+        code, _ = api.dispatch(
+            "GET", "/api/v1/users/signin/github/callback",
+            {"code": VALID_CODE, "state": state}, {})
+        assert code == 401  # burned
+
+
+class TestAccountLinking:
+    """Linking keys on the provider's STABLE subject (github numeric
+    id), never on the attacker-chosen display name — naming a GitHub
+    profile 'root' must not sign in as the seeded root user."""
+
+    def test_cannot_take_over_password_account(self, api, provider_url):
+        _configure_github(api, provider_url, _root(api))
+        original = dict(_FakeProvider.userinfo)
+        _FakeProvider.userinfo = dict(original, name="root")
+        try:
+            code, payload = _oauth_roundtrip(api)
+            assert code == 200
+            ident = api.auth.verify_jwt(payload["token"])
+            # a NEW uniquified guest account — not the seeded root
+            assert ident.name != "root"
+            assert not ident.can("models", "write")
+            root_row = api.auth.db.find_one("users", name="root")
+            assert root_row.oauth_provider == ""   # untouched
+        finally:
+            _FakeProvider.userinfo = original
+
+    def test_display_name_rename_keeps_account(self, api, provider_url):
+        """Subject-keyed linking: renaming the GitHub profile must land
+        in the SAME local account (the old name-keyed linking would
+        have minted a second user)."""
+        _configure_github(api, provider_url, _root(api))
+        code, first = _oauth_roundtrip(api)
+        assert code == 200
+        uid1 = api.auth.verify_jwt(first["token"]).user_id
+        original = dict(_FakeProvider.userinfo)
+        _FakeProvider.userinfo = dict(original, name="Renamed Mona")
+        try:
+            code, second = _oauth_roundtrip(api)
+            assert code == 200
+            assert api.auth.verify_jwt(second["token"]).user_id == uid1
+        finally:
+            _FakeProvider.userinfo = original
+
+    def test_same_name_other_provider_separate_account(self, api,
+                                                       provider_url):
+        root = _root(api)
+        _configure_github(api, provider_url, root)
+        code, _ = _oauth_roundtrip(api)
+        assert code == 200
+        # same display name arriving via a different provider config
+        code2, payload = api.dispatch(
+            "POST", "/api/v1/oauth", {},
+            {"name": "google", "client_id": "cid", "client_secret": "sec",
+             "token_url": f"{provider_url}/token",
+             "userinfo_url": f"{provider_url}/user"},
+            authorization=root)
+        assert code2 == 200
+        state = _fresh_state(api, "google")
+        code3, payload = api.dispatch(
+            "GET", "/api/v1/users/signin/google/callback",
+            {"code": VALID_CODE, "state": state}, {})
+        assert code3 == 200
+        ident = api.auth.verify_jwt(payload["token"])
+        github_user = api.auth.db.find_one("users", name="Mona Lisa")
+        assert ident.user_id != github_user.id  # distinct local accounts
+        assert api.auth.db.get("users", ident.user_id
+                               ).oauth_provider == "google"
+
+
+def _fresh_state(api, provider="github"):
+    code, payload = api.dispatch(
+        "GET", f"/api/v1/users/signin/{provider}", {}, {})
+    assert code == 200, payload
+    return dict(urllib.parse.parse_qsl(
+        urllib.parse.urlparse(payload["location"]).query))["state"]
+
+
+def _oauth_roundtrip(api, code=VALID_CODE, provider="github"):
+    """signin → extract state → callback, like a browser would."""
+    state = _fresh_state(api, provider)
+    return api.dispatch(
+        "GET", f"/api/v1/users/signin/{provider}/callback",
+        {"code": code, "state": state}, {})
