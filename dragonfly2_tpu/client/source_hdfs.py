@@ -1,0 +1,170 @@
+"""``hdfs://`` back-to-source client over the WebHDFS REST gateway.
+
+Reference counterpart: pkg/source/clients/hdfsprotocol/
+hdfs_source_client.go — GetContentLength / IsSupportRange (always true) /
+IsExpired (mtime comparison) / Download with range / GetLastModified,
+plus directory listing for recursive downloads. The reference links the
+colinmarc/hdfs native-RPC client; the TPU-native rebuild speaks WebHDFS
+(the REST gateway every namenode ships, dfs.webhdfs.enabled) so the
+daemon stays stdlib-pure: ``hdfs://host:port/path`` maps to
+``http://host:port/webhdfs/v1/path?op=...``, with OPEN's offset/length
+parameters carrying the piece range (WebHDFS has random reads natively —
+no Range-header probe dance needed).
+
+Redirect note: classic namenodes answer OPEN with a 307 to a datanode;
+urllib follows it transparently. HttpFS gateways answer directly.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from dragonfly2_tpu.client.source import (
+    Request,
+    ResourceClient,
+    Response,
+    SourceError,
+)
+
+DEFAULT_WEBHDFS_PORT = 9870
+
+
+@dataclass(frozen=True)
+class HDFSConfig:
+    """hdfs_source_client.go HDFSSourceClientOption equivalents."""
+
+    user: str = ""          # user.name= query auth (simple auth mode)
+    timeout: float = 30.0
+    use_https: bool = False  # swebhdfs gateways
+
+
+class HDFSSourceClient(ResourceClient):
+    """WebHDFS-backed ResourceClient."""
+
+    def __init__(self, config: HDFSConfig | None = None):
+        self.config = config or HDFSConfig()
+
+    # -- URL mapping -----------------------------------------------------
+
+    def _api_url(self, request: Request, op: str,
+                 extra: Optional[Dict[str, str]] = None) -> str:
+        parsed = urllib.parse.urlparse(request.url)
+        if not parsed.hostname:
+            raise SourceError(f"{request.url}: missing namenode host")
+        port = parsed.port or DEFAULT_WEBHDFS_PORT
+        scheme = "https" if self.config.use_https else "http"
+        path = urllib.parse.quote(parsed.path or "/")
+        query = {"op": op}
+        if self.config.user:
+            query["user.name"] = self.config.user
+        if extra:
+            query.update(extra)
+        return (f"{scheme}://{parsed.hostname}:{port}/webhdfs/v1{path}"
+                f"?{urllib.parse.urlencode(query)}")
+
+    def _call(self, url: str, method: str = "GET"):
+        req = urllib.request.Request(url, method=method)
+        try:
+            return urllib.request.urlopen(req, timeout=self.config.timeout)
+        except urllib.error.HTTPError as exc:
+            raise SourceError(f"{url}: HTTP {exc.code}") from exc
+        except urllib.error.URLError as exc:
+            raise SourceError(f"{url}: {exc.reason}") from exc
+
+    def _file_status(self, request: Request) -> dict:
+        resp = self._call(self._api_url(request, "GETFILESTATUS"))
+        try:
+            payload = json.loads(resp.read())
+        finally:
+            resp.close()
+        status = payload.get("FileStatus")
+        if status is None:
+            raise SourceError(f"{request.url}: no FileStatus in answer")
+        return status
+
+    # -- ResourceClient --------------------------------------------------
+
+    def get_content_length(self, request: Request) -> int:
+        return int(self._file_status(request)["length"])
+
+    def is_support_range(self, request: Request) -> bool:
+        # hdfs_source_client.go:92 — HDFS reads are positional, always.
+        return True
+
+    def is_expired(self, request: Request, last_modified: str,
+                   etag: str) -> bool:
+        """mtime comparison (hdfs_source_client.go:104-115; HDFS has no
+        etags). ``last_modified`` is the HTTP-date we previously handed
+        out; expired iff the file's mtime moved."""
+        if not last_modified:
+            return True
+        try:
+            known = email.utils.parsedate_to_datetime(last_modified)
+        except (TypeError, ValueError):
+            return True
+        mtime_ms = int(self._file_status(request)["modificationTime"])
+        return int(known.timestamp() * 1000) != mtime_ms
+
+    def download(self, request: Request) -> Response:
+        extra: Dict[str, str] = {}
+        if request.rng is not None:
+            extra = {"offset": str(request.rng.start),
+                     "length": str(request.rng.length)}
+        resp = self._call(self._api_url(request, "OPEN", extra))
+        length = resp.headers.get("Content-Length")
+        status = self._file_status(request)
+        mtime = email.utils.formatdate(
+            int(status["modificationTime"]) / 1000.0, usegmt=True)
+        return Response(
+            body=resp,
+            content_length=(int(length) if length is not None
+                            else (request.rng.length if request.rng
+                                  else int(status["length"]))),
+            status=206 if request.rng is not None else 200,
+            header={"Last-Modified": mtime},
+        )
+
+    def get_last_modified(self, request: Request) -> int:
+        return int(self._file_status(request)["modificationTime"])
+
+    def list(self, request: Request) -> list:
+        """All FILE URLs under the directory tree (LISTSTATUS walked
+        depth-first) — same flat-recursive contract as the file/s3
+        clients, which dfget --recursive consumes."""
+        parsed = urllib.parse.urlparse(request.url)
+        out: list = []
+
+        def walk(path: str) -> None:
+            resp = self._call(self._api_url(
+                Request(urllib.parse.urlunparse(parsed._replace(path=path))),
+                "LISTSTATUS"))
+            try:
+                payload = json.loads(resp.read())
+            finally:
+                resp.close()
+            for status in payload.get("FileStatuses",
+                                      {}).get("FileStatus", []):
+                suffix = status.get("pathSuffix", "")
+                child = f"{path.rstrip('/')}/{suffix}" if suffix else path
+                if status.get("type") == "DIRECTORY":
+                    walk(child)
+                else:
+                    out.append(urllib.parse.urlunparse(
+                        parsed._replace(path=child)))
+
+        walk(parsed.path or "/")
+        return sorted(out)
+
+
+def register_hdfs(config: HDFSConfig | None = None,
+                  replace: bool = True) -> None:
+    """Install the hdfs scheme (hdfs_source_client.go:46 init())."""
+    from dragonfly2_tpu.client import source
+
+    source.register("hdfs", HDFSSourceClient(config), replace=replace)

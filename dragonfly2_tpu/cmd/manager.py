@@ -35,7 +35,8 @@ def main(argv=None) -> int:
                              "dynconfig; unauthenticated — firewall it); "
                              "-1 disables")
     parser.add_argument("--db", default="./manager.db")
-    parser.add_argument("--object-store", default="fs", choices=["fs", "s3"],
+    parser.add_argument("--object-store", default="fs",
+                        choices=["fs", "s3", "oss", "obs"],
                         help="artifact backend; s3 reads AWS_* env vars "
                              "(AWS_ENDPOINT_URL for MinIO-compatibles)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
@@ -66,10 +67,10 @@ def main(argv=None) -> int:
 
     metrics = ManagerMetrics(version=__version__)
     db = Database(args.db)
-    if args.object_store == "s3":
-        from dragonfly2_tpu.manager.objectstore import S3ObjectStore
+    if args.object_store in ("s3", "oss", "obs"):
+        from dragonfly2_tpu.manager.objectstore import new_object_store
 
-        object_store = S3ObjectStore()
+        object_store = new_object_store(args.object_store)
     else:
         object_store = FilesystemObjectStore(args.object_store_dir)
     service = ManagerService(db, object_store, metrics=metrics)

@@ -3,8 +3,11 @@
 Reference counterpart: pkg/objectstorage (S3/OSS/OBS behind one interface,
 objectstorage.go:215 factory). The filesystem backend is the hermetic
 default; :class:`S3ObjectStore` (pkg/objectstorage/s3.go:304) speaks
-SigV4-signed S3 REST to AWS or S3-compatibles (MinIO). OSS/OBS are the
-same wire shape behind different signers and are not implemented.
+SigV4-signed S3 REST to AWS or S3-compatibles (MinIO);
+:class:`OSSObjectStore` (oss.go) and :class:`OBSObjectStore` (obs.go)
+speak the same REST verb set behind the providers' HMAC-SHA1 header
+signatures (``utils/hmacsig.py``) with v1-style list pagination.
+:func:`new_object_store` is the objectstorage.go:215 name→backend factory.
 """
 
 from __future__ import annotations
@@ -123,6 +126,8 @@ class S3ObjectStore(ObjectStore):
     stdlib HTTP, path-style against ``endpoint_url`` (MinIO/Ceph) or
     virtual-hosted AWS when no endpoint is set."""
 
+    provider = "s3"
+
     def __init__(self, access_key: str = "", secret_key: str = "",
                  region: str = "us-east-1", endpoint_url: str = "",
                  timeout: float = 30.0):
@@ -143,22 +148,27 @@ class S3ObjectStore(ObjectStore):
         url = base + ("/" + urllib.parse.quote(key) if key else "/")
         return url + (("?" + query) if query else "")
 
-    def _call(self, method: str, bucket: str, key: str = "",
-              query: str = "", data: bytes = b"",
-              ok: tuple = (200,), tolerate: tuple = ()):
+    def _sign_headers(self, method: str, url: str, bucket: str, key: str,
+                      data: bytes) -> dict:
         import hashlib
-        import urllib.error
-        import urllib.request
 
         from dragonfly2_tpu.utils.awssig import EMPTY_SHA256, sign_request
 
-        url = self._url(bucket, key, query)
         payload_hash = (hashlib.sha256(data).hexdigest() if data
                         else EMPTY_SHA256)
-        headers = sign_request(method, url, region=self.region,
-                               access_key=self.access_key,
-                               secret_key=self.secret_key,
-                               payload_hash=payload_hash)
+        return sign_request(method, url, region=self.region,
+                            access_key=self.access_key,
+                            secret_key=self.secret_key,
+                            payload_hash=payload_hash)
+
+    def _call(self, method: str, bucket: str, key: str = "",
+              query: str = "", data: bytes = b"",
+              ok: tuple = (200,), tolerate: tuple = ()):
+        import urllib.error
+        import urllib.request
+
+        url = self._url(bucket, key, query)
+        headers = self._sign_headers(method, url, bucket, key, data)
         req = urllib.request.Request(url, data=data or None, headers=headers,
                                      method=method)
         try:
@@ -167,13 +177,13 @@ class S3ObjectStore(ObjectStore):
             if exc.code in tolerate:
                 return exc
             raise ObjectStoreError(
-                f"s3 {method} {bucket}/{key}: HTTP {exc.code}") from exc
+                f"{self.provider} {method} {bucket}/{key}: HTTP {exc.code}") from exc
         except urllib.error.URLError as exc:
             raise ObjectStoreError(
-                f"s3 {method} {bucket}/{key}: {exc.reason}") from exc
+                f"{self.provider} {method} {bucket}/{key}: {exc.reason}") from exc
         if resp.status not in ok:
             raise ObjectStoreError(
-                f"s3 {method} {bucket}/{key}: HTTP {resp.status}")
+                f"{self.provider} {method} {bucket}/{key}: HTTP {resp.status}")
         return resp
 
     def create_bucket(self, bucket: str) -> None:
@@ -235,3 +245,117 @@ class S3ObjectStore(ObjectStore):
             token = root.findtext(f"{ns}NextContinuationToken") or ""
             if not truncated or not token:
                 return sorted(keys)
+
+
+class OSSObjectStore(S3ObjectStore):
+    """Aliyun OSS backend (pkg/objectstorage/oss.go) — same REST verbs,
+    ``OSS <ak>:<sig>`` HMAC-SHA1 header auth, v1 list pagination
+    (prefix/marker/NextMarker). ``endpoint_url`` (path-style) targets
+    fakes/self-hosted gateways; the default is the region's
+    virtual-hosted endpoint."""
+
+    provider = "oss"
+    _auth_word = "OSS"
+    _meta_prefix = "x-oss-"
+
+    def __init__(self, access_key: str = "", secret_key: str = "",
+                 region: str = "oss-cn-hangzhou", endpoint_url: str = "",
+                 timeout: float = 30.0):
+        super().__init__(access_key=access_key, secret_key=secret_key,
+                         region=region, endpoint_url=endpoint_url,
+                         timeout=timeout)
+        self.access_key = access_key or os.environ.get("OSS_ACCESS_KEY_ID", "")
+        self.secret_key = (secret_key
+                           or os.environ.get("OSS_ACCESS_KEY_SECRET", ""))
+        # Never inherit the S3 path's AWS_ENDPOINT_URL fallback — an
+        # OSS-signed request against a MinIO endpoint set for s3 would
+        # fail confusingly (or hit the wrong store).
+        self.endpoint_url = (endpoint_url
+                             or os.environ.get("OSS_ENDPOINT_URL", ""))
+
+    def _url(self, bucket: str, key: str = "", query: str = "") -> str:
+        import urllib.parse
+
+        if self.endpoint_url:
+            base = f"{self.endpoint_url.rstrip('/')}/{bucket}"
+        else:
+            base = f"https://{bucket}.{self.region}.aliyuncs.com"
+        url = base + ("/" + urllib.parse.quote(key) if key else "/")
+        return url + (("?" + query) if query else "")
+
+    def _sign_headers(self, method: str, url: str, bucket: str, key: str,
+                      data: bytes) -> dict:
+        from dragonfly2_tpu.utils.hmacsig import sign_header_auth
+
+        # The signature covers Content-Type, so pin it explicitly —
+        # urllib would otherwise inject its form-encoded default on
+        # bodied requests and break verification server-side.
+        headers = {"Content-Type": "application/octet-stream"} if data else {}
+        signed, _ = sign_header_auth(
+            method, bucket, key, headers,
+            access_key=self.access_key, secret_key=self.secret_key,
+            auth_word=self._auth_word, meta_prefix=self._meta_prefix)
+        return signed
+
+    def list_objects(self, bucket: str, prefix: str = "") -> List[str]:
+        import urllib.parse
+        import xml.etree.ElementTree as ET
+
+        keys: List[str] = []
+        marker = ""
+        while True:
+            parts = []
+            if prefix:
+                parts.append("prefix=" + urllib.parse.quote(prefix, safe=""))
+            if marker:
+                parts.append("marker=" + urllib.parse.quote(marker, safe=""))
+            resp = self._call("GET", bucket, query="&".join(parts))
+            root = ET.fromstring(resp.read())
+            ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+            keys.extend(e.text for e in root.iter(f"{ns}Key"))
+            truncated = root.findtext(f"{ns}IsTruncated") == "true"
+            marker = root.findtext(f"{ns}NextMarker") or ""
+            if not truncated or not marker:
+                return sorted(keys)
+
+
+class OBSObjectStore(OSSObjectStore):
+    """Huawei OBS backend (pkg/objectstorage/obs.go) — the OSS wire shape
+    with ``OBS <ak>:<sig>`` auth and ``x-obs-`` metadata headers."""
+
+    provider = "obs"
+    _auth_word = "OBS"
+    _meta_prefix = "x-obs-"
+
+    def __init__(self, access_key: str = "", secret_key: str = "",
+                 region: str = "cn-north-1", endpoint_url: str = "",
+                 timeout: float = 30.0):
+        super().__init__(access_key=access_key, secret_key=secret_key,
+                         region=region, endpoint_url=endpoint_url,
+                         timeout=timeout)
+        self.access_key = access_key or os.environ.get("OBS_ACCESS_KEY_ID", "")
+        self.secret_key = (secret_key
+                           or os.environ.get("OBS_SECRET_ACCESS_KEY", ""))
+        self.endpoint_url = (endpoint_url
+                             or os.environ.get("OBS_ENDPOINT_URL", ""))
+
+    def _url(self, bucket: str, key: str = "", query: str = "") -> str:
+        import urllib.parse
+
+        if self.endpoint_url:
+            base = f"{self.endpoint_url.rstrip('/')}/{bucket}"
+        else:
+            base = f"https://{bucket}.obs.{self.region}.myhuaweicloud.com"
+        url = base + ("/" + urllib.parse.quote(key) if key else "/")
+        return url + (("?" + query) if query else "")
+
+
+def new_object_store(name: str, **kwargs) -> ObjectStore:
+    """objectstorage.go:215 New(): backend name → client. Names: ``fs``
+    (hermetic default), ``s3``, ``oss``, ``obs``."""
+    backends = {"fs": FilesystemObjectStore, "s3": S3ObjectStore,
+                "oss": OSSObjectStore, "obs": OBSObjectStore}
+    cls = backends.get(name)
+    if cls is None:
+        raise ObjectStoreError(f"unknown object storage name {name!r}")
+    return cls(**kwargs)

@@ -1,0 +1,278 @@
+"""OSS/OBS object-storage backends against a faked provider gateway.
+
+The fake verifies every request's ``OSS``/``OBS`` HMAC-SHA1 header
+signature by *independently* reconstructing the string-to-sign from the
+received request (spec-derived code in this file, not the signer under
+test — the non-circular-oracle lesson from ADVICE r3 on awssig). It also
+paginates listings at 2 keys/page to exercise the marker walk.
+
+Reference: pkg/objectstorage/oss.go, obs.go, objectstorage.go:215.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac as hmac_mod
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.manager.objectstore import (
+    OBSObjectStore,
+    OSSObjectStore,
+    ObjectStoreError,
+    S3ObjectStore,
+    FilesystemObjectStore,
+    new_object_store,
+)
+from dragonfly2_tpu.utils.hmacsig import sign_oss_request, string_to_sign
+
+ACCESS, SECRET = "LTAItest", "oss-secret-key"
+PAGE = 2  # keys per list page
+
+
+def _expected_signature(handler, auth_word, meta_prefix, body):
+    """Independent server-side reconstruction of the string-to-sign,
+    written from the documented layout (VERB, MD5, Type, Date, canonical
+    x-<provider>- headers, /bucket/key)."""
+    parsed = urllib.parse.urlparse(handler.path)
+    resource = urllib.parse.unquote(parsed.path)  # /bucket/key (path-style)
+    meta = sorted(
+        (name.lower(), value.strip())
+        for name, value in handler.headers.items()
+        if name.lower().startswith(meta_prefix))
+    sts = "\n".join([
+        handler.command,
+        handler.headers.get("Content-MD5", ""),
+        handler.headers.get("Content-Type", ""),
+        handler.headers.get("Date", ""),
+    ]) + "\n" + "".join(f"{k}:{v}\n" for k, v in meta) + resource
+    digest = hmac_mod.new(SECRET.encode(), sts.encode(), hashlib.sha1)
+    return f"{auth_word} {ACCESS}:{base64.b64encode(digest.digest()).decode()}"
+
+
+class _FakeGateway(BaseHTTPRequestHandler):
+    """In-memory path-style OSS/OBS gateway with signature verification."""
+
+    auth_word = "OSS"
+    meta_prefix = "x-oss-"
+    store: dict = {}  # bucket -> {key: bytes}
+
+    def _authorize(self, body: bytes) -> bool:
+        expected = _expected_signature(
+            self, self.auth_word, self.meta_prefix, body)
+        if self.headers.get("Authorization", "") != expected:
+            self.send_error(403, "SignatureDoesNotMatch")
+            return False
+        return True
+
+    def _bucket_key(self):
+        path = urllib.parse.urlparse(self.path).path
+        parts = path.lstrip("/").split("/", 1)
+        return parts[0], urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._authorize(body):
+            return
+        bucket, key = self._bucket_key()
+        if key:
+            if bucket not in self.store:
+                return self.send_error(404, "NoSuchBucket")
+            self.store[bucket][key] = body
+        else:
+            if bucket in self.store:
+                return self.send_error(409, "BucketAlreadyOwnedByYou")
+            self.store[bucket] = {}
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_HEAD(self):
+        if not self._authorize(b""):
+            return
+        bucket, key = self._bucket_key()
+        objects = self.store.get(bucket)
+        if objects is None or (key and key not in objects):
+            return self.send_error(404)
+        self.send_response(200)
+        self.send_header("Content-Length",
+                         str(len(objects[key])) if key else "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._authorize(b""):
+            return
+        bucket, key = self._bucket_key()
+        objects = self.store.get(bucket)
+        if objects is None:
+            return self.send_error(404, "NoSuchBucket")
+        if key:
+            if key not in objects:
+                return self.send_error(404, "NoSuchKey")
+            body = objects[key]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        # v1 list: prefix/marker, PAGE keys per page
+        q = dict(urllib.parse.parse_qsl(
+            urllib.parse.urlparse(self.path).query))
+        prefix, marker = q.get("prefix", ""), q.get("marker", "")
+        keys = sorted(k for k in objects if k.startswith(prefix) and k > marker)
+        page, rest = keys[:PAGE], keys[PAGE:]
+        contents = "".join(f"<Contents><Key>{k}</Key></Contents>"
+                           for k in page)
+        next_marker = (f"<NextMarker>{page[-1]}</NextMarker>"
+                       if rest else "")
+        body = (f"<ListBucketResult><IsTruncated>"
+                f"{'true' if rest else 'false'}</IsTruncated>{next_marker}"
+                f"{contents}</ListBucketResult>").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_DELETE(self):
+        if not self._authorize(b""):
+            return
+        bucket, key = self._bucket_key()
+        self.store.get(bucket, {}).pop(key, None)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+class _FakeOBSGateway(_FakeGateway):
+    auth_word = "OBS"
+    meta_prefix = "x-obs-"
+    store: dict = {}
+
+
+def _serve(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture(scope="module")
+def oss_url():
+    server, url = _serve(_FakeGateway)
+    yield url
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def obs_url():
+    server, url = _serve(_FakeOBSGateway)
+    yield url
+    server.shutdown()
+
+
+class TestStringToSign:
+    def test_documented_layout(self):
+        """The canonical PUT example layout from the OSS signing docs:
+        meta headers lowercased + sorted, resource is /bucket/key."""
+        headers = {
+            "Content-MD5": "eB5eJF1ptWaXm4bijSPyxw==",
+            "Content-Type": "text/html",
+            "Date": "Thu, 17 Nov 2005 18:49:58 GMT",
+            "X-OSS-Meta-Author": "foo@bar.com",
+            "X-OSS-Magic": "abracadabra",
+        }
+        sts = string_to_sign("PUT", "oss-example", "nelson", headers,
+                             meta_prefix="x-oss-")
+        assert sts == (
+            "PUT\n"
+            "eB5eJF1ptWaXm4bijSPyxw==\n"
+            "text/html\n"
+            "Thu, 17 Nov 2005 18:49:58 GMT\n"
+            "x-oss-magic:abracadabra\n"
+            "x-oss-meta-author:foo@bar.com\n"
+            "/oss-example/nelson")
+
+    def test_subresources_and_bare_bucket(self):
+        sts = string_to_sign("GET", "b", "", {"Date": "d"},
+                             meta_prefix="x-oss-",
+                             subresources={"acl": "", "prefix": "x"})
+        assert sts.endswith("/b/?acl")  # prefix is not a subresource
+
+    def test_sign_adds_date_and_auth(self):
+        signed, sts = sign_oss_request("GET", "b", "k", {},
+                                       access_key="ak", secret_key="sk")
+        assert signed["Authorization"].startswith("OSS ak:")
+        assert "Date" in signed
+        # independent HMAC over the returned string-to-sign
+        expected = base64.b64encode(hmac_mod.new(
+            b"sk", sts.encode(), hashlib.sha1).digest()).decode()
+        assert signed["Authorization"] == f"OSS ak:{expected}"
+
+
+def _roundtrip(store):
+    store.create_bucket("models")
+    store.create_bucket("models")  # idempotent (409 tolerated)
+    assert store.is_bucket_exist("models")
+    assert not store.is_bucket_exist("nope")
+
+    store.put_object("models", "gnn/v1/weights.bin", b"\x00\x01tpu")
+    store.put_object("models", "gnn/v2/weights.bin", b"v2")
+    store.put_object("models", "mlp/v1/weights.bin", b"mlp")
+    assert store.get_object("models", "gnn/v1/weights.bin") == b"\x00\x01tpu"
+    assert store.is_object_exist("models", "gnn/v1/weights.bin")
+    assert not store.is_object_exist("models", "missing")
+    assert store.object_size("models", "gnn/v2/weights.bin") == 2
+
+    # pagination: 3 keys at 2/page forces a marker walk
+    assert store.list_objects("models") == [
+        "gnn/v1/weights.bin", "gnn/v2/weights.bin", "mlp/v1/weights.bin"]
+    assert store.list_objects("models", prefix="gnn/") == [
+        "gnn/v1/weights.bin", "gnn/v2/weights.bin"]
+
+    store.delete_object("models", "mlp/v1/weights.bin")
+    assert not store.is_object_exist("models", "mlp/v1/weights.bin")
+    with pytest.raises(ObjectStoreError):
+        store.get_object("models", "mlp/v1/weights.bin")
+
+
+class TestOSS:
+    def test_roundtrip_signed(self, oss_url):
+        _FakeGateway.store.clear()
+        _roundtrip(OSSObjectStore(ACCESS, SECRET, endpoint_url=oss_url))
+
+    def test_bad_secret_rejected(self, oss_url):
+        _FakeGateway.store.clear()
+        bad = OSSObjectStore(ACCESS, "wrong", endpoint_url=oss_url)
+        with pytest.raises(ObjectStoreError, match="403"):
+            bad.create_bucket("models")
+
+
+class TestOBS:
+    def test_roundtrip_signed(self, obs_url):
+        _FakeOBSGateway.store.clear()
+        _roundtrip(OBSObjectStore(ACCESS, SECRET, endpoint_url=obs_url))
+
+    def test_obs_auth_word(self, obs_url):
+        _FakeOBSGateway.store.clear()
+        oss_signed = OSSObjectStore(ACCESS, SECRET, endpoint_url=obs_url)
+        with pytest.raises(ObjectStoreError, match="403"):
+            oss_signed.create_bucket("x")  # OSS sig against OBS gateway
+
+
+class TestFactory:
+    def test_names(self, tmp_path):
+        assert isinstance(new_object_store("fs", root=str(tmp_path)),
+                          FilesystemObjectStore)
+        assert isinstance(new_object_store("s3"), S3ObjectStore)
+        assert isinstance(new_object_store("oss"), OSSObjectStore)
+        assert isinstance(new_object_store("obs"), OBSObjectStore)
+        with pytest.raises(ObjectStoreError):
+            new_object_store("gcs")

@@ -1,0 +1,204 @@
+"""hdfs:// source client against a faked WebHDFS namenode.
+
+The fake implements GETFILESTATUS / OPEN (with offset+length and the
+classic 307-to-datanode redirect) / LISTSTATUS over an in-memory tree.
+Reference: pkg/source/clients/hdfsprotocol/hdfs_source_client.go.
+"""
+
+from __future__ import annotations
+
+import email.utils
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dragonfly2_tpu.client.piece import Range
+from dragonfly2_tpu.client.source import Request, SourceError
+from dragonfly2_tpu.client.source_hdfs import (
+    HDFSConfig,
+    HDFSSourceClient,
+    register_hdfs,
+)
+
+MTIME_MS = 1_700_000_000_000
+
+TREE = {
+    "/data/train/part-00000.parquet": b"parquet-bytes-0" * 10,
+    "/data/train/part-00001.parquet": b"parquet-bytes-1" * 10,
+    "/data/train/sub/part-00002.parquet": b"deep" * 4,
+    "/data/readme.txt": b"hello hdfs",
+}
+
+
+def _dirs():
+    out = set()
+    for path in TREE:
+        parts = path.strip("/").split("/")
+        for i in range(1, len(parts)):
+            out.add("/" + "/".join(parts[:i]))
+    out.add("/")
+    return out
+
+
+class _FakeWebHDFS(BaseHTTPRequestHandler):
+    redirect_opens = True  # classic namenode behavior
+
+    def do_GET(self):
+        parsed = urllib.parse.urlparse(self.path)
+        q = dict(urllib.parse.parse_qsl(parsed.query))
+        op = q.get("op", "")
+        if not parsed.path.startswith("/webhdfs/v1"):
+            return self.send_error(404)
+        path = urllib.parse.unquote(parsed.path[len("/webhdfs/v1"):]) or "/"
+        if op == "GETFILESTATUS":
+            return self._filestatus(path)
+        if op == "OPEN":
+            return self._open(path, q, redirected="redirected" in q)
+        if op == "LISTSTATUS":
+            return self._liststatus(path)
+        self.send_error(400, f"unsupported op {op}")
+
+    def _status_of(self, path):
+        if path in TREE:
+            return {"type": "FILE", "length": len(TREE[path]),
+                    "modificationTime": MTIME_MS,
+                    "pathSuffix": path.rsplit("/", 1)[-1]}
+        if path in _dirs():
+            return {"type": "DIRECTORY", "length": 0,
+                    "modificationTime": MTIME_MS,
+                    "pathSuffix": path.rstrip("/").rsplit("/", 1)[-1]}
+        return None
+
+    def _filestatus(self, path):
+        status = self._status_of(path)
+        if status is None:
+            return self.send_error(404, "FileNotFoundException")
+        self._json({"FileStatus": status})
+
+    def _open(self, path, q, redirected):
+        if path not in TREE:
+            return self.send_error(404, "FileNotFoundException")
+        if self.redirect_opens and not redirected:
+            # 307 to the "datanode" (same server, marked query)
+            target = self.path + "&redirected=1"
+            self.send_response(307)
+            self.send_header("Location", target)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = TREE[path]
+        offset = int(q.get("offset", 0))
+        length = int(q["length"]) if "length" in q else len(body) - offset
+        chunk = body[offset:offset + length]
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(chunk)))
+        self.end_headers()
+        self.wfile.write(chunk)
+
+    def _liststatus(self, path):
+        base = path.rstrip("/") or ""
+        if self._status_of(path or "/") is None:
+            return self.send_error(404, "FileNotFoundException")
+        children = []
+        seen = set()
+        for file_path in sorted(TREE):
+            if not file_path.startswith(base + "/"):
+                continue
+            rest = file_path[len(base) + 1:]
+            first = rest.split("/", 1)[0]
+            if first in seen:
+                continue
+            seen.add(first)
+            children.append(self._status_of(
+                base + "/" + first if "/" in rest else file_path)
+                or {"type": "DIRECTORY", "length": 0,
+                    "modificationTime": MTIME_MS, "pathSuffix": first})
+        self._json({"FileStatuses": {"FileStatus": children}})
+
+    def _json(self, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def namenode():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeWebHDFS)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+@pytest.fixture()
+def client():
+    return HDFSSourceClient(HDFSConfig(user="df2"))
+
+
+class TestHDFS:
+    def test_content_length_and_mtime(self, namenode, client):
+        req = Request(f"hdfs://{namenode}/data/readme.txt")
+        assert client.get_content_length(req) == len(b"hello hdfs")
+        assert client.get_last_modified(req) == MTIME_MS
+        assert client.is_support_range(req)
+
+    def test_download_full(self, namenode, client):
+        req = Request(f"hdfs://{namenode}/data/readme.txt")
+        resp = client.download(req)
+        assert resp.body.read() == b"hello hdfs"
+        assert resp.status == 200
+        assert "Last-Modified" in resp.header
+        resp.close()
+
+    def test_download_range_follows_redirect(self, namenode, client):
+        """Piece range rides OPEN's offset/length through the 307."""
+        req = Request(f"hdfs://{namenode}/data/readme.txt",
+                      rng=Range(start=6, length=4))
+        resp = client.download(req)
+        assert resp.body.read() == b"hdfs"
+        assert resp.status == 206
+        assert resp.content_length == 4
+        resp.close()
+
+    def test_expiry_by_mtime(self, namenode, client):
+        req = Request(f"hdfs://{namenode}/data/readme.txt")
+        fresh = email.utils.formatdate(MTIME_MS / 1000.0, usegmt=True)
+        stale = email.utils.formatdate(MTIME_MS / 1000.0 - 60, usegmt=True)
+        assert not client.is_expired(req, fresh, "")
+        assert client.is_expired(req, stale, "")
+        assert client.is_expired(req, "", "")
+
+    def test_missing_file(self, namenode, client):
+        with pytest.raises(SourceError, match="404"):
+            client.get_content_length(
+                Request(f"hdfs://{namenode}/data/nope.bin"))
+
+    def test_recursive_list(self, namenode, client):
+        urls = client.list(Request(f"hdfs://{namenode}/data/train"))
+        paths = [urllib.parse.urlparse(u).path for u in urls]
+        assert paths == [
+            "/data/train/part-00000.parquet",
+            "/data/train/part-00001.parquet",
+            "/data/train/sub/part-00002.parquet",
+        ]
+
+    def test_registration(self, namenode):
+        from dragonfly2_tpu.client import source
+
+        register_hdfs(HDFSConfig())
+        try:
+            req = Request(f"hdfs://{namenode}/data/readme.txt")
+            assert source.get_content_length(req) == len(b"hello hdfs")
+            assert source.list_children(
+                Request(f"hdfs://{namenode}/data"))
+        finally:
+            source.unregister("hdfs")
