@@ -154,8 +154,10 @@ class ProxyServer(ThreadedHTTPService):
 
     # -- request handling --------------------------------------------------
 
-    def _check_auth(self, req: BaseHTTPRequestHandler) -> bool:
-        if self.config.basic_auth is None:
+    def _check_auth(self, req: BaseHTTPRequestHandler,
+                    cfg: ProxyConfig | None = None) -> bool:
+        cfg = cfg or self.config
+        if cfg.basic_auth is None:
             return True
         # Clients send Proxy-Authorization on the CONNECT only; requests
         # inside an intercepted MITM session were authorized at tunnel
@@ -165,7 +167,7 @@ class ProxyServer(ThreadedHTTPService):
             return True
         import base64
 
-        user, password = self.config.basic_auth
+        user, password = cfg.basic_auth
         expected = "Basic " + base64.b64encode(
             f"{user}:{password}".encode()).decode()
         if req.headers.get("Proxy-Authorization") == expected:
@@ -176,7 +178,8 @@ class ProxyServer(ThreadedHTTPService):
         req.end_headers()
         return False
 
-    def _target_url(self, req: BaseHTTPRequestHandler) -> str:
+    def _target_url(self, req: BaseHTTPRequestHandler,
+                    cfg: ProxyConfig | None = None) -> str:
         """Absolute-form proxy URL, or mirror-mode path rewrite
         (mirrorRegistry: requests arrive origin-form and map onto the
         configured remote)."""
@@ -187,15 +190,17 @@ class ProxyServer(ThreadedHTTPService):
             # Inner request of an intercepted CONNECT / SNI connection:
             # origin-form path against the handshake's target host.
             return f"https://{hijacked}{req.path}"
-        mirror = self.config.registry_mirror
+        mirror = (cfg or self.config).registry_mirror
         if mirror is not None:
             return mirror.remote.rstrip("/") + req.path
         host = req.headers.get("Host", "")
         return f"http://{host}{req.path}"
 
-    def _should_use_p2p(self, req, url: str) -> tuple:
+    def _should_use_p2p(self, req, url: str,
+                        cfg: ProxyConfig | None = None) -> tuple:
         """(use_p2p, final_url) — shouldUseDragonfly semantics."""
-        mirror = self.config.registry_mirror
+        cfg = cfg or self.config
+        mirror = cfg.registry_mirror
         # Hijacked inner requests are origin-form but target their own
         # host, not the mirror remote — they take the rule ladder.
         if (mirror is not None and not req.path.startswith("http")
@@ -207,7 +212,7 @@ class ProxyServer(ThreadedHTTPService):
             if req.command == "GET" and "/blobs/sha256:" in url:
                 return True, url
             return False, url
-        for rule in self.config.rules:
+        for rule in cfg.rules:
             if rule.match(url):
                 final = rule.rewrite(url)
                 if req.command != "GET":
@@ -215,14 +220,44 @@ class ProxyServer(ThreadedHTTPService):
                 return not rule.direct, final
         return False, url
 
+    _KEEP = object()  # watch(): "option not mentioned in this reload"
+
+    def watch(self, rules=_KEEP, registry_mirror=_KEEP,
+              basic_auth=_KEEP) -> None:
+        """Hot-swap the reloadable options (proxy_manager.go:157 Watch —
+        the reference swaps the rule ladder on config reload). Listener,
+        CA, and hijack mode stay fixed. Defaulted (unmentioned) options
+        keep their values; passing ``None`` explicitly CLEARS an option
+        (so a decommissioned registry mirror actually goes away). A fresh
+        ProxyConfig is published in one reference assignment; request
+        handlers snapshot it once per request."""
+        old = self.config
+        keep = ProxyServer._KEEP
+        self.config = ProxyConfig(
+            rules=old.rules if rules is keep else list(rules or []),
+            registry_mirror=(old.registry_mirror if registry_mirror is keep
+                             else registry_mirror),
+            basic_auth=old.basic_auth if basic_auth is keep else basic_auth,
+            max_concurrency=old.max_concurrency,
+            default_tag=old.default_tag,
+            default_filter=old.default_filter,
+            hijack_https=old.hijack_https,
+            ca_dir=old.ca_dir,
+            ca_cert_path=old.ca_cert_path,
+            ca_key_path=old.ca_key_path,
+        )
+
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
-        if not self._check_auth(req):
+        # One snapshot per request: a concurrent watch() reload must not
+        # hand this request the old mirror with the new rule ladder.
+        cfg = self.config
+        if not self._check_auth(req, cfg):
             return
         if self._semaphore is not None:
             self._semaphore.acquire()
         try:
-            url = self._target_url(req)
-            use_p2p, url = self._should_use_p2p(req, url)
+            url = self._target_url(req, cfg)
+            use_p2p, url = self._should_use_p2p(req, url, cfg)
             metrics = getattr(self.daemon, "metrics", None)
             if metrics:
                 metrics.proxy_request_count.labels(

@@ -37,6 +37,16 @@ def build_daemon(args):
 
         register_s3()
 
+    # oras:// (OCI artifacts; creds come from ~/.docker/config.json) and
+    # hdfs:// (WebHDFS; simple-auth user from DF2_HDFS_USER) need no
+    # secrets on argv — always installed, like the reference's
+    # clients-from-init registration (pkg/source/clients).
+    from dragonfly2_tpu.client.source_hdfs import HDFSConfig, register_hdfs
+    from dragonfly2_tpu.client.source_oras import register_oras
+
+    register_oras()
+    register_hdfs(HDFSConfig(user=os.environ.get("DF2_HDFS_USER", "")))
+
     # Task-affine multi-scheduler routing; a single --scheduler is the
     # one-replica degenerate ring.
     scheduler = BalancedSchedulerClient(args.scheduler)
@@ -83,6 +93,12 @@ def main(argv=None) -> int:
     parser.add_argument("--download-rate", type=float, default=0,
                         help="bytes/sec total download limit (0 = unlimited)")
     parser.add_argument("--upload-rate", type=float, default=0)
+    parser.add_argument("--reload-interval", type=float, default=10,
+                        help="re-read --config every N seconds and hot-"
+                             "apply reloadable options (proxy rules, "
+                             "registry mirror, upload rate); SIGHUP "
+                             "forces an immediate re-read; 0 disables "
+                             "(peerhost.go Reload.Interval)")
     parser.add_argument("--traffic-shaper", default="plain",
                         choices=["plain", "sampling"])
     parser.add_argument("--probe-interval", type=float, default=0.0,
@@ -223,7 +239,43 @@ def main(argv=None) -> int:
         gateway.start()
         print(f"object gateway on 127.0.0.1:{gateway.port}", flush=True)
 
+    watcher = None
+    if args.config and args.reload_interval > 0:
+        from dragonfly2_tpu.utils.ratelimit import INF
+        from dragonfly2_tpu.utils.reload import ConfigWatcher
+
+        def _apply_reload(cfg: dict) -> None:
+            # The reloadable subset (daemon.go:648 watchers): proxy
+            # options + rates. Structural options (ports, storage root,
+            # hijack mode) still need a restart, as in the reference.
+            if "upload_rate" in cfg:
+                daemon.upload.limiter.set_rate(
+                    float(cfg["upload_rate"]) or INF)
+            if proxy is not None and ("proxy_rule" in cfg
+                                      or "registry_mirror" in cfg):
+                from dragonfly2_tpu.client.proxy import (
+                    ProxyRule,
+                    RegistryMirror,
+                )
+
+                # Only keys present in the file are touched; an empty
+                # value present in the file clears the option.
+                kwargs = {}
+                if "proxy_rule" in cfg:
+                    kwargs["rules"] = [ProxyRule(regx=r)
+                                       for r in cfg.get("proxy_rule") or []]
+                if "registry_mirror" in cfg:
+                    kwargs["registry_mirror"] = (
+                        RegistryMirror(remote=cfg["registry_mirror"])
+                        if cfg.get("registry_mirror") else None)
+                proxy.watch(**kwargs)
+
+        watcher = ConfigWatcher(args.config, _apply_reload,
+                                interval=args.reload_interval).start()
+
     wait_for_shutdown()
+    if watcher is not None:
+        watcher.stop()
     if dynconfig is not None:
         dynconfig.stop()
     if metrics_server:

@@ -40,6 +40,10 @@ class Limiter:
         with self._lock:
             self._advance()
             self._rate = float(rate)
+            if burst is None and self._rate != INF and self._burst == INF:
+                # Unlimited → finite without an explicit burst: an inf
+                # bucket would never drain, making the new rate a no-op.
+                burst = int(max(self._rate, 1))
             if burst is not None:
                 self._burst = float(burst)
                 self._tokens = min(self._tokens, self._burst)

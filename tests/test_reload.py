@@ -1,0 +1,176 @@
+"""Daemon config hot-reload (round-3 verdict missing #8).
+
+Done-criteria: editing the config file swaps proxy rules / upload rate on
+a live daemon without restart; a corrupt edit keeps the previous options.
+Reference: client/daemon/daemon.go:797 WatchConfig + proxy Watch.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+import yaml
+
+from dragonfly2_tpu.client.proxy import ProxyConfig, ProxyRule, ProxyServer
+from dragonfly2_tpu.utils.ratelimit import INF, Limiter
+from dragonfly2_tpu.utils.reload import ConfigWatcher
+
+
+def _write(path, data):
+    path.write_text(yaml.safe_dump(data))
+
+
+def _wait_until(check, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if check():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestConfigWatcher:
+    def test_change_applied_on_poke(self, tmp_path):
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"upload_rate": 100})
+        seen = []
+        watcher = ConfigWatcher(str(cfg), seen.append, interval=0,
+                                install_sighup=False).start()
+        try:
+            _write(cfg, {"upload_rate": 250})
+            watcher.poke()
+            assert _wait_until(lambda: seen
+                               and seen[-1]["upload_rate"] == 250)
+        finally:
+            watcher.stop()
+
+    def test_interval_polling(self, tmp_path):
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"a": 1})
+        seen = []
+        watcher = ConfigWatcher(str(cfg), seen.append, interval=0.05,
+                                install_sighup=False).start()
+        try:
+            _write(cfg, {"a": 2})
+            assert _wait_until(lambda: seen and seen[-1]["a"] == 2)
+        finally:
+            watcher.stop()
+
+    def test_unchanged_content_not_reapplied(self, tmp_path):
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"a": 1})
+        seen = []
+        watcher = ConfigWatcher(str(cfg), seen.append, interval=0,
+                                install_sighup=False).start()
+        try:
+            watcher.poke()
+            time.sleep(0.2)
+            assert seen == []  # same digest as baseline
+        finally:
+            watcher.stop()
+
+    def test_corrupt_config_keeps_previous(self, tmp_path):
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"a": 1})
+        seen = []
+        watcher = ConfigWatcher(str(cfg), seen.append, interval=0,
+                                install_sighup=False).start()
+        try:
+            cfg.write_text("]]]] not yaml {{{{")
+            watcher.poke()
+            time.sleep(0.3)
+            assert seen == []
+            # and a later good edit still lands
+            _write(cfg, {"a": 3})
+            watcher.poke()
+            assert _wait_until(lambda: seen and seen[-1]["a"] == 3)
+        finally:
+            watcher.stop()
+
+
+class TestHotSwapTargets:
+    def test_limiter_set_rate(self):
+        limiter = Limiter(100, burst=100)
+        assert limiter.allow_n(100)
+        assert not limiter.allow_n(50)
+        limiter.set_rate(INF)
+        assert limiter.allow_n(10**9)
+
+    def test_limiter_unlimited_to_finite(self):
+        """INF → finite without an explicit burst must actually start
+        limiting (an inf bucket would never drain)."""
+        limiter = Limiter(INF)
+        assert limiter.allow_n(10**12)
+        limiter.set_rate(100)
+        assert not limiter.allow_n(10**6)
+        assert limiter.allow_n(50)
+
+    def test_hyphenated_keys_normalized(self, tmp_path):
+        """YAML spells keys like the flags (upload-rate); watchers match
+        dests (upload_rate) — both must hot-apply."""
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"upload-rate": 100})
+        seen = []
+        watcher = ConfigWatcher(str(cfg), seen.append, interval=0,
+                                install_sighup=False).start()
+        try:
+            _write(cfg, {"upload-rate": 777, "proxy-rule": ["x"]})
+            watcher.poke()
+            assert _wait_until(lambda: seen
+                               and seen[-1].get("upload_rate") == 777)
+            assert seen[-1]["proxy_rule"] == ["x"]
+        finally:
+            watcher.stop()
+
+    def test_proxy_watch_clears_mirror(self):
+        from dragonfly2_tpu.client.proxy import RegistryMirror
+
+        proxy = ProxyServer.__new__(ProxyServer)
+        proxy.config = ProxyConfig(
+            registry_mirror=RegistryMirror(remote="https://old.mirror"))
+        proxy.watch(rules=[])               # unmentioned → mirror kept
+        assert proxy.config.registry_mirror is not None
+        proxy.watch(registry_mirror=None)   # explicit None → cleared
+        assert proxy.config.registry_mirror is None
+
+    def test_proxy_watch_swaps_rules_only(self):
+        proxy = ProxyServer.__new__(ProxyServer)  # no listener needed
+        proxy.config = ProxyConfig(
+            rules=[ProxyRule(regx=r"old\.example\.com")],
+            basic_auth=("u", "p"), max_concurrency=7)
+        proxy.watch(rules=[ProxyRule(regx=r"new\.example\.com")])
+        assert proxy.config.rules[0].match("http://new.example.com/f")
+        assert not proxy.config.rules[0].match("http://old.example.com/f")
+        # non-reloadable / unspecified options survive
+        assert proxy.config.basic_auth == ("u", "p")
+        assert proxy.config.max_concurrency == 7
+
+    def test_end_to_end_reload(self, tmp_path):
+        """File edit → watcher → proxy rules + upload limiter update,
+        mirroring the df2-daemon wiring."""
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"proxy_rule": [r"blobs\.old"], "upload_rate": 100})
+
+        proxy = ProxyServer.__new__(ProxyServer)
+        proxy.config = ProxyConfig(rules=[ProxyRule(regx=r"blobs\.old")])
+        limiter = Limiter(100, burst=100)
+
+        def apply(data: dict) -> None:
+            if "upload_rate" in data:
+                limiter.set_rate(float(data["upload_rate"]) or INF)
+            if "proxy_rule" in data:
+                proxy.watch(rules=[ProxyRule(regx=r)
+                                   for r in data["proxy_rule"] or []])
+
+        watcher = ConfigWatcher(str(cfg), apply, interval=0,
+                                install_sighup=False).start()
+        try:
+            _write(cfg, {"proxy_rule": [r"blobs\.new"], "upload_rate": 0})
+            watcher.poke()
+            assert _wait_until(
+                lambda: proxy.config.rules
+                and proxy.config.rules[0].match("http://blobs.new/x"))
+            assert limiter.allow_n(10**9)  # 0 → INF
+        finally:
+            watcher.stop()
