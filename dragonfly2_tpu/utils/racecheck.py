@@ -1,0 +1,173 @@
+"""Lock-order auditing — the deadlock half of a ``-race`` analogue.
+
+Reference counterpart: SURVEY §5 race detection. The reference leans on
+Go's ``-race`` test mode; CPython has no equivalent, and the repo's
+stance is layered: (1) churn/stress tests hammer the concurrent
+structures (tests/test_churn_stress.py) for data races, and (2) THIS
+module proves deadlock-freedom structurally — every lock acquisition is
+recorded into a global lock-ORDER graph, and a cycle in that graph is a
+potential ABBA deadlock even if the schedule never actually interleaved
+badly during the run. That last property is what makes order auditing
+stronger than timeout-based deadlock tests: one pass over any schedule
+certifies all schedules over the same edges.
+
+Usage (tests)::
+
+    auditor = LockOrderAuditor()
+    storage._lock = auditor.wrap(storage._lock, "storage")
+    daemon._conductors_lock = auditor.wrap(daemon._conductors_lock,
+                                           "daemon.conductors")
+    ... run the concurrent workload ...
+    auditor.assert_acyclic()        # raises LockOrderViolation w/ cycle
+
+Zero overhead in production: nothing imports this outside tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle in the lock-order graph: the witnessed acquisition orders
+    admit an interleaving that deadlocks."""
+
+    def __init__(self, cycle: List[str],
+                 witnesses: Dict[Tuple[str, str], str]):
+        self.cycle = cycle
+        lines = [" -> ".join(cycle + cycle[:1])]
+        for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+            where = witnesses.get((a, b), "")
+            lines.append(f"  {a} held while acquiring {b}"
+                         + (f" ({where})" if where else ""))
+        super().__init__("lock-order cycle:\n" + "\n".join(lines))
+
+
+class _WrappedLock:
+    """Transparent proxy over a Lock/RLock that reports acquisitions to
+    the auditor. Supports the context-manager protocol and the plain
+    acquire/release/locked surface the codebase uses."""
+
+    def __init__(self, inner, name: str, auditor: "LockOrderAuditor"):
+        self._inner = inner
+        self._name = name
+        self._auditor = auditor
+
+    def acquire(self, *args, **kwargs) -> bool:
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._auditor._on_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._auditor._on_release(self._name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class LockOrderAuditor:
+    """Global lock-order graph across all threads of the process."""
+
+    def __init__(self):
+        self._graph_lock = threading.Lock()
+        # name -> set of names acquired WHILE name was held
+        self._edges: Dict[str, Set[str]] = defaultdict(set)
+        # (a, b) -> thread name that witnessed the edge (diagnostics)
+        self._witnesses: Dict[Tuple[str, str], str] = {}
+        self._held = threading.local()
+        self.acquire_count = 0  # total acquisitions seen (sanity probe)
+
+    def wrap(self, lock, name: str) -> _WrappedLock:
+        return _WrappedLock(lock, name, self)
+
+    # -- hooks -----------------------------------------------------------
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _on_acquire(self, name: str) -> None:
+        self.acquire_count += 1  # benign race: a probe, not a metric
+        stack = self._stack()
+        if stack:
+            holder = stack[-1]
+            if holder != name:  # re-entrant RLock acquires are not edges
+                with self._graph_lock:
+                    if name not in self._edges[holder]:
+                        self._edges[holder].add(name)
+                        self._witnesses[(holder, name)] = (
+                            threading.current_thread().name)
+        stack.append(name)
+
+    def _on_release(self, name: str) -> None:
+        stack = self._stack()
+        # Locks are usually released LIFO, but tolerate out-of-order
+        # (hand-over-hand patterns) by removing the newest matching hold.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # -- verdicts --------------------------------------------------------
+
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._graph_lock:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """One cycle in the order graph, or None. Iterative DFS with the
+        classic white/grey/black coloring."""
+        graph = self.edges()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(graph) | {m for vs in graph.values() for m in vs}}
+        parent: Dict[str, Optional[str]] = {}
+        for root in sorted(color):
+            if color[root] != WHITE:
+                continue
+            stack = [(root, iter(sorted(graph.get(root, ()))))]
+            color[root] = GREY
+            parent[root] = None
+            while stack:
+                node, children = stack[-1]
+                advanced = False
+                for child in children:
+                    if color.get(child, WHITE) == WHITE:
+                        color[child] = GREY
+                        parent[child] = node
+                        stack.append(
+                            (child, iter(sorted(graph.get(child, ())))))
+                        advanced = True
+                        break
+                    if color.get(child) == GREY:
+                        cycle = [child]
+                        cursor = node
+                        while cursor is not None and cursor != child:
+                            cycle.append(cursor)
+                            cursor = parent.get(cursor)
+                        cycle.reverse()
+                        return cycle
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+        return None
+
+    def assert_acyclic(self) -> None:
+        cycle = self.find_cycle()
+        if cycle is not None:
+            with self._graph_lock:
+                witnesses = dict(self._witnesses)
+            raise LockOrderViolation(cycle, witnesses)
