@@ -1,0 +1,44 @@
+"""Quick (batch, steps_per_call) grid on the real chip to find headroom
+over bench.py's (8192, 8). Each cell: 12 s of steady-state steps."""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+
+from dragonfly2_tpu.data import SyntheticCluster  # noqa: E402
+from dragonfly2_tpu.parallel import data_parallel_mesh  # noqa: E402
+from dragonfly2_tpu.train import GNNTrainConfig, train_gnn  # noqa: E402
+
+mesh = data_parallel_mesh()
+print(json.dumps({"platform": jax.devices()[0].platform}), flush=True)
+graph = SyntheticCluster(n_hosts=2000, seed=0).probe_graph(2_000_000)
+
+results = []
+for batch, k in [(8192, 8), (8192, 16), (8192, 32), (16384, 8),
+                 (16384, 16), (4096, 16)]:
+    t0 = time.perf_counter()
+    res = train_gnn(
+        graph,
+        GNNTrainConfig(batch_size=batch, epochs=1000, eval_fraction=0.02,
+                       steps_per_call=k, max_seconds=12.0,
+                       eval_max_seconds=0.0),
+        mesh,
+    )
+    row = {"batch": batch, "steps_per_call": k,
+           "samples_per_sec_per_chip": int(res.samples_per_sec / mesh.n_data),
+           "steps": res.steps,
+           "wall_s": round(time.perf_counter() - t0, 1)}
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+best = max(results, key=lambda r: r["samples_per_sec_per_chip"])
+print(json.dumps({"best": best}), flush=True)
+if len(sys.argv) > 1:
+    with open(sys.argv[1], "w") as f:
+        json.dump(results, f, indent=1)

@@ -246,7 +246,10 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
     # steady-state rate. CPU insurance shrinks the problem so every
     # stage COMPLETES — a small honest number beats a kill mid-compile.
     if platform == "tpu":
-        n_edges, batch, steps_per_call = 2_000_000, 8192, 8
+        # (8192, 16) won the round-4 on-chip grid (artifacts/
+        # tune_gnn_r4.json: 351k vs 275k at k=8 in matched windows) —
+        # deeper scan amortizes the tunnel dispatch further.
+        n_edges, batch, steps_per_call = 2_000_000, 8192, 16
     else:
         n_edges, batch, steps_per_call = 200_000, 2048, 1
     cluster = SyntheticCluster(n_hosts=2000, seed=0)
