@@ -157,7 +157,7 @@ def main() -> None:
     gnn = train_gnn(graph, GNNTrainConfig(
         batch_size=batch_size, epochs=50,
         max_seconds=GNN_SECONDS,
-        steps_per_call=8 if on_tpu else 1,
+        steps_per_call=16 if on_tpu else 1,  # tune_gnn_r4.json winner
         eval_fraction=0.005,
         eval_max_seconds=30.0,
         progress_callback=on_progress,
