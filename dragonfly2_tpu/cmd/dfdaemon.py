@@ -37,6 +37,13 @@ def build_daemon(args):
 
         register_s3()
 
+    if os.environ.get("OSS_ACCESS_KEY_ID"):
+        # oss:// back-to-source (pkg/source/clients/ossprotocol):
+        # configured from OSS_* env vars, same stance as s3.
+        from dragonfly2_tpu.client.source_oss import register_oss
+
+        register_oss()
+
     # oras:// (OCI artifacts; creds come from ~/.docker/config.json) and
     # hdfs:// (WebHDFS; simple-auth user from DF2_HDFS_USER) need no
     # secrets on argv — always installed, like the reference's

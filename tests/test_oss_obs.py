@@ -34,6 +34,10 @@ ACCESS, SECRET = "LTAItest", "oss-secret-key"
 PAGE = 2  # keys per list page
 
 
+def _etag(body: bytes) -> str:
+    return '"' + hashlib.md5(body).hexdigest() + '"'
+
+
 def _expected_signature(handler, auth_word, meta_prefix, body):
     """Independent server-side reconstruction of the string-to-sign,
     written from the documented layout (VERB, MD5, Type, Date, canonical
@@ -100,6 +104,8 @@ class _FakeGateway(BaseHTTPRequestHandler):
         if objects is None or (key and key not in objects):
             return self.send_error(404)
         self.send_response(200)
+        if key:
+            self.send_header("ETag", _etag(objects[key]))
         self.send_header("Content-Length",
                          str(len(objects[key])) if key else "0")
         self.end_headers()
@@ -115,7 +121,22 @@ class _FakeGateway(BaseHTTPRequestHandler):
             if key not in objects:
                 return self.send_error(404, "NoSuchKey")
             body = objects[key]
+            rng = self.headers.get("Range", "")
+            if rng.startswith("bytes="):
+                start_s, _, end_s = rng[len("bytes="):].partition("-")
+                start = int(start_s)
+                end = int(end_s) if end_s else len(body) - 1
+                chunk = body[start:end + 1]
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range",
+                    f"bytes {start}-{start + len(chunk) - 1}/{len(body)}")
+                self.send_header("Content-Length", str(len(chunk)))
+                self.end_headers()
+                self.wfile.write(chunk)
+                return
             self.send_response(200)
+            self.send_header("ETag", _etag(body))
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -276,3 +297,79 @@ class TestFactory:
         assert isinstance(new_object_store("obs"), OBSObjectStore)
         with pytest.raises(ObjectStoreError):
             new_object_store("gcs")
+
+
+class TestOSSSource:
+    """oss:// back-to-source client against the same signed fake
+    gateway (pkg/source/clients/ossprotocol parity)."""
+
+    def _client(self, oss_url):
+        from dragonfly2_tpu.client.source_oss import (
+            OSSConfig,
+            OSSSourceClient,
+        )
+
+        return OSSSourceClient(OSSConfig(
+            access_key=ACCESS, secret_key=SECRET, endpoint_url=oss_url))
+
+    @pytest.fixture()
+    def seeded(self, oss_url):
+        _FakeGateway.store.clear()
+        _FakeGateway.store["models"] = {
+            "gnn/v1/weights.bin": b"0123456789abcdef",
+            "gnn/v2/weights.bin": b"v2",
+            "mlp/v1/weights.bin": b"mlp",
+        }
+        return oss_url
+
+    def test_length_and_range_download(self, seeded):
+        from dragonfly2_tpu.client.piece import Range
+        from dragonfly2_tpu.client.source import Request
+
+        client = self._client(seeded)
+        req = Request("oss://models/gnn/v1/weights.bin")
+        assert client.get_content_length(req) == 16
+        assert client.is_support_range(req)
+
+        ranged = Request("oss://models/gnn/v1/weights.bin",
+                         rng=Range(start=4, length=6))
+        resp = client.download(ranged)
+        assert resp.status == 206
+        assert resp.body.read() == b"456789"
+        resp.close()
+
+    def test_expiry_by_etag(self, seeded):
+        from dragonfly2_tpu.client.source import Request
+
+        client = self._client(seeded)
+        req = Request("oss://models/gnn/v2/weights.bin")
+        etag = client.download(req).header.get("ETag")
+        assert etag
+        assert not client.is_expired(req, "", etag)
+        assert client.is_expired(req, "", '"deadbeef"')
+        _FakeGateway.store["models"]["gnn/v2/weights.bin"] = b"v2-new"
+        assert client.is_expired(req, "", etag)
+
+    def test_list_directory_semantics(self, seeded):
+        from dragonfly2_tpu.client.source import Request
+
+        client = self._client(seeded)
+        urls = client.list(Request("oss://models/gnn"))
+        assert urls == ["oss://models/gnn/v1/weights.bin",
+                        "oss://models/gnn/v2/weights.bin"]
+
+    def test_registration(self, seeded):
+        from dragonfly2_tpu.client import source
+        from dragonfly2_tpu.client.source import Request
+        from dragonfly2_tpu.client.source_oss import (
+            OSSConfig,
+            register_oss,
+        )
+
+        register_oss(OSSConfig(access_key=ACCESS, secret_key=SECRET,
+                               endpoint_url=seeded))
+        try:
+            assert source.get_content_length(
+                Request("oss://models/mlp/v1/weights.bin")) == 3
+        finally:
+            source.unregister("oss")
