@@ -25,11 +25,16 @@ attention implementations with identical semantics:
   ``jax.checkpoint``-ed body keeps backward memory at
   O(rows·heads·chunk). For graphs dense enough that K ~ N, its
   MXU-shaped [rows, chunk] matmuls beat per-row gathers.
+- ``attention="ring"``: blocks mode where K/V stay row-sharded and
+  rotate around the device ring via ``lax.ppermute``
+  (``ring_graph_attention``) — no full-width K/V at all, for topologies
+  past the point where even the O(N·H) replicated table binds.
 
 Common sharding: queries/neighbor lists/accumulators are row-sharded
-over the mesh's ``data`` axis (each device owns N/d query rows); K/V are
-full-width — one O(N·H) all-gather over ICI per layer (25 MB at 100k
-hosts; never the scale cap — the O(N²) dense tensors were).
+over the mesh's ``data`` axis (each device owns N/d query rows); in the
+gather/blocks modes K/V go full-width — one O(N·H) all-gather over ICI
+per layer (25 MB at 100k hosts; never the scale cap — the O(N²) dense
+tensors were); ring mode trades that gather for d ppermute hops.
 
 Reference parity: Dragonfly2 leaves GNN training a stub
 (`/root/reference/trainer/training/training.go`); the topology features
@@ -158,16 +163,19 @@ def pad_multiple(n_data: int, chunk: int, n_nodes: int) -> int:
     return n_data * chunk // math.gcd(n_data, chunk)
 
 
-def _block_bias(nbr, val, start, block):
+def _block_bias(nbr, val, start, block, local=False):
     """[rows, block] (bias, mask) for key columns [start, start+block),
     scattered on device from the neighbor lists. Scatter-ADD is exact
     because build_neighbor_lists dedups (row, col) pairs; pad slots
-    (PAD_ID) are out of range of every block and contribute nothing."""
+    (PAD_ID) are out of range of every block and contribute nothing.
+    ``local=True`` forces the plain (per-device) scatter path — used
+    inside shard_map bodies, where arrays are already local and the
+    explicit-sharding reshard/out_sharding machinery must not run."""
     in_range = (nbr >= start) & (nbr < start + block)
     col = jnp.clip(nbr - start, 0, block - 1)
     rows_iota = jax.lax.broadcasted_iota(jnp.int32, nbr.shape, 0)
     base = jnp.broadcast_to(val[:, :1] * 0, (nbr.shape[0], block))
-    if _mesh_empty():
+    if local or _mesh_empty():
         bias = base.at[rows_iota, col].add(jnp.where(in_range, val, 0.0))
         hits = base.at[rows_iota, col].add(in_range.astype(val.dtype))
     else:
@@ -178,6 +186,81 @@ def _block_bias(nbr, val, start, block):
         hits = base.at[rows_iota, col].add(
             in_range.astype(val.dtype), out_sharding=spec)
     return bias, hits > 0
+
+
+def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
+    """Neighbor-masked attention with K/V blocks ppermute-ing around the
+    device ring — K/V NEVER go full-width, so per-device memory is
+    O(N/d · (heads·head_dim + K)): the layout for topologies past the
+    point where even the O(N·H) replicated K/V table binds.
+
+    Same online-softmax algebra as ``sparse_graph_attention``, same ring
+    mechanics as ``parallel/ring_attention.py`` (which handles the
+    sequence/causal case); here each visiting block's bias/mask is
+    scattered from the LOCAL rows' neighbor lists at the block's global
+    offset — all per-device ops, differentiable through ppermute with no
+    custom VJP. Each ring step scans the received block in ``chunk``-
+    column sub-blocks (rematerialized) to bound the score tile.
+
+    q/k/v: [N, heads, head_dim] row-sharded over ``axis``; nbr/val:
+    [N, K] row-sharded. Requires an ambient mesh (jax.set_mesh).
+    """
+    from functools import partial
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or axis not in mesh.shape:
+        # No ambient mesh (e.g. model.init outside jax.set_mesh, or a
+        # single-process run): the ring degenerates to the local chunked
+        # scan — same math, no collectives.
+        return sparse_graph_attention(q, k, v, nbr, val, chunk)
+    n_dev = mesh.shape[axis]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    spec3, spec2 = P(axis, None, None), P(axis, None)
+
+    @partial(jax.shard_map, in_specs=(spec3, spec3, spec3, spec2, spec2),
+             out_specs=spec3)
+    def run(ql, kl, vl, nbrl, vall):
+        n_loc = ql.shape[0]
+        block = min(chunk, n_loc)
+        assert n_loc % block == 0, (n_loc, block)
+        my_idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        m = ql.astype(jnp.float32).sum(-1) * 0 + NEG_INF     # [n_loc, h]
+        l = jnp.zeros_like(m)
+        acc = (ql * 0).astype(jnp.float32)
+        kb, vb = kl, vl
+        for ring_step in range(n_dev):
+            src_idx = (my_idx - ring_step) % n_dev           # block owner
+            base_pos = src_idx * n_loc
+
+            def sub(carry, j, kb=kb, vb=vb, base_pos=base_pos):
+                m, l, acc = carry
+                kj = jax.lax.dynamic_slice_in_dim(kb, j * block, block, 0)
+                vj = jax.lax.dynamic_slice_in_dim(vb, j * block, block, 0)
+                bias, mask = _block_bias(
+                    nbrl, vall, base_pos + j * block, block, local=True)
+                s = jnp.einsum("nhd,bhd->nhb", ql, kj).astype(
+                    jnp.float32) * scale
+                s = s + bias[:, None, :]
+                s = jnp.where(mask[:, None, :], s, NEG_INF)
+                m_new = jnp.maximum(m, s.max(-1))
+                p = jnp.exp(s - m_new[..., None]) * mask[:, None, :]
+                fold = jnp.exp(m - m_new)
+                l = l * fold + p.sum(-1)
+                acc = acc * fold[..., None] + jnp.einsum(
+                    "nhb,bhd->nhd", p.astype(ql.dtype), vj
+                ).astype(jnp.float32)
+                return (m_new, l, acc), None
+
+            (m, l, acc), _ = jax.lax.scan(
+                jax.checkpoint(sub), (m, l, acc),
+                jnp.arange(n_loc // block))
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+        return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(ql.dtype)
+
+    return run(q, k, v, nbr, val)
 
 
 def gather_graph_attention(q, k, v, nbr, val):
@@ -279,14 +362,19 @@ class GraphAttentionBlock(nn.Module):
         def split(t):  # [N, H] -> [N, heads, head_dim]
             return t.reshape(-1, self.heads, head_dim)
 
-        # Queries keep their row sharding; K/V go full-width (O(N·H)
-        # all-gather over ICI) and are consumed per-neighbor or
-        # block-by-block.
-        q, k, v = split(q), replicate(split(k)), replicate(split(v))
-        if self.attention == "gather":
-            out = gather_graph_attention(q, k, v, nbr, val)
+        if self.attention == "ring":
+            # K/V stay row-sharded; blocks ppermute around the ring.
+            out = ring_graph_attention(split(q), split(k), split(v),
+                                       nbr, val, self.chunk)
         else:
-            out = sparse_graph_attention(q, k, v, nbr, val, self.chunk)
+            # Queries keep their row sharding; K/V go full-width (O(N·H)
+            # all-gather over ICI) and are consumed per-neighbor or
+            # block-by-block.
+            q, k, v = split(q), replicate(split(k)), replicate(split(v))
+            if self.attention == "gather":
+                out = gather_graph_attention(q, k, v, nbr, val)
+            else:
+                out = sparse_graph_attention(q, k, v, nbr, val, self.chunk)
         out = out.reshape(-1, self.hidden)
         out = nn.Dense(self.hidden, dtype=self.dtype,
                        param_dtype=jnp.float32)(out)

@@ -110,10 +110,18 @@ def train_gat(
         graph.edge_rtt_ns[train_ids],
         cap=config.neighbor_cap,
     )
-    # The chunk-divisibility constraint (and its up-to-lcm padding cost)
-    # only exists for blocks mode; gather mode needs mesh rows only.
-    multiple = (pad_multiple(mesh.n_data, config.chunk, graph.n_nodes)
-                if config.attention == "blocks" else mesh.n_data)
+    # The chunk-divisibility constraint (and its padding cost) only
+    # exists for the chunked modes; gather mode needs mesh rows only.
+    # Ring mode chunks PER-DEVICE rows, so once those exceed a chunk the
+    # row count must be a multiple of n_data·chunk.
+    if config.attention == "blocks":
+        multiple = pad_multiple(mesh.n_data, config.chunk, graph.n_nodes)
+    elif config.attention == "ring":
+        per_device = -(-graph.n_nodes // mesh.n_data)
+        multiple = (mesh.n_data * config.chunk
+                    if per_device > config.chunk else mesh.n_data)
+    else:
+        multiple = mesh.n_data
     node_features, nbr, val, n_real = pad_graph_sparse(
         graph.node_features, nbr, val, multiple,
     )
