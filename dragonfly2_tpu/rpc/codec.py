@@ -158,8 +158,14 @@ def encode(msg: Any) -> bytes:
 
 def decode(data: bytes | memoryview) -> Any:
     view = memoryview(data)
+    # Length checks up front: truncated wire bytes must fail as a clean
+    # ValueError, never a struct.error leaking from the unpack.
+    if len(view) < 8:
+        raise ValueError("truncated DF2 message (shorter than header)")
     if bytes(view[:4]) != _MAGIC:
         raise ValueError("bad magic; not a DF2 message")
     (hlen,) = struct.unpack("<I", view[4:8])
+    if 8 + hlen > len(view):
+        raise ValueError("DF2 header length exceeds message size")
     header = json.loads(bytes(view[8 : 8 + hlen]).decode())
     return _dec(header, view[8 + hlen :])

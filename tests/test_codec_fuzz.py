@@ -1,0 +1,150 @@
+"""Codec robustness fuzzing: random message trees round-trip exactly,
+and corrupted wire bytes fail CLEANLY (ValueError/KeyError family, never
+a crash, hang, or silently-wrong decode) — the property a peer-facing
+wire format owes the daemon. Deterministic seeds: failures reproduce.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc import codec
+
+
+@codec.message("fuzz.Inner")
+@dataclasses.dataclass
+class Inner:
+    name: str = ""
+    payload: bytes = b""
+    weights: Optional[np.ndarray] = None
+    tags: List[str] = dataclasses.field(default_factory=list)
+
+
+@codec.message("fuzz.Outer")
+@dataclasses.dataclass
+class Outer:
+    idx: int = 0
+    ratio: float = 0.0
+    flag: bool = False
+    inner: Optional[Inner] = None
+    children: List[Inner] = dataclasses.field(default_factory=list)
+    table: Dict[str, int] = dataclasses.field(default_factory=dict)
+    raw: bytes = b""
+
+
+def _rand_inner(rng: np.random.Generator) -> Inner:
+    return Inner(
+        name="".join(chr(rng.integers(32, 0x2FA0)) for _ in
+                     range(rng.integers(0, 12))),
+        payload=rng.bytes(int(rng.integers(0, 512))),
+        weights=(rng.standard_normal(
+            tuple(rng.integers(0, 5, size=rng.integers(1, 3)))
+        ).astype(rng.choice(["float32", "float64", "int32"]))
+            if rng.random() < 0.7 else None),
+        tags=[f"t{j}" for j in range(rng.integers(0, 4))],
+    )
+
+
+def _rand_outer(rng: np.random.Generator) -> Outer:
+    return Outer(
+        idx=int(rng.integers(-2**53, 2**53)),
+        ratio=float(rng.standard_normal()),
+        flag=bool(rng.random() < 0.5),
+        inner=_rand_inner(rng) if rng.random() < 0.8 else None,
+        children=[_rand_inner(rng) for _ in range(rng.integers(0, 4))],
+        table={f"k{j}": int(rng.integers(0, 1000))
+               for j in range(rng.integers(0, 5))},
+        raw=rng.bytes(int(rng.integers(0, 2048))),
+    )
+
+
+def _assert_equal(a: Any, b: Any) -> None:
+    assert type(a) is type(b), (type(a), type(b))
+    if isinstance(a, Inner | Outer):
+        for f in dataclasses.fields(a):
+            _assert_equal(getattr(a, f.name), getattr(b, f.name))
+    elif isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    elif isinstance(a, list):
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            _assert_equal(x, y)
+    elif isinstance(a, float):
+        assert a == b or (np.isnan(a) and np.isnan(b))
+    else:
+        assert a == b
+
+
+class TestRoundTripFuzz:
+    @pytest.mark.parametrize("seed", range(30))
+    def test_random_trees_round_trip(self, seed):
+        rng = np.random.default_rng(seed)
+        msg = _rand_outer(rng)
+        wire = codec.encode(msg)
+        back = codec.decode(wire)
+        _assert_equal(msg, back)
+
+    def test_empty_and_edge_values(self):
+        for msg in (
+            Outer(),
+            Outer(raw=b"\x00" * 65536),
+            Outer(inner=Inner(weights=np.zeros((0, 4), np.float32))),
+            Outer(ratio=float("inf")),
+            Outer(ratio=float("nan")),
+            Outer(idx=-1),
+        ):
+            _assert_equal(msg, codec.decode(codec.encode(msg)))
+
+
+class TestCorruptionFuzz:
+    _CLEAN = (ValueError, KeyError, TypeError, IndexError,
+              EOFError, UnicodeDecodeError)
+
+    def _expect_clean_failure_or_valid(self, data: bytes) -> None:
+        """Corruption may still decode (flipping a blob byte changes a
+        payload, legitimately) — what it must never do is escape the
+        clean-error family or hang."""
+        try:
+            codec.decode(data)
+        except self._CLEAN:
+            pass
+        except Exception as exc:  # noqa: BLE001
+            raise AssertionError(
+                f"dirty failure {type(exc).__name__}: {exc}") from exc
+
+    def test_truncations(self):
+        wire = codec.encode(_rand_outer(np.random.default_rng(1)))
+        for cut in list(range(0, min(64, len(wire)))) + [len(wire) // 2,
+                                                         len(wire) - 1]:
+            self._expect_clean_failure_or_valid(wire[:cut])
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_random_byte_flips(self, seed):
+        rng = np.random.default_rng(1000 + seed)
+        wire = bytearray(codec.encode(_rand_outer(rng)))
+        for _ in range(8):
+            pos = int(rng.integers(0, len(wire)))
+            wire[pos] ^= int(rng.integers(1, 256))
+        self._expect_clean_failure_or_valid(bytes(wire))
+
+    def test_garbage(self):
+        rng = np.random.default_rng(7)
+        for size in (0, 1, 4, 8, 64, 4096):
+            self._expect_clean_failure_or_valid(rng.bytes(size))
+        self._expect_clean_failure_or_valid(b"DF2\x01" + b"\xff" * 64)
+
+    def test_header_length_lies(self):
+        wire = codec.encode(Outer(idx=7))
+        import struct as _struct
+
+        # header_len claims more bytes than exist
+        forged = wire[:4] + _struct.pack("<I", 2**31) + wire[8:]
+        self._expect_clean_failure_or_valid(forged)
+        # header_len zero
+        forged = wire[:4] + _struct.pack("<I", 0) + wire[8:]
+        self._expect_clean_failure_or_valid(forged)
