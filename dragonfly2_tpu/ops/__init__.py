@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The XLA compiler fuses most of this framework's compute well on its own
+(the GNN headline path is pure XLA); kernels live here where explicit
+VMEM scheduling buys something XLA's fusion cannot — currently the
+serving-path flash attention (``flash_attention``).
+"""
+
+from dragonfly2_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

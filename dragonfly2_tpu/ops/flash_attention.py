@@ -1,0 +1,169 @@
+"""Pallas TPU flash-attention kernel — the serving-path hot op.
+
+The scorer sidecar and embedding exports run attention forward passes
+per request; this kernel keeps the whole online-softmax loop in VMEM —
+one [block_q, block_k] score tile at a time, running (max, sum, acc)
+scratch carried across the key-block grid dimension — so the [T, T]
+score matrix never exists in HBM and each tile's QK^T / P·V land on the
+MXU back-to-back without an HBM round trip between them.
+
+Scope: FORWARD is the pallas kernel (with a block-level causal skip);
+backward (``jax.custom_vjp``) recomputes through the XLA dense
+reference — correct but O(T²) activation memory, fine at scorer sizes.
+Training-scale long context should use ``parallel/ring_attention.py``
+(sequence-parallel, O((T/d)²) per device); this kernel's job is
+single-chip serving latency. Non-TPU backends fall back to the dense
+XLA path automatically (the pallas path also runs under
+``interpret=True`` on CPU, which is how the hermetic tests drive it).
+
+Layouts: public API takes ``[T, heads, head_dim]`` (the repo's
+convention); the kernel runs ``[heads, T, head_dim]`` so each grid step
+owns one contiguous (head, q-block) tile.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+def _dense_reference(q, k, v, causal: bool, t_real: int):
+    """XLA fallback / backward path. q/k/v: [T, h, d] (padded)."""
+    t = q.shape[0]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("nhd,mhd->hnm", q, k).astype(jnp.float32) * scale
+    mask = (jnp.arange(t) < t_real)[None, None, :]
+    if causal:
+        mask = mask & (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+                       )[None, ...]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1) * mask
+    return jnp.einsum("hnm,mhd->nhd", p.astype(q.dtype), v)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            *, block_q: int, block_k: int, t_real: int, causal: bool):
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    i = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    # Block-level causal skip: a key block strictly in the future of the
+    # whole query block contributes nothing — don't even load it.
+    run_pred = (k_start <= q_start + block_q - 1) if causal \
+        else jnp.bool_(True)
+
+    @pl.when(run_pred)
+    def _compute():
+        q = q_ref[0]                                   # [block_q, d]
+        kb = k_ref[0]                                  # [block_k, d]
+        vb = v_ref[0]
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        k_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        mask = k_pos < t_real
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None]) * mask
+        fold = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * fold + p.sum(axis=1)
+        acc_ref[...] = acc_ref[...] * fold[:, None] + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-20)[:, None]
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, causal: bool, t_real: int,
+                    block_q: int, block_k: int, interpret: bool):
+    """q/k/v: [h, T, d] padded so T % block == 0."""
+    heads, t, d = q.shape
+    grid = (heads, t // block_q, t // block_k)
+    return pl.pallas_call(
+        partial(_kernel, block_q=block_q, block_k=block_k,
+                t_real=t_real, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((heads, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),       # running max
+            pltpu.VMEM((block_q,), jnp.float32),       # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),     # V accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _pad_to(t: int, block: int) -> int:
+    return ((t + block - 1) // block) * block
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+                    interpret=False):
+    """Softmax attention over [T, heads, head_dim] tensors.
+
+    Pallas kernel on TPU (or anywhere with ``interpret=True``); dense
+    XLA otherwise. Pads T up to the block size internally; padded keys
+    are masked out, padded query rows are dropped on return.
+    """
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    t_real = q.shape[0]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not (on_tpu or interpret):
+        return _dense_reference(q, k, v, causal, t_real), (q, k, v)
+    block = max(block_q, block_k)
+    t_pad = _pad_to(t_real, block)
+    pad = [(0, t_pad - t_real), (0, 0), (0, 0)]
+    qp, kp, vp = (jnp.pad(a, pad) for a in (q, k, v))
+    # [T, h, d] -> [h, T, d] for contiguous (head, block) tiles.
+    qp, kp, vp = (jnp.moveaxis(a, 1, 0) for a in (qp, kp, vp))
+    out = _pallas_forward(qp, kp, vp, causal, t_real, block_q, block_k,
+                          interpret)
+    return jnp.moveaxis(out, 0, 1)[:t_real], (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_reference(q, k, v, causal, q.shape[0]),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
