@@ -3,26 +3,18 @@
 Reference counterpart: pkg/source/clients/ossprotocol (aliyun-oss-go-sdk
 GetObject/GetObjectMeta behind the ResourceClient interface). URLs are
 ``oss://bucket/key``; endpoint/region/credentials come from the config
-or the ``OSS_*`` env vars. OSS GetObject honors HTTP Range, and
-expiry rides ETag/Last-Modified exactly like the s3 client.
+or the ``OSS_*`` env vars. The REST machinery (ranged GETs, expiry,
+listing) is shared with s3:// in ``source_signedhttp.py``; this module
+supplies only the OSS URL layout and signer.
 """
 
 from __future__ import annotations
 
-import email.utils
 import os
-import urllib.error
 import urllib.parse
-import urllib.request
 from dataclasses import dataclass
 
-from dragonfly2_tpu.client.source import (
-    Request,
-    ResourceClient,
-    Response,
-    SourceError,
-    UNKNOWN_SOURCE_FILE_LEN,
-)
+from dragonfly2_tpu.client.source_signedhttp import SignedHttpSourceClient
 from dragonfly2_tpu.utils.hmacsig import sign_oss_request
 
 
@@ -46,17 +38,12 @@ class OSSConfig:
         )
 
 
-class OSSSourceClient(ResourceClient):
+class OSSSourceClient(SignedHttpSourceClient):
+    scheme = "oss"
+
     def __init__(self, config: OSSConfig | None = None):
         self.config = config or OSSConfig.from_env()
-
-    def _bucket_key(self, request: Request) -> tuple:
-        parsed = urllib.parse.urlparse(request.url)
-        bucket = parsed.netloc
-        key = urllib.parse.unquote(parsed.path.lstrip("/"))
-        if not bucket or not key:
-            raise SourceError(f"malformed oss url {request.url!r}")
-        return bucket, key
+        self.timeout = self.config.timeout
 
     def _http_url(self, bucket: str, key: str) -> str:
         cfg = self.config
@@ -66,101 +53,26 @@ class OSSSourceClient(ResourceClient):
         return (f"https://{bucket}.{cfg.region}.aliyuncs.com/"
                 f"{urllib.parse.quote(key)}")
 
-    def _open(self, request: Request, method: str = "GET",
-              extra_header=None):
-        bucket, key = self._bucket_key(request)
-        url = self._http_url(bucket, key)
-        headers = dict(extra_header or {})
-        if request.rng is not None and method == "GET":
-            headers["Range"] = request.rng.http_header()
-        cfg = self.config
+    def _signed_headers(self, method: str, url: str, bucket: str,
+                        key: str, headers: dict) -> dict:
         # Range is not part of the OSS string-to-sign (it is neither a
         # canonical header nor an x-oss- one), so signing the base
         # request keeps ranged piece reads valid.
+        cfg = self.config
         signed, _ = sign_oss_request(method, bucket, key, headers,
                                      access_key=cfg.access_key,
                                      secret_key=cfg.secret_key)
-        req = urllib.request.Request(url, headers=signed, method=method)
-        try:
-            return urllib.request.urlopen(req, timeout=cfg.timeout)
-        except urllib.error.HTTPError as exc:
-            raise SourceError(f"{request.url}: HTTP {exc.code}") from exc
-        except urllib.error.URLError as exc:
-            raise SourceError(f"{request.url}: {exc.reason}") from exc
+        return signed
 
-    def get_content_length(self, request: Request) -> int:
-        resp = self._open(request, method="HEAD")
-        try:
-            length = resp.headers.get("Content-Length")
-            return (int(length) if length is not None
-                    else UNKNOWN_SOURCE_FILE_LEN)
-        finally:
-            resp.close()
-
-    def is_support_range(self, request: Request) -> bool:
-        return True  # OSS GetObject always honors Range
-
-    def is_expired(self, request: Request, last_modified: str,
-                   etag: str) -> bool:
-        if not etag and not last_modified:
-            return True
-        try:
-            resp = self._open(request, method="HEAD")
-        except SourceError:
-            return True
-        try:
-            if etag:
-                return resp.headers.get("ETag", "") != etag
-            return resp.headers.get("Last-Modified", "") != last_modified
-        finally:
-            resp.close()
-
-    def download(self, request: Request) -> Response:
-        resp = self._open(request)
-        if request.rng is not None and resp.status != 206:
-            resp.close()
-            raise SourceError(
-                f"{request.url}: endpoint ignored Range "
-                f"(status {resp.status})")
-        length = resp.headers.get("Content-Length")
-        return Response(
-            body=resp,
-            content_length=int(length) if length is not None else -1,
-            status=resp.status,
-            header={k: v for k, v in resp.headers.items()},
-        )
-
-    def get_last_modified(self, request: Request) -> int:
-        resp = self._open(request, method="HEAD")
-        try:
-            lm = resp.headers.get("Last-Modified")
-            if not lm:
-                return -1
-            return int(email.utils.parsedate_to_datetime(
-                lm).timestamp() * 1000)
-        finally:
-            resp.close()
-
-    def list(self, request: Request) -> list:
-        """oss://bucket/prefix/ → child object URLs (v1 marker-paginated
-        listing via the shared OSS REST backend — same signer)."""
+    def _make_store(self):
         from dragonfly2_tpu.manager.objectstore import OSSObjectStore
 
-        parsed = urllib.parse.urlparse(request.url)
-        bucket = parsed.netloc
-        prefix = urllib.parse.unquote(parsed.path.lstrip("/"))
-        # Directory semantics, not raw prefix match: 'data' must not
-        # sweep in a sibling 'database/'.
-        if prefix and not prefix.endswith("/"):
-            prefix += "/"
         cfg = self.config
-        store = OSSObjectStore(access_key=cfg.access_key,
-                               secret_key=cfg.secret_key,
-                               region=cfg.region,
-                               endpoint_url=cfg.endpoint_url,
-                               timeout=cfg.timeout)
-        return [f"oss://{bucket}/{urllib.parse.quote(key)}"
-                for key in store.list_objects(bucket, prefix=prefix)]
+        return OSSObjectStore(access_key=cfg.access_key,
+                              secret_key=cfg.secret_key,
+                              region=cfg.region,
+                              endpoint_url=cfg.endpoint_url,
+                              timeout=cfg.timeout)
 
 
 def register_oss(config: OSSConfig | None = None,

@@ -5,25 +5,18 @@ GetObject/HeadObject behind the ResourceClient interface). URLs are
 ``s3://bucket/key``; endpoint/region/credentials come from the config or
 the standard AWS env vars, so MinIO-style S3-compatibles work with
 ``endpoint_url`` pointing at them (the reference e2e suite runs minio,
-test/testdata/k8s).
+test/testdata/k8s). The REST machinery (ranged GETs, expiry, listing)
+is shared with oss:// in ``source_signedhttp.py``; this module supplies
+only the S3 URL layout and SigV4 signer.
 """
 
 from __future__ import annotations
 
-import email.utils
 import os
-import urllib.error
 import urllib.parse
-import urllib.request
 from dataclasses import dataclass
 
-from dragonfly2_tpu.client.source import (
-    Request,
-    ResourceClient,
-    Response,
-    SourceError,
-    UNKNOWN_SOURCE_FILE_LEN,
-)
+from dragonfly2_tpu.client.source_signedhttp import SignedHttpSourceClient
 from dragonfly2_tpu.utils.awssig import sign_request
 
 
@@ -47,113 +40,36 @@ class S3Config:
         )
 
 
-class S3SourceClient(ResourceClient):
+class S3SourceClient(SignedHttpSourceClient):
+    scheme = "s3"
+
     def __init__(self, config: S3Config | None = None):
         self.config = config or S3Config.from_env()
+        self.timeout = self.config.timeout
 
-    def _http_url(self, request: Request) -> str:
-        parsed = urllib.parse.urlparse(request.url)
-        # Unquote before re-quoting: s3 URLs from list() carry encoded
-        # keys, and quoting them again would double-encode.
-        bucket = parsed.netloc
-        key = urllib.parse.unquote(parsed.path.lstrip("/"))
-        if not bucket or not key:
-            raise SourceError(f"malformed s3 url {request.url!r}")
+    def _http_url(self, bucket: str, key: str) -> str:
         cfg = self.config
         if cfg.endpoint_url:
-            base = cfg.endpoint_url.rstrip("/")
-            return f"{base}/{bucket}/{urllib.parse.quote(key)}"
+            return (f"{cfg.endpoint_url.rstrip('/')}/{bucket}/"
+                    f"{urllib.parse.quote(key)}")
         return (f"https://{bucket}.s3.{cfg.region}.amazonaws.com/"
                 f"{urllib.parse.quote(key)}")
 
-    def _open(self, request: Request, method: str = "GET",
-              extra_header=None):
-        url = self._http_url(request)
-        headers = dict(extra_header or {})
-        if request.rng is not None and method == "GET":
-            headers["Range"] = request.rng.http_header()
+    def _signed_headers(self, method: str, url: str, bucket: str,
+                        key: str, headers: dict) -> dict:
         cfg = self.config
-        signed = sign_request(method, url, region=cfg.region,
-                              access_key=cfg.access_key,
-                              secret_key=cfg.secret_key, headers=headers)
-        req = urllib.request.Request(url, headers=signed, method=method)
-        try:
-            return urllib.request.urlopen(req, timeout=cfg.timeout)
-        except urllib.error.HTTPError as exc:
-            raise SourceError(f"{request.url}: HTTP {exc.code}") from exc
-        except urllib.error.URLError as exc:
-            raise SourceError(f"{request.url}: {exc.reason}") from exc
+        return sign_request(method, url, region=cfg.region,
+                            access_key=cfg.access_key,
+                            secret_key=cfg.secret_key, headers=headers)
 
-    def get_content_length(self, request: Request) -> int:
-        resp = self._open(request, method="HEAD")
-        try:
-            length = resp.headers.get("Content-Length")
-            return int(length) if length is not None else UNKNOWN_SOURCE_FILE_LEN
-        finally:
-            resp.close()
-
-    def is_support_range(self, request: Request) -> bool:
-        return True  # S3 GetObject always honors Range
-
-    def is_expired(self, request: Request, last_modified: str, etag: str) -> bool:
-        if not etag and not last_modified:
-            return True
-        try:
-            resp = self._open(request, method="HEAD")
-        except SourceError:
-            return True
-        try:
-            if etag:
-                return resp.headers.get("ETag", "") != etag
-            return resp.headers.get("Last-Modified", "") != last_modified
-        finally:
-            resp.close()
-
-    def download(self, request: Request) -> Response:
-        resp = self._open(request)
-        if request.rng is not None and resp.status != 206:
-            resp.close()
-            raise SourceError(
-                f"{request.url}: endpoint ignored Range (status {resp.status})")
-        length = resp.headers.get("Content-Length")
-        return Response(
-            body=resp,
-            content_length=int(length) if length is not None else -1,
-            status=resp.status,
-            header={k: v for k, v in resp.headers.items()},
-        )
-
-    def get_last_modified(self, request: Request) -> int:
-        resp = self._open(request, method="HEAD")
-        try:
-            lm = resp.headers.get("Last-Modified")
-            if not lm:
-                return -1
-            return int(email.utils.parsedate_to_datetime(lm).timestamp() * 1000)
-        finally:
-            resp.close()
-
-    def list(self, request: Request) -> list:
-        """s3://bucket/prefix/ → child object URLs (ListObjectsV2 via the
-        shared S3 REST backend — same signer, same pagination)."""
+    def _make_store(self):
         from dragonfly2_tpu.manager.objectstore import S3ObjectStore
 
-        parsed = urllib.parse.urlparse(request.url)
-        bucket = parsed.netloc
-        prefix = urllib.parse.unquote(parsed.path.lstrip("/"))
-        # Directory semantics, not raw prefix match: 'data' must not
-        # sweep in a sibling 'database/'.
-        if prefix and not prefix.endswith("/"):
-            prefix += "/"
         cfg = self.config
-        store = S3ObjectStore(access_key=cfg.access_key,
-                              secret_key=cfg.secret_key, region=cfg.region,
-                              endpoint_url=cfg.endpoint_url,
-                              timeout=cfg.timeout)
-        # Keys are percent-encoded into the URL (consumers unquote), so
-        # '%'/'#'/'?' in object names survive the round trip.
-        return [f"s3://{bucket}/{urllib.parse.quote(key)}"
-                for key in store.list_objects(bucket, prefix=prefix)]
+        return S3ObjectStore(access_key=cfg.access_key,
+                             secret_key=cfg.secret_key, region=cfg.region,
+                             endpoint_url=cfg.endpoint_url,
+                             timeout=cfg.timeout)
 
 
 def register_s3(config: S3Config | None = None, replace: bool = True) -> None:

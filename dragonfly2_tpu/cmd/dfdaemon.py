@@ -29,30 +29,11 @@ def build_daemon(args):
 
     import os
 
-    if os.environ.get("AWS_ACCESS_KEY_ID"):
-        # s3:// back-to-source (pkg/source/clients/s3protocol): configured
-        # purely from the standard AWS env vars (incl. AWS_ENDPOINT_URL
-        # for MinIO-style compatibles) — secrets never ride argv.
-        from dragonfly2_tpu.client.source_s3 import register_s3
+    # Extra back-to-source schemes (s3/oss/oras/hdfs), env-configured —
+    # secrets never ride argv (pkg/source/clients init registration).
+    from dragonfly2_tpu.client.source_signedhttp import register_env_sources
 
-        register_s3()
-
-    if os.environ.get("OSS_ACCESS_KEY_ID"):
-        # oss:// back-to-source (pkg/source/clients/ossprotocol):
-        # configured from OSS_* env vars, same stance as s3.
-        from dragonfly2_tpu.client.source_oss import register_oss
-
-        register_oss()
-
-    # oras:// (OCI artifacts; creds come from ~/.docker/config.json) and
-    # hdfs:// (WebHDFS; simple-auth user from DF2_HDFS_USER) need no
-    # secrets on argv — always installed, like the reference's
-    # clients-from-init registration (pkg/source/clients).
-    from dragonfly2_tpu.client.source_hdfs import HDFSConfig, register_hdfs
-    from dragonfly2_tpu.client.source_oras import register_oras
-
-    register_oras()
-    register_hdfs(HDFSConfig(user=os.environ.get("DF2_HDFS_USER", "")))
+    register_env_sources()
 
     # Task-affine multi-scheduler routing; a single --scheduler is the
     # one-replica degenerate ring.

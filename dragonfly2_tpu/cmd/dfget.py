@@ -20,6 +20,12 @@ from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_
 
 
 def main(argv=None) -> int:
+    # The ephemeral-peer fallback fetches origin itself, so it needs the
+    # same scheme registry the daemon installs.
+    from dragonfly2_tpu.client.source_signedhttp import register_env_sources
+
+    register_env_sources()
+
     parser = argparse.ArgumentParser("df2-get")
     parser.add_argument("url")
     parser.add_argument("-O", "--output", required=True)

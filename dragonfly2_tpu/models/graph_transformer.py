@@ -342,7 +342,9 @@ class GraphAttentionBlock(nn.Module):
     throughout. ``attention="gather"`` (default) is O(N·K) neighbor-
     gather attention; ``"blocks"`` is flash-style chunked block
     attention (same math — useful when the graph is dense enough that
-    MXU-shaped [rows, chunk] matmuls beat per-row gathers)."""
+    MXU-shaped [rows, chunk] matmuls beat per-row gathers); ``"ring"``
+    is blocks with K/V row-sharded and ppermuted around the mesh (no
+    full-width K/V at all)."""
 
     hidden: int
     heads: int

@@ -125,8 +125,14 @@ def _pallas_forward(q, k, v, causal: bool, t_real: int,
     )(q, k, v)
 
 
-def _pad_to(t: int, block: int) -> int:
-    return ((t + block - 1) // block) * block
+def _pad_to(t: int, block_q: int, block_k: int) -> int:
+    """Pad T to a common multiple of BOTH blocks — the grid uses floor
+    divisions for each axis, so a T divisible by only one block size
+    would silently drop the other axis's tail blocks."""
+    import math
+
+    lcm = block_q * block_k // math.gcd(block_q, block_k)
+    return ((t + lcm - 1) // lcm) * lcm
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -147,8 +153,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     on_tpu = jax.devices()[0].platform == "tpu"
     if not (on_tpu or interpret):
         return _dense_reference(q, k, v, causal, t_real), (q, k, v)
-    block = max(block_q, block_k)
-    t_pad = _pad_to(t_real, block)
+    t_pad = _pad_to(t_real, block_q, block_k)
     pad = [(0, t_pad - t_real), (0, 0), (0, 0)]
     qp, kp, vp = (jnp.pad(a, pad) for a in (q, k, v))
     # [T, h, d] -> [h, T, d] for contiguous (head, block) tiles.

@@ -111,6 +111,18 @@ def _enc(value: Any, blob: _Blob) -> Any:
     raise TypeError(f"cannot encode {type(value).__name__}")
 
 
+def _span(blob: memoryview, off: Any, n: Any) -> memoryview:
+    """Bounds-checked blob span. Python slicing CLAMPS out-of-range
+    indexes, so without this a message truncated in the blob region
+    would decode silently with a shortened payload — the silently-wrong
+    decode a wire format must never produce."""
+    if (not isinstance(off, int) or not isinstance(n, int)
+            or off < 0 or n < 0 or off + n > len(blob)):
+        raise ValueError(
+            f"blob span [{off}:{off}+{n}] outside blob of {len(blob)} bytes")
+    return blob[off : off + n]
+
+
 def _dec(node: Any, blob: memoryview) -> Any:
     if isinstance(node, list):
         return [_dec(v, blob) for v in node]
@@ -120,10 +132,12 @@ def _dec(node: Any, blob: memoryview) -> Any:
         return float(node["$f"])
     if "$b" in node:
         off, n = node["$b"]
-        return bytes(blob[off : off + n])
+        return bytes(_span(blob, off, n))
     if "$a" in node:
         dtype, shape, off, n = node["$a"]
-        return np.frombuffer(blob[off : off + n], dtype=np.dtype(dtype)).reshape(shape).copy()
+        return np.frombuffer(
+            _span(blob, off, n), dtype=np.dtype(dtype)
+        ).reshape(shape).copy()
     if "$e" in node:
         tag, raw = node["$e"]
         return lookup(tag)(raw)

@@ -60,7 +60,10 @@ class GATTrainConfig:
     # bias; self always survives).
     chunk: int = 1024
     neighbor_cap: int = 128
-    attention: str = "gather"  # "gather" (O(N·K)) or "blocks" (chunked)
+    # "gather" (O(N·K) neighbor gather, default) | "blocks" (flash-style
+    # chunked, full-width K/V) | "ring" (chunked with K/V row-sharded,
+    # ppermuted around the mesh — no full-width K/V at all)
+    attention: str = "gather"
     # Shared step-loop accounting (see GNNTrainConfig): wall cap for the
     # step loop plus incremental publishing hooks.
     max_seconds: float | None = None

@@ -148,3 +148,32 @@ class TestCorruptionFuzz:
         # header_len zero
         forged = wire[:4] + _struct.pack("<I", 0) + wire[8:]
         self._expect_clean_failure_or_valid(forged)
+
+
+class TestBlobSpanIntegrity:
+    def test_blob_truncation_raises_not_shortens(self):
+        """Truncation INSIDE the blob region must raise, never hand back
+        a silently shortened payload (python slice clamping)."""
+        wire = codec.encode(Outer(raw=b"x" * 100))
+        with pytest.raises(ValueError, match="blob span"):
+            codec.decode(wire[:-50])
+
+    def test_array_truncation_raises(self):
+        wire = codec.encode(
+            Outer(inner=Inner(weights=np.ones(64, np.float32))))
+        with pytest.raises(ValueError, match="blob span"):
+            codec.decode(wire[:-16])
+
+    def test_forged_negative_offset_raises(self):
+        import json as _json
+        import struct as _struct
+
+        wire = codec.encode(Outer(raw=b"abcd"))
+        hlen = _struct.unpack("<I", wire[4:8])[0]
+        header = _json.loads(wire[8:8 + hlen])
+        header["d"]["raw"]["$b"] = [-4, 4]
+        forged_header = _json.dumps(header, separators=(",", ":")).encode()
+        forged = (wire[:4] + _struct.pack("<I", len(forged_header))
+                  + forged_header + wire[8 + hlen:])
+        with pytest.raises(ValueError, match="blob span"):
+            codec.decode(forged)

@@ -44,6 +44,16 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("bq,bk", [(128, 96), (96, 128), (48, 32)])
+    def test_non_dividing_blocks(self, bq, bk):
+        """T divisible by one block but not the other: the internal pad
+        must go to the lcm so neither axis drops tail blocks."""
+        q, k, v = _qkv(128, 2, 16, seed=7)
+        out = flash_attention(q, k, v, True, bq, bk, True)
+        ref = _dense_reference(q, k, v, True, 128)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_grad_through_custom_vjp(self):
         q, k, v = _qkv(64, 2, 16, seed=3)
 
