@@ -179,3 +179,71 @@ class TestDaemonLockOrder:
         # (No EDGES is the expected verdict — the daemon never nests
         # these two locks, which is exactly the deadlock-free shape.)
         assert auditor.acquire_count > 50, auditor.acquire_count
+
+
+class TestJobPlaneLockOrder:
+    def test_manager_job_plane_acyclic(self, tmp_path):
+        """Wrap the manager DB's RLock and the job bus lock while
+        concurrent producers enqueue, workers lease/complete over the
+        DurableJobStore, and REST reads race them — the witnessed
+        acquisition graph must be acyclic."""
+        from dragonfly2_tpu.manager import (
+            Database,
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.jobplane import DurableJobStore
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        auditor = LockOrderAuditor()
+        db = Database(":memory:")
+        db._lock = auditor.wrap(db._lock, "manager.db")
+        service = ManagerService(
+            db, FilesystemObjectStore(str(tmp_path / "objects")))
+        store = DurableJobStore(db)
+        api = RestApi(service, auth=None, jobstore=store)
+
+        errors = []
+
+        from dragonfly2_tpu.manager.jobs import Job
+
+        def producer(i):
+            try:
+                for j in range(5):
+                    store.post("scheduler_1", Job(
+                        id=f"j{i}-{j}", type="preheat",
+                        payload={"url": f"http://o/{i}/{j}"},
+                        group_id=f"g{i}"))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def worker(name):
+            try:
+                for _ in range(8):
+                    job = store.lease(["scheduler_1"], worker_id=name)
+                    if job is not None:
+                        store.complete(job["id"], ok=True,
+                                       result={"ok": 1}, worker_id=name)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def reader():
+            try:
+                for _ in range(10):
+                    code, _ = api.dispatch("GET", "/api/v1/jobs", {}, {})
+                    assert code == 200
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = ([threading.Thread(target=producer, args=(i,))
+                    for i in range(3)]
+                   + [threading.Thread(target=worker, args=(f"w{i}",))
+                      for i in range(3)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        auditor.assert_acyclic()
+        assert auditor.acquire_count > 30, auditor.acquire_count
