@@ -29,6 +29,9 @@ def main(argv=None) -> int:
                         help="manager sqlite path for model registration "
                              "(co-located deployment)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
+    parser.add_argument("--train-gat", action="store_true",
+                        help="also train + register the GraphTransformer "
+                             "(BASELINE config #3) each cycle")
     parser.add_argument("--profile-dir", default="",
                         help="run train-step loops under "
                              "jax.profiler.trace; XPlane dumps land here "
@@ -62,12 +65,13 @@ def main(argv=None) -> int:
     storage = TrainerStorage(args.data_dir)
     metrics = TrainerMetrics(version=__version__)
     training_config = None
-    if args.profile_dir:
+    if args.profile_dir or args.train_gat:
         from dragonfly2_tpu.trainer.training import TrainingConfig
 
-        training_config = TrainingConfig()
-        training_config.gnn.profile_dir = args.profile_dir
-        training_config.mlp.profile_dir = args.profile_dir
+        training_config = TrainingConfig(train_gat_model=args.train_gat)
+        if args.profile_dir:
+            training_config.gnn.profile_dir = args.profile_dir
+            training_config.mlp.profile_dir = args.profile_dir
     service = TrainerService(
         storage,
         Training(storage, registry, config=training_config,

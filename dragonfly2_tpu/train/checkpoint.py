@@ -65,6 +65,22 @@ def gnn_from_tree(tree: dict) -> tuple[Any, np.ndarray]:
     return tree["params"], np.asarray(tree["node_features"])
 
 
+def gat_tree(params: Any, node_features: np.ndarray,
+             neighbors: np.ndarray, neighbor_vals: np.ndarray) -> dict:
+    """GraphTransformer checkpoint: params + the padded node features and
+    neighbor lists (serving recomputes embeddings over the same padded
+    attention structure the model trained on)."""
+    return {"params": params,
+            "node_features": np.asarray(node_features),
+            "neighbors": np.asarray(neighbors),
+            "neighbor_vals": np.asarray(neighbor_vals)}
+
+
+def gat_from_tree(tree: dict) -> tuple:
+    return (tree["params"], np.asarray(tree["node_features"]),
+            np.asarray(tree["neighbors"]), np.asarray(tree["neighbor_vals"]))
+
+
 def mlp_tree(params: Any, normalizer: Normalizer, target_norm: Normalizer) -> dict:
     return {
         "params": params,

@@ -24,24 +24,32 @@ from dragonfly2_tpu.data.features import graph_from_table, pair_examples_from_ta
 from dragonfly2_tpu.schema import Download, NetworkTopology
 from dragonfly2_tpu.schema.io import records_to_table
 from dragonfly2_tpu.train import (
+    GATTrainConfig,
     GNNTrainConfig,
     MLPTrainConfig,
+    train_gat,
     train_gnn,
     train_mlp,
 )
 from dragonfly2_tpu.train.checkpoint import (
     ModelMetadata,
+    gat_tree,
     gnn_tree,
     mlp_tree,
     save_model,
 )
 from dragonfly2_tpu.trainer.storage import TrainerStorage
-from dragonfly2_tpu.utils.idgen import gnn_model_id_v1, mlp_model_id_v1
+from dragonfly2_tpu.utils.idgen import (
+    gat_model_id_v1,
+    gnn_model_id_v1,
+    mlp_model_id_v1,
+)
 
 logger = logging.getLogger(__name__)
 
 MODEL_TYPE_GNN = "gnn"
 MODEL_TYPE_MLP = "mlp"
+MODEL_TYPE_GAT = "gat"
 
 
 class ModelRegistry(Protocol):
@@ -65,10 +73,16 @@ class ModelRegistry(Protocol):
 class TrainingConfig:
     gnn: GNNTrainConfig = field(default_factory=GNNTrainConfig)
     mlp: MLPTrainConfig = field(default_factory=MLPTrainConfig)
+    # Config #3 (GraphTransformer) as an opt-in third job — the
+    # reference trainer runs two (training.go trainGNN/trainMLP); the
+    # scale-out model is this framework's extension, so it defaults off.
+    gat: GATTrainConfig = field(default_factory=GATTrainConfig)
+    train_gat_model: bool = False
     # Minimum records before a model is trained at all (tiny datasets
     # produce garbage models that would evict good ones in the registry).
     min_gnn_records: int = 8
     min_mlp_records: int = 8
+    min_gat_records: int = 8
 
 
 @dataclass
@@ -76,8 +90,10 @@ class TrainOutcome:
     host_id: str
     gnn_model_id: Optional[str] = None
     mlp_model_id: Optional[str] = None
+    gat_model_id: Optional[str] = None
     gnn_evaluation: dict = field(default_factory=dict)
     mlp_evaluation: dict = field(default_factory=dict)
+    gat_evaluation: dict = field(default_factory=dict)
     errors: list = field(default_factory=list)
 
 
@@ -131,6 +147,13 @@ class Training:
             except Exception as exc:  # noqa: BLE001
                 logger.exception("trainMLP failed for %s", host_id)
                 outcome.errors.append(f"mlp: {exc}")
+            if self.config.train_gat_model:
+                try:
+                    self._train_gat(ip, hostname, host_id, scheduler_id,
+                                    topology_files, outcome)
+                except Exception as exc:  # noqa: BLE001
+                    logger.exception("trainGAT failed for %s", host_id)
+                    outcome.errors.append(f"gat: {exc}")
             self.storage.discard_files(download_files + topology_files)
         return outcome
 
@@ -168,6 +191,43 @@ class Training:
         )
         outcome.gnn_model_id = model_id
         outcome.gnn_evaluation = evaluation
+
+    def _train_gat(self, ip, hostname, host_id, scheduler_id, files,
+                   outcome: TrainOutcome) -> None:
+        records = self.storage.list_network_topology(host_id, files)
+        if len(records) < self.config.min_gat_records:
+            logger.info(
+                "skip GAT for %s: %d records < %d",
+                host_id, len(records), self.config.min_gat_records,
+            )
+            return
+        graph = graph_from_table(records_to_table(NetworkTopology, records))
+        job_start = time.monotonic()
+        result = train_gat(graph, self.config.gat, self.mesh)
+        self._observe_job("gat", time.monotonic() - job_start,
+                          result.samples_per_sec)
+        evaluation = {
+            "precision": result.precision,
+            "recall": result.recall,
+            "f1": result.f1,
+            "n_samples": len(records),
+        }
+        model_id = gat_model_id_v1(ip, hostname)
+        self._register(
+            model_id,
+            MODEL_TYPE_GAT,
+            host_id, ip, hostname, scheduler_id,
+            evaluation,
+            tree=gat_tree(result.params, result.node_features,
+                          result.neighbors, result.neighbor_vals),
+            config={"hidden": result.config.hidden,
+                    "embed": result.config.embed,
+                    "layers": result.config.layers,
+                    "heads": result.config.heads,
+                    "attention": result.config.attention},
+        )
+        outcome.gat_model_id = model_id
+        outcome.gat_evaluation = evaluation
 
     def _train_mlp(self, ip, hostname, host_id, scheduler_id, files,
                    outcome: TrainOutcome) -> None:

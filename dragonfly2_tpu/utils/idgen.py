@@ -127,3 +127,8 @@ def gnn_model_id_v1(ip: str, hostname: str) -> str:
 
 def mlp_model_id_v1(ip: str, hostname: str) -> str:
     return sha256_from_strings(ip, hostname, "MLP")
+
+
+def gat_model_id_v1(ip: str, hostname: str) -> str:
+    """Config #3 (GraphTransformer) follows the same binding scheme."""
+    return sha256_from_strings(ip, hostname, "GAT")

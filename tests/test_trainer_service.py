@@ -260,3 +260,61 @@ class TestSnapshotSemantics:
         assert ts.download_files("h") == []
         cli.close()
         server.stop()
+
+
+def _ingest_cluster_records(ts: TrainerStorage, host_id="sched-host-1"):
+    """Feed a synthetic cluster's CSV datasets straight into the
+    trainer's per-host storage (the announcer-stream shortcut for tests
+    that only exercise the training jobs)."""
+    import tempfile
+
+    cluster = SyntheticCluster(n_hosts=24, seed=3)
+    storage = Storage(tempfile.mkdtemp(prefix="df2-ingest-"),
+                      StorageConfig())
+    for rec in cluster.downloads(200):
+        storage.create_download(rec)
+    for rec in cluster.topology(400):
+        storage.create_network_topology(rec)
+    for kind, files in (
+        ("download", storage.snapshot_download()),
+        ("networktopology", storage.snapshot_network_topology()),
+    ):
+        for path in files:
+            with open(path, "rb") as f:
+                ts.append(kind, host_id, f.read(), new_file=True)
+    ts.close_host(host_id)
+
+
+class TestGATJob:
+    def test_opt_in_gat_registered(self, tmp_path):
+        """Config #3 as the opt-in third trainer job: same topology
+        records, GraphTransformer trained + registered as type 'gat'."""
+        from dragonfly2_tpu.train import GATTrainConfig
+
+        ts = TrainerStorage(str(tmp_path / "trainer"))
+        _ingest_cluster_records(ts)
+        registry = FakeRegistry()
+        cfg = TrainingConfig(
+            gnn=TINY.gnn, mlp=TINY.mlp,
+            gat=GATTrainConfig(hidden=8, embed=4, layers=1, heads=2,
+                               epochs=1, edge_batch_size=16,
+                               eval_fraction=0.25),
+            train_gat_model=True,
+        )
+        outcome = Training(ts, registry, cfg).train(
+            "10.0.0.1", "sched-host-1", "sched-host-1", scheduler_id=7)
+        assert outcome.gat_model_id is not None, outcome.errors
+        model = registry.models[outcome.gat_model_id]
+        assert model["type"] == "gat"
+        assert set(outcome.gat_evaluation) == {
+            "precision", "recall", "f1", "n_samples"}
+        assert "metadata.json" in model["files"] and "tree" in model["files"]
+
+    def test_default_off(self, tmp_path):
+        ts = TrainerStorage(str(tmp_path / "trainer"))
+        _ingest_cluster_records(ts)
+        registry = FakeRegistry()
+        outcome = Training(ts, registry, TINY).train(
+            "10.0.0.1", "sched-host-1", "sched-host-1", scheduler_id=7)
+        assert outcome.gat_model_id is None
+        assert all(m["type"] != "gat" for m in registry.models.values())
