@@ -161,3 +161,68 @@ class MLEvaluator:
 
     def is_bad_node(self, peer: PeerLike) -> bool:
         return self._fallback.is_bad_node(peer)
+
+
+class GATParentScorer:
+    """Pair scorer over a trained GraphTransformer (config #3).
+
+    The expensive full-graph attention runs ONCE at construction —
+    ``node_embeddings`` over the checkpointed padded features/neighbor
+    lists — leaving an [N, E] table on device. Every request is then a
+    [n, 2] host-index gather + the tiny edge head: the same
+    bucketed-jit/zero-pad recipe as :class:`ParentScorer`, so serving
+    latency is head-MLP-sized regardless of graph size.
+    """
+
+    def __init__(self, model, params, node_features, neighbors,
+                 neighbor_vals, max_batch: int = 64, device=None):
+        self._device = device or jax.devices()[0]
+        self._params = jax.device_put(params, self._device)
+        self.n_nodes = int(np.asarray(node_features).shape[0])
+        # One full-graph pass; block until the table is resident.
+        emb = model.apply(
+            params,
+            jnp.asarray(node_features), jnp.asarray(neighbors),
+            jnp.asarray(neighbor_vals),
+            method=type(model).node_embeddings)
+        self._emb = jax.device_put(jnp.asarray(emb), self._device)
+        self._emb.block_until_ready()
+
+        def forward(p, emb, src, dst):
+            return model.apply(p, emb, src, dst,
+                               method=type(model).score_pairs)
+
+        self._forward = jax.jit(forward)
+        self.buckets = _buckets(max_batch)
+        self.max_batch = max_batch
+        for b in self.buckets:
+            zero = jnp.zeros(b, jnp.int32)
+            self._forward(self._params, self._emb, zero,
+                          zero).block_until_ready()
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+
+    def score(self, pairs: np.ndarray) -> np.ndarray:
+        """Edge logits for [n, 2] (src, dst) host indices; higher is a
+        better parent edge."""
+        pairs = np.asarray(pairs)
+        n = len(pairs)
+        if n == 0:
+            return np.zeros(0, np.float32)
+        if pairs.ndim != 2 or pairs.shape[1] != 2:
+            raise ValueError(f"expected [n, 2] host-index pairs, "
+                             f"got {pairs.shape}")
+        if (pairs < 0).any() or (pairs >= self.n_nodes).any():
+            raise ValueError("host index out of range for the "
+                             f"{self.n_nodes}-node embedding table")
+        b = self._bucket(n)
+        padded = np.zeros((b, 2), np.int32)
+        padded[:n] = pairs
+        out = self._forward(self._params, self._emb,
+                            jnp.asarray(padded[:, 0]),
+                            jnp.asarray(padded[:, 1]))
+        return np.asarray(out)[:n]

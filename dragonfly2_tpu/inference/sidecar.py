@@ -34,6 +34,7 @@ logger = logging.getLogger(__name__)
 
 MODEL_NAME_MLP = "mlp"
 MODEL_NAME_GNN = "gnn"
+MODEL_NAME_GAT = "gat"
 
 
 @message("inference.ModelInferRequest")
@@ -156,31 +157,34 @@ class InferenceService:
             timer.start()
 
     def reload_from_manager(self) -> bool:
-        """Pull the active MLP model if its version changed. Returns True
-        when a (re)load happened. The steady-state poll is metadata-only:
-        the artifact is fetched only after the version check."""
+        """Pull every servable model type whose active version changed.
+        Returns True when any (re)load happened. The steady-state poll is
+        metadata-only: artifacts are fetched only after a version check."""
         if self.manager is None:
             return False
-        version = self.manager.get_active_model_version(
-            MODEL_NAME_MLP, self.scheduler_id
-        )
-        if version is None:
-            return False
-        with self._lock:
-            current = self._models.get(MODEL_NAME_MLP)
-            if current is not None and current.version == version:
-                return False
-        active = self.manager.get_active_model(
-            MODEL_NAME_MLP, self.scheduler_id
-        )
-        if active is None:
-            return False
-        scorer = _scorer_from_artifact(active.artifact)
-        # Through install_scorer so the micro-batcher front is (re)built
-        # and the old one drained.
-        self.install_scorer(MODEL_NAME_MLP, scorer, version=active.version)
-        logger.info("inference sidecar loaded mlp version %s", active.version)
-        return True
+        reloaded = False
+        for name, builder in ((MODEL_NAME_MLP, _scorer_from_artifact),
+                              (MODEL_NAME_GAT, _gat_scorer_from_artifact)):
+            version = self.manager.get_active_model_version(
+                name, self.scheduler_id
+            )
+            if version is None:
+                continue
+            with self._lock:
+                current = self._models.get(name)
+                if current is not None and current.version == version:
+                    continue
+            active = self.manager.get_active_model(name, self.scheduler_id)
+            if active is None:
+                continue
+            scorer = builder(active.artifact)
+            # Through install_scorer so the micro-batcher front is
+            # (re)built and the old one drained.
+            self.install_scorer(name, scorer, version=active.version)
+            logger.info("inference sidecar loaded %s version %s",
+                        name, active.version)
+            reloaded = True
+        return reloaded
 
     def serve_watcher(self) -> None:
         if self._watcher is not None and self._watcher.is_alive():
@@ -238,12 +242,23 @@ class InferenceService:
         inputs = request.inputs
         if inputs is None or inputs.size == 0:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty inputs")
-        inputs = np.asarray(inputs, dtype=np.float32)
-        if inputs.ndim != 2 or inputs.shape[1] != FEATURE_DIM:
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"inputs must be [batch, {FEATURE_DIM}], got {inputs.shape}",
-            )
+        if request.model_name == MODEL_NAME_GAT:
+            # Pair scorer: [batch, 2] int host indexes, not feature rows.
+            inputs = np.asarray(inputs, dtype=np.int32)
+            if inputs.ndim != 2 or inputs.shape[1] != 2:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"gat inputs must be [batch, 2] host-index pairs, "
+                    f"got {inputs.shape}",
+                )
+        else:
+            inputs = np.asarray(inputs, dtype=np.float32)
+            if inputs.ndim != 2 or inputs.shape[1] != FEATURE_DIM:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"inputs must be [batch, {FEATURE_DIM}], "
+                    f"got {inputs.shape}",
+                )
         if inputs.shape[0] > model.scorer.max_batch:
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
@@ -286,6 +301,35 @@ def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
         hidden = tuple(metadata.config.get("hidden", (128, 128, 64)))
         model = MLPBandwidthPredictor(hidden=hidden)
         return ParentScorer(model, params, normalizer, target_norm)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _gat_scorer_from_artifact(artifact: bytes):
+    """model.tar → GATParentScorer: one full-graph embedding pass at
+    load, pair-gather scoring per request."""
+    from dragonfly2_tpu.inference.scorer import GATParentScorer
+    from dragonfly2_tpu.manager.service import untar_to_directory
+    from dragonfly2_tpu.models.graph_transformer import GraphTransformer
+    from dragonfly2_tpu.train.checkpoint import gat_from_tree, load_model
+
+    tmp = tempfile.mkdtemp(prefix="df2-sidecar-gat-")
+    try:
+        untar_to_directory(artifact, tmp)
+        tree, metadata = load_model(tmp)
+        params, node_features, neighbors, neighbor_vals = gat_from_tree(tree)
+        cfg = metadata.config
+        model = GraphTransformer(
+            hidden=int(cfg.get("hidden", 128)),
+            embed=int(cfg.get("embed", 64)),
+            layers=int(cfg.get("layers", 2)),
+            heads=int(cfg.get("heads", 4)),
+            attention=str(cfg.get("attention", "gather")),
+        )
+        return GATParentScorer(model, params, node_features, neighbors,
+                               neighbor_vals)
     finally:
         import shutil
 

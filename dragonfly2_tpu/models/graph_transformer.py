@@ -429,13 +429,19 @@ class GraphTransformer(nn.Module):
             h = block(h, nbr, val)
         return self.embed_proj(self.final_norm(h))
 
-    def __call__(self, node_features, nbr, val, edge_src, edge_dst):
-        emb = self.node_embeddings(node_features, nbr, val)    # [N, E]
-        # One all-gather of the (small) embedding table per step; edge
-        # index gathers then stay local.
-        emb = replicate(emb)
+    def score_pairs(self, emb, edge_src, edge_dst):
+        """Edge logits from an ALREADY-COMPUTED embedding table — the
+        serving fast path: the sidecar runs ``node_embeddings`` once at
+        model load, then every request is one gather + this tiny head."""
         src = emb[edge_src]                                    # [B, E]
         dst = emb[edge_dst]
         pair = jnp.concatenate([src, dst], axis=-1)
         x = nn.relu(self.head_hidden(pair))
         return self.head_out(x)[..., 0]
+
+    def __call__(self, node_features, nbr, val, edge_src, edge_dst):
+        emb = self.node_embeddings(node_features, nbr, val)    # [N, E]
+        # One all-gather of the (small) embedding table per step; edge
+        # index gathers then stay local.
+        emb = replicate(emb)
+        return self.score_pairs(emb, edge_src, edge_dst)

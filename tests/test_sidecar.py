@@ -169,3 +169,75 @@ class TestRemoteMLEvaluator:
                               result.target_norm)
         latency = scorer.benchmark(batch=15, iters=100)
         assert latency["p50_ms"] < 1.0, latency
+
+
+class TestGATServing:
+    @pytest.fixture(scope="class")
+    def gat_registered(self, tmp_path_factory):
+        """Train config #3 tiny, register as type 'gat' beside an MLP."""
+        import tempfile
+
+        from dragonfly2_tpu.data import SyntheticCluster
+        from dragonfly2_tpu.train import GATTrainConfig, train_gat
+        from dragonfly2_tpu.train.checkpoint import (
+            ModelMetadata,
+            gat_tree,
+            save_model,
+        )
+
+        base = tmp_path_factory.mktemp("sidecar-gat")
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(base / "objects")))
+        graph = SyntheticCluster(n_hosts=24, seed=2).probe_graph(1500)
+        result = train_gat(
+            graph,
+            GATTrainConfig(hidden=16, embed=8, layers=1, heads=2,
+                           epochs=2, edge_batch_size=128,
+                           eval_fraction=0.25), None)
+        artifact = tempfile.mkdtemp(dir=base)
+        save_model(
+            artifact,
+            gat_tree(result.params, result.node_features,
+                     result.neighbors, result.neighbor_vals),
+            ModelMetadata(model_id="df2-gat-t", model_type="gat",
+                          evaluation={"f1": result.f1},
+                          config={"hidden": 16, "embed": 8, "layers": 1,
+                                  "heads": 2, "attention": "gather"}),
+        )
+        manager.create_model("df2-gat-t", "gat", "h", "1.1.1.1", "hn",
+                             {"f1": result.f1}, artifact)
+        return {"manager": manager, "result": result, "graph": graph}
+
+    def test_reload_and_pair_scoring(self, gat_registered):
+        service = InferenceService(manager=gat_registered["manager"])
+        assert service.reload_from_manager() is True
+        server = serve([(INFERENCE_SPEC, service)])
+        try:
+            client = InferenceClient(server.target, timeout=10.0)
+            assert client.model_ready("gat")
+            pairs = np.array([[0, 1], [2, 3], [5, 4]], np.int32)
+            scores = client.model_infer("gat", pairs)
+            assert scores.shape == (3,)
+            assert np.isfinite(scores).all()
+            # Serving scores must match the model's training-path logits
+            # for the same pairs (embedding table precompute is exact).
+            result = gat_registered["result"]
+            direct = np.asarray(result.model.apply(
+                result.params, result.node_features, result.neighbors,
+                result.neighbor_vals,
+                pairs[:, 0].astype(np.int32), pairs[:, 1].astype(np.int32)))
+            np.testing.assert_allclose(scores, direct, rtol=5e-2, atol=5e-2)
+            client.close()
+        finally:
+            server.stop()
+            service.stop()
+
+    def test_out_of_range_pair_rejected(self, gat_registered):
+        from dragonfly2_tpu.inference.sidecar import _gat_scorer_from_artifact
+
+        active = gat_registered["manager"].get_active_model("gat", 0)
+        scorer = _gat_scorer_from_artifact(active.artifact)
+        with pytest.raises(ValueError, match="host index"):
+            scorer.score(np.array([[0, 10**6]], np.int32))
+        with pytest.raises(ValueError, match="pairs"):
+            scorer.score(np.zeros((4, 3), np.int32))
