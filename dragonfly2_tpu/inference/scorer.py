@@ -175,10 +175,17 @@ class GATParentScorer:
     """
 
     def __init__(self, model, params, node_features, neighbors,
-                 neighbor_vals, max_batch: int = 64, device=None):
+                 neighbor_vals, max_batch: int = 64, device=None,
+                 node_ids=None):
         self._device = device or jax.devices()[0]
         self._params = jax.device_put(params, self._device)
         self.n_nodes = int(np.asarray(node_features).shape[0])
+        # Host-ID → embedding-row translation (checkpoint node_ids are
+        # the REAL rows in training order; padded phantom rows have no id
+        # and are unreachable through this map by construction).
+        self.node_ids = list(node_ids) if node_ids is not None else None
+        self._id_index = ({h: i for i, h in enumerate(self.node_ids)}
+                          if self.node_ids is not None else None)
         # One full-graph pass; block until the table is resident.
         emb = model.apply(
             params,
@@ -226,3 +233,19 @@ class GATParentScorer:
                             jnp.asarray(padded[:, 0]),
                             jnp.asarray(padded[:, 1]))
         return np.asarray(out)[:n]
+
+    def index_of(self, host_id: str):
+        """Embedding-row index for a host ID, or None when the host was
+        not in the training graph (callers fall back to rules)."""
+        if self._id_index is None:
+            return None
+        return self._id_index.get(host_id)
+
+    def score_host_pairs(self, id_pairs) -> np.ndarray:
+        """Edge logits for [(src_host_id, dst_host_id), ...]; raises
+        KeyError on hosts outside the training graph."""
+        if self._id_index is None:
+            raise ValueError("checkpoint carries no node_ids")
+        pairs = np.array([[self._id_index[a], self._id_index[b]]
+                          for a, b in id_pairs], np.int32).reshape(-1, 2)
+        return self.score(pairs)

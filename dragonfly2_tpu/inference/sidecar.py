@@ -319,7 +319,8 @@ def _gat_scorer_from_artifact(artifact: bytes):
     try:
         untar_to_directory(artifact, tmp)
         tree, metadata = load_model(tmp)
-        params, node_features, neighbors, neighbor_vals = gat_from_tree(tree)
+        (params, node_features, neighbors, neighbor_vals,
+         node_ids) = gat_from_tree(tree)
         cfg = metadata.config
         model = GraphTransformer(
             hidden=int(cfg.get("hidden", 128)),
@@ -329,7 +330,7 @@ def _gat_scorer_from_artifact(artifact: bytes):
             attention=str(cfg.get("attention", "gather")),
         )
         return GATParentScorer(model, params, node_features, neighbors,
-                               neighbor_vals)
+                               neighbor_vals, node_ids=node_ids)
     finally:
         import shutil
 

@@ -66,19 +66,34 @@ def gnn_from_tree(tree: dict) -> tuple[Any, np.ndarray]:
 
 
 def gat_tree(params: Any, node_features: np.ndarray,
-             neighbors: np.ndarray, neighbor_vals: np.ndarray) -> dict:
+             neighbors: np.ndarray, neighbor_vals: np.ndarray,
+             node_ids=None) -> dict:
     """GraphTransformer checkpoint: params + the padded node features and
     neighbor lists (serving recomputes embeddings over the same padded
-    attention structure the model trained on)."""
-    return {"params": params,
+    attention structure the model trained on). ``node_ids`` — the REAL
+    (pre-padding) rows' host IDs, row index = embedding index — ship as
+    a newline-joined UTF-8 byte array (orbax/tensorstore has no string
+    dtype), so serving can translate host IDs to table indexes."""
+    tree = {"params": params,
             "node_features": np.asarray(node_features),
             "neighbors": np.asarray(neighbors),
             "neighbor_vals": np.asarray(neighbor_vals)}
+    if node_ids is not None:
+        blob = "\n".join(str(i) for i in node_ids).encode()
+        tree["node_ids_utf8"] = np.frombuffer(blob, dtype=np.uint8).copy()
+    return tree
 
 
 def gat_from_tree(tree: dict) -> tuple:
+    """→ (params, node_features, neighbors, neighbor_vals, node_ids) —
+    ``node_ids`` is None for checkpoints written without them."""
+    node_ids = None
+    if "node_ids_utf8" in tree:
+        blob = bytes(np.asarray(tree["node_ids_utf8"], dtype=np.uint8))
+        node_ids = blob.decode().split("\n") if blob else []
     return (tree["params"], np.asarray(tree["node_features"]),
-            np.asarray(tree["neighbors"]), np.asarray(tree["neighbor_vals"]))
+            np.asarray(tree["neighbors"]), np.asarray(tree["neighbor_vals"]),
+            node_ids)
 
 
 def mlp_tree(params: Any, normalizer: Normalizer, target_norm: Normalizer) -> dict:

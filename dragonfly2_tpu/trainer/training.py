@@ -219,7 +219,8 @@ class Training:
             host_id, ip, hostname, scheduler_id,
             evaluation,
             tree=gat_tree(result.params, result.node_features,
-                          result.neighbors, result.neighbor_vals),
+                          result.neighbors, result.neighbor_vals,
+                          node_ids=graph.node_ids),
             config={"hidden": result.config.hidden,
                     "embed": result.config.embed,
                     "layers": result.config.layers,

@@ -198,7 +198,8 @@ class TestGATServing:
         save_model(
             artifact,
             gat_tree(result.params, result.node_features,
-                     result.neighbors, result.neighbor_vals),
+                     result.neighbors, result.neighbor_vals,
+                     node_ids=graph.node_ids),
             ModelMetadata(model_id="df2-gat-t", model_type="gat",
                           evaluation={"f1": result.f1},
                           config={"hidden": 16, "embed": 8, "layers": 1,
@@ -241,3 +242,19 @@ class TestGATServing:
             scorer.score(np.array([[0, 10**6]], np.int32))
         with pytest.raises(ValueError, match="pairs"):
             scorer.score(np.zeros((4, 3), np.int32))
+
+    def test_host_id_scoring(self, gat_registered):
+        """Checkpoint node_ids make the scorer addressable by host ID —
+        the form a scheduler actually holds."""
+        from dragonfly2_tpu.inference.sidecar import _gat_scorer_from_artifact
+
+        graph = gat_registered["graph"]
+        active = gat_registered["manager"].get_active_model("gat", 0)
+        scorer = _gat_scorer_from_artifact(active.artifact)
+        ids = list(graph.node_ids[:4])
+        by_id = scorer.score_host_pairs([(ids[0], ids[1]),
+                                         (ids[2], ids[3])])
+        by_index = scorer.score(np.array([[0, 1], [2, 3]], np.int32))
+        np.testing.assert_allclose(by_id, by_index)
+        assert scorer.index_of(ids[2]) == 2
+        assert scorer.index_of("no-such-host") is None
