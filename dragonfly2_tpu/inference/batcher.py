@@ -55,7 +55,13 @@ class MicroBatcher:
         if len(features) > self.max_rows:
             raise ValueError(
                 f"batch {len(features)} exceeds max {self.max_rows}")
-        pending = _Pending(np.asarray(features, np.float32))
+        # Preserve the caller's dtype: pair scorers take int32 host
+        # indexes, and a float32 coercion would silently corrupt indexes
+        # above 2^24. Float inputs still normalize to float32.
+        features = np.asarray(features)
+        if features.dtype.kind == "f":
+            features = features.astype(np.float32, copy=False)
+        pending = _Pending(features)
         # closed-check + enqueue under the same lock close() takes to set
         # the flag — otherwise a request can slip in after the final
         # drain and hang until its timeout.

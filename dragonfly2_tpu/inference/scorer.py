@@ -181,9 +181,13 @@ class GATParentScorer:
         self._params = jax.device_put(params, self._device)
         self.n_nodes = int(np.asarray(node_features).shape[0])
         # Host-ID → embedding-row translation (checkpoint node_ids are
-        # the REAL rows in training order; padded phantom rows have no id
-        # and are unreachable through this map by construction).
+        # the REAL rows in training order). Index validation uses the
+        # REAL count when ids ship — a padded phantom row would pass a
+        # padded-count check and return a plausible-looking garbage
+        # logit from an all-zero embedding.
         self.node_ids = list(node_ids) if node_ids is not None else None
+        self.n_real = (len(self.node_ids) if self.node_ids is not None
+                       else self.n_nodes)
         self._id_index = ({h: i for i, h in enumerate(self.node_ids)}
                           if self.node_ids is not None else None)
         # One full-graph pass; block until the table is resident.
@@ -223,9 +227,9 @@ class GATParentScorer:
         if pairs.ndim != 2 or pairs.shape[1] != 2:
             raise ValueError(f"expected [n, 2] host-index pairs, "
                              f"got {pairs.shape}")
-        if (pairs < 0).any() or (pairs >= self.n_nodes).any():
+        if (pairs < 0).any() or (pairs >= self.n_real).any():
             raise ValueError("host index out of range for the "
-                             f"{self.n_nodes}-node embedding table")
+                             f"{self.n_real}-host embedding table")
         b = self._bucket(n)
         padded = np.zeros((b, 2), np.int32)
         padded[:n] = pairs

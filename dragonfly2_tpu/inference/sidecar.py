@@ -165,25 +165,32 @@ class InferenceService:
         reloaded = False
         for name, builder in ((MODEL_NAME_MLP, _scorer_from_artifact),
                               (MODEL_NAME_GAT, _gat_scorer_from_artifact)):
-            version = self.manager.get_active_model_version(
-                name, self.scheduler_id
-            )
-            if version is None:
-                continue
-            with self._lock:
-                current = self._models.get(name)
-                if current is not None and current.version == version:
+            # Per-model isolation: one corrupt artifact must not block
+            # the OTHER type's hot-reloads on every subsequent poll.
+            try:
+                version = self.manager.get_active_model_version(
+                    name, self.scheduler_id
+                )
+                if version is None:
                     continue
-            active = self.manager.get_active_model(name, self.scheduler_id)
-            if active is None:
-                continue
-            scorer = builder(active.artifact)
-            # Through install_scorer so the micro-batcher front is
-            # (re)built and the old one drained.
-            self.install_scorer(name, scorer, version=active.version)
-            logger.info("inference sidecar loaded %s version %s",
-                        name, active.version)
-            reloaded = True
+                with self._lock:
+                    current = self._models.get(name)
+                    if current is not None and current.version == version:
+                        continue
+                active = self.manager.get_active_model(
+                    name, self.scheduler_id)
+                if active is None:
+                    continue
+                scorer = builder(active.artifact)
+                # Through install_scorer so the micro-batcher front is
+                # (re)built and the old one drained.
+                self.install_scorer(name, scorer, version=active.version)
+                logger.info("inference sidecar loaded %s version %s",
+                            name, active.version)
+                reloaded = True
+            except Exception:  # noqa: BLE001 — keep serving + polling
+                logger.exception("reload of %s model failed; keeping the "
+                                 "previous version", name)
         return reloaded
 
     def serve_watcher(self) -> None:
@@ -250,6 +257,17 @@ class InferenceService:
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"gat inputs must be [batch, 2] host-index pairs, "
                     f"got {inputs.shape}",
+                )
+            # Range-check BEFORE enqueueing: inside the micro-batcher a
+            # bad index's ValueError would fan out to every coalesced
+            # request and surface as an internal error, not a 4xx.
+            n_real = getattr(model.scorer, "n_real", None)
+            if n_real is not None and (
+                    (inputs < 0).any() or (inputs >= n_real).any()):
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"host index out of range for the {n_real}-host "
+                    "embedding table",
                 )
         else:
             inputs = np.asarray(inputs, dtype=np.float32)
@@ -328,6 +346,7 @@ def _gat_scorer_from_artifact(artifact: bytes):
             layers=int(cfg.get("layers", 2)),
             heads=int(cfg.get("heads", 4)),
             attention=str(cfg.get("attention", "gather")),
+            chunk=int(cfg.get("chunk", 1024)),
         )
         return GATParentScorer(model, params, node_features, neighbors,
                                neighbor_vals, node_ids=node_ids)

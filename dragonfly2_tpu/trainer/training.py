@@ -135,9 +135,25 @@ class Training:
         outcome = TrainOutcome(host_id=host_id)
         with self._train_lock:
             download_files, topology_files = self.storage.snapshot(host_id)
+            # Both graph jobs consume the identical topology snapshot:
+            # parse the records and build the Graph ONCE per cycle.
+            n_topology, graph = 0, None
+            try:
+                records = self.storage.list_network_topology(
+                    host_id, topology_files)
+                n_topology = len(records)
+                thresholds = [self.config.min_gnn_records]
+                if self.config.train_gat_model:
+                    thresholds.append(self.config.min_gat_records)
+                if n_topology >= min(thresholds):
+                    graph = graph_from_table(
+                        records_to_table(NetworkTopology, records))
+            except Exception as exc:  # noqa: BLE001 — job isolation
+                logger.exception("topology parse failed for %s", host_id)
+                outcome.errors.append(f"topology: {exc}")
             try:
                 self._train_gnn(ip, hostname, host_id, scheduler_id,
-                                topology_files, outcome)
+                                n_topology, graph, outcome)
             except Exception as exc:  # noqa: BLE001 — job isolation
                 logger.exception("trainGNN failed for %s", host_id)
                 outcome.errors.append(f"gnn: {exc}")
@@ -150,7 +166,7 @@ class Training:
             if self.config.train_gat_model:
                 try:
                     self._train_gat(ip, hostname, host_id, scheduler_id,
-                                    topology_files, outcome)
+                                    n_topology, graph, outcome)
                 except Exception as exc:  # noqa: BLE001
                     logger.exception("trainGAT failed for %s", host_id)
                     outcome.errors.append(f"gat: {exc}")
@@ -159,16 +175,14 @@ class Training:
 
     # -- jobs -----------------------------------------------------------------
 
-    def _train_gnn(self, ip, hostname, host_id, scheduler_id, files,
-                   outcome: TrainOutcome) -> None:
-        records = self.storage.list_network_topology(host_id, files)
-        if len(records) < self.config.min_gnn_records:
+    def _train_gnn(self, ip, hostname, host_id, scheduler_id,
+                   n_records, graph, outcome: TrainOutcome) -> None:
+        if graph is None or n_records < self.config.min_gnn_records:
             logger.info(
                 "skip GNN for %s: %d records < %d",
-                host_id, len(records), self.config.min_gnn_records,
+                host_id, n_records, self.config.min_gnn_records,
             )
             return
-        graph = graph_from_table(records_to_table(NetworkTopology, records))
         job_start = time.monotonic()
         result = train_gnn(graph, self.config.gnn, self.mesh)
         self._observe_job("gnn", time.monotonic() - job_start,
@@ -177,7 +191,7 @@ class Training:
             "precision": result.precision,
             "recall": result.recall,
             "f1": result.f1,
-            "n_samples": len(records),
+            "n_samples": n_records,
         }
         model_id = gnn_model_id_v1(ip, hostname)
         self._register(
@@ -192,16 +206,14 @@ class Training:
         outcome.gnn_model_id = model_id
         outcome.gnn_evaluation = evaluation
 
-    def _train_gat(self, ip, hostname, host_id, scheduler_id, files,
-                   outcome: TrainOutcome) -> None:
-        records = self.storage.list_network_topology(host_id, files)
-        if len(records) < self.config.min_gat_records:
+    def _train_gat(self, ip, hostname, host_id, scheduler_id,
+                   n_records, graph, outcome: TrainOutcome) -> None:
+        if graph is None or n_records < self.config.min_gat_records:
             logger.info(
                 "skip GAT for %s: %d records < %d",
-                host_id, len(records), self.config.min_gat_records,
+                host_id, n_records, self.config.min_gat_records,
             )
             return
-        graph = graph_from_table(records_to_table(NetworkTopology, records))
         job_start = time.monotonic()
         result = train_gat(graph, self.config.gat, self.mesh)
         self._observe_job("gat", time.monotonic() - job_start,
@@ -210,7 +222,7 @@ class Training:
             "precision": result.precision,
             "recall": result.recall,
             "f1": result.f1,
-            "n_samples": len(records),
+            "n_samples": n_records,
         }
         model_id = gat_model_id_v1(ip, hostname)
         self._register(
@@ -225,7 +237,11 @@ class Training:
                     "embed": result.config.embed,
                     "layers": result.config.layers,
                     "heads": result.config.heads,
-                    "attention": result.config.attention},
+                    "attention": result.config.attention,
+                    # chunk is structural for blocks/ring modes: serving
+                    # must rebuild with the block size the padded row
+                    # count was sized for.
+                    "chunk": result.config.chunk},
         )
         outcome.gat_model_id = model_id
         outcome.gat_evaluation = evaluation
