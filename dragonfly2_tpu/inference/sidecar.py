@@ -251,16 +251,17 @@ class InferenceService:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty inputs")
         if request.model_name == MODEL_NAME_GAT:
             # Pair scorer: [batch, 2] int host indexes, not feature rows.
-            inputs = np.asarray(inputs, dtype=np.int32)
+            inputs = np.asarray(inputs)
             if inputs.ndim != 2 or inputs.shape[1] != 2:
                 context.abort(
                     grpc.StatusCode.INVALID_ARGUMENT,
                     f"gat inputs must be [batch, 2] host-index pairs, "
                     f"got {inputs.shape}",
                 )
-            # Range-check BEFORE enqueueing: inside the micro-batcher a
-            # bad index's ValueError would fan out to every coalesced
-            # request and surface as an internal error, not a 4xx.
+            # Range-check BEFORE the int32 cast (an int64 index past
+            # 2^31 would wrap back INTO range) and before enqueueing
+            # (inside the micro-batcher a bad index's ValueError would
+            # fan out to every coalesced request as an internal error).
             n_real = getattr(model.scorer, "n_real", None)
             if n_real is not None and (
                     (inputs < 0).any() or (inputs >= n_real).any()):
@@ -269,6 +270,7 @@ class InferenceService:
                     f"host index out of range for the {n_real}-host "
                     "embedding table",
                 )
+            inputs = inputs.astype(np.int32)
         else:
             inputs = np.asarray(inputs, dtype=np.float32)
             if inputs.ndim != 2 or inputs.shape[1] != FEATURE_DIM:

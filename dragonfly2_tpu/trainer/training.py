@@ -177,11 +177,17 @@ class Training:
 
     def _train_gnn(self, ip, hostname, host_id, scheduler_id,
                    n_records, graph, outcome: TrainOutcome) -> None:
-        if graph is None or n_records < self.config.min_gnn_records:
+        if n_records < self.config.min_gnn_records:
             logger.info(
                 "skip GNN for %s: %d records < %d",
                 host_id, n_records, self.config.min_gnn_records,
             )
+            return
+        if graph is None:
+            # Enough records but the shared topology parse failed — the
+            # 'topology:' entry in outcome.errors carries the cause.
+            logger.info("skip GNN for %s: topology graph unavailable",
+                        host_id)
             return
         job_start = time.monotonic()
         result = train_gnn(graph, self.config.gnn, self.mesh)
@@ -208,11 +214,15 @@ class Training:
 
     def _train_gat(self, ip, hostname, host_id, scheduler_id,
                    n_records, graph, outcome: TrainOutcome) -> None:
-        if graph is None or n_records < self.config.min_gat_records:
+        if n_records < self.config.min_gat_records:
             logger.info(
                 "skip GAT for %s: %d records < %d",
                 host_id, n_records, self.config.min_gat_records,
             )
+            return
+        if graph is None:
+            logger.info("skip GAT for %s: topology graph unavailable",
+                        host_id)
             return
         job_start = time.monotonic()
         result = train_gat(graph, self.config.gat, self.mesh)
