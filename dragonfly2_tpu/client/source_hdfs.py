@@ -109,7 +109,10 @@ class HDFSSourceClient(ResourceClient):
         except (TypeError, ValueError):
             return True
         mtime_ms = int(self._file_status(request)["modificationTime"])
-        return int(known.timestamp() * 1000) != mtime_ms
+        # HTTP-dates carry second granularity, WebHDFS milliseconds —
+        # compare at the coarser unit or any sub-second mtime component
+        # reads as "expired" forever and defeats cache revalidation.
+        return int(known.timestamp()) != mtime_ms // 1000
 
     def download(self, request: Request) -> Response:
         extra: Dict[str, str] = {}

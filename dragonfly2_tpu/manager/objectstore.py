@@ -312,11 +312,24 @@ class OSSObjectStore(S3ObjectStore):
             resp = self._call("GET", bucket, query="&".join(parts))
             root = ET.fromstring(resp.read())
             ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
-            keys.extend(e.text for e in root.iter(f"{ns}Key"))
+            page = [e.text for e in root.iter(f"{ns}Key")]
+            keys.extend(page)
             truncated = root.findtext(f"{ns}IsTruncated") == "true"
-            marker = root.findtext(f"{ns}NextMarker") or ""
-            if not truncated or not marker:
+            if not truncated:
                 return sorted(keys)
+            # Providers only guarantee NextMarker when a delimiter is set;
+            # without it, continue from the last key of this page rather
+            # than silently returning a partial listing.
+            next_marker = root.findtext(f"{ns}NextMarker") or (
+                page[-1] if page else "")
+            if not next_marker or next_marker <= marker:
+                # Empty page, or a server that ignores the marker param
+                # and re-serves the same page — fail loudly rather than
+                # loop forever or return partial keys.
+                raise ObjectStoreError(
+                    f"{bucket}: truncated listing did not advance past "
+                    f"marker {marker!r} — refusing to return partial keys")
+            marker = next_marker
 
 
 class OBSObjectStore(OSSObjectStore):

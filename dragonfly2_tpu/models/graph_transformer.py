@@ -163,6 +163,23 @@ def pad_multiple(n_data: int, chunk: int, n_nodes: int) -> int:
     return n_data * chunk // math.gcd(n_data, chunk)
 
 
+def _divisor_block(n: int, chunk: int) -> int:
+    """Largest divisor of ``n`` that is ≤ ``chunk`` (≥ 1). Host-side,
+    static shapes — used by the ring fallback to keep the chunked scan
+    legal for row counts the ring padding rule aligned per-device but
+    not globally (e.g. n=104 over 8 devices with chunk=16)."""
+    best = 1
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            if d <= chunk:
+                best = max(best, d)
+            if n // d <= chunk:
+                best = max(best, n // d)
+        d += 1
+    return best
+
+
 def _block_bias(nbr, val, start, block, local=False):
     """[rows, block] (bias, mask) for key columns [start, start+block),
     scattered on device from the neighbor lists. Scatter-ADD is exact
@@ -211,8 +228,13 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
     if mesh.empty or axis not in mesh.shape:
         # No ambient mesh (e.g. model.init outside jax.set_mesh, or a
         # single-process run): the ring degenerates to the local chunked
-        # scan — same math, no collectives.
-        return sparse_graph_attention(q, k, v, nbr, val, chunk)
+        # scan — same math, no collectives. The GLOBAL row count is only
+        # guaranteed divisible by per-DEVICE chunks (ring padding aligns
+        # n/d, not n, to ``chunk``), so shrink the block to a divisor of
+        # n rather than asserting — this path is a trace-time fallback,
+        # not the hot loop.
+        return sparse_graph_attention(
+            q, k, v, nbr, val, _divisor_block(q.shape[0], chunk))
     n_dev = mesh.shape[axis]
     scale = 1.0 / np.sqrt(q.shape[-1])
     spec3, spec2 = P(axis, None, None), P(axis, None)

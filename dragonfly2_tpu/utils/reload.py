@@ -37,6 +37,7 @@ class ConfigWatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_digest = self._digest()  # baseline: current content
+        self._last_failed_digest = ""       # apply-failure log dedup
 
     def _digest(self) -> str:
         try:
@@ -66,12 +67,20 @@ class ConfigWatcher:
                          "options): %s", self.path, exc)
             self._last_digest = digest  # don't re-log every tick
             return False
-        self._last_digest = digest
         try:
             self.on_change(data)
         except Exception:  # noqa: BLE001
-            logger.exception("config watcher callback failed")
+            # Do NOT commit the digest: the config parsed but was never
+            # applied, so the next tick must retry it (a transient apply
+            # failure would otherwise skip this version forever). Log the
+            # traceback once per version — a permanently-rejected config
+            # retries every tick and would otherwise spam the log.
+            if digest != self._last_failed_digest:
+                logger.exception("config watcher callback failed; will retry")
+                self._last_failed_digest = digest
             return False
+        self._last_failed_digest = ""
+        self._last_digest = digest
         logger.info("reloaded config from %s", self.path)
         return True
 

@@ -101,6 +101,14 @@ class TestNeighborLists:
         # 6-way mesh) — must go chunked, not trip n % block
         assert pad_multiple(6, 1024, 1023) == 3072
 
+    def test_divisor_block(self):
+        from dragonfly2_tpu.models.graph_transformer import _divisor_block
+
+        assert _divisor_block(104, 16) == 13   # the ADVICE r4 repro shape
+        assert _divisor_block(1024, 256) == 256
+        assert _divisor_block(7, 4) == 1       # prime: degenerate but legal
+        assert _divisor_block(12, 100) == 12   # whole array in one block
+
 
 class TestTraining:
     def test_runs_sharded_on_mesh(self, trained):
@@ -212,6 +220,24 @@ class TestTraining:
         assert len(result.history) == 3
         assert np.isfinite(result.history[-1])
         assert result.history[-1] < result.history[0]
+
+    def test_ring_small_graph_large_chunk(self):
+        """ADVICE r4 (medium): ring mode where per-device rows fit one
+        chunk but the PADDED global N exceeds it (104 rows, chunk=16 on
+        8 devices) used to trip ``n % block == 0`` at model.init — init
+        runs outside the mesh, so the ring falls back to the global
+        chunked scan, and ring padding only aligns rows per-device. The
+        fallback now shrinks its block to a divisor of N."""
+        cluster = SyntheticCluster(n_hosts=100, seed=2)
+        graph = cluster.probe_graph(1500)
+        result = train_gat(
+            graph,
+            GATTrainConfig(hidden=16, embed=8, layers=1, heads=2,
+                           epochs=2, edge_batch_size=256,
+                           eval_fraction=0.2, attention="ring", chunk=16),
+            data_parallel_mesh(),
+        )
+        assert np.isfinite(result.history[-1])
 
     def test_edge_scores_finite_and_discriminative(self, trained):
         result = trained["result"]

@@ -64,6 +64,7 @@ class _FakeGateway(BaseHTTPRequestHandler):
     auth_word = "OSS"
     meta_prefix = "x-oss-"
     store: dict = {}  # bucket -> {key: bytes}
+    omit_next_marker = False  # some providers skip it without a delimiter
 
     def _authorize(self, body: bytes) -> bool:
         expected = _expected_signature(
@@ -150,7 +151,7 @@ class _FakeGateway(BaseHTTPRequestHandler):
         contents = "".join(f"<Contents><Key>{k}</Key></Contents>"
                            for k in page)
         next_marker = (f"<NextMarker>{page[-1]}</NextMarker>"
-                       if rest else "")
+                       if rest and not self.omit_next_marker else "")
         body = (f"<ListBucketResult><IsTruncated>"
                 f"{'true' if rest else 'false'}</IsTruncated>{next_marker}"
                 f"{contents}</ListBucketResult>").encode()
@@ -176,6 +177,15 @@ class _FakeGateway(BaseHTTPRequestHandler):
 class _FakeOBSGateway(_FakeGateway):
     auth_word = "OBS"
     meta_prefix = "x-obs-"
+    store: dict = {}
+
+
+class _FakeNoMarkerGateway(_FakeGateway):
+    """Truncated listings WITHOUT NextMarker — providers only guarantee
+    the element with a delimiter; the client must walk from the last
+    returned key instead of returning a silently partial listing."""
+
+    omit_next_marker = True
     store: dict = {}
 
 
@@ -274,6 +284,19 @@ class TestOSS:
         bad = OSSObjectStore(ACCESS, "wrong", endpoint_url=oss_url)
         with pytest.raises(ObjectStoreError, match="403"):
             bad.create_bucket("models")
+
+    def test_truncated_listing_without_next_marker(self):
+        _FakeNoMarkerGateway.store.clear()
+        server, url = _serve(_FakeNoMarkerGateway)
+        try:
+            store = OSSObjectStore(ACCESS, SECRET, endpoint_url=url)
+            store.create_bucket("models")
+            expect = [f"k{i:02d}" for i in range(PAGE * 2 + 1)]  # 3 pages
+            for k in expect:
+                store.put_object("models", k, b"x")
+            assert store.list_objects("models") == expect
+        finally:
+            server.shutdown()
 
 
 class TestOBS:

@@ -88,6 +88,27 @@ class TestConfigWatcher:
         finally:
             watcher.stop()
 
+    def test_failed_apply_retried_next_tick(self, tmp_path):
+        """A transient on_change failure must NOT burn that config
+        version: the digest is only committed after a successful apply,
+        so the next tick retries the same content."""
+        cfg = tmp_path / "daemon.yaml"
+        _write(cfg, {"a": 1})
+        attempts = []
+
+        def flaky(data):
+            attempts.append(data)
+            if len(attempts) == 1:
+                raise RuntimeError("transient apply failure")
+
+        watcher = ConfigWatcher(str(cfg), flaky, interval=0,
+                                install_sighup=False)
+        _write(cfg, {"a": 2})
+        assert not watcher._check()        # first apply raises
+        assert watcher._check()            # same content retried, lands
+        assert not watcher._check()        # now committed, not reapplied
+        assert [d["a"] for d in attempts] == [2, 2]
+
 
 class TestHotSwapTargets:
     def test_limiter_set_rate(self):

@@ -23,7 +23,10 @@ from dragonfly2_tpu.client.source_hdfs import (
     register_hdfs,
 )
 
-MTIME_MS = 1_700_000_000_000
+# Deliberately NOT second-aligned: real HDFS mtimes carry milliseconds,
+# and is_expired must compare at second granularity (the HTTP-date we
+# hand out can't represent the .123).
+MTIME_MS = 1_700_000_000_123
 
 TREE = {
     "/data/train/part-00000.parquet": b"parquet-bytes-0" * 10,
