@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Mint deployment TLS material: a CA, server leaves for the scheduler
+wire, and client leaves for mutual TLS.
+
+Deployment counterpart of the reference's cert distribution
+(deploy/helm chart TLS values; pkg/rpc/credential.go consumes the
+material). Usage:
+
+    python deploy/gen_certs.py --out certs/ \
+        --server scheduler --server 127.0.0.1 --client daemon
+
+Each ``--server NAME`` mints ``NAME.pem``/``NAME.key`` with a DNS or IP
+SAN (auto-detected); each ``--client NAME`` mints a CLIENT_AUTH leaf.
+The CA (``ca.pem``/``ca.key``) is created on first run and reused, so
+re-running adds leaves without invalidating the fleet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dragonfly2_tpu.utils.certs import CertAuthority  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("gen_certs")
+    parser.add_argument("--out", default="certs",
+                        help="directory for the CA and leaves")
+    parser.add_argument("--server", action="append", default=[],
+                        help="server SAN (DNS name or IP); repeatable")
+    parser.add_argument("--client", action="append", default=[],
+                        help="client identity for mutual TLS; repeatable")
+    args = parser.parse_args(argv)
+
+    ca = CertAuthority(args.out)
+    print(f"CA: {ca.ca_cert_path}")
+    for host in args.server or ["127.0.0.1"]:
+        cert, key = ca.cert_for(host)
+        # cert_for caches under hashed leaf names; copy to stable,
+        # operator-friendly paths the compose file can mount.
+        safe = host.replace(":", "_").replace("/", "_")
+        dst_cert = os.path.join(args.out, f"{safe}.pem")
+        dst_key = os.path.join(args.out, f"{safe}.key")
+        if os.path.abspath(cert) != os.path.abspath(dst_cert):
+            shutil.copyfile(cert, dst_cert)
+            shutil.copyfile(key, dst_key)
+        print(f"server {host}: {dst_cert}")
+    for name in args.client:
+        cert, key = ca.client_cert_for(name)
+        print(f"client {name}: {cert}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Localhost process supervisor — stand up the full topology with one
+command, no container runtime required.
+
+The plain-process twin of ``deploy/docker-compose.yaml`` (reference:
+deploy/ helm charts + test/testdata/kind/config.yaml — the environment
+its e2e tier runs against). Starts manager → scheduler (registered with
+the manager, TLS-terminated wire when ``--tls``) → seed daemon → N peer
+daemons (scheduler targets via manager **dynconfig**, not pinned), waits
+for each to be ready, and writes ``state.json`` with every port and pid
+so tests and operators can drive the mesh:
+
+    python deploy/local/up.py up   --dir /tmp/df2 --tls --peers 2
+    python deploy/local/up.py down --dir /tmp/df2
+
+``df2-get`` against the deployed mesh (ports from state.json):
+
+    python -m dragonfly2_tpu.cmd.dfget URL -O out \
+        --daemon 127.0.0.1:<peer_rpc_port>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_port(port: int, proc: subprocess.Popen, what: str,
+              timeout: float = 60.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited rc={proc.returncode} during startup — "
+                f"see its .err log")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.1)
+    raise TimeoutError(f"{what}: port {port} never opened")
+
+
+def spawn(run_dir: str, name: str, module: str, flags: list) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    out = open(os.path.join(run_dir, f"{name}.out"), "wb")
+    err = open(os.path.join(run_dir, f"{name}.err"), "wb")
+    return subprocess.Popen([sys.executable, "-m", module] + flags,
+                            stdout=out, stderr=err, env=env, cwd=run_dir)
+
+
+def cmd_up(args) -> int:
+    run_dir = os.path.abspath(args.dir)
+    os.makedirs(run_dir, exist_ok=True)
+    state_path = os.path.join(run_dir, "state.json")
+    if os.path.exists(state_path):
+        print(f"{state_path} exists — run `down` first", file=sys.stderr)
+        return 1
+
+    ports = {
+        "manager": free_port(), "manager_internal": free_port(),
+        "scheduler": free_port(), "seed_rpc": free_port(),
+        "seed_metrics": free_port(),
+        "peer_rpc": [free_port() for _ in range(args.peers)],
+        "peer_metrics": [free_port() for _ in range(args.peers)],
+    }
+    state = {"ports": ports, "pids": {}, "tls": bool(args.tls),
+             "tls_ca": "", "run_dir": run_dir}
+    procs = {}
+
+    tls_server_flags, tls_client_flags = [], []
+    if args.tls:
+        from dragonfly2_tpu.utils.certs import CertAuthority
+
+        ca = CertAuthority(os.path.join(run_dir, "certs"))
+        cert, key = ca.cert_for("127.0.0.1")
+        state["tls_ca"] = ca.ca_cert_path
+        tls_server_flags = ["--tls-cert", cert, "--tls-key", key]
+        tls_client_flags = ["--scheduler-tls-ca", ca.ca_cert_path]
+
+    try:
+        procs["manager"] = spawn(run_dir, "manager",
+                                 "dragonfly2_tpu.cmd.manager", [
+            "--host", "127.0.0.1", "--port", str(ports["manager"]),
+            "--internal-port", str(ports["manager_internal"]),
+            "--db", os.path.join(run_dir, "manager.db"),
+            "--object-store-dir", os.path.join(run_dir, "manager-objects"),
+        ])
+        wait_port(ports["manager_internal"], procs["manager"], "manager")
+
+        procs["scheduler"] = spawn(run_dir, "scheduler",
+                                   "dragonfly2_tpu.cmd.scheduler", [
+            "--host", "127.0.0.1", "--port", str(ports["scheduler"]),
+            "--data-dir", os.path.join(run_dir, "scheduler-data"),
+            "--manager", f"127.0.0.1:{ports['manager_internal']}",
+            "--advertise-ip", "127.0.0.1",
+            "--seed-peer", f"127.0.0.1:{ports['seed_rpc']}",
+        ] + tls_server_flags)
+        wait_port(ports["scheduler"], procs["scheduler"], "scheduler")
+
+        # Daemons discover the scheduler via manager dynconfig — wait for
+        # the registration + first keepalive to land so their boot-time
+        # fetch already lists it.
+        from dragonfly2_tpu.manager.client import ManagerHTTPClient
+
+        mgr = ManagerHTTPClient(f"127.0.0.1:{ports['manager_internal']}")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if mgr.daemon_dynconfig(ip="127.0.0.1").get("schedulers"):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("scheduler never became active at the "
+                               "manager (dynconfig lists no schedulers)")
+
+        def daemon(name, rpc_port, metrics_port, host_type):
+            p = spawn(run_dir, name, "dragonfly2_tpu.cmd.dfdaemon", [
+                "--manager", f"127.0.0.1:{ports['manager_internal']}",
+                "--rpc-port", str(rpc_port),
+                "--metrics-port", str(metrics_port),
+                "--storage-dir", os.path.join(run_dir, name),
+                "--hostname", name, "--type", host_type,
+                "--announce-interval", "5",
+            ] + tls_client_flags)
+            wait_port(rpc_port, p, name)
+            return p
+
+        procs["seed-1"] = daemon("seed-1", ports["seed_rpc"],
+                                 ports["seed_metrics"], "super")
+        for i in range(args.peers):
+            procs[f"peer-{i}"] = daemon(
+                f"peer-{i}", ports["peer_rpc"][i],
+                ports["peer_metrics"][i], "normal")
+    except Exception:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        raise
+
+    state["pids"] = {name: p.pid for name, p in procs.items()}
+    with open(state_path, "w") as f:
+        json.dump(state, f, indent=2)
+    print(json.dumps(state, indent=2))
+    print(f"\nmesh up — try:\n  python -m dragonfly2_tpu.cmd.dfget "
+          f"<URL> -O /tmp/out.bin --daemon "
+          f"127.0.0.1:{ports['peer_rpc'][0] if args.peers else ports['seed_rpc']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    run_dir = os.path.abspath(args.dir)
+    state_path = os.path.join(run_dir, "state.json")
+    with open(state_path) as f:
+        state = json.load(f)
+    failures = 0
+    # Daemons first, control plane last (same order as service shutdown
+    # in the compose file's stop_grace_period ordering).
+    order = sorted(state["pids"], key=lambda n: (
+        0 if n.startswith(("peer-", "seed-")) else
+        1 if n == "scheduler" else 2))
+    for name in order:
+        pid = state["pids"][name]
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            print(f"{name} (pid {pid}): already gone")
+            continue
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            print(f"{name} (pid {pid}): SIGKILL after grace", file=sys.stderr)
+            os.kill(pid, signal.SIGKILL)
+            failures += 1
+        print(f"{name} stopped")
+    os.remove(state_path)
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2 local deploy")
+    sub = parser.add_subparsers(dest="action", required=True)
+    up = sub.add_parser("up", help="start the topology")
+    up.add_argument("--dir", required=True, help="run directory")
+    up.add_argument("--peers", type=int, default=2)
+    up.add_argument("--tls", action="store_true",
+                    help="mint a CA and TLS-terminate the scheduler wire")
+    down = sub.add_parser("down", help="stop a running topology")
+    down.add_argument("--dir", required=True)
+    args = parser.parse_args(argv)
+    return cmd_up(args) if args.action == "up" else cmd_down(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

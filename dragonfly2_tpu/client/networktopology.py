@@ -71,11 +71,11 @@ class GrpcProbeSync:
     to the started request.
     """
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, tls=None):
         from dragonfly2_tpu.rpc.client import ServiceClient
         from dragonfly2_tpu.scheduler.rpcserver import SCHEDULER_SPEC
 
-        self._client = ServiceClient(target, SCHEDULER_SPEC)
+        self._client = ServiceClient(target, SCHEDULER_SPEC, tls=tls)
 
     def sync(self, host_id: str, measure) -> int:
         """started → candidates → measure() → finished/failed, one stream.

@@ -37,7 +37,14 @@ def build_daemon(args):
 
     # Task-affine multi-scheduler routing; a single --scheduler is the
     # one-replica degenerate ring.
-    scheduler = BalancedSchedulerClient(args.scheduler)
+    tls = None
+    if args.scheduler_tls_ca:
+        from dragonfly2_tpu.rpc.client import ClientTLS
+
+        tls = ClientTLS(ca_path=args.scheduler_tls_ca,
+                        cert_path=args.tls_cert, key_path=args.tls_key,
+                        server_name_override=args.scheduler_tls_server_name)
+    scheduler = BalancedSchedulerClient(args.scheduler, tls=tls)
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=args.storage_dir,
         ip=args.ip,
@@ -116,6 +123,17 @@ def main(argv=None) -> int:
                         help="enable the object gateway (>=0)")
     parser.add_argument("--object-storage-dir", default="",
                         help="filesystem object-store root for the gateway")
+    parser.add_argument("--scheduler-tls-ca", default="",
+                        help="trust roots for the scheduler wire (PEM); "
+                             "enables TLS to every scheduler target")
+    parser.add_argument("--tls-cert", default="",
+                        help="client certificate presented to the "
+                             "scheduler (mutual TLS)")
+    parser.add_argument("--tls-key", default="",
+                        help="private key for --tls-cert")
+    parser.add_argument("--scheduler-tls-server-name", default="",
+                        help="expected server cert hostname when dialing "
+                             "by IP (SNI override)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfdaemon")

@@ -49,6 +49,15 @@ def main(argv=None) -> int:
                              "(file://, s3://): download every child under "
                              "it into -O as a directory, each through the "
                              "mesh as its own task")
+    parser.add_argument("--scheduler-tls-ca", default="",
+                        help="trust roots for the scheduler wire (PEM)")
+    parser.add_argument("--tls-cert", default="",
+                        help="client certificate for mutual TLS")
+    parser.add_argument("--tls-key", default="",
+                        help="private key for --tls-cert")
+    parser.add_argument("--scheduler-tls-server-name", default="",
+                        help="expected server cert hostname when dialing "
+                             "by IP")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfget")
@@ -74,12 +83,7 @@ def main(argv=None) -> int:
 
     ephemeral = not args.storage_dir
     storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
-    if args.scheduler:
-        from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
-
-        scheduler = BalancedSchedulerClient(args.scheduler)
-    else:
-        scheduler = _DirectScheduler()
+    scheduler = _scheduler_client(args)
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=storage_dir, keep_storage=not ephemeral,
     ))
@@ -196,14 +200,7 @@ def _recursive_download(args, headers) -> int:
         from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
 
         storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
-        if args.scheduler:
-            from dragonfly2_tpu.scheduler.rpcserver import (
-                BalancedSchedulerClient,
-            )
-
-            scheduler = BalancedSchedulerClient(args.scheduler)
-        else:
-            scheduler = _DirectScheduler()
+        scheduler = _scheduler_client(args)
         daemon = Daemon(scheduler, DaemonConfig(
             storage_root=storage_dir, keep_storage=bool(args.storage_dir)))
         daemon.start()
@@ -253,6 +250,23 @@ def _daemon_download(args, headers):
     print(f"{args.output}: {result.content_length} bytes via daemon {via} "
           f"(task {result.task_id[:16]}…)")
     return 0
+
+
+def _scheduler_client(args):
+    """Ephemeral-peer scheduler client honoring the TLS flags; the
+    no-scheduler case degrades to the direct back-to-source stub."""
+    if not args.scheduler:
+        return _DirectScheduler()
+    from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
+
+    tls = None
+    if args.scheduler_tls_ca:
+        from dragonfly2_tpu.rpc.client import ClientTLS
+
+        tls = ClientTLS(ca_path=args.scheduler_tls_ca,
+                        cert_path=args.tls_cert, key_path=args.tls_key,
+                        server_name_override=args.scheduler_tls_server_name)
+    return BalancedSchedulerClient(args.scheduler, tls=tls)
 
 
 class _DirectScheduler:

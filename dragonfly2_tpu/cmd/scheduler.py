@@ -69,8 +69,16 @@ def build_scheduler(args):
     )
     resource.serve()
     service.network_topology.serve()
+    tls = None
+    if args.tls_cert:
+        # pkg/rpc/credential.go's role: server TLS, mutual when a client
+        # CA is configured (the reference's mTLS security mode).
+        from dragonfly2_tpu.rpc.service import ServerTLS
+
+        tls = ServerTLS(cert_path=args.tls_cert, key_path=args.tls_key,
+                        client_ca_path=args.tls_client_ca)
     server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))],
-                   host=args.host, port=args.port)
+                   host=args.host, port=args.port, tls=tls)
     return service, server
 
 
@@ -109,8 +117,18 @@ def main(argv=None) -> int:
                              "(0 = manager default cluster)")
     parser.add_argument("--job-poll-interval", type=float, default=1.0,
                         help="seconds between job-plane lease polls")
+    parser.add_argument("--tls-cert", default="",
+                        help="serve the scheduler wire over TLS with this "
+                             "certificate (PEM)")
+    parser.add_argument("--tls-key", default="",
+                        help="private key for --tls-cert")
+    parser.add_argument("--tls-client-ca", default="",
+                        help="require client certs signed by this CA "
+                             "(mutual TLS)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
+    if bool(args.tls_cert) != bool(args.tls_key):
+        parser.error("--tls-cert and --tls-key must be given together")
     init_logging(args.verbose, args.log_dir, service="scheduler")
     init_tracing(args, "scheduler")
 

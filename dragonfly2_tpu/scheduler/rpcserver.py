@@ -528,11 +528,12 @@ class GrpcSchedulerClient:
     """SchedulerAPI over the wire — what the conductor/daemon use when the
     scheduler is a separate process."""
 
-    def __init__(self, target: str):
+    def __init__(self, target: str, tls=None):
         from dragonfly2_tpu.rpc.client import ServiceClient
 
         self.target = target
-        self._client = ServiceClient(target, SCHEDULER_SPEC)
+        self.tls = tls
+        self._client = ServiceClient(target, SCHEDULER_SPEC, tls=tls)
         self._sessions: Dict[str, _AnnounceSession] = {}
         self._lock = threading.Lock()
 
@@ -544,7 +545,7 @@ class GrpcSchedulerClient:
         """
         from dragonfly2_tpu.client.networktopology import GrpcProbeSync
 
-        return GrpcProbeSync(self.target)
+        return GrpcProbeSync(self.target, tls=self.tls)
 
     # -- host lifecycle --------------------------------------------------
 
@@ -745,10 +746,12 @@ class BalancedSchedulerClient:
     ``update_targets`` is the dynconfig observer hook.
     """
 
-    def __init__(self, targets, client_factory=None):
+    def __init__(self, targets, client_factory=None, tls=None):
         from dragonfly2_tpu.rpc.client import HashRing
 
-        self._factory = client_factory or GrpcSchedulerClient
+        self._factory = client_factory or (
+            (lambda t: GrpcSchedulerClient(t, tls=tls)) if tls is not None
+            else GrpcSchedulerClient)
         self.ring = HashRing(targets)
         self._clients: Dict[str, GrpcSchedulerClient] = {}
         self._peer_owner: Dict[str, GrpcSchedulerClient] = {}
