@@ -64,6 +64,13 @@ class GATTrainConfig:
     # chunked, full-width K/V) | "ring" (chunked with K/V row-sharded,
     # ppermuted around the mesh — no full-width K/V at all)
     attention: str = "gather"
+    # >1 runs this many optimizer steps per dispatch under lax.scan —
+    # the same dispatch amortization the GNN path uses
+    # (gnn_trainer.steps_per_call): on a remote/tunneled accelerator the
+    # per-dispatch round trip bounds throughput, and the GAT step's
+    # edge minibatches are tiny next to the resident graph tensors, so
+    # stacking K of them per call is nearly free.
+    steps_per_call: int = 1
     # Shared step-loop accounting (see GNNTrainConfig): wall cap for the
     # step loop plus incremental publishing hooks.
     max_seconds: float | None = None
@@ -156,13 +163,23 @@ def train_gat(
     g_val = jax.device_put(val, row)
     rep = mesh.replicated
 
-    def train_step(state, feat, nbr_, val_, src, dst, y):
-        def loss_fn(params):
-            logits = state.apply_fn(params, feat, nbr_, val_, src, dst)
-            return optax.sigmoid_binary_cross_entropy(logits, y).mean()
+    # K optimizer steps per dispatch: a lax.scan over stacked [K, B]
+    # edge minibatches with the graph tensors as loop invariants. k=1
+    # degenerates to the plain single-step program (scan of length 1).
+    k = max(min(int(config.steps_per_call), steps_per_epoch), 1)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        return state.apply_gradients(grads=grads), loss
+    def train_step(state, feat, nbr_, val_, src_k, dst_k, y_k):
+        def body(st, batch):
+            src, dst, y = batch
+
+            def loss_fn(params):
+                logits = st.apply_fn(params, feat, nbr_, val_, src, dst)
+                return optax.sigmoid_binary_cross_entropy(logits, y).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(st.params)
+            return st.apply_gradients(grads=grads), loss
+
+        return jax.lax.scan(body, state, (src_k, dst_k, y_k))
 
     train_step = jax.jit(
         train_step,
@@ -196,25 +213,34 @@ def train_gat(
     # Explicit-sharding mode: the in-model reshards (K/V + embedding
     # all-gathers, block-bias scatter) need the ambient mesh during trace.
     with jax.set_mesh(mesh.mesh):
+        # Full-k groups plus one tail dispatch for the remainder — no
+        # silently dropped steps when k ∤ steps_per_epoch (the tail is a
+        # second, smaller scan program; compiled once).
+        group_sizes = [k] * (steps_per_epoch // k)
+        if steps_per_epoch % k:
+            group_sizes.append(steps_per_epoch % k)
         for _ in range(config.epochs):
             order = rng.permutation(train_ids)
-            losses = []
-            for i in range(steps_per_epoch):
-                ids = order[i * batch:(i + 1) * batch]
-                if len(ids) < batch:
+            losses = []  # per-STEP losses ([gk] arrays), k-invariant
+            offset = 0
+            for gk in group_sizes:
+                ids = order[offset * batch:(offset + gk) * batch]
+                offset += gk
+                if len(ids) < gk * batch:
                     break
-                state, loss = train_step(
+                ids_k = ids.reshape(gk, batch)
+                state, loss_k = train_step(
                     state, g_feat, g_nbr, g_val,
-                    rep_put(graph.edge_src[ids].astype(np.int32)),
-                    rep_put(graph.edge_dst[ids].astype(np.int32)),
-                    rep_put(labels_all[ids]),
+                    rep_put(graph.edge_src[ids_k].astype(np.int32)),
+                    rep_put(graph.edge_dst[ids_k].astype(np.int32)),
+                    rep_put(labels_all[ids_k]),
                 )
-                losses.append(loss)
-                if budget.tick(len(ids), loss):
+                losses.append(loss_k)
+                if budget.tick(gk * batch, jnp.mean(loss_k)):
                     stop = True
                     break
             if losses:
-                history.append(float(jnp.mean(jnp.stack(losses))))
+                history.append(float(jnp.mean(jnp.concatenate(losses))))
             if stop:
                 break
         jax.block_until_ready(state.params)
