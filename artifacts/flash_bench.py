@@ -1,0 +1,101 @@
+"""Graph-flash kernel vs XLA chunked scan — on-chip A/B (round-5 #3).
+
+Measures the GraphTransformer blocks-mode inner loop both ways at the
+config #3 shape (20k hosts padded, cap-64 neighbor lists, hidden 128 /
+4 heads), forward (the serving-side embedding export) and
+forward+backward (the training step), on whatever device jax gives us.
+Dispatch amortized by timing BATCH pipelined calls between syncs.
+
+Usage: python artifacts/flash_bench.py [out.json]
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+from dragonfly2_tpu.utils.compilecache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from dragonfly2_tpu.models.graph_transformer import (  # noqa: E402
+    build_neighbor_lists,
+    sparse_graph_attention,
+)
+from dragonfly2_tpu.ops.flash_attention import graph_flash_attention  # noqa: E402
+from dragonfly2_tpu.data import SyntheticCluster  # noqa: E402
+
+N_HOSTS, CAP, HEADS, HEAD_DIM, CHUNK = 20_000, 64, 4, 32, 512
+BATCH, WARMUP = 16, 3
+
+out = {"platform": jax.devices()[0].platform,
+       "n_hosts": N_HOSTS, "cap": CAP, "heads": HEADS,
+       "head_dim": HEAD_DIM, "chunk": CHUNK}
+print(json.dumps(out), flush=True)
+
+cluster = SyntheticCluster(n_hosts=N_HOSTS, seed=0)
+graph = cluster.probe_graph(500_000)
+nbr, val = build_neighbor_lists(
+    graph.n_nodes, graph.edge_src, graph.edge_dst, graph.edge_rtt_ns,
+    cap=CAP)
+n = ((graph.n_nodes + CHUNK - 1) // CHUNK) * CHUNK
+pad = n - graph.n_nodes
+nbr = np.pad(nbr, [(0, pad), (0, 0)], constant_values=2**30)
+val = np.pad(val, [(0, pad), (0, 0)])
+rng = np.random.default_rng(0)
+q, k, v = (jnp.asarray(rng.standard_normal(
+    (n, HEADS, HEAD_DIM)).astype(np.float32) * 0.1).astype(jnp.bfloat16)
+    for _ in range(3))
+nbr_d, val_d = jnp.asarray(nbr), jnp.asarray(val)
+
+scan_fwd = jax.jit(lambda *a: sparse_graph_attention(*a, CHUNK))
+flash_fwd = jax.jit(lambda *a: graph_flash_attention(*a, CHUNK, CHUNK))
+
+
+def grad_of(f):
+    return jax.jit(jax.grad(
+        lambda q, k, v, nbr, val: (f(q, k, v, nbr, val)
+                                   .astype(jnp.float32) ** 2).sum(),
+        argnums=(0, 1, 2)))
+
+
+scan_bwd = grad_of(lambda *a: sparse_graph_attention(*a, CHUNK))
+flash_bwd = grad_of(lambda *a: graph_flash_attention(*a, CHUNK, CHUNK))
+
+
+def bench(name, fn):
+    t0 = time.perf_counter()
+    r = fn(q, k, v, nbr_d, val_d)
+    jax.block_until_ready(r)
+    out[f"{name}_compile_s"] = round(time.perf_counter() - t0, 2)
+    for _ in range(WARMUP):
+        r = fn(q, k, v, nbr_d, val_d)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(BATCH):
+        r = fn(q, k, v, nbr_d, val_d)
+    jax.block_until_ready(r)
+    ms = (time.perf_counter() - t0) / BATCH * 1000
+    out[f"{name}_ms"] = round(ms, 2)
+    print(json.dumps({name: out[f"{name}_ms"]}), flush=True)
+    return r
+
+
+r_scan = bench("scan_fwd", scan_fwd)
+r_flash = bench("flash_fwd", flash_fwd)
+err = float(jnp.max(jnp.abs(
+    r_scan.astype(jnp.float32) - r_flash.astype(jnp.float32))))
+out["fwd_max_abs_diff"] = round(err, 5)
+bench("scan_fwdbwd", scan_bwd)
+bench("flash_fwdbwd", flash_bwd)
+out["fwd_speedup"] = round(out["scan_fwd_ms"] / out["flash_fwd_ms"], 3)
+out["fwdbwd_speedup"] = round(
+    out["scan_fwdbwd_ms"] / out["flash_fwdbwd_ms"], 3)
+
+print(json.dumps(out), flush=True)
+if len(sys.argv) > 1:
+    with open(sys.argv[1], "w") as f:
+        json.dump(out, f, indent=1)

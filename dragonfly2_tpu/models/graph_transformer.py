@@ -18,13 +18,16 @@ attention implementations with identical semantics:
   compute and memory (``gather_graph_attention``) — the right shape for
   degree-capped probe graphs, where scoring all N key columns wastes an
   N/K ≈ 1000× factor masking columns that can never attend.
-- ``attention="blocks"``: flash-style chunked block attention
-  (``sparse_graph_attention``) — a ``lax.scan`` over key blocks of
-  ``chunk`` rows with an online softmax; per block, the [rows, chunk]
-  bias/mask block is scattered on device from the neighbor lists and the
-  ``jax.checkpoint``-ed body keeps backward memory at
-  O(rows·heads·chunk). For graphs dense enough that K ~ N, its
-  MXU-shaped [rows, chunk] matmuls beat per-row gathers.
+- ``attention="blocks"``: flash-style chunked block attention — on a
+  single TPU device this is the pallas ``graph_flash_attention`` kernel
+  (``ops/flash_attention.py``: bias scatter + online softmax fused in
+  VMEM, no HBM bias/mask tensors at all); elsewhere the XLA ``lax.scan``
+  over key blocks (``sparse_graph_attention``) with the [rows, chunk]
+  bias/mask block scattered on device and a ``jax.checkpoint``-ed body
+  keeping backward memory at O(rows·heads·chunk). For graphs dense
+  enough that K ~ N, its MXU-shaped [rows, chunk] matmuls beat per-row
+  gathers. (``attention="flash"`` forces the kernel, interpret-mode off
+  TPU — tests/benchmarks.)
 - ``attention="ring"``: blocks mode where K/V stay row-sharded and
   rotate around the device ring via ``lax.ppermute``
   (``ring_graph_attention``) — no full-width K/V at all, for topologies
@@ -318,6 +321,33 @@ def gather_graph_attention(q, k, v, nbr, val):
     return jnp.einsum("nhk,nkhd->nhd", p, vg)
 
 
+def blocks_graph_attention(q, k, v, nbr, val, chunk):
+    """Blocks-mode dispatcher: the pallas graph-flash kernel when the
+    program runs on a single TPU device (the bench/serving hardware —
+    the kernel is a per-device program, so a >1-device mesh keeps the
+    XLA scan whose explicit-sharding scatter XLA already partitions);
+    the ``lax.scan`` online-softmax path otherwise."""
+    import os
+
+    mesh = jax.sharding.get_abstract_mesh()
+    single_device = mesh.empty or mesh.size == 1
+    if (single_device and jax.devices()[0].platform == "tpu"
+            and not os.environ.get("DF2_DISABLE_GRAPH_FLASH")):
+        from dragonfly2_tpu.ops.flash_attention import graph_flash_attention
+
+        block = _flash_block(q.shape[0], chunk)
+        return graph_flash_attention(q, k, v, nbr, val,
+                                     block_q=block, block_k=block)
+    return sparse_graph_attention(q, k, v, nbr, val, chunk)
+
+
+def _flash_block(n: int, chunk: int) -> int:
+    """Kernel tile size: the kernel pads rows internally, so no
+    divisibility constraint — just avoid padding a small graph up to a
+    huge chunk (cap at n rounded to the 128-lane MXU width)."""
+    return min(chunk, ((n + 127) // 128) * 128)
+
+
 def sparse_graph_attention(q, k, v, nbr, val, chunk):
     """Flash-style chunked attention over neighbor-masked key blocks.
 
@@ -397,8 +427,19 @@ class GraphAttentionBlock(nn.Module):
             q, k, v = split(q), replicate(split(k)), replicate(split(v))
             if self.attention == "gather":
                 out = gather_graph_attention(q, k, v, nbr, val)
+            elif self.attention == "flash":
+                # Force the pallas kernel (interpret-mode off TPU) —
+                # hermetic kernel tests and A/B benchmarks use this.
+                from dragonfly2_tpu.ops.flash_attention import (
+                    graph_flash_attention,
+                )
+
+                block = _flash_block(q.shape[0], self.chunk)
+                out = graph_flash_attention(
+                    q, k, v, nbr, val, block_q=block, block_k=block,
+                    interpret=jax.devices()[0].platform != "tpu")
             else:
-                out = sparse_graph_attention(q, k, v, nbr, val, self.chunk)
+                out = blocks_graph_attention(q, k, v, nbr, val, self.chunk)
         out = out.reshape(-1, self.hidden)
         out = nn.Dense(self.hidden, dtype=self.dtype,
                        param_dtype=jnp.float32)(out)

@@ -1,24 +1,34 @@
-"""Pallas TPU flash-attention kernel — the serving-path hot op.
+"""Pallas TPU flash-attention kernels.
 
-The scorer sidecar and embedding exports run attention forward passes
-per request; this kernel keeps the whole online-softmax loop in VMEM —
-one [block_q, block_k] score tile at a time, running (max, sum, acc)
-scratch carried across the key-block grid dimension — so the [T, T]
-score matrix never exists in HBM and each tile's QK^T / P·V land on the
-MXU back-to-back without an HBM round trip between them.
+Two entry points, one algebra (online softmax with (max, sum, acc)
+scratch carried across the key-block grid, so no score matrix ever
+exists in HBM and each tile's QK^T / P·V land on the MXU back-to-back):
 
-Scope: FORWARD is the pallas kernel (with a block-level causal skip);
-backward (``jax.custom_vjp``) recomputes through the XLA dense
-reference — correct but O(T²) activation memory, fine at scorer sizes.
-Training-scale long context should use ``parallel/ring_attention.py``
-(sequence-parallel, O((T/d)²) per device); this kernel's job is
-single-chip serving latency. Non-TPU backends fall back to the dense
-XLA path automatically (the pallas path also runs under
-``interpret=True`` on CPU, which is how the hermetic tests drive it).
+- :func:`flash_attention` — plain (optionally causal) sequence
+  attention over ``[T, heads, head_dim]``. A standalone primitive for
+  sequence models built on this framework; exercised hermetically under
+  ``interpret=True`` and on the TPU smoke tier.
+- :func:`graph_flash_attention` — the PRODUCTION kernel: neighbor-
+  masked graph attention with the RTT bias scattered from per-row
+  neighbor lists *inside* the kernel, tile by tile in VMEM. This is the
+  inner loop of ``GraphTransformer`` "blocks" mode on a single TPU
+  device (``models/graph_transformer.py`` selects it over the XLA
+  ``lax.scan`` path), which the serving-side embedding export
+  (``inference/scorer.py`` → ``node_embeddings``) runs at model load.
+
+Scope: FORWARD is the pallas kernel; backward (``jax.custom_vjp``)
+recomputes through the XLA reference — the dense path for the sequence
+kernel (O(T²), fine at scorer sizes) and the chunked online-softmax
+scan for the graph kernel (O(N·block) — the same memory class as
+training's default path). Training-scale long context should use
+``parallel/ring_attention.py``; multi-device graph training uses the
+scan/ring paths (the kernel is a per-device program — its multi-chip
+composition via shard_map is future work, documented in
+docs/DESIGN_DECISIONS.md).
 
 Layouts: public API takes ``[T, heads, head_dim]`` (the repo's
-convention); the kernel runs ``[heads, T, head_dim]`` so each grid step
-owns one contiguous (head, q-block) tile.
+convention); the kernels run ``[heads, T, head_dim]`` so each grid step
+owns one contiguous (head, block) tile.
 """
 
 from __future__ import annotations
@@ -172,3 +182,162 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# ----------------------------------------------------------------------
+# Graph-biased flash attention (the GraphTransformer "blocks" hot op)
+# ----------------------------------------------------------------------
+
+
+def _graph_kernel(q_ref, k_ref, v_ref, nbr_ref, val_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, block_k: int):
+    """One (head, q-block, k-block) tile: scatter this tile's bias/mask
+    from the q-rows' neighbor lists, then the online-softmax update.
+
+    The scatter runs as a fori_loop over the K neighbor slots — each
+    iteration one [block_q, block_k] one-hot compare — so no
+    [block_q, K, block_k] intermediate ever materializes in VMEM.
+    Slots are deduped host-side (build_neighbor_lists), so add is exact;
+    PAD_ID slots are out of range of every block and contribute nothing.
+    """
+    j = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _reset():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                       # [bq, d]
+    kb = k_ref[0]                                      # [bk, d]
+    vb = v_ref[0]
+    nbrb = nbr_ref[...]                                # [bq, K] int32
+    valb = val_ref[...]                                # [bq, K] f32
+    k_start = j * block_k
+
+    col = nbrb - k_start                               # [bq, K]
+    in_rng = (col >= 0) & (col < block_k)
+    cols_iota = jax.lax.broadcasted_iota(
+        jnp.int32, (q.shape[0], block_k), 1)           # [bq, bk]
+
+    def slot(kk, carry):
+        bias, hit = carry
+        c = jax.lax.dynamic_index_in_dim(col, kk, axis=1, keepdims=True)
+        ok = jax.lax.dynamic_index_in_dim(in_rng, kk, axis=1,
+                                          keepdims=True)
+        vv = jax.lax.dynamic_index_in_dim(valb, kk, axis=1, keepdims=True)
+        onehot = (cols_iota == c) & ok                 # [bq, bk]
+        return bias + jnp.where(onehot, vv, 0.0), hit | onehot
+
+    bias, hit = jax.lax.fori_loop(
+        0, nbrb.shape[1], slot,
+        (jnp.zeros_like(cols_iota, jnp.float32),
+         jnp.zeros_like(cols_iota, jnp.bool_)))
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [bq, bk]
+    s = jnp.where(hit, s + bias, NEG_INF)
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    p = jnp.exp(s - m_new[:, None]) * hit
+    fold = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_ref[...] * fold + p.sum(axis=1)
+    acc_ref[...] = acc_ref[...] * fold[:, None] + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(j == n_k - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[...], 1e-20)[:, None]
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def graph_flash_attention(q, k, v, nbr, val, block_q=128, block_k=128,
+                          interpret=False):
+    """Neighbor-masked attention with in-kernel bias scatter.
+
+    Same semantics as ``models.graph_transformer.sparse_graph_attention``
+    (scores + RTT bias on listed neighbors, NEG_INF elsewhere, rows with
+    no in-range neighbor produce 0): q ``[Nq, h, d]``, k/v ``[Nk, h, d]``
+    full-width, nbr/val ``[Nq, K]`` with ids in k's GLOBAL index space.
+    Row counts are padded internally to the block grid; padded query
+    rows return 0 and are dropped, padded key columns are unreachable
+    (no neighbor id points at them).
+    """
+    out, _ = _graph_fwd(q, k, v, nbr, val, block_q, block_k, interpret)
+    return out
+
+
+def _graph_fwd(q, k, v, nbr, val, block_q, block_k, interpret):
+    n_q, heads, d = q.shape
+    n_k = k.shape[0]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not (on_tpu or interpret):
+        from dragonfly2_tpu.models.graph_transformer import (
+            _divisor_block,
+            sparse_graph_attention,
+        )
+
+        return (sparse_graph_attention(q, k, v, nbr, val,
+                                       _divisor_block(n_q, block_k)),
+                (q, k, v, nbr, val))
+    q_pad = ((n_q + block_q - 1) // block_q) * block_q - n_q
+    k_pad = ((n_k + block_k - 1) // block_k) * block_k - n_k
+    qp = jnp.pad(q, [(0, q_pad), (0, 0), (0, 0)])
+    kp = jnp.pad(k, [(0, k_pad), (0, 0), (0, 0)])
+    vp = jnp.pad(v, [(0, k_pad), (0, 0), (0, 0)])
+    # Padded query rows must scatter nothing: PAD_ID is out of range of
+    # every key block (same invariant as the host-side pad rows).
+    from dragonfly2_tpu.models.graph_transformer import PAD_ID
+
+    nbrp = jnp.pad(nbr, [(0, q_pad), (0, 0)], constant_values=PAD_ID)
+    valp = jnp.pad(val, [(0, q_pad), (0, 0)])
+    qp, kp, vp = (jnp.moveaxis(a, 1, 0) for a in (qp, kp, vp))
+    t_q, t_k = qp.shape[1], kp.shape[1]
+    kw = nbr.shape[1]
+    grid = (heads, t_q // block_q, t_k // block_k)
+    out = pl.pallas_call(
+        partial(_graph_kernel, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((block_q, kw), lambda h, i, j: (i, 0)),
+            pl.BlockSpec((block_q, kw), lambda h, i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((heads, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, nbrp, valp)
+    return jnp.moveaxis(out, 0, 1)[:n_q], (q, k, v, nbr, val)
+
+
+def _graph_bwd(block_q, block_k, interpret, residuals, g):
+    """Recompute through the XLA chunked scan — same memory class as the
+    training default, and numerically the same algebra as the kernel."""
+    q, k, v, nbr, val = residuals
+    from dragonfly2_tpu.models.graph_transformer import (
+        _divisor_block,
+        sparse_graph_attention,
+    )
+
+    chunk = _divisor_block(q.shape[0], block_k)
+    _, vjp = jax.vjp(
+        lambda q, k, v, val: sparse_graph_attention(
+            q, k, v, nbr, val, chunk), q, k, v, val)
+    dq, dk, dv, dval = vjp(g)
+    return dq, dk, dv, None, dval
+
+
+graph_flash_attention.defvjp(_graph_fwd, _graph_bwd)

@@ -76,3 +76,120 @@ class TestFlashAttention:
         ref = _dense_reference(q, k, v, False, 64)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-6, atol=1e-6)
+
+
+def _graph_case(n, k_width, h=2, d=16, seed=0):
+    """Random neighbor lists with the build_neighbor_lists invariants:
+    deduped (row, col), a self slot per row, PAD_ID padding."""
+    from dragonfly2_tpu.models.graph_transformer import PAD_ID
+
+    rng = np.random.default_rng(seed)
+    q, k, v = (rng.standard_normal((n, h, d)).astype(np.float32)
+               for _ in range(3))
+    nbr = np.full((n, k_width), PAD_ID, dtype=np.int32)
+    val = np.zeros((n, k_width), dtype=np.float32)
+    others = np.arange(n, dtype=np.int32)
+    for r in range(n):
+        deg = int(rng.integers(1, k_width))
+        # Self slot first, then deg-1 distinct NON-self columns — keeps
+        # the (row, col)-unique invariant the scatter-add relies on.
+        pool = np.delete(others, r)
+        cols = np.concatenate([[r], rng.choice(
+            pool, size=deg - 1, replace=False)]).astype(np.int32)
+        nbr[r, :deg] = cols
+        val[r, :deg] = -rng.random(deg).astype(np.float32)
+        val[r, 0] = 0.0
+    return q, k, v, nbr, val
+
+
+class TestGraphFlashAttention:
+    """The production kernel (GraphTransformer blocks mode on TPU):
+    in-kernel bias scatter vs the XLA chunked-scan reference."""
+
+    def _ref(self, q, k, v, nbr, val, chunk):
+        from dragonfly2_tpu.models.graph_transformer import (
+            _divisor_block,
+            sparse_graph_attention,
+        )
+
+        # The scan reference needs a block dividing N; the kernel does
+        # not (it pads internally) — that asymmetry is the point.
+        return sparse_graph_attention(
+            q, k, v, nbr, val, _divisor_block(q.shape[0], chunk))
+
+    @pytest.mark.parametrize("n,kw,block", [(128, 8, 32), (96, 5, 32),
+                                            (64, 16, 64),
+                                            # n % block != 0: exercises
+                                            # the kernel's internal row
+                                            # padding (q_pad/k_pad > 0)
+                                            (100, 8, 32), (70, 4, 64)])
+    def test_matches_scan(self, n, kw, block):
+        from dragonfly2_tpu.ops.flash_attention import graph_flash_attention
+
+        q, k, v, nbr, val = _graph_case(n, kw, seed=n)
+        out = graph_flash_attention(q, k, v, nbr, val, block, block, True)
+        ref = self._ref(q, k, v, nbr, val, 32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_isolated_row_zero_output(self):
+        """A row whose only slot is out of every block (all PAD) gets 0,
+        like the scan path's fully-masked guard."""
+        from dragonfly2_tpu.models.graph_transformer import PAD_ID
+        from dragonfly2_tpu.ops.flash_attention import graph_flash_attention
+
+        q, k, v, nbr, val = _graph_case(64, 4, seed=9)
+        nbr[3, :] = PAD_ID
+        out = graph_flash_attention(q, k, v, nbr, val, 32, 32, True)
+        np.testing.assert_allclose(np.asarray(out)[3], 0.0, atol=1e-6)
+        ref = self._ref(q, k, v, nbr, val, 32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grad_matches_scan(self):
+        from dragonfly2_tpu.ops.flash_attention import graph_flash_attention
+
+        q, k, v, nbr, val = _graph_case(64, 6, seed=5)
+
+        def loss_kernel(q, k, v, val):
+            return (graph_flash_attention(
+                q, k, v, nbr, val, 32, 32, True) ** 2).sum()
+
+        def loss_ref(q, k, v, val):
+            return (self._ref(q, k, v, nbr, val, 32) ** 2).sum()
+
+        g1 = jax.grad(loss_kernel, argnums=(0, 1, 2, 3))(q, k, v, val)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, val)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_flash_mode_end_to_end(self):
+        """GraphTransformer(attention="flash") — the production wiring —
+        produces the same embeddings as blocks/gather mode."""
+        from dragonfly2_tpu.data import SyntheticCluster
+        from dragonfly2_tpu.models.graph_transformer import (
+            GraphTransformer,
+            build_neighbor_lists,
+        )
+
+        cluster = SyntheticCluster(n_hosts=48, seed=0)
+        graph = cluster.probe_graph(2000)
+        nbr, val = build_neighbor_lists(
+            graph.n_nodes, graph.edge_src, graph.edge_dst,
+            graph.edge_rtt_ns)
+
+        def embed(attention):
+            model = GraphTransformer(hidden=32, embed=16, layers=1,
+                                     heads=2, chunk=16,
+                                     attention=attention)
+            params = model.init(
+                jax.random.key(0), graph.node_features, nbr, val,
+                np.zeros(2, np.int32), np.zeros(2, np.int32))
+            return params, np.asarray(model.apply(
+                params, graph.node_features, nbr, val,
+                method=GraphTransformer.node_embeddings))
+
+        params, flash = embed("flash")
+        _, blocks = embed("blocks")
+        np.testing.assert_allclose(flash, blocks, rtol=6e-2, atol=6e-2)
