@@ -69,6 +69,24 @@ def build_scheduler(args):
     )
     resource.serve()
     service.network_topology.serve()
+    if args.replica_peer:
+        # Cross-replica probe anti-entropy: symmetric push-pull of
+        # probe-window deltas, bounding mid-window loss to one tick —
+        # the role Redis plays for the reference (probes.go:115-186).
+        from dragonfly2_tpu.scheduler.networktopology import ReplicaSyncer
+
+        peer_tls = None
+        if args.replica_peer_tls_ca:
+            from dragonfly2_tpu.rpc.client import ClientTLS
+
+            peer_tls = ClientTLS(
+                ca_path=args.replica_peer_tls_ca,
+                server_name_override=args.replica_peer_tls_server_name)
+        service.replica_syncer = ReplicaSyncer(
+            service.network_topology, args.replica_peer,
+            interval=args.replica_sync_interval, tls=peer_tls,
+            metrics=service.metrics)
+        service.replica_syncer.serve()
     tls = None
     if args.tls_cert:
         # pkg/rpc/credential.go's role: server TLS, mutual when a client
@@ -117,6 +135,17 @@ def main(argv=None) -> int:
                              "(0 = manager default cluster)")
     parser.add_argument("--job-poll-interval", type=float, default=1.0,
                         help="seconds between job-plane lease polls")
+    parser.add_argument("--replica-peer", default=None, action="append",
+                        help="host:port of a peer scheduler replica "
+                             "(repeatable); enables probe anti-entropy")
+    parser.add_argument("--replica-sync-interval", type=float, default=60.0,
+                        help="seconds between probe anti-entropy ticks")
+    parser.add_argument("--replica-peer-tls-ca", default="",
+                        help="CA bundle for dialing TLS-serving replica "
+                             "peers")
+    parser.add_argument("--replica-peer-tls-server-name", default="",
+                        help="SNI/SAN override when peers present a "
+                             "service-DNS certificate")
     parser.add_argument("--tls-cert", default="",
                         help="serve the scheduler wire over TLS with this "
                              "certificate (PEM)")

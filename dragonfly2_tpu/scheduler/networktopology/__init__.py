@@ -1,9 +1,11 @@
 """Probe store + topology snapshotting (reference: scheduler/networktopology/)."""
 
+from dragonfly2_tpu.scheduler.networktopology.antientropy import ReplicaSyncer
 from dragonfly2_tpu.scheduler.networktopology.store import (
     NetworkTopologyConfig,
     NetworkTopologyStore,
     Probe,
 )
 
-__all__ = ["NetworkTopologyConfig", "NetworkTopologyStore", "Probe"]
+__all__ = ["NetworkTopologyConfig", "NetworkTopologyStore", "Probe",
+           "ReplicaSyncer"]

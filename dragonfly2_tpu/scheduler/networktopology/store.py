@@ -66,6 +66,16 @@ class _Edge:
         self.average_rtt: float = 0.0
         self.created_at = time.time()
         self.updated_at = time.time()
+        # LOCAL arrival stamp (this replica's MONOTONIC clock), distinct
+        # from ``updated_at`` (the probing HOST's created_at, kept for
+        # the snapshot schema): anti-entropy watermarks must compare
+        # local time against local time, or a probe created before a
+        # sync tick but delivered after it (in-flight SyncProbes, host
+        # clock skew) would sort below the watermark and never
+        # replicate. Monotonic, not wall-clock, so an NTP step cannot
+        # hide a window either; the store's ``epoch`` token lets peers
+        # detect the monotonic-clock reset a process restart causes.
+        self.seen_at = time.monotonic()
 
     def enqueue(self, probe: Probe) -> None:
         self.queue.append(probe)  # deque(maxlen) evicts the oldest
@@ -79,6 +89,7 @@ class _Edge:
                 avg = avg * MOVING_AVERAGE_WEIGHT + p.rtt * (1 - MOVING_AVERAGE_WEIGHT)
         self.average_rtt = avg
         self.updated_at = probe.created_at
+        self.seen_at = time.monotonic()
 
 
 class NetworkTopologyStore:
@@ -92,6 +103,11 @@ class NetworkTopologyStore:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Identifies THIS store instance's monotonic clock: anti-entropy
+        # deltas carry it so a peer can detect a restart (monotonic time
+        # restarts near zero) and reset its watermark instead of
+        # filtering everything new below a stale high-water mark.
+        self.epoch = uuid.uuid4().hex
 
     # -- adjacency ------------------------------------------------------------
 
@@ -279,6 +295,95 @@ class NetworkTopologyStore:
                 self._probed_count[host_id] = max(
                     self._probed_count.get(host_id, 0), count)
         return imported
+
+    # -- replica anti-entropy (cross-replica probe sharing) --------------------
+
+    def export_delta(self, since: float) -> dict:
+        """Probe-window delta: edges that ARRIVED here after ``since``
+        (full queues — a queue is 5 probes, so shipping it whole is
+        cheaper than probe-level bookkeeping) plus the probed-count map.
+        This is what one replica pushes to another on the anti-entropy
+        tick, standing in for the reference's shared Redis probe lists
+        (probes.go:115-186): with sharing, a replica dying mid-window
+        loses at most one tick of probes instead of the whole window.
+
+        The filter runs on ``seen_at`` — this replica's MONOTONIC
+        arrival clock — never on the host-supplied probe timestamps: a
+        probe created before a tick but DELIVERED after it must still
+        ship on the next tick, or the one-tick-loss bound silently
+        breaks for in-flight probes and skewed host clocks (and, with a
+        wall clock, for NTP steps). ``exported_at`` is the matching
+        monotonic watermark a peer hands back as its next ``since``;
+        ``epoch`` identifies this clock so a restart (monotonic resets
+        to ~0) makes peers discard their watermark rather than filter
+        against a stale high-water mark."""
+        with self._lock:
+            return {
+                "version": 1,
+                "epoch": self.epoch,
+                "exported_at": time.monotonic(),
+                "probed_count": dict(self._probed_count),
+                "edges": [
+                    {
+                        "src": src, "dst": dst,
+                        "updated_at": edge.updated_at,
+                        "created_at": edge.created_at,
+                        "probes": [
+                            {"host_id": p.host_id, "rtt": p.rtt,
+                             "created_at": p.created_at}
+                            for p in edge.queue
+                        ],
+                    }
+                    for (src, dst), edge in self._edges.items()
+                    if edge.seen_at > since
+                ],
+            }
+
+    def merge_delta(self, blob: dict) -> int:
+        """Merge a peer replica's delta: per edge, union local and remote
+        probes by (created_at, rtt), keep the newest ``queue_length``, and
+        rebuild the queue in arrival order so the EWMA recurrence sees the
+        merged history exactly as a single replica would have. Probed
+        counts merge by max (each replica's count already includes what
+        it merged before — max, not sum, keeps the merge idempotent).
+        Returns the number of PROBES actually added — the same unit the
+        direct SyncProbes ingest path counts, so the probes_stored
+        metric stays comparable across both."""
+        added = 0
+        with self._lock:
+            for e in blob.get("edges", []):
+                key = (e["src"], e["dst"])
+                remote = [Probe(host_id=p["host_id"], rtt=p["rtt"],
+                                created_at=p["created_at"])
+                          for p in e.get("probes", [])]
+                local = self._edges.get(key)
+                if local is None:
+                    merged_probes = remote
+                    fresh_count = len(remote)
+                else:
+                    seen = {(p.created_at, p.rtt) for p in local.queue}
+                    fresh = [p for p in remote
+                             if (p.created_at, p.rtt) not in seen]
+                    if not fresh:
+                        continue
+                    merged_probes = list(local.queue) + fresh
+                    fresh_count = len(fresh)
+                merged_probes.sort(key=lambda p: p.created_at)
+                merged_probes = merged_probes[-self.config.probe_queue_length:]
+                edge = _Edge(self.config.probe_queue_length)
+                for p in merged_probes:
+                    edge.enqueue(p)
+                if local is not None:
+                    edge.created_at = min(local.created_at,
+                                          e.get("created_at", local.created_at))
+                else:
+                    edge.created_at = e.get("created_at", edge.created_at)
+                self._edges[key] = edge
+                added += fresh_count
+            for host_id, count in blob.get("probed_count", {}).items():
+                self._probed_count[host_id] = max(
+                    self._probed_count.get(host_id, 0), count)
+        return added
 
     # -- background collection ------------------------------------------------
 

@@ -276,6 +276,23 @@ class WireProbeFinished:
     results: List[WireProbeResult] = field(default_factory=list)
 
 
+@message("scheduler.ReplicaProbeDelta")
+@dataclass
+class ReplicaProbeDelta:
+    """Anti-entropy exchange between scheduler replicas: the caller's
+    probe-window delta rides the request, the callee's rides the reply.
+    ``since`` is the caller's last-merged watermark for this peer."""
+
+    since: float = 0.0
+    delta: dict = field(default_factory=dict)
+
+
+@message("scheduler.ReplicaProbeDeltaReply")
+@dataclass
+class ReplicaProbeDeltaReply:
+    delta: dict = field(default_factory=dict)
+
+
 @message("scheduler.HostListResponse")
 @dataclass
 class HostListResponse:
@@ -295,6 +312,7 @@ SCHEDULER_SPEC = ServiceSpec(
         "ListHosts": MethodKind.UNARY_UNARY,
         "AnnouncePeer": MethodKind.STREAM_STREAM,
         "SyncProbes": MethodKind.STREAM_STREAM,
+        "SyncReplicaProbes": MethodKind.UNARY_UNARY,
     },
 )
 
@@ -358,6 +376,12 @@ class SchedulerRpcService:
 
     def ListHosts(self, request: Empty, context) -> HostListResponse:  # noqa: N802
         return HostListResponse(hosts=self.service.list_host_snapshot())
+
+    def SyncReplicaProbes(self, request: ReplicaProbeDelta,  # noqa: N802
+                          context) -> ReplicaProbeDeltaReply:
+        delta = self._guard(context, self.service.sync_replica_probes,
+                            request.delta, request.since)
+        return ReplicaProbeDeltaReply(delta=delta)
 
     @staticmethod
     def _guard(context, fn, *args):
@@ -558,6 +582,12 @@ class GrpcSchedulerClient:
 
     def leave_peer(self, peer_id: str) -> None:
         self._client.LeavePeer(PeerID(peer_id), timeout=10)
+
+    def sync_replica_probes(self, delta: dict, since: float = 0.0) -> dict:
+        """Anti-entropy exchange: push our probe delta, pull the peer's."""
+        reply = self._client.SyncReplicaProbes(
+            ReplicaProbeDelta(since=since, delta=delta), timeout=10)
+        return reply.delta
 
     def stat_task(self, task_id: str) -> StatTaskResponse:
         return self._client.StatTask(TaskID(task_id), timeout=10)

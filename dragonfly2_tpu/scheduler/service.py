@@ -463,6 +463,23 @@ class SchedulerService:
         for result in results:
             logger.debug("probe %s -> %s failed", host_id, result.dest_host_id)
 
+    def sync_replica_probes(self, delta: dict, since: float) -> dict:
+        """Anti-entropy exchange with a peer scheduler replica: merge the
+        caller's probe-window delta, answer with ours since the caller's
+        watermark. Replaces the reference's shared-Redis probe state
+        (probes.go:115-186) with symmetric push-pull — either side's tick
+        converges both. The reply may echo an edge the caller itself
+        just pushed (merging stamps it newly-seen here); that costs one
+        deduped round trip and is deliberate — excluding pushed edges
+        from the reply would also drop THIS replica's own probes on
+        shared edges while the caller advances its watermark past them,
+        losing them permanently."""
+        if self.network_topology is None:
+            raise ServiceError(FAILED_PRECONDITION, "network topology disabled")
+        if delta:
+            self.network_topology.merge_delta(delta)
+        return self.network_topology.export_delta(since)
+
     # ------------------------------------------------------------------
     # Dataset sink (service_v1.go:1418 createDownloadRecord)
     # ------------------------------------------------------------------
