@@ -47,6 +47,10 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
                         help="write JSONL span traces here (rotated); "
                              "trace ids propagate across services via "
                              "gRPC metadata (default: tracing off)")
+    parser.add_argument("--otlp-endpoint", default="",
+                        help="export spans to this OTLP/HTTP collector "
+                             "base URL, e.g. http://collector:4318 — the "
+                             "reference's --jaeger role (default: off)")
     parser.add_argument("--pprof-port", type=int, default=-1,
                         help="debug monitor on this port (/debug/threads, "
                              "/debug/profile?seconds=N, /debug/vars — the "
@@ -55,12 +59,15 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
 
 
 def init_tracing(args, service_name: str) -> None:
-    """Install the process-wide tracer when --trace-dir was given (the
-    reference's jaeger bootstrap, cmd/dependency/dependency.go:263-295)."""
-    if getattr(args, "trace_dir", ""):
+    """Install the process-wide tracer when --trace-dir or
+    --otlp-endpoint was given (the reference's jaeger bootstrap,
+    cmd/dependency/dependency.go:263-295)."""
+    if getattr(args, "trace_dir", "") or getattr(args, "otlp_endpoint", ""):
         from dragonfly2_tpu.utils.tracing import Tracer, set_default_tracer
 
-        set_default_tracer(Tracer(service_name, out_dir=args.trace_dir))
+        set_default_tracer(Tracer(
+            service_name, out_dir=args.trace_dir,
+            otlp_endpoint=getattr(args, "otlp_endpoint", "")))
 
 
 def parse_with_config(parser: argparse.ArgumentParser, argv=None):

@@ -59,12 +59,16 @@ def extract_metadata(invocation_metadata) -> Optional[Tuple[str, str]]:
 
 
 class Tracer:
-    """Per-service span recorder with rotated JSONL output."""
+    """Per-service span recorder: rotated JSONL locally, and — when
+    ``otlp_endpoint`` is set — OTLP/HTTP export to a collector, the role
+    the reference's Jaeger exporter plays (dependency.go:263-295).
+    Export is off by default and never blocks or fails a span."""
 
     def __init__(self, service: str, out_dir: str = "",
-                 max_bytes: int = 32 * 1024 * 1024, backups: int = 2):
+                 max_bytes: int = 32 * 1024 * 1024, backups: int = 2,
+                 otlp_endpoint: str = ""):
         self.service = service
-        self.enabled = bool(out_dir)
+        self.enabled = bool(out_dir) or bool(otlp_endpoint)
         self._lock = threading.Lock()
         self._path = (os.path.join(out_dir, f"trace-{service}.jsonl")
                       if out_dir else "")
@@ -72,6 +76,11 @@ class Tracer:
         self.backups = backups
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
+        self._otlp = None
+        if otlp_endpoint:
+            from dragonfly2_tpu.utils.otlp import OTLPSpanExporter
+
+            self._otlp = OTLPSpanExporter(otlp_endpoint, service)
 
     @contextlib.contextmanager
     def span(self, name: str, *, remote_parent: Tuple[str, str] | None = None,
@@ -106,6 +115,10 @@ class Tracer:
             self._write(record)
 
     def _write(self, record: dict) -> None:
+        if self._otlp is not None:
+            self._otlp.enqueue(record)
+        if not self._path:
+            return
         line = json.dumps(record, separators=(",", ":")) + "\n"
         with self._lock:
             try:
@@ -116,6 +129,15 @@ class Tracer:
                     f.write(line)
             except OSError:
                 pass  # tracing must never take the service down
+
+    def flush(self) -> None:
+        """Push any queued OTLP spans out now (shutdown / tests)."""
+        if self._otlp is not None:
+            self._otlp.flush()
+
+    def close(self) -> None:
+        if self._otlp is not None:
+            self._otlp.close()
 
     def _rotate(self) -> None:
         for i in range(self.backups - 1, 0, -1):

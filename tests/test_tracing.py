@@ -103,3 +103,169 @@ class TestCrossProcessPropagation:
 
     def test_default_tracer_off_by_default(self):
         assert default_tracer().enabled is False
+
+
+class _FakeCollector:
+    """Minimal OTLP/HTTP trace collector: accepts POST /v1/traces and
+    records the decoded ExportTraceServiceRequest bodies."""
+
+    def __init__(self):
+        import http.server
+        import threading
+
+        collector = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                collector.requests.append({
+                    "path": self.path,
+                    "content_type": self.headers["Content-Type"],
+                    "body": json.loads(body),
+                })
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        self.requests = []
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.endpoint = f"http://127.0.0.1:{self.server.server_port}"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def spans(self):
+        out = []
+        for req in self.requests:
+            for rs in req["body"]["resourceSpans"]:
+                for ss in rs["scopeSpans"]:
+                    out.extend(ss["spans"])
+        return out
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestOTLPExport:
+    """Verdict r5 item 9: spans leave the box over OTLP/HTTP like the
+    reference's Jaeger path (dependency.go:263-295) — off by default,
+    JSON encoding (proto3 mapping), best-effort delivery."""
+
+    def test_spans_reach_collector_with_otlp_shape(self):
+        collector = _FakeCollector()
+        try:
+            t = Tracer("scheduler", otlp_endpoint=collector.endpoint)
+            assert t.enabled
+            with t.span("schedule", peer_id="p1", retries=2):
+                pass
+            try:
+                with t.span("boom"):
+                    raise ValueError("x")
+            except ValueError:
+                pass
+            t.flush()
+            assert collector.requests[0]["path"] == "/v1/traces"
+            assert collector.requests[0]["content_type"] == "application/json"
+            resource = collector.requests[0]["body"]["resourceSpans"][0]
+            assert resource["resource"]["attributes"][0] == {
+                "key": "service.name",
+                "value": {"stringValue": "scheduler"}}
+            by_name = {s["name"]: s for s in collector.spans()}
+            span = by_name["schedule"]
+            # W3C widths: 16-byte trace id, 8-byte span id, hex.
+            assert len(span["traceId"]) == 32
+            assert len(span["spanId"]) == 16
+            assert int(span["endTimeUnixNano"]) >= int(
+                span["startTimeUnixNano"])
+            attrs = {a["key"]: a["value"] for a in span["attributes"]}
+            assert attrs["peer_id"] == {"stringValue": "p1"}
+            assert attrs["retries"] == {"intValue": "2"}
+            assert span["status"] == {"code": 1}
+            assert by_name["boom"]["status"]["code"] == 2
+            t.close()
+        finally:
+            collector.close()
+
+    def test_parent_chain_survives_export(self):
+        collector = _FakeCollector()
+        try:
+            t = Tracer("svc", otlp_endpoint=collector.endpoint)
+            with t.span("outer"):
+                with t.span("inner"):
+                    pass
+            t.flush()
+            by_name = {s["name"]: s for s in collector.spans()}
+            assert by_name["inner"]["parentSpanId"] == \
+                by_name["outer"]["spanId"]
+            assert by_name["inner"]["traceId"] == by_name["outer"]["traceId"]
+            assert "parentSpanId" not in by_name["outer"]
+            t.close()
+        finally:
+            collector.close()
+
+    def test_spans_flush_at_process_exit_without_explicit_flush(self):
+        """A short-lived CLI must not lose its spans: the exporter's
+        atexit hook drains the queue when the interpreter exits, even
+        though nothing called flush()/close()."""
+        import subprocess
+        import sys
+        import time
+
+        collector = _FakeCollector()
+        try:
+            code = (
+                "import sys; sys.path.insert(0, %r)\n"
+                "from dragonfly2_tpu.utils.tracing import Tracer\n"
+                "t = Tracer('cli', otlp_endpoint=%r)\n"
+                "with t.span('one-shot'):\n"
+                "    pass\n"
+                # exit immediately — faster than any flush interval
+            ) % (str(__import__('pathlib').Path(__file__).parent.parent),
+                 collector.endpoint)
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=60)
+            assert proc.returncode == 0, proc.stderr
+            deadline = time.monotonic() + 5
+            while not collector.requests and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert [s["name"] for s in collector.spans()] == ["one-shot"]
+        finally:
+            collector.close()
+
+    def test_close_drains_more_than_one_batch(self):
+        """Shutdown must deliver EVERYTHING queued, not just the first
+        max_batch-sized POST."""
+        from dragonfly2_tpu.utils.otlp import OTLPSpanExporter
+
+        collector = _FakeCollector()
+        try:
+            exporter = OTLPSpanExporter(collector.endpoint, "svc",
+                                        flush_interval=30.0, max_batch=64)
+            for i in range(300):
+                exporter.enqueue({"trace_id": "t", "span_id": f"{i}",
+                                  "name": f"s{i}", "start": 0.0,
+                                  "duration_ms": 0.1})
+            exporter.close()
+            assert len(collector.spans()) == 300
+            assert exporter.exported == 300
+        finally:
+            collector.close()
+
+    def test_dead_collector_never_blocks_spans(self, tmp_path):
+        # Port 1 refuses connections instantly; spans must still land in
+        # the local JSONL and the span context manager must not raise.
+        t = Tracer("svc", out_dir=str(tmp_path),
+                   otlp_endpoint="http://127.0.0.1:1")
+        with t.span("survives"):
+            pass
+        t.flush()
+        assert t._otlp.dropped >= 1
+        spans = read_spans(tmp_path / "trace-svc.jsonl")
+        assert spans[0]["name"] == "survives"
+        t.close()
