@@ -49,6 +49,10 @@ import time
 
 TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP = 100_000.0
 TARGET_P50_MS = 1.0
+# Round-5 latency budget (verdict item 6): colocated parent-selection p99
+# under 8 scheduler threads must stay under 2 ms on the CPU device — the
+# micro-batcher owes a tail bound, not just an idle p50.
+COLOCATED_P99_TARGET_MS = 2.0
 
 # Total wall budget. The driver's observed kill horizon is >240 s; leave
 # margin so the watchdog always wins the race against SIGKILL.
@@ -221,23 +225,42 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
             TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
     )
 
-    # (b) colocated: 8 concurrent scheduler threads → MicroBatcher → one
+    # (b) colocated: concurrent scheduler threads → MicroBatcher → one
     # padded dispatch per in-flight window. parent_select_colocated_*
-    # fields are the deliverable named by the round-3 verdict.
-    colo_secs = max(min(scorer_budget - (time.perf_counter() - scorer_t0),
-                        6.0), 1.0)
-    colo = measure_colocated(scorer, threads=8, rows_per_request=16,
-                             duration_s=colo_secs,
-                             dispatch_floor_ms=floor_p50)
-    state.record(
-        parent_select_colocated_p50_ms=colo["p50_ms"],
-        parent_select_colocated_p99_ms=colo["p99_ms"],
-        parent_select_colocated_p50_floor_corrected_ms=colo[
-            "p50_floor_corrected_ms"],
-        parent_select_colocated_requests_per_sec=colo["requests_per_sec"],
-        parent_select_colocated_coalesce_factor=colo["coalesce_factor"],
-        parent_select_colocated_threads=colo["threads"],
-    )
+    # fields are the deliverable named by the round-3 verdict; the
+    # 8/32/128-thread ladder and the explicit p99 budget are round 5's
+    # (verdict item 6) — p99 must hold under load, not just p50 when
+    # idle. Target: p99 < 2 ms CPU-colocated at 8 threads (BASELINE.md).
+    colo_secs = max(min((scorer_budget
+                         - (time.perf_counter() - scorer_t0)) / 3, 4.0), 1.0)
+    load_ladder = {}
+    for n_threads in (8, 32, 128):
+        colo = measure_colocated(scorer, threads=n_threads,
+                                 rows_per_request=16,
+                                 duration_s=colo_secs,
+                                 dispatch_floor_ms=floor_p50)
+        load_ladder[n_threads] = colo
+        if n_threads == 8:
+            state.record(
+                parent_select_colocated_p50_ms=colo["p50_ms"],
+                parent_select_colocated_p95_ms=colo["p95_ms"],
+                parent_select_colocated_p99_ms=colo["p99_ms"],
+                parent_select_colocated_p50_floor_corrected_ms=colo[
+                    "p50_floor_corrected_ms"],
+                parent_select_colocated_requests_per_sec=colo[
+                    "requests_per_sec"],
+                parent_select_colocated_coalesce_factor=colo[
+                    "coalesce_factor"],
+                parent_select_colocated_threads=colo["threads"],
+                parent_select_colocated_p99_target_ms=COLOCATED_P99_TARGET_MS,
+                parent_select_colocated_p99_vs_target=round(
+                    COLOCATED_P99_TARGET_MS / max(colo["p99_ms"], 1e-9), 3),
+            )
+    state.record(parent_select_colocated_load_ladder={
+        str(k): {f: v[f] for f in ("p50_ms", "p95_ms", "p99_ms",
+                                   "requests_per_sec", "coalesce_factor",
+                                   "requests")}
+        for k, v in load_ladder.items()})
     state.stage_done("scorer")
 
     # Stage 2 (headline): GraphSAGE on a probe graph. The step loop gets

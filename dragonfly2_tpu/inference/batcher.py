@@ -9,15 +9,22 @@ concurrent requests share a single round trip — the worst-case extra
 latency is one in-flight dispatch, and throughput scales to
 ``max_batch`` rows per dispatch.
 
-No timer: the worker blocks for the first request, then drains whatever
+Batch close is deadline-aware: by default (``max_wait_s=0``) the worker
+never waits — it blocks for the first request, then drains whatever
 queued while the previous dispatch ran (natural batching under load,
-zero added latency when idle).
+zero added latency when idle). A positive ``max_wait_s`` lets the worker
+hold the batch open up to that long for stragglers — a throughput knob
+for remote/tunneled devices where dispatches are expensive — but the
+deadline is firm, so the knob bounds queueing delay instead of trading
+it away: worst-case added latency is ``max_wait_s`` plus one in-flight
+dispatch, never "until the batch fills".
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -36,9 +43,11 @@ class _Pending:
 class MicroBatcher:
     """Thread-safe coalescing front for a :class:`ParentScorer`."""
 
-    def __init__(self, scorer, max_rows: Optional[int] = None):
+    def __init__(self, scorer, max_rows: Optional[int] = None,
+                 max_wait_s: float = 0.0):
         self.scorer = scorer
         self.max_rows = max_rows or scorer.max_batch
+        self.max_wait_s = max_wait_s
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._closed = False
         self._close_lock = threading.Lock()
@@ -92,9 +101,21 @@ class MicroBatcher:
             rows = len(first.features)
             saw_sentinel = False
             # Drain whatever is already queued, up to the device batch.
+            # With max_wait_s > 0, also hold the batch open for
+            # stragglers until the deadline — measured from the FIRST
+            # request, so its queueing delay is bounded by max_wait_s
+            # regardless of how many stragglers trickle in.
+            deadline = (time.monotonic() + self.max_wait_s
+                        if self.max_wait_s > 0 else 0.0)
             while rows < self.max_rows:
                 try:
-                    nxt = self._queue.get_nowait()
+                    if deadline:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        nxt = self._queue.get(timeout=remaining)
+                    else:
+                        nxt = self._queue.get_nowait()
                 except queue.Empty:
                     break
                 if nxt is None:

@@ -89,12 +89,14 @@ def measure_colocated(
     coalesce = (batcher.coalesced_requests / batcher.dispatches
                 if batcher.dispatches else 0.0)
     p50 = _percentile(merged, 0.50)
+    p95 = _percentile(merged, 0.95)
     p99 = _percentile(merged, 0.99)
     return {
         "threads": threads,
         "requests": n,
         "requests_per_sec": round(n / wall, 1) if wall > 0 else 0.0,
         "p50_ms": round(p50, 4),
+        "p95_ms": round(p95, 4),
         "p99_ms": round(p99, 4),
         "p50_floor_corrected_ms": round(max(p50 - dispatch_floor_ms, 0.0), 4),
         "p99_floor_corrected_ms": round(max(p99 - dispatch_floor_ms, 0.0), 4),

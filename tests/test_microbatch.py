@@ -85,6 +85,42 @@ class TestMicroBatcher:
         assert b.score(np.zeros((0, 4), np.float32)).shape == (0,)
         b.close()
 
+    def test_max_wait_holds_batch_open_for_stragglers(self):
+        """max_wait_s > 0: requests arriving within the window share one
+        dispatch even when the device is otherwise idle (the remote-
+        device throughput knob)."""
+        scorer = SlowScorer(delay=0.0)
+        b = MicroBatcher(scorer, max_wait_s=0.2)
+        results: dict = {}
+
+        def call(i, delay):
+            time.sleep(delay)
+            results[i] = b.score(np.full((1, 4), float(i), np.float32))
+
+        threads = [threading.Thread(target=call, args=(i, 0.02 * i))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        b.close()
+        assert scorer.calls == 1, scorer.calls
+        for i in range(4):
+            np.testing.assert_allclose(results[i], [4.0 * i])
+
+    def test_max_wait_deadline_is_firm(self):
+        """The deadline is measured from the FIRST request: a trickle of
+        stragglers cannot hold the batch open past max_wait_s."""
+        scorer = SlowScorer(delay=0.0)
+        b = MicroBatcher(scorer, max_wait_s=0.1)
+        t0 = time.monotonic()
+        b.score(np.zeros((1, 4), np.float32))
+        elapsed = time.monotonic() - t0
+        b.close()
+        # One lone request waits out the window but no longer.
+        assert 0.08 <= elapsed < 1.0, elapsed
+        assert scorer.calls == 1
+
 
 class TestSidecarMicroBatch:
     def test_model_infer_through_batcher(self):
