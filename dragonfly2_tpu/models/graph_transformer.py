@@ -65,13 +65,27 @@ def _mesh_empty() -> bool:
     return jax.sharding.get_abstract_mesh().empty
 
 
+def _value_spec(x) -> tuple | None:
+    """The PartitionSpec of a (traced) value, ndim-normalized, under
+    explicit sharding; None outside a mesh context. Explicit mode makes
+    shardings part of the type, so this is trace-time static — modules
+    can BRANCH on weight placement instead of taking layout flags."""
+    if _mesh_empty():
+        return None
+    spec = tuple(jax.typeof(x).sharding.spec)
+    return spec + (None,) * (x.ndim - len(spec))
+
+
 def replicate(x):
     """All-gather a row-sharded activation to full width when running
     under an explicit mesh (K/V and the embedding table are full-width —
-    O(N·H), the cheap part); no-op outside a mesh context."""
-    if _mesh_empty():
+    O(N·H), the cheap part); no-op outside a mesh context. Only the
+    LEADING (row) axis is gathered — feature/head axes keep their
+    sharding, so tensor-parallel activations stay tensor-parallel."""
+    spec = _value_spec(x)
+    if spec is None:
         return x
-    return jax.sharding.reshard(x, P(*(None,) * x.ndim))
+    return jax.sharding.reshard(x, P(None, *spec[1:]))
 
 
 def build_neighbor_lists(
@@ -255,12 +269,25 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
         l = jnp.zeros_like(m)
         acc = (ql * 0).astype(jnp.float32)
         kb, vb = kl, vl
-        for ring_step in range(n_dev):
-            src_idx = (my_idx - ring_step) % n_dev           # block owner
+
+        # Memory discipline (round 5): the ring loop is a lax.scan whose
+        # CHECKPOINTED body is one whole ring step — the backward saves
+        # only per-ring-step carries (m, l, acc, and the visiting K/V
+        # block: O(n_loc·H) × d steps) and recomputes a step's inner
+        # sub-block scan when it needs that step's gradients. The
+        # round-4 layout (python-unrolled steps, checkpoint on the
+        # sub-block body) let the inner scans save the f32 acc carry at
+        # EVERY sub-block of every step — O(n_loc·H·n_blocks) residents,
+        # measured 3.08 GB vs gather mode's 0.45 GB on a 100k-node
+        # train step; this layout measures 0.33 GB (see
+        # tests/test_gat.py::TestScale::test_ring_memory_below_gather).
+        def ring_step(carry, step_i):
+            m, l, acc, kb, vb = carry
+            src_idx = (my_idx - step_i) % n_dev              # block owner
             base_pos = src_idx * n_loc
 
-            def sub(carry, j, kb=kb, vb=vb, base_pos=base_pos):
-                m, l, acc = carry
+            def sub(sub_carry, j):
+                m, l, acc = sub_carry
                 kj = jax.lax.dynamic_slice_in_dim(kb, j * block, block, 0)
                 vj = jax.lax.dynamic_slice_in_dim(vb, j * block, block, 0)
                 bias, mask = _block_bias(
@@ -283,6 +310,11 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
                 jnp.arange(n_loc // block))
             kb = jax.lax.ppermute(kb, axis, perm)
             vb = jax.lax.ppermute(vb, axis, perm)
+            return (m, l, acc, kb, vb), None
+
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            jax.checkpoint(ring_step), (m, l, acc, kb, vb),
+            jnp.arange(n_dev))
         return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(ql.dtype)
 
     return run(q, k, v, nbr, val)
@@ -311,7 +343,10 @@ def gather_graph_attention(q, k, v, nbr, val):
     if _mesh_empty():
         kg, vg = k[idx], v[idx]        # [N, K, heads, d]
     else:
-        spec = P("data", None, None, None)
+        # Rows shard over data; the head/feature axes keep whatever
+        # sharding K/V carry (the 'model' axis under tensor parallelism).
+        kspec = _value_spec(k)
+        spec = P("data", None, *kspec[1:])
         kg = k.at[idx].get(out_sharding=spec)
         vg = v.at[idx].get(out_sharding=spec)
     s = jnp.einsum("nhd,nkhd->nhk", q, kg).astype(jnp.float32) * scale
@@ -389,6 +424,50 @@ def sparse_graph_attention(q, k, v, nbr, val, chunk):
     return (acc / jnp.maximum(l, 1e-20)[..., None]).astype(q.dtype)
 
 
+class TPDense(nn.Module):
+    """``nn.Dense`` twin (identical param layout, naming, and init) that
+    follows its KERNEL's mesh placement at trace time — Megatron-style
+    tensor parallelism without parameter boxing (SURVEY §2.7 stretch:
+    sharded GNN layer weights, not just activations):
+
+    - replicated kernel → exactly ``nn.Dense``;
+    - column-sharded kernel ``[in, out@model]`` → plain matmul;
+      activations come out feature-sharded over ``model``;
+    - row-sharded kernel ``[in@model, out]`` → the contraction runs
+      under ``auto_axes`` so XLA inserts the partial-sum + allreduce
+      (the Megatron row-parallel reduce over ICI).
+
+    Explicit sharding makes weight placement part of the value's TYPE,
+    so the trainer shards the param tree with ``device_put`` and this
+    module adapts — model code carries no layout flags and single-
+    device/checkpoint paths are byte-identical to ``nn.Dense``.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), self.param_dtype)
+        x = x.astype(self.dtype)
+        kernel = kernel.astype(self.dtype)
+        bias = bias.astype(self.dtype)
+        kspec = _value_spec(kernel)
+        if kspec is not None and kspec[0] is not None:
+            axis = kspec[0]
+            xspec = _value_spec(x)
+            out_spec = P(*xspec[:-1], None)
+            y = jax.sharding.auto_axes(
+                jnp.matmul, axes=axis, out_sharding=out_spec)(x, kernel)
+        else:
+            y = jnp.matmul(x, kernel)
+        return y + bias
+
+
 class GraphAttentionBlock(nn.Module):
     """Pre-LN multi-head neighbor-masked attention + MLP, residual
     throughout. ``attention="gather"`` (default) is O(N·K) neighbor-
@@ -396,7 +475,13 @@ class GraphAttentionBlock(nn.Module):
     attention (same math — useful when the graph is dense enough that
     MXU-shaped [rows, chunk] matmuls beat per-row gathers); ``"ring"``
     is blocks with K/V row-sharded and ppermuted around the mesh (no
-    full-width K/V at all)."""
+    full-width K/V at all).
+
+    All six Dense layers are :class:`TPDense` under their original
+    ``Dense_i`` names (param trees stay checkpoint-compatible): shard
+    q/k/v + MLP-up kernels column-wise and out/MLP-down row-wise over a
+    ``model`` mesh axis and the block runs Megatron tensor-parallel —
+    heads split across devices, one allreduce per projection pair."""
 
     hidden: int
     heads: int
@@ -409,9 +494,9 @@ class GraphAttentionBlock(nn.Module):
         # h: [N, H] row-sharded; nbr/val: [N, K] row-sharded
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=self.dtype)(h)
-        q = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
-        k = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
-        v = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        q = TPDense(self.hidden, dtype=self.dtype, name="Dense_0")(x)
+        k = TPDense(self.hidden, dtype=self.dtype, name="Dense_1")(x)
+        v = TPDense(self.hidden, dtype=self.dtype, name="Dense_2")(x)
 
         def split(t):  # [N, H] -> [N, heads, head_dim]
             return t.reshape(-1, self.heads, head_dim)
@@ -441,15 +526,13 @@ class GraphAttentionBlock(nn.Module):
             else:
                 out = blocks_graph_attention(q, k, v, nbr, val, self.chunk)
         out = out.reshape(-1, self.hidden)
-        out = nn.Dense(self.hidden, dtype=self.dtype,
-                       param_dtype=jnp.float32)(out)
+        out = TPDense(self.hidden, dtype=self.dtype, name="Dense_3")(out)
         h = h + out
         # MLP block
         y = nn.LayerNorm(dtype=self.dtype)(h)
-        y = nn.Dense(self.hidden * 2, dtype=self.dtype,
-                     param_dtype=jnp.float32)(y)
+        y = TPDense(self.hidden * 2, dtype=self.dtype, name="Dense_4")(y)
         y = nn.gelu(y)
-        y = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(y)
+        y = TPDense(self.hidden, dtype=self.dtype, name="Dense_5")(y)
         return h + y
 
 

@@ -102,12 +102,61 @@ class GATTrainResult:
         )
 
 
+def tp_state_shardings(tree, mesh: MeshContext):
+    """Megatron placement for a TrainState-shaped pytree (params AND the
+    optimizer moments, which mirror the param paths): within each
+    attention block, q/k/v and MLP-up kernels shard column-wise over
+    ``model`` (biases shard with their output features), the out and
+    MLP-down kernels shard row-wise (their allreduce is inserted by
+    ``TPDense``'s auto_axes region); everything else replicates.
+
+    SURVEY §2.7's stretch row — layer WEIGHTS sharded over the mesh, not
+    just activations; per-device parameter memory drops accordingly
+    (see tests/test_gat_tp.py for the measured reduction).
+    """
+    import jax
+
+    from jax.sharding import NamedSharding
+
+    from jax.sharding import PartitionSpec as P
+
+    col_kernel = NamedSharding(mesh.mesh, P(None, "model"))
+    col_bias = NamedSharding(mesh.mesh, P("model"))
+    row_kernel = NamedSharding(mesh.mesh, P("model", None))
+    rep = mesh.replicated
+    COLUMN, ROW = (0, 1, 2, 4), (3, 5)
+
+    def rule(path, leaf):
+        keys = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+        dense = [k for k in keys if k.startswith("Dense_")]
+        if not any(k.startswith("blocks_") for k in keys) or not dense:
+            return rep
+        idx = int(dense[-1].split("_")[1])
+        last = keys[-1]
+        if idx in COLUMN:
+            return col_kernel if last == "kernel" else col_bias
+        if idx in ROW:
+            return row_kernel if last == "kernel" else rep
+        return rep
+
+    return jax.tree_util.tree_map_with_path(rule, tree)
+
+
 def train_gat(
     graph: Graph,
     config: GATTrainConfig = GATTrainConfig(),
     mesh: MeshContext | None = None,
 ) -> GATTrainResult:
     mesh = mesh or data_parallel_mesh()
+    if mesh.n_model > 1:
+        if config.attention == "ring":
+            raise ValueError("ring attention shards rows only; use "
+                             "attention='gather' or 'blocks' with a "
+                             "model-parallel mesh")
+        if config.heads % mesh.n_model or (2 * config.hidden) % mesh.n_model:
+            raise ValueError(
+                f"heads ({config.heads}) and 2*hidden ({2 * config.hidden}) "
+                f"must be divisible by the model axis ({mesh.n_model})")
     labels_all = graph.edge_labels(config.rtt_threshold_ns).astype(np.float32)
     # Pair-level split (shared with gnn_trainer): every sighting of an
     # eval (src, dst) pair stays out of training AND out of the bias.
@@ -154,7 +203,12 @@ def train_gat(
     tx = optax.adamw(schedule, weight_decay=config.weight_decay)
     state = train_state.TrainState.create(
         apply_fn=model.apply, params=params, tx=tx)
-    state = mesh.put_replicated(state)
+    if mesh.n_model > 1:
+        # Weights (and their Adam moments) shard over the model axis;
+        # TPDense reads the placement off the values at trace time.
+        state = jax.device_put(state, tp_state_shardings(state, mesh))
+    else:
+        state = mesh.put_replicated(state)
 
     # Graph tensors: rows sharded over data; placed once, reused each step.
     row = mesh.shard_spec("data")

@@ -278,6 +278,17 @@ class TestTraining:
         assert logits[labels == 1].mean() > logits[labels == 0].mean()
 
 
+def _graph_100k(n_edges=400_000, cap=32):
+    rng = np.random.default_rng(0)
+    n_nodes, feat_dim = 100_000, 8
+    src = rng.integers(0, n_nodes, n_edges)
+    dst = rng.integers(0, n_nodes, n_edges)
+    rtt = rng.integers(1_000_000, 50_000_000, n_edges)
+    feats = rng.standard_normal((n_nodes, feat_dim)).astype(np.float32)
+    nbr, val = build_neighbor_lists(n_nodes, src, dst, rtt, cap=cap)
+    return n_nodes, feats, nbr, val, src, dst, rtt
+
+
 class TestScale:
     def test_100k_node_train_step(self):
         """The round-4 scale mandate: a 100k-node full-topology graph —
@@ -331,3 +342,110 @@ class TestScale:
             assert np.isfinite(float(loss))
             flat = jax.tree.leaves(grads)
             assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+    def test_ring_memory_below_gather_at_100k(self):
+        """Round-5 verdict item 5: ring mode's POINT is memory scaling —
+        measure it. The full train step (fwd+grad) for a 100k-node,
+        3.2M-edge graph is lowered and compiled in both modes on the
+        8-device mesh and the compiled executable's per-device temp
+        memory compared: ring must come in materially below gather —
+        both with gradients and on the forward (serving/embedding) path.
+
+        Measured at this commit (XLA CPU, hidden=64, heads=4, cap=64,
+        ring chunk=128): grad 628 MB vs 1105 MB; forward 103 MB vs
+        442 MB. Execution at 100k is compile-checked only: ring scores
+        all N key columns by design — O(N²) FLOPs that are MXU work on
+        TPU but ~20 min on the CPU harness; executed ring training is
+        covered at 16k nodes (test below) and in the multichip dryrun.
+        """
+        import jax.numpy as jnp
+        import optax
+
+        mesh = data_parallel_mesh()
+        n_nodes, feats, nbr, val, src, dst, rtt = _graph_100k(
+            n_edges=3_200_000, cap=64)
+        row = mesh.shard_spec("data")
+        rep = mesh.replicated
+
+        def compiled_temp_mb(attention, chunk, grad):
+            if attention == "ring":
+                per_device = -(-n_nodes // mesh.n_data)
+                multiple = (mesh.n_data * chunk
+                            if per_device > chunk else mesh.n_data)
+            else:
+                multiple = mesh.n_data
+            f, nb, vl, _ = pad_graph_sparse(feats, nbr, val, multiple)
+            model = GraphTransformer(hidden=64, embed=16, layers=1,
+                                     heads=4, chunk=chunk,
+                                     attention=attention)
+            with jax.set_mesh(mesh.mesh):
+                g = (jax.device_put(f, row), jax.device_put(nb, row),
+                     jax.device_put(vl, row))
+                # Init on a tiny same-width graph — params depend on
+                # feature/hidden dims, not node count.
+                tf, tn, tv, _ = pad_graph_sparse(
+                    feats[:1024], nbr[:1024], val[:1024], 8)
+                params = model.init(
+                    jax.random.key(0), tf, tn, tv,
+                    jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
+                es = jax.device_put(src[:1024].astype(np.int32), rep)
+                ed = jax.device_put(dst[:1024].astype(np.int32), rep)
+                y = jax.device_put(
+                    (rtt[:1024] < 20_000_000).astype(np.float32), rep)
+
+                if grad:
+                    def step(params, feat, nbr_, val_, s, d, y):
+                        def loss_fn(p):
+                            logits = model.apply(p, feat, nbr_, val_, s, d)
+                            return optax.sigmoid_binary_cross_entropy(
+                                logits, y).mean()
+                        return jax.value_and_grad(loss_fn)(params)
+
+                    compiled = jax.jit(step).lower(
+                        params, *g, es, ed, y).compile()
+                else:
+                    def fwd(params, feat, nbr_, val_):
+                        return model.apply(
+                            params, feat, nbr_, val_,
+                            method=GraphTransformer.node_embeddings)
+
+                    compiled = jax.jit(fwd).lower(params, *g).compile()
+            return compiled.memory_analysis().temp_size_in_bytes / 1e6
+
+        gather_grad = compiled_temp_mb("gather", 4096, grad=True)
+        ring_grad = compiled_temp_mb("ring", 128, grad=True)
+        gather_fwd = compiled_temp_mb("gather", 4096, grad=False)
+        ring_fwd = compiled_temp_mb("ring", 128, grad=False)
+        print(f"temp MB — grad: ring {ring_grad:.0f} vs gather "
+              f"{gather_grad:.0f}; fwd: ring {ring_fwd:.0f} vs gather "
+              f"{gather_fwd:.0f}")
+        assert ring_grad < 0.75 * gather_grad, (ring_grad, gather_grad)
+        assert ring_fwd < 0.5 * gather_fwd, (ring_fwd, gather_fwd)
+
+    def test_16k_ring_training_executes(self):
+        """Executed ring-mode training at a non-toy size: 16k nodes on
+        the 8-device mesh, loss decreases. (100k ring execution is
+        compile-checked above — O(N²) score FLOPs are prohibitive on
+        the CPU harness, not on the MXU.)"""
+        rng = np.random.default_rng(1)
+        n_nodes, n_edges = 16_384, 60_000
+        from dragonfly2_tpu.data.features import Graph
+
+        src = rng.integers(0, n_nodes, n_edges)
+        dst = rng.integers(0, n_nodes, n_edges)
+        rtt = rng.integers(1_000_000, 50_000_000, n_edges)
+        feats = rng.standard_normal((n_nodes, 8)).astype(np.float32)
+        graph = Graph(
+            node_ids=np.array([f"h{i}" for i in range(n_nodes)]),
+            node_features=feats, edge_src=src.astype(np.int32),
+            edge_dst=dst.astype(np.int32), edge_rtt_ns=rtt)
+        result = train_gat(
+            graph,
+            GATTrainConfig(hidden=8, embed=8, layers=1, heads=2,
+                           epochs=2, edge_batch_size=8192,
+                           eval_fraction=0.1, attention="ring",
+                           chunk=2048),
+            data_parallel_mesh(),
+        )
+        assert np.isfinite(result.history[-1])
+        assert result.history[-1] < result.history[0]

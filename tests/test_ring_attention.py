@@ -115,3 +115,45 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref),
             rtol=5e-2, atol=5e-2)
+
+
+class TestLongContext:
+    """Round-5 verdict item 5: ring attention at T in the tens of
+    thousands — the regime the primitive exists for. The dense [T, T]
+    reference is unbuildable here (a 32k² f32 score matrix is 4.3 GB),
+    which is exactly the point: correctness is spot-checked row-wise
+    against direct per-row attention, and the compiled per-device
+    memory is asserted far below the dense score matrix."""
+
+    T = 32_768
+
+    def test_32k_tokens_causal(self, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        t, heads, hd = self.T, 1, 8
+        q, k, v = _qkv((t, heads, hd), seed=7)
+        spec = NamedSharding(mesh, P("data", None, None))
+        qs, ks, vs = (jax.device_put(a, spec) for a in (q, k, v))
+
+        jitted = jax.jit(lambda *a: ring_attention(
+            *a, mesh=mesh, causal=True))
+        compiled = jitted.lower(qs, ks, vs).compile()
+        temp_mb = compiled.memory_analysis().temp_size_in_bytes / 1e6
+        # Dense causal scores alone would be t*t*4 bytes = 4295 MB.
+        dense_mb = t * t * 4 / 1e6
+        assert temp_mb < dense_mb / 4, (temp_mb, dense_mb)
+
+        out = np.asarray(compiled(qs, ks, vs))
+        assert out.shape == (t, heads, hd)
+        assert np.isfinite(out).all()
+
+        # Spot-check rows against direct causal attention over keys
+        # [0, i] — O(rows · T · d), cheap where the full matrix is not.
+        scale = 1.0 / np.sqrt(hd)
+        for i in (0, 1, 4097, 17_000, t - 1):
+            scores = (k[: i + 1, 0] @ q[i, 0]) * scale
+            p = np.exp(scores - scores.max())
+            p /= p.sum()
+            ref_row = p @ v[: i + 1, 0]
+            np.testing.assert_allclose(out[i, 0], ref_row,
+                                       rtol=2e-3, atol=2e-3)
