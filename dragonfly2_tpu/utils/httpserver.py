@@ -36,7 +36,12 @@ class ThreadedHTTPService:
         self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() handshakes with serve_forever via an event that is
+        # only ever SET by serve_forever exiting — on a server that was
+        # never started it blocks forever (stdlib footgun). Only
+        # handshake when the serve thread actually ran.
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -1,0 +1,35 @@
+"""ThreadedHTTPService lifecycle edge cases."""
+
+from http.server import BaseHTTPRequestHandler
+
+from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *args):
+        pass
+
+
+class TestThreadedHTTPService:
+    def test_stop_without_start_returns(self):
+        """stdlib shutdown() handshakes with serve_forever — calling it
+        on a never-started server blocks forever. Regression: an
+        in-process Daemon that only downloads (never serves uploads)
+        wedged on stop(); stop() must be safe in any lifecycle state."""
+        svc = ThreadedHTTPService(_Handler, name="never-started")
+        svc.stop()  # must return, not deadlock
+
+    def test_start_stop_roundtrip(self):
+        import urllib.request
+
+        svc = ThreadedHTTPService(_Handler, name="roundtrip")
+        svc.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.port}/", timeout=5) as resp:
+            assert resp.status == 200
+        svc.stop()
+        svc.stop()  # idempotent
