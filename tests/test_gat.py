@@ -176,6 +176,21 @@ class TestTraining:
         np.testing.assert_allclose(gather, embed("blocks", 4096),
                                    rtol=6e-2, atol=6e-2)
 
+        # Ragged two-level grouping: 112 rows at chunk=16 → 7 key
+        # blocks, group=2, so the last outer group carries a phantom
+        # block that must be a no-op (cond'd out), not a double-count.
+        f7, nb7, vl7, _ = pad_graph_sparse(graph.node_features, nbr, val,
+                                           112)
+        model7 = GraphTransformer(
+            hidden=result.config.hidden, embed=result.config.embed,
+            layers=result.config.layers, heads=result.config.heads,
+            chunk=16, attention="blocks")
+        blocks7 = np.asarray(model7.apply(
+            result.params, f7, nb7, vl7,
+            method=GraphTransformer.node_embeddings))[:graph.n_nodes]
+        np.testing.assert_allclose(gather[:graph.n_nodes], blocks7,
+                                   rtol=6e-2, atol=6e-2)
+
     def test_ring_matches_gather(self, trained):
         """Ring mode (K/V row-sharded, ppermuted around the mesh) is the
         same math again — and trains end to end."""
@@ -197,12 +212,20 @@ class TestTraining:
                 hidden=result.config.hidden, embed=result.config.embed,
                 layers=result.config.layers, heads=result.config.heads,
                 chunk=chunk, attention=attention)
+
+            # Jit, never eager: op-by-op shard_map collectives abort
+            # intermittently on XLA:CPU (conftest rendezvous note).
+            @jax.jit
+            def run(p, f_, nb_, vl_):
+                return model.apply(
+                    p, f_, nb_, vl_,
+                    method=GraphTransformer.node_embeddings)
+
             with jax.set_mesh(mesh.mesh):
-                return np.asarray(model.apply(
+                return np.asarray(run(
                     result.params,
                     jax.device_put(f, row), jax.device_put(nb, row),
-                    jax.device_put(vl, row),
-                    method=GraphTransformer.node_embeddings))
+                    jax.device_put(vl, row)))
 
         np.testing.assert_allclose(embed("ring"), embed("gather"),
                                    rtol=6e-2, atol=6e-2)
@@ -245,6 +268,38 @@ class TestTraining:
         np.testing.assert_allclose(four.history, one.history,
                                    rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(four.f1, one.f1, rtol=1e-3, atol=1e-3)
+
+    def test_blocks_mode_unsharded_inputs_under_mesh(self):
+        """Regression: chunked (blocks) attention over UNSHARDED inputs
+        inside an ambient mesh — e.g. model.init on a small throwaway
+        graph under jax.set_mesh — used to trip a scan-carry sharding
+        mismatch because the bias scatter force-sharded its rows over
+        'data' regardless of what the operands carried. The scatter now
+        follows the operands' sharding."""
+        import jax.numpy as jnp
+
+        mesh = data_parallel_mesh()
+        cluster = SyntheticCluster(n_hosts=40, seed=5)
+        graph = cluster.probe_graph(1200)
+        nbr, val = build_neighbor_lists(
+            graph.n_nodes, graph.edge_src, graph.edge_dst,
+            graph.edge_rtt_ns)
+        f, nb, vl, _ = pad_graph_sparse(graph.node_features, nbr, val, 16)
+        model = GraphTransformer(hidden=16, embed=8, layers=1, heads=2,
+                                 chunk=16, attention="blocks")
+
+        @jax.jit
+        def run(p, f_, nb_, vl_):
+            return model.apply(p, f_, nb_, vl_,
+                               method=GraphTransformer.node_embeddings)
+
+        with jax.set_mesh(mesh.mesh):
+            # Plain (unsharded) host arrays, mesh ambient.
+            params = model.init(jax.random.key(0), f, nb, vl,
+                                jnp.zeros(2, jnp.int32),
+                                jnp.zeros(2, jnp.int32))
+            emb = run(params, f, nb, vl)
+        assert np.isfinite(np.asarray(emb)).all()
 
     def test_ring_small_graph_large_chunk(self):
         """ADVICE r4 (medium): ring mode where per-device rows fit one
@@ -316,14 +371,24 @@ class TestScale:
                                  chunk=chunk)
         row = mesh.shard_spec("data")
         rep = mesh.replicated
+        # Init outside the mesh on a tiny same-width graph: flax init
+        # executes eagerly, and eager collectives (the gather path's
+        # all-gathers) are intermittently fatal on XLA:CPU's in-process
+        # rendezvous; params depend on dims, not node count.
+        t_feat, t_nbr, t_val, _ = pad_graph_sparse(
+            feats[:1024], nbr[:1024], val[:1024], 8)
+        params = model.init(
+            jax.random.key(0), t_feat, t_nbr, t_val,
+            jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
         with jax.set_mesh(mesh.mesh):
+            # Commit params replicated: the backward's kernel-grad dot
+            # contracts over the data-sharded row axis, and explicit
+            # mode resolves its psum only when the weights carry an
+            # explicit (replicated) sharding.
+            params = jax.device_put(params, rep)
             g_feat = jax.device_put(feats, row)
             g_nbr = jax.device_put(nbr, row)
             g_val = jax.device_put(val, row)
-            params = model.init(
-                jax.random.key(0), g_feat, g_nbr, g_val,
-                jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
-
             e_src = jax.device_put(src[:1024].astype(np.int32), rep)
             e_dst = jax.device_put(dst[:1024].astype(np.int32), rep)
             y = jax.device_put(
@@ -378,16 +443,25 @@ class TestScale:
             model = GraphTransformer(hidden=64, embed=16, layers=1,
                                      heads=4, chunk=chunk,
                                      attention=attention)
+            # Init OUTSIDE the mesh scope on a tiny same-width graph —
+            # params depend on feature/hidden dims, not node count, and
+            # flax init runs EAGERLY: under an ambient mesh the ring
+            # path would execute shard_map ppermutes op-by-op, which
+            # XLA:CPU's in-process collectives abort intermittently
+            # (the conftest-documented rendezvous fragility). Outside
+            # the mesh, init takes the collective-free local fallback.
+            tf, tn, tv, _ = pad_graph_sparse(
+                feats[:1024], nbr[:1024], val[:1024], 8)
+            params = model.init(
+                jax.random.key(0), tf, tn, tv,
+                jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
             with jax.set_mesh(mesh.mesh):
+                # Replicate-commit params: the backward's kernel-grad
+                # dot contracts over the sharded row axis and needs
+                # explicitly-replicated weights to place its psum.
+                params = jax.device_put(params, rep)
                 g = (jax.device_put(f, row), jax.device_put(nb, row),
                      jax.device_put(vl, row))
-                # Init on a tiny same-width graph — params depend on
-                # feature/hidden dims, not node count.
-                tf, tn, tv, _ = pad_graph_sparse(
-                    feats[:1024], nbr[:1024], val[:1024], 8)
-                params = model.init(
-                    jax.random.key(0), tf, tn, tv,
-                    jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.int32))
                 es = jax.device_put(src[:1024].astype(np.int32), rep)
                 ed = jax.device_put(dst[:1024].astype(np.int32), rep)
                 y = jax.device_put(
