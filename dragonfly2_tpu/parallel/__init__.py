@@ -9,5 +9,7 @@ annotate, XLA lays out the collectives.
 
 from dragonfly2_tpu.parallel.mesh import MeshContext, data_parallel_mesh
 from dragonfly2_tpu.parallel.ring_attention import ring_attention
+from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["MeshContext", "data_parallel_mesh", "ring_attention"]
+__all__ = ["MeshContext", "data_parallel_mesh", "ring_attention",
+           "ulysses_attention"]

@@ -160,6 +160,27 @@ class TestHBMSinkSmoke:
             *a, mesh=mesh, causal=True))(q, k, v)
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_ulysses_attention_on_chip(self, tpu_device):
+        """All-to-all sequence parallelism on the real backend
+        (degenerate 1-chip exchange) — and on TPU the local attention
+        IS the pallas flash kernel, so this exercises the production
+        a2a + flash composition end to end."""
+        import jax
+        import numpy as np
+
+        from dragonfly2_tpu.parallel import (
+            data_parallel_mesh,
+            ulysses_attention,
+        )
+
+        mesh = data_parallel_mesh().mesh
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.standard_normal((256, 4, 128)).astype(np.float32)
+                   for _ in range(3))
+        out = jax.jit(lambda *a: ulysses_attention(
+            *a, mesh=mesh, causal=True))(q, k, v)
+        assert np.isfinite(np.asarray(out)).all()
+
     def test_graph_flash_kernel_on_chip(self, tpu_device):
         """The graph-flash pallas kernel (blocks-mode inner loop on a
         single TPU device) must agree with gather-mode attention through
