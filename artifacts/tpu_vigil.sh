@@ -20,7 +20,18 @@ while true; do
     BENCH_BUDGET_S=240 timeout 300 python bench.py \
       > artifacts/bench_r5_try1.json.tmp 2>> "$LOG"
     rc=$?
-    tail -1 artifacts/bench_r5_try1.json.tmp > artifacts/bench_r5_try1.json
+    # Promote only a clean run whose last line parses as JSON — a
+    # timeout/crash must not leave a truncated artifact masquerading
+    # as a measurement.
+    if [ "$rc" -eq 0 ] && tail -1 artifacts/bench_r5_try1.json.tmp \
+        | python -c "import json,sys; json.loads(sys.stdin.read())" \
+        2>> "$LOG"; then
+      tail -1 artifacts/bench_r5_try1.json.tmp \
+        > artifacts/bench_r5_try1.json
+    else
+      mv artifacts/bench_r5_try1.json.tmp \
+        artifacts/bench_r5_try1.failed.txt
+    fi
     rm -f artifacts/bench_r5_try1.json.tmp
     echo "$(date -u +%H:%M:%S) bench rc=$rc" >> "$LOG"
     echo "$(date -u +%H:%M:%S) vigil DONE" >> "$LOG"

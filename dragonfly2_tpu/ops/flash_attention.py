@@ -17,14 +17,14 @@ exists in HBM and each tile's QK^T / P·V land on the MXU back-to-back):
   (``inference/scorer.py`` → ``node_embeddings``) runs at model load.
 
 Scope: FORWARD is the pallas kernel; backward (``jax.custom_vjp``)
-recomputes through the XLA reference — the dense path for the sequence
-kernel (O(T²), fine at scorer sizes) and the chunked online-softmax
-scan for the graph kernel (O(N·block) — the same memory class as
-training's default path). Training-scale long context should use
-``parallel/ring_attention.py``; multi-device graph training uses the
-scan/ring paths (the kernel is a per-device program — its multi-chip
-composition via shard_map is future work, documented in
-docs/DESIGN_DECISIONS.md).
+recomputes through the XLA chunked online-softmax scan
+(:func:`chunked_attention` for the sequence kernel, the graph scan for
+the graph kernel) — O(T·block) residents, the same memory class as the
+forward, so differentiating through the kernels at training-scale T
+never materializes a dense score matrix. Multi-device composition:
+``parallel/ring_attention.py`` (K/V rotation) and
+``parallel/ulysses.py`` (all-to-all head partition, which runs THIS
+kernel per device); the kernel itself is a per-device program.
 
 Layouts: public API takes ``[T, heads, head_dim]`` (the repo's
 convention); the kernels run ``[heads, T, head_dim]`` so each grid step
@@ -45,7 +45,7 @@ NEG_INF = -1e9
 
 
 def _dense_reference(q, k, v, causal: bool, t_real: int):
-    """XLA fallback / backward path. q/k/v: [T, h, d] (padded)."""
+    """XLA fallback path (small T). q/k/v: [T, h, d] (padded)."""
     t = q.shape[0]
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("nhd,mhd->hnm", q, k).astype(jnp.float32) * scale
@@ -56,6 +56,58 @@ def _dense_reference(q, k, v, causal: bool, t_real: int):
     s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1) * mask
     return jnp.einsum("hnm,mhd->nhd", p.astype(q.dtype), v)
+
+
+def chunked_attention(q, k, v, causal: bool = False, block: int = 512):
+    """Key-blocked online-softmax attention in plain XLA — the same
+    algebra as the pallas kernel at O(T·block) residents instead of the
+    dense O(T²) score matrix. Three roles: the kernel's BACKWARD
+    recompute path (differentiating this under ``jax.checkpoint`` keeps
+    training-scale T inside the flash memory class), the off-TPU local
+    attention inside :func:`~dragonfly2_tpu.parallel.ulysses_attention`,
+    and a long-T forward fallback. q/k/v: [T, h, d]."""
+    t = q.shape[0]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    block = min(block, t)
+    # Pad K/V to whole blocks: a ragged tail would make dynamic_slice
+    # CLAMP its start and silently re-read earlier keys; the k_pos
+    # mask keeps phantom keys out of the softmax.
+    pad = (-t) % block
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+    n_blocks = (t + pad) // block
+    q_pos = jnp.arange(t)
+
+    # Carries derive from q (not fresh constants) so the scan stays
+    # legal inside shard_map, where constants are axis-unvarying.
+    m = (q.astype(jnp.float32).sum(-1) * 0 + NEG_INF).swapaxes(-1, -2)
+    l = jnp.zeros_like(m)                                  # [h, T]
+    acc = (q * 0).astype(jnp.float32)                      # [T, h, d]
+
+    def step(carry, j):
+        m, l, acc = carry
+        start = j * block
+        kj = jax.lax.dynamic_slice_in_dim(k, start, block, 0)
+        vj = jax.lax.dynamic_slice_in_dim(v, start, block, 0)
+        s = jnp.einsum("nhd,mhd->hnm", q, kj).astype(jnp.float32) * scale
+        k_pos = start + jnp.arange(block)
+        mask = (k_pos < t)[None, None, :]
+        if causal:
+            mask = mask & (q_pos[:, None] >= k_pos[None, :])[None]
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None]) * mask
+        fold = jnp.exp(m - m_new)
+        l = l * fold + p.sum(-1)
+        acc = acc * fold.swapaxes(-1, -2)[..., None] + jnp.einsum(
+            "hnm,mhd->nhd", p.astype(q.dtype), vj).astype(jnp.float32)
+        return (m_new, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        jax.checkpoint(step), (m, l, acc), jnp.arange(n_blocks))
+    denom = jnp.maximum(l, 1e-20).swapaxes(-1, -2)[..., None]
+    return (acc / denom).astype(q.dtype)
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
@@ -174,9 +226,14 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    """Recompute through the chunked online-softmax scan — O(T·block)
+    residents, so differentiating the kernel at training-scale T stays
+    in the flash memory class instead of materializing the dense [T, T]
+    score matrix the forward exists to avoid."""
     q, k, v = residuals
     _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, causal, q.shape[0]),
+        lambda q, k, v: chunked_attention(
+            q, k, v, causal, block=max(block_k, 512)),
         q, k, v)
     return vjp(g)
 

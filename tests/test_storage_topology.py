@@ -256,6 +256,38 @@ class TestTopologyDurability:
             replica.stop()
 
 
+def make_replica(tmp_path, name, serve_wire=True):
+    """One scheduler replica (resource + store + service), optionally
+    served over the real wire — shared scaffolding for the anti-entropy
+    tests so the construction can't drift between them."""
+    from dragonfly2_tpu.rpc import serve
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.rpcserver import (
+        SCHEDULER_SPEC,
+        SchedulerRpcService,
+    )
+    from dragonfly2_tpu.scheduler.scheduling.core import Scheduling
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    resource = Resource()
+    for i in range(10):
+        resource.host_manager.store(
+            Host(id=f"h{i}", hostname=f"h{i}", ip=f"10.0.0.{i}",
+                 network=Network(idc=f"idc-{i % 2}")))
+    storage = Storage(str(tmp_path / name), StorageConfig(buffer_size=1))
+    service = SchedulerService(
+        resource=resource,
+        scheduling=Scheduling(BaseEvaluator()),
+        storage=storage,
+        network_topology=NetworkTopologyStore(
+            NetworkTopologyConfig(), resource=resource, storage=storage),
+    )
+    server = (serve([(SCHEDULER_SPEC, SchedulerRpcService(service))])
+              if serve_wire else None)
+    return {"service": service, "server": server,
+            "store": service.network_topology}
+
+
 class TestReplicaAntiEntropy:
     """Cross-replica probe sharing (round-5 verdict item 7): replicas
     exchange probe-window deltas over the scheduler wire, so killing one
@@ -264,36 +296,9 @@ class TestReplicaAntiEntropy:
 
     @pytest.fixture
     def two_replicas(self, tmp_path):
-        from dragonfly2_tpu.rpc import serve
-        from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
         from dragonfly2_tpu.scheduler.networktopology import ReplicaSyncer
-        from dragonfly2_tpu.scheduler.rpcserver import (
-            SCHEDULER_SPEC,
-            SchedulerRpcService,
-        )
-        from dragonfly2_tpu.scheduler.scheduling.core import Scheduling
-        from dragonfly2_tpu.scheduler.service import SchedulerService
 
-        replicas = []
-        for name in ("a", "b"):
-            resource = Resource()
-            for i in range(10):
-                resource.host_manager.store(
-                    Host(id=f"h{i}", hostname=f"h{i}", ip=f"10.0.0.{i}",
-                         network=Network(idc=f"idc-{i % 2}")))
-            storage = Storage(str(tmp_path / name),
-                              StorageConfig(buffer_size=1))
-            service = SchedulerService(
-                resource=resource,
-                scheduling=Scheduling(BaseEvaluator()),
-                storage=storage,
-                network_topology=NetworkTopologyStore(
-                    NetworkTopologyConfig(), resource=resource,
-                    storage=storage),
-            )
-            server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))])
-            replicas.append({"service": service, "server": server,
-                             "store": service.network_topology})
+        replicas = [make_replica(tmp_path, name) for name in ("a", "b")]
         a, b = replicas
         # B runs anti-entropy against A (either side's tick converges
         # both — the exchange is symmetric push-pull).
@@ -302,7 +307,8 @@ class TestReplicaAntiEntropy:
         yield a, b, syncer
         syncer.stop()
         for r in replicas:
-            r["server"].stop()
+            if r["server"] is not None:
+                r["server"].stop()
 
     def test_kill_one_of_two_bounded_loss(self, two_replicas):
         a, b, syncer = two_replicas
@@ -357,6 +363,44 @@ class TestReplicaAntiEntropy:
         syncer.sync_once()
         syncer.sync_once()
         assert b["store"].average_rtt("h2", "h3") == pytest.approx(0.007)
+
+    def test_three_replica_chain_propagates_transitively(self, tmp_path):
+        """A ↔ B ↔ C with no direct A–C link: merges stamp arrivals
+        with the local clock, so B's next exchanges forward what it
+        learned — probes cross the whole chain in two ticks."""
+        from dragonfly2_tpu.scheduler.networktopology import ReplicaSyncer
+
+        # B is the bridge: it peers with both ends and needs no wire
+        # server of its own; A and C peer with nobody (their probes
+        # reach the fleet via B's ticks).
+        nodes = {
+            "a": make_replica(tmp_path, "a"),
+            "b": make_replica(tmp_path, "b", serve_wire=False),
+            "c": make_replica(tmp_path, "c"),
+        }
+        syncer = ReplicaSyncer(
+            nodes["b"]["store"],
+            [nodes["a"]["server"].target, nodes["c"]["server"].target],
+            interval=3600.0)
+        try:
+            nodes["a"]["store"].enqueue_probe(
+                "h0", Probe("h1", 0.010, created_at=10.0))
+            syncer.sync_once()   # B learns from A
+            syncer.sync_once()   # B forwards to C (arrival-stamped)
+            assert nodes["c"]["store"].average_rtt(
+                "h0", "h1") == pytest.approx(0.010)
+            # And the reverse direction: C's probes reach A via B.
+            nodes["c"]["store"].enqueue_probe(
+                "h2", Probe("h3", 0.020, created_at=20.0))
+            syncer.sync_once()
+            syncer.sync_once()
+            assert nodes["a"]["store"].average_rtt(
+                "h2", "h3") == pytest.approx(0.020)
+        finally:
+            syncer.stop()
+            for n in nodes.values():
+                if n["server"] is not None:
+                    n["server"].stop()
 
     def test_push_direction_converges_too(self, two_replicas):
         """The syncer PUSHES its local window as well — probes landing on
