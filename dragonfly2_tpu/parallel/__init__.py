@@ -8,8 +8,12 @@ annotate, XLA lays out the collectives.
 """
 
 from dragonfly2_tpu.parallel.mesh import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+)
 from dragonfly2_tpu.parallel.ring_attention import ring_attention
 from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["MeshContext", "data_parallel_mesh", "ring_attention",
-           "ulysses_attention"]
+__all__ = ["MeshContext", "data_parallel_mesh", "pipeline_apply",
+           "ring_attention", "stack_stage_params", "ulysses_attention"]
