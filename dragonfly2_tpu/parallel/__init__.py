@@ -8,6 +8,7 @@ annotate, XLA lays out the collectives.
 """
 
 from dragonfly2_tpu.parallel.mesh import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.parallel.moe import moe_apply
 from dragonfly2_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
@@ -15,5 +16,6 @@ from dragonfly2_tpu.parallel.pipeline import (
 from dragonfly2_tpu.parallel.ring_attention import ring_attention
 from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["MeshContext", "data_parallel_mesh", "pipeline_apply",
-           "ring_attention", "stack_stage_params", "ulysses_attention"]
+__all__ = ["MeshContext", "data_parallel_mesh", "moe_apply",
+           "pipeline_apply", "ring_attention", "stack_stage_params",
+           "ulysses_attention"]

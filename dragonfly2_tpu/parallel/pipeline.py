@@ -39,6 +39,20 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def check_stacked(params, n: int, axis: str, name: str, unit: str) -> None:
+    """Every leaf's leading dim must equal the mesh axis size — with a
+    mismatch, shard_map hands each device several slices and downstream
+    code would silently use only the first (a finite, plausible, wrong
+    answer). Shared by the pipeline and MoE layouts."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if leaf.ndim == 0 or leaf.shape[0] != n:
+            have = "a scalar" if leaf.ndim == 0 else str(leaf.shape[0])
+            raise ValueError(
+                f"{name} leaf {jax.tree_util.keystr(path)} has {have} "
+                f"{unit} but the '{axis}' axis has {n} devices; stack "
+                f"exactly one per device")
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params,
@@ -70,13 +84,7 @@ def pipeline_apply(
     # devices, shard_map would hand each device several and the
     # pipeline would silently run only the first of each — a finite,
     # plausible, wrong answer.
-    for path, leaf in jax.tree_util.tree_leaves_with_path(stage_params):
-        if leaf.shape[0] != n_stages:
-            raise ValueError(
-                f"stage_params leaf {jax.tree_util.keystr(path)} has "
-                f"{leaf.shape[0]} stages but the '{axis}' axis has "
-                f"{n_stages} devices; stack exactly one stage per "
-                "device")
+    check_stacked(stage_params, n_stages, axis, "stage_params", "stages")
     mb = batch // m
     x_mbs = x.reshape(m, mb, *x.shape[1:])
     n_steps = m + n_stages - 1
@@ -114,10 +122,7 @@ def pipeline_apply(
             is_last = s_idx == n_stages - 1
             slot = jnp.clip(mb_idx, 0, m - 1)
             bank = jnp.where(active & is_last, y, jnp.zeros_like(y))
-            out = jax.lax.dynamic_update_index_in_dim(
-                out, jax.lax.dynamic_index_in_dim(
-                    out, slot, 0, keepdims=False) + bank,
-                slot, 0)
+            out = out.at[slot].add(bank)
             # Activation hops one stage forward around the ring.
             act = jax.lax.ppermute(y, axis, perm)
             return (act, out), None
