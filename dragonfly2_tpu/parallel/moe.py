@@ -52,6 +52,9 @@ def moe_apply(
     """
     from dragonfly2_tpu.parallel.pipeline import check_stacked
 
+    if x.ndim != 2:
+        raise ValueError(f"expected x as [tokens, d], got {x.shape}; "
+                         "flatten batch dims before routing")
     n_exp = mesh.shape[axis]
     if gate_logits.shape[-1] != n_exp:
         raise ValueError(

@@ -103,6 +103,10 @@ class TestMoE:
 
     def test_rejects_bad_shapes(self, mesh):
         params = make_experts(8, 8)
+        with pytest.raises(ValueError, match="flatten batch"):
+            moe_apply(expert_fn, params,
+                      np.zeros((2, 16, 8), np.float32),
+                      np.zeros((2, 8), np.float32), mesh=mesh)
         with pytest.raises(ValueError, match="gate_logits"):
             moe_apply(expert_fn, params, np.zeros((16, 8), np.float32),
                       np.zeros((16, 4), np.float32), mesh=mesh)

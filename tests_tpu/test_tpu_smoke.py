@@ -181,6 +181,41 @@ class TestHBMSinkSmoke:
             *a, mesh=mesh, causal=True))(q, k, v)
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_pipeline_and_moe_on_chip(self, tpu_device):
+        """The pipeline and expert layouts on the real backend
+        (degenerate 1-stage/1-expert meshes): the ppermute/all_to_all
+        collective programs must lower and run on axon."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dragonfly2_tpu.parallel import (
+            moe_apply,
+            pipeline_apply,
+            stack_stage_params,
+        )
+
+        n = jax.device_count()
+        rng = np.random.default_rng(0)
+        d = 8
+        params = stack_stage_params([
+            {"w": np.eye(d, dtype=np.float32)} for _ in range(n)])
+        x = rng.standard_normal((4 * n, d)).astype(np.float32)
+
+        mesh_s = jax.make_mesh((n,), ("stage",))
+        out = pipeline_apply(lambda p, t: t @ p["w"], params, x,
+                             mesh=mesh_s)
+        np.testing.assert_allclose(np.asarray(out), x, rtol=1e-5)
+
+        mesh_e = jax.make_mesh((n,), ("expert",))
+        gates = rng.standard_normal((4 * n, n)).astype(np.float32)
+        out = moe_apply(lambda p, t: t @ p["w"], params, x, gates,
+                        mesh=mesh_e, capacity_factor=float(n) * 4)
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(gates), axis=-1))
+        top = probs[np.arange(len(gates)), gates.argmax(-1)]
+        np.testing.assert_allclose(np.asarray(out), x * top[:, None],
+                                   rtol=1e-4, atol=1e-5)
+
     def test_graph_flash_kernel_on_chip(self, tpu_device):
         """The graph-flash pallas kernel (blocks-mode inner loop on a
         single TPU device) must agree with gather-mode attention through
