@@ -52,9 +52,11 @@ def moe_apply(
     """
     from dragonfly2_tpu.parallel.pipeline import check_stacked
 
-    if x.ndim != 2:
-        raise ValueError(f"expected x as [tokens, d], got {x.shape}; "
-                         "flatten batch dims before routing")
+    if x.ndim != 2 or gate_logits.ndim != 2:
+        raise ValueError(
+            f"expected x as [tokens, d] and gate_logits as "
+            f"[tokens, experts], got {x.shape} / {gate_logits.shape}; "
+            "flatten batch dims before routing")
     n_exp = mesh.shape[axis]
     if gate_logits.shape[-1] != n_exp:
         raise ValueError(
