@@ -5,18 +5,48 @@
 # a dead tunnel costs polling, not a wedged session.
 LOG=/root/repo/artifacts/tpu_vigil.log
 cd /root/repo
-echo "$(date -u +%H:%M:%S) vigil start" >> "$LOG"
+# Hard deadline (epoch seconds, arg 1; default +100 min): the vigil
+# must never overlap the driver's own round-end bench on the single
+# chip — it exits cleanly at the deadline and scales its suite down
+# when the tunnel returns late.
+DEADLINE=${1:-$(( $(date +%s) + 6000 ))}
+if [ "$DEADLINE" -le "$(( $(date +%s) + 120 ))" ]; then
+  echo "deadline '$1' is not a future absolute epoch; defaulting +100min" \
+    >> "$LOG"
+  DEADLINE=$(( $(date +%s) + 6000 ))
+fi
+echo "$(date -u +%H:%M:%S) vigil start (deadline $(date -u -d @$DEADLINE +%H:%M:%S))" >> "$LOG"
 while true; do
+  LEFT=$(( DEADLINE - $(date +%s) ))
+  if [ "$LEFT" -le 120 ]; then
+    echo "$(date -u +%H:%M:%S) deadline reached — vigil exiting" >> "$LOG"
+    exit 0
+  fi
   if timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform!='cpu'" \
       >/dev/null 2>&1; then
-    echo "$(date -u +%H:%M:%S) tunnel UP — running on-chip suite" >> "$LOG"
-    timeout 1500 python artifacts/gat_bench.py \
-      artifacts/gat_bench_r5.json >> "$LOG" 2>&1
-    echo "$(date -u +%H:%M:%S) gat_bench rc=$?" >> "$LOG"
-    timeout 2400 python -u artifacts/hbm_fanout.py --size-gb 2.1 \
-      --out artifacts/hbm_fanout_r5.json --base /tmp/df2-hbm-tpu \
-      >> "$LOG" 2>&1
-    echo "$(date -u +%H:%M:%S) hbm_fanout rc=$?" >> "$LOG"
+    echo "$(date -u +%H:%M:%S) tunnel UP — running on-chip suite" \
+      "(${LEFT}s to deadline)" >> "$LOG"
+    # gat_bench needs its full ~1500s budget; a shorter timeout would
+    # SIGKILL it before it writes anything (JSON lands only at the
+    # end) — skip rather than waste the remaining window on a doomed
+    # run, leaving budget for the cheap bench stage.
+    if [ "$LEFT" -ge 1800 ]; then
+      timeout 1500 python artifacts/gat_bench.py \
+        artifacts/gat_bench_r5.json >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) gat_bench rc=$?" >> "$LOG"
+    fi
+    LEFT=$(( DEADLINE - $(date +%s) ))
+    if [ "$LEFT" -ge 2700 ]; then
+      timeout 2400 python -u artifacts/hbm_fanout.py --size-gb 2.1 \
+        --out artifacts/hbm_fanout_r5.json --base /tmp/df2-hbm-tpu \
+        >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) hbm_fanout rc=$?" >> "$LOG"
+    fi
+    LEFT=$(( DEADLINE - $(date +%s) ))
+    if [ "$LEFT" -lt 420 ]; then
+      echo "$(date -u +%H:%M:%S) no margin for bench — vigil done" >> "$LOG"
+      exit 0
+    fi
     BENCH_BUDGET_S=240 timeout 300 python bench.py \
       > artifacts/bench_r5_try1.json.tmp 2>> "$LOG"
     rc=$?
