@@ -1,10 +1,16 @@
-"""Lock-order auditing (SURVEY §5 race detection, the -race deadlock half).
+"""Race checking (SURVEY §5 race detection — both halves of ``-race``).
 
-Unit tests prove the auditor's math (ABBA cycle found from witnessed
-orders alone, re-entrancy and hand-over-hand tolerated); the integration
-test wires the auditor into a REAL daemon's hot locks — storage manager,
-conductor registry, piece store — and certifies the whole concurrent
-download/delete workload acquires them acyclically.
+Lock-order half: unit tests prove the auditor's math (ABBA cycle found
+from witnessed orders alone, re-entrancy and hand-over-hand tolerated);
+the integration test wires the auditor into a REAL daemon's hot locks —
+storage manager, conductor registry, piece store — and certifies the
+whole concurrent download/delete workload acquires them acyclically.
+
+Data-race half: the lockset (Eraser) detector convicts unlocked and
+wrong-lock sharing from ONE benign schedule (no bad interleaving
+required), exempts init-then-publish and read-only sharing, and — wired
+into a real StorageManager under concurrent register/read/delete churn —
+certifies the task map is consistently protected.
 """
 
 from __future__ import annotations
@@ -14,8 +20,10 @@ import threading
 import pytest
 
 from dragonfly2_tpu.utils.racecheck import (
+    DataRaceViolation,
     LockOrderAuditor,
     LockOrderViolation,
+    RaceDetector,
 )
 
 
@@ -179,6 +187,223 @@ class TestDaemonLockOrder:
         # (No EDGES is the expected verdict — the daemon never nests
         # these two locks, which is exactly the deadlock-free shape.)
         assert auditor.acquire_count > 50, auditor.acquire_count
+
+
+def _run_threads(*targets, n_each: int = 1):
+    threads = [threading.Thread(target=t, name=f"worker-{i}-{j}")
+               for i, t in enumerate(targets) for j in range(n_each)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    return threads
+
+
+class TestLocksetMath:
+    def test_unlocked_cross_thread_write_is_a_race(self):
+        det = RaceDetector()
+        shared = det.wrap_dict({}, "shared")
+
+        def writer(val):
+            def go():
+                shared[val] = val  # no lock held
+            return go
+
+        # Sequential schedules — never actually interleaved, still a race.
+        for t in _run_threads(writer(1)):
+            t.join()
+        for t in _run_threads(writer(2)):
+            t.join()
+        with pytest.raises(DataRaceViolation) as err:
+            det.assert_race_free()
+        assert err.value.races[0].variable == "shared"
+
+    def test_common_lock_is_clean(self):
+        det = RaceDetector()
+        lock = det.wrap(threading.Lock(), "L")
+        shared = det.wrap_dict({}, "shared")
+
+        def worker(i):
+            def go():
+                for j in range(50):
+                    with lock:
+                        shared[i * 100 + j] = j
+                        _ = shared.get(j)
+            return go
+
+        _run_threads(worker(1), worker(2), worker(3))
+        det.assert_race_free()
+        assert det.access_count > 200
+
+    def test_init_then_publish_is_exempt(self):
+        """Single-thread construction without locks, then lock-free
+        READ-ONLY sharing: the exclusive phase plus the SHARED state
+        must keep this silent (the Eraser false-positive guard)."""
+        det = RaceDetector()
+        table = det.wrap_dict({}, "table")
+        for i in range(20):
+            table[i] = i * i  # main thread, no locks: init phase
+
+        def reader():
+            for i in range(20):
+                assert table[i] == i * i  # no locks: still fine
+
+        _run_threads(reader, reader, reader)
+        det.assert_race_free()
+
+    def test_write_after_read_sharing_is_a_race(self):
+        """Lock-free read-sharing is benign until someone WRITES while
+        shared — then the empty candidate set convicts."""
+        det = RaceDetector()
+        cell = det.cell("flag", value=0)
+        cell.set(1)  # init
+
+        def reader():
+            cell.get()
+
+        _run_threads(reader, reader)
+        det.assert_race_free()  # read-only sharing: still clean
+
+        def writer():
+            cell.set(2)
+
+        _run_threads(writer)
+        with pytest.raises(DataRaceViolation):
+            det.assert_race_free()
+
+    def test_disjoint_locks_convicted_without_interleaving(self):
+        """The classic wrong-lock bug: thread 1 guards the map with A,
+        thread 2 guards it with B. Every individual access is locked and
+        this schedule is strictly sequential — but no COMMON lock
+        protects the variable, so some schedule corrupts it. The
+        intersection-emptiness test catches it from this benign run."""
+        det = RaceDetector()
+        a = det.wrap(threading.Lock(), "A")
+        b = det.wrap(threading.Lock(), "B")
+        shared = det.wrap_dict({}, "shared")
+
+        def with_a():
+            with a:
+                shared["x"] = 1
+
+        def with_b():
+            with b:
+                shared["x"] = 2
+
+        # Three strictly-sequential accesses: A-locked write (init
+        # phase), B-locked write (sharing begins, C={B}), A-locked write
+        # (C={B}∩{A}=∅ → race). Matches Eraser's sensitivity: the
+        # exclusive phase is exempt, so conviction needs the first
+        # thread to come back after sharing begins — which any real
+        # churn workload does.
+        for fn in (with_a, with_b, with_a):
+            for t in _run_threads(fn):
+                t.join()
+        with pytest.raises(DataRaceViolation) as err:
+            det.assert_race_free()
+        assert err.value.races[0].variable == "shared"
+
+    def test_superset_locksets_survive_refinement(self):
+        """Accesses holding {A,B} and {A} share A — the refined
+        candidate set is {A}, non-empty, no race."""
+        det = RaceDetector()
+        a = det.wrap(threading.Lock(), "A")
+        b = det.wrap(threading.Lock(), "B")
+        shared = det.wrap_dict({}, "shared")
+
+        def both():
+            with a, b:
+                shared["k"] = 1
+
+        def just_a():
+            with a:
+                shared["k"] = 2
+
+        _run_threads(both, just_a, both, just_a)
+        det.assert_race_free()
+
+    def test_report_is_bounded_and_deduped(self):
+        det = RaceDetector()
+        cells = [det.cell(f"v{i}") for i in range(40)]
+
+        def touch_all():
+            for c in cells:
+                c.set(1)
+
+        _run_threads(touch_all, touch_all)
+        races = det.races()
+        assert 0 < len(races) <= RaceDetector.MAX_REPORTS
+        assert len({r.variable for r in races}) == len(races)
+
+
+class TestStorageRaces:
+    def test_storage_manager_task_map_race_free(self, tmp_path):
+        """Wrap the REAL StorageManager's lock and task map and churn it
+        from 8 threads (register / read / reuse-scan / delete). Every
+        access must be protected by the one storage lock — the lockset
+        detector certifies the invariant for all schedules over these
+        accesses, not just this run's."""
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+
+        det = RaceDetector()
+        mgr = StorageManager(StorageOptions(root=str(tmp_path / "s"),
+                                            keep_storage=False))
+        mgr._lock = det.wrap(mgr._lock, "storage.lock")
+        mgr._tasks = det.wrap_dict(mgr._tasks, "storage.tasks")
+        errors = []
+
+        def churn(i):
+            def go():
+                try:
+                    for j in range(15):
+                        tid = f"task-{(i + j) % 5:040d}"
+                        store = mgr.register_task(tid, f"peer-{i}")
+                        store.update(content_length=10)
+                        assert mgr.get(tid, f"peer-{i}") is not None
+                        mgr.find_completed_task(tid)
+                        if j % 5 == 4:
+                            mgr.delete_task(tid, f"peer-{i}")
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+            return go
+
+        _run_threads(*[churn(i) for i in range(8)])
+        assert not errors, errors
+        det.assert_race_free()
+        det.assert_acyclic()
+        assert det.access_count > 300, det.access_count
+
+    def test_seeded_unprotected_access_is_caught(self, tmp_path):
+        """Mutate the same wrapped task map while BYPASSING the storage
+        lock from one rogue thread — the detector must convict, proving
+        the integration test above can actually fail."""
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+
+        det = RaceDetector()
+        mgr = StorageManager(StorageOptions(root=str(tmp_path / "s"),
+                                            keep_storage=False))
+        mgr._lock = det.wrap(mgr._lock, "storage.lock")
+        mgr._tasks = det.wrap_dict(mgr._tasks, "storage.tasks")
+
+        def legit():
+            mgr.register_task("t" * 40, "peer-a")
+
+        def rogue():
+            mgr._tasks.pop(("nope", "nope"), None)  # no lock!
+
+        for t in _run_threads(legit):
+            t.join()
+        for t in _run_threads(rogue):
+            t.join()
+        with pytest.raises(DataRaceViolation) as err:
+            det.assert_race_free()
+        assert err.value.races[0].variable == "storage.tasks"
 
 
 class TestJobPlaneLockOrder:
