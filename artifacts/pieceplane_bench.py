@@ -1,0 +1,187 @@
+"""Piece data-plane throughput: pure-Python path vs the C++ native path.
+
+One UploadServer process-local instance serving a synthetic task; the
+fetch side runs the exact code paths the daemon uses:
+
+- python: PieceDownloader (urllib, connection per piece) feeding
+  TaskStorage.write_piece (DigestReader md5 while writing) — the
+  pre-round-5 data plane.
+- native: NativePieceFetcher (keep-alive pooled sockets, one C call per
+  piece doing recv+pwrite+md5 with the GIL released) feeding
+  TaskStorage.record_piece, while the server answers via sendfile(2).
+
+Reported per concurrency level so the GIL-release benefit is visible.
+"""
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import hashlib
+import random
+
+from dragonfly2_tpu import native
+from dragonfly2_tpu.client.downloader import (
+    DownloadPieceRequest,
+    NativePieceFetcher,
+    PieceDownloader,
+)
+from dragonfly2_tpu.client.piece import PieceMetadata
+from dragonfly2_tpu.client.storage import (
+    StorageManager,
+    StorageOptions,
+    WritePieceRequest,
+)
+from dragonfly2_tpu.client.upload import UploadServer
+
+TASK_ID = "f" * 40
+PIECE = 4 * 1024 * 1024
+SIZE = int(os.environ.get("PIECEPLANE_MB", "512")) * 1024 * 1024
+
+
+def build_source(root):
+    mgr = StorageManager(StorageOptions(root=root, keep_storage=False))
+    store = mgr.register_task(TASK_ID, "peer-src")
+    rnd = random.Random(0)
+    pieces = []
+    # Write in 4 MiB pieces of deterministic pseudo-random bytes.
+    for num in range(SIZE // PIECE):
+        chunk = rnd.randbytes(PIECE)
+        p = PieceMetadata(num=num, md5=hashlib.md5(chunk).hexdigest(),
+                          offset=num * PIECE, start=num * PIECE,
+                          length=PIECE)
+        store.write_piece(WritePieceRequest(TASK_ID, "peer-src", p),
+                          io.BytesIO(chunk))
+        pieces.append(p)
+    store.update(content_length=SIZE, total_pieces=len(pieces))
+    store.mark_done()
+    return mgr, pieces
+
+
+def run_python(addr, pieces, root, threads):
+    mgr = StorageManager(StorageOptions(root=root, keep_storage=False))
+    store = mgr.register_task(TASK_ID, "peer-dst")
+    downloader = PieceDownloader()
+    it = iter(pieces)
+    lock = threading.Lock()
+    errors = []
+
+    def worker():
+        while True:
+            with lock:
+                p = next(it, None)
+            if p is None:
+                return
+            req = DownloadPieceRequest(TASK_ID, "peer-dst", "peer-src",
+                                       addr, p)
+            try:
+                data = downloader.download_piece(req)
+                store.write_piece(
+                    WritePieceRequest(TASK_ID, "peer-dst", p),
+                    io.BytesIO(data))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+                return
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    assert not errors, errors[0]
+    assert len(store.existing_piece_nums()) == len(pieces)
+    return dt
+
+
+def run_native(addr, pieces, root, threads):
+    mgr = StorageManager(StorageOptions(root=root, keep_storage=False))
+    store = mgr.register_task(TASK_ID, "peer-dst")
+    fetcher = NativePieceFetcher()
+    it = iter(pieces)
+    lock = threading.Lock()
+    errors = []
+
+    def worker():
+        while True:
+            with lock:
+                p = next(it, None)
+            if p is None:
+                return
+            req = DownloadPieceRequest(TASK_ID, "peer-dst", "peer-src",
+                                       addr, p)
+            try:
+                fd = store.data_write_fd()
+                try:
+                    md5 = fetcher.fetch(req, fd)
+                finally:
+                    os.close(fd)
+                store.record_piece(p, p.length, md5)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+                return
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    fetcher.close()
+    assert not errors, errors[0]
+    assert len(store.existing_piece_nums()) == len(pieces)
+    return dt
+
+
+def main():
+    out = {"bench": "pieceplane", "piece_mb": PIECE // (1 << 20),
+           "size_mb": SIZE // (1 << 20), "native_available":
+           native.available(), "runs": []}
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr, pieces = build_source(os.path.join(tmp, "src"))
+        # Two servers so each mode runs its own serve path end to end:
+        # python = read-bytes serve + urllib fetch + write_piece;
+        # native = sendfile serve + pooled C fetch + record_piece.
+        srv_py = UploadServer(mgr, port=0, sendfile=False)
+        srv_nat = UploadServer(mgr, port=0, sendfile=True)
+        srv_py.start()
+        srv_nat.start()
+        try:
+            for threads in (1, 4):
+                for mode, fn, srv in (
+                        ("python", run_python, srv_py),
+                        ("native", run_native, srv_nat)):
+                    if mode == "native" and not native.available():
+                        continue
+                    addr = f"127.0.0.1:{srv.port}"
+                    root = os.path.join(tmp, f"dst-{mode}-{threads}")
+                    cpu0 = time.process_time()
+                    dt = fn(addr, pieces, root, threads)
+                    cpu = time.process_time() - cpu0
+                    row = {"mode": mode, "threads": threads,
+                           "seconds": round(dt, 2),
+                           "MBps": round(SIZE / dt / (1 << 20), 1),
+                           # server + client share this process, so this
+                           # is the WHOLE plane's CPU bill for the run
+                           "cpu_s_per_gb": round(
+                               cpu / (SIZE / (1 << 30)), 2)}
+                    out["runs"].append(row)
+                    print(json.dumps(row), flush=True)
+        finally:
+            srv_py.stop()
+            srv_nat.stop()
+    if len(sys.argv) > 1:
+        with open(sys.argv[1], "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps({"summary": out["runs"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,13 +13,16 @@ Reference counterparts:
 from __future__ import annotations
 
 import random
+import socket
+import struct
 import threading
 import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
+from dragonfly2_tpu import native
 from dragonfly2_tpu.client.piece import PieceMetadata
 
 MAX_SCORE_NS = 0                     # best (lower is better)
@@ -130,6 +133,16 @@ class PieceDispatcher:
             self._cond.notify_all()
 
 
+def piece_request_path(task_id: str, peer_id: str) -> str:
+    """Route shape both fetchers (and the upload server) share:
+    ``/download/{task_prefix}/{task_id}?peerId=`` — the reference's
+    piece URL (piece_downloader.go:165-225). Raises on task ids too
+    short to carry the 3-char prefix."""
+    if len(task_id) <= 3:
+        raise DownloadPieceError(f"invalid task id {task_id!r}")
+    return f"/download/{task_id[:3]}/{task_id}?peerId={peer_id}"
+
+
 class PieceDownloader:
     """HTTP piece fetch from a parent's upload server
     (piece_downloader.go:165-225)."""
@@ -139,12 +152,8 @@ class PieceDownloader:
         self.scheme = scheme
 
     def download_piece(self, req: DownloadPieceRequest) -> bytes:
-        if len(req.task_id) <= 3:
-            raise DownloadPieceError(f"invalid task id {req.task_id!r}")
-        url = (
-            f"{self.scheme}://{req.dst_addr}/download/"
-            f"{req.task_id[:3]}/{req.task_id}?peerId={req.dst_peer_id}"
-        )
+        path = piece_request_path(req.task_id, req.dst_peer_id)
+        url = f"{self.scheme}://{req.dst_addr}{path}"
         http_req = urllib.request.Request(
             url, headers={"Range": req.piece.range.http_header()}
         )
@@ -159,3 +168,143 @@ class PieceDownloader:
                 f"want {req.piece.length}"
             )
         return data
+
+
+class NativePieceFetcher:
+    """Keep-alive piece fetch through the C++ data plane.
+
+    Replaces the connection-per-piece urllib path with one persistent
+    socket per parent and ONE native call per piece: the C side sends
+    the GET, parses the response, and streams the body recv → pwrite →
+    MD5 with the GIL released (dragonfly2_tpu/native/pieceio.cpp). The
+    reference's equivalent hot loop is likewise compiled code
+    (client/daemon/peer/piece_downloader.go:165-225 over a pooled
+    http.Client transport).
+
+    Only the transfer moves to C; dedup, digest validation and metadata
+    stay in :class:`~dragonfly2_tpu.client.storage.TaskStorage` via
+    ``record_piece``.
+    """
+
+    def __init__(self, timeout: float = 30.0, pool_per_addr: int = 4):
+        self.timeout = timeout
+        self.pool_per_addr = pool_per_addr
+        self._pool: Dict[str, List[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @staticmethod
+    def supported() -> bool:
+        return native.available()
+
+    # -- connection pool ---------------------------------------------------
+
+    def _checkout(self, addr: str) -> Tuple[socket.socket, bool]:
+        """(socket, was_pooled). A pooled socket may have been closed by
+        the server's keep-alive timeout — callers retry once fresh."""
+        with self._lock:
+            stack = self._pool.get(addr)
+            if stack:
+                return stack.pop(), True
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            # Malformed parent address from scheduler/peer metadata must
+            # surface as a piece failure (retried on another parent),
+            # not a ValueError that kills the worker thread.
+            raise DownloadPieceError(f"malformed parent address {addr!r}")
+        sock = socket.create_connection((host, int(port)),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Python's timeout mode puts the fd in O_NONBLOCK, which the C
+        # recv/send loop would see as spurious EAGAIN. Switch to a
+        # blocking fd with KERNEL timeouts so a dead parent still fails
+        # the native call (EAGAIN after SO_RCVTIMEO) instead of hanging.
+        sock.setblocking(True)
+        tv = struct.pack("ll", int(self.timeout),
+                         int((self.timeout % 1.0) * 1_000_000))
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+        return sock, False
+
+    def _flush(self, addr: str) -> None:
+        """Drop every pooled socket for a parent. Called when a pooled
+        socket turns out stale: its siblings were opened to the same
+        (now restarted/dead) server, so retrying through them would
+        just burn the retry budget on more stale sockets."""
+        with self._lock:
+            stack = self._pool.pop(addr, [])
+        for sock in stack:
+            sock.close()
+
+    def _checkin(self, addr: str, sock: socket.socket) -> None:
+        with self._lock:
+            # A worker finishing its fetch after close() must not park
+            # its socket in the emptied pool (nothing would ever close
+            # it — fd leak per completed task).
+            if not self._closed:
+                stack = self._pool.setdefault(addr, [])
+                if len(stack) < self.pool_per_addr:
+                    stack.append(sock)
+                    return
+        sock.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pools, self._pool = self._pool, {}
+        for stack in pools.values():
+            for sock in stack:
+                sock.close()
+
+    # -- fetch -------------------------------------------------------------
+
+    def fetch(self, req: DownloadPieceRequest, file_fd: int) -> str:
+        """Stream one piece into ``file_fd`` at the piece's offset;
+        returns the md5 hex computed in C. Raises DownloadPieceError on
+        any failure (the unrecorded file bytes are overwritten by the
+        next attempt)."""
+        piece = req.piece
+        path = piece_request_path(req.task_id, req.dst_peer_id)
+        request = (
+            f"GET {path} HTTP/1.1\r\n"
+            f"Host: {req.dst_addr}\r\n"
+            f"Range: {piece.range.http_header()}\r\n"
+            f"Connection: keep-alive\r\n\r\n"
+        ).encode()
+        last_exc: Exception | None = None
+        for _attempt in range(2):
+            try:
+                sock, was_pooled = self._checkout(req.dst_addr)
+            except OSError as exc:
+                raise DownloadPieceError(
+                    f"{req.dst_addr}: connect failed: {exc}") from exc
+            try:
+                res = native.http_fetch_to_file(
+                    sock.fileno(), request, file_fd, piece.offset,
+                    piece.length)
+            except (native.NativeIOError, ValueError, OSError) as exc:
+                sock.close()
+                last_exc = exc
+                if was_pooled:
+                    # Stale keep-alive: drop its pooled siblings too (same
+                    # dead server) so the retry really is a fresh connect.
+                    self._flush(req.dst_addr)
+                    continue
+                raise DownloadPieceError(
+                    f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+            if res.status != 206 or res.body_len != piece.length:
+                if res.keep_alive:
+                    self._checkin(req.dst_addr, sock)
+                else:
+                    sock.close()
+                raise DownloadPieceError(
+                    f"{req.dst_addr} piece {piece.num}: status "
+                    f"{res.status}, body {res.body_len}/{piece.length}"
+                )
+            if res.keep_alive:
+                self._checkin(req.dst_addr, sock)
+            else:
+                sock.close()
+            return res.md5_hex
+        raise DownloadPieceError(
+            f"{req.dst_addr} piece {piece.num}: {last_exc}")

@@ -18,6 +18,7 @@ harness, tests) or by the gRPC client adapter (multi-process deployment).
 from __future__ import annotations
 
 import io
+import os
 import json
 import logging
 import queue
@@ -33,6 +34,7 @@ from dragonfly2_tpu.client.downloader import (
     DownloadPieceRequest,
     DownloadPieceResult,
     DispatcherClosedError,
+    NativePieceFetcher,
     PieceDispatcher,
     PieceDownloader,
 )
@@ -152,6 +154,9 @@ class PeerTaskOptions:
     metadata_poll_interval: float = 0.2
     timeout: float = 120.0
     random_ratio: float = 0.1  # dispatcher exploration
+    # Use the C++ piece transfer loop (native/pieceio.cpp) when the
+    # compiled module is loadable; False pins the pure-Python path.
+    native_data_plane: bool = True
 
 
 @dataclass
@@ -226,6 +231,11 @@ class PeerTaskConductor:
         self.channel = QueueChannel()
         self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
         self.downloader = PieceDownloader()
+        self.native_fetcher = (
+            NativePieceFetcher()
+            if self.opts.native_data_plane and NativePieceFetcher.supported()
+            else None
+        )
         self.store: Optional[TaskStorage] = None
         self.content_length = -1
         self.total_pieces = -1
@@ -427,8 +437,15 @@ class PeerTaskConductor:
                     continue
             self.shaper.wait_n(self.task_id, req.piece.length)
             begin = time.monotonic_ns()
+            native_md5: str | None = None
             try:
-                data = self.downloader.download_piece(req)
+                if (self.native_fetcher is not None
+                        and self.store is not None
+                        and not self.store.has_piece(req.piece.num)):
+                    native_md5 = self._download_piece_native(req)
+                    data = None
+                else:
+                    data = self.downloader.download_piece(req)
             except DownloadPieceError as exc:
                 logger.debug("piece %d from %s failed: %s",
                              req.piece.num, req.dst_peer_id, exc)
@@ -442,7 +459,39 @@ class PeerTaskConductor:
             cost = time.monotonic_ns() - begin
             self.dispatcher.report(DownloadPieceResult(
                 req.dst_peer_id, req.piece.num, fail=False, cost_ns=cost))
-            self._store_piece(req, data, cost)
+            if native_md5 is not None:
+                self._record_native_piece(req, native_md5, cost)
+            else:
+                self._store_piece(req, data, cost)
+
+    def _download_piece_native(self, req: DownloadPieceRequest) -> str:
+        """C data plane: the piece streams socket → data file inside one
+        native call (recv+pwrite+md5, GIL released); returns the md5."""
+        try:
+            fd = self.store.data_write_fd()
+        except OSError as exc:
+            # Task directory raced away (concurrent delete_task/GC —
+            # the documented ENOENT-under-churn case): surface as a
+            # piece failure like the Python path does, not a dead
+            # worker thread.
+            raise DownloadPieceError(f"data file unavailable: {exc}") from exc
+        try:
+            return self.native_fetcher.fetch(req, fd)
+        finally:
+            os.close(fd)
+
+    def _record_native_piece(self, req: DownloadPieceRequest, md5_hex: str,
+                             cost_ns: int) -> None:
+        piece = req.piece
+        try:
+            self.store.record_piece(piece, piece.length, md5_hex, cost_ns)
+        except Exception as exc:
+            logger.warning("store piece %d failed: %s", piece.num, exc)
+            self._report_piece_failed(req.dst_peer_id, piece.num)
+            with self._written_lock:
+                self._enqueued.discard(piece.num)
+            return
+        self._after_piece_stored(req, cost_ns)
 
     def _store_piece(self, req: DownloadPieceRequest, data: bytes,
                      cost_ns: int) -> None:
@@ -458,6 +507,11 @@ class PeerTaskConductor:
             with self._written_lock:
                 self._enqueued.discard(piece.num)
             return
+        self._after_piece_stored(req, cost_ns)
+
+    def _after_piece_stored(self, req: DownloadPieceRequest,
+                            cost_ns: int) -> None:
+        piece = req.piece
         with self._written_lock:
             self._written.add(piece.num)
         self._notify_piece_sink(piece.num)
@@ -542,6 +596,8 @@ class PeerTaskConductor:
         self._sync_stop.set()
         self.dispatcher.close()
         self.channel.close()
+        if self.native_fetcher is not None:
+            self.native_fetcher.close()
         for t in self._workers:
             t.join(timeout=2)
         for t in self._syncers.values():

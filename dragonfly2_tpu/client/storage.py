@@ -140,6 +140,70 @@ class TaskStorage:
             self.meta.pieces[piece.num] = final
         return written
 
+    # -- native data-plane hooks ------------------------------------------
+    # The C++ hot loops (dragonfly2_tpu/native) stream bytes directly
+    # between the data file and peer sockets; storage stays the owner of
+    # dedup, digest validation and metadata, so the native path cannot
+    # diverge from write_piece's semantics.
+
+    def has_piece(self, num: int) -> bool:
+        with self._lock:
+            return num in self.meta.pieces
+
+    def data_write_fd(self) -> int:
+        """Raw O_WRONLY fd on the data file for native pwrite. Caller
+        closes (os.close); position-independent, so concurrent piece
+        writers don't conflict."""
+        self.touch()
+        return os.open(self.data_path, os.O_WRONLY)
+
+    def record_piece(self, piece: PieceMetadata, written: int,
+                     md5_hex: str, cost_ns: int = 0) -> int:
+        """Record a piece whose bytes a native writer already placed at
+        ``piece.offset``. Validates length and digest exactly like
+        write_piece; an unrecorded slot is simply garbage bytes the next
+        attempt overwrites."""
+        if written != piece.length:
+            raise StorageError(
+                f"piece {piece.num}: wrote {written}, expected {piece.length}"
+            )
+        if piece.md5 and md5_hex and md5_hex != piece.md5:
+            raise InvalidPieceDigestError(
+                f"piece {piece.num}: md5 {md5_hex} != {piece.md5}"
+            )
+        final = PieceMetadata(
+            num=piece.num, md5=piece.md5 or md5_hex, offset=piece.offset,
+            start=piece.start, length=written, cost_ns=cost_ns,
+        )
+        with self._lock:
+            self.meta.pieces[piece.num] = final
+        return written
+
+    def piece_span(self, rng: Range) -> Optional[Tuple[str, int, int]]:
+        """``(data_path, file_offset, length)`` when ``rng`` is fully
+        covered by verified pieces — the upload server's sendfile fast
+        path. The data file is addressed by CONTENT offset (write_piece
+        seeks ``piece.offset`` and every producer sets offset == start),
+        so the file offset IS the content offset.
+
+        Only exact in-extent ranges qualify: ``covers()`` answers True
+        for any ``done`` store regardless of range end, and the upload
+        server resolves open-ended ranges against a 2^62 sentinel — a
+        span taken at face value would sendfile a 2^62 Content-Length.
+        Out-of-extent ranges return None and the bytes path clamps
+        them as before."""
+        self.touch()
+        extent = self.meta.content_length
+        if extent < 0:
+            with self._lock:
+                extent = max((p.start + p.length
+                              for p in self.meta.pieces.values()), default=0)
+        if rng.start + rng.length > extent:
+            return None
+        if not self.covers(rng):
+            return None
+        return (self.data_path, rng.start, rng.length)
+
     def set_piece_digest(self, num: int, md5: str, cost_ns: int = 0) -> None:
         """Attach an after-the-fact digest to a stored piece (the
         back-to-source path learns the md5 from the wire while writing)."""
@@ -216,8 +280,12 @@ class TaskStorage:
         if rng is None:
             raise StorageError("need piece num or range")
         with open(self.data_path, "rb") as f:
+            # Clamp to the file extent: an open-ended HTTP range reaches
+            # here resolved against a 2^62 sentinel, and f.read(2^62)
+            # tries to allocate the buffer up front (MemoryError).
+            size = os.fstat(f.fileno()).st_size
             f.seek(rng.start)
-            return f.read(rng.length)
+            return f.read(min(rng.length, max(size - rng.start, 0)))
 
     def iter_content(self, rng: Range | None = None,
                      chunk: int = 1 << 20) -> Iterable[bytes]:
@@ -380,6 +448,17 @@ class StorageManager:
                 f"task {task_id}: range {rng.start}+{rng.length} not stored"
             )
         return store.read_piece(num=num, rng=rng)
+
+    def piece_span_any(self, task_id: str, peer_id: str,
+                       rng: Range) -> Optional[Tuple[str, int, int]]:
+        """sendfile span with read_piece_any's lookup order (exact peer,
+        else any completed replica); None = caller takes the bytes path."""
+        store = self.get(task_id, peer_id)
+        if store is None or not store.covers(rng):
+            store = self.find_completed_task(task_id)
+        if store is None:
+            return None
+        return store.piece_span(rng)
 
     # A not-yet-done registration touched within this window is a live
     # writer; rmtree under it turns its next piece write into ENOENT and

@@ -11,6 +11,7 @@ no framework.
 from __future__ import annotations
 
 import logging
+import os
 import urllib.parse
 from http.server import BaseHTTPRequestHandler
 
@@ -30,9 +31,11 @@ class UploadServer(ThreadedHTTPService):
     """Serves stored piece bytes to child peers."""
 
     def __init__(self, storage: StorageManager, host: str = "127.0.0.1",
-                 port: int = 0, rate_limit_bps: float = INF, metrics=None):
+                 port: int = 0, rate_limit_bps: float = INF, metrics=None,
+                 sendfile: bool = True):
         self.storage = storage
         self.metrics = metrics  # DaemonMetrics or None
+        self.sendfile = sendfile  # False pins the read-bytes serve path
         self.limiter = Limiter(rate_limit_bps, burst=int(rate_limit_bps)
                                if rate_limit_bps != INF else None)
         manager = self
@@ -86,6 +89,8 @@ class UploadServer(ThreadedHTTPService):
         except ValueError as exc:
             req.send_error(400, str(exc))
             return
+        if self._try_sendfile(req, task_id, peer_id, rng):
+            return
         try:
             data = self.storage.read_piece_any(task_id, peer_id, rng=rng)
         except StorageError as exc:
@@ -105,6 +110,59 @@ class UploadServer(ThreadedHTTPService):
         )
         req.end_headers()
         req.wfile.write(data)
+
+    def _try_sendfile(self, req: BaseHTTPRequestHandler, task_id: str,
+                      peer_id: str, rng) -> bool:
+        """Native fast path: piece bytes go page-cache → socket via
+        sendfile(2) (native/pieceio.cpp), skipping the Python bytes
+        object and one userspace copy per piece. False = caller takes
+        the read-bytes path (native unavailable, range not fully
+        stored, or a TLS-wrapped connection where writing the raw fd
+        would bypass the record layer)."""
+        from dragonfly2_tpu import native
+
+        if (not self.sendfile or not native.available()
+                or hasattr(req.connection, "cipher")):
+            return False
+        try:
+            span = self.storage.piece_span_any(task_id, peer_id, rng)
+        except StorageError:
+            return False
+        if span is None:
+            return False
+        path, offset, length = span
+        self.limiter.wait_n(min(length, self.limiter.burst))
+        req.send_response(206)
+        req.send_header("Content-Length", str(length))
+        req.send_header(
+            "Content-Range", f"bytes {rng.start}-{rng.start + length - 1}/*"
+        )
+        req.end_headers()
+        req.wfile.flush()  # headers out before bytes hit the raw fd
+        try:
+            in_fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            req.close_connection = True  # headers already sent
+            return True
+        try:
+            sent = native.send_file_range(
+                req.connection.fileno(), in_fd, offset, length)
+        except native.NativeIOError as exc:
+            logger.debug("sendfile failed mid-stream: %s", exc)
+            sent = 0
+        finally:
+            os.close(in_fd)
+        if self.metrics and sent > 0:
+            # Count AFTER the transfer with the actual byte count — a
+            # failed attempt is retried and would otherwise be counted
+            # twice (phantom traffic on the failure, real on the retry).
+            self.metrics.upload_piece_count.inc()
+            self.metrics.upload_traffic.inc(sent)
+        if sent != length:
+            # Can't resend headers; poison the connection so the peer
+            # sees a short body and retries.
+            req.close_connection = True
+        return True
 
     def _handle_metadata(self, req: BaseHTTPRequestHandler, parsed) -> None:
         """``GET /metadata/{task_id}?peerId=`` — the parent's piece

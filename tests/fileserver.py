@@ -59,7 +59,20 @@ class FileServer:
                     self.end_headers()
                     self.wfile.write(data)
 
-            do_HEAD = do_GET
+            def do_HEAD(self):  # noqa: N802 — headers only, no body
+                # (aliasing do_GET would write a body, which corrupts
+                # keep-alive framing for any pooled client)
+                path = os.path.join(server.root, self.path.lstrip("/"))
+                if not os.path.isfile(path):
+                    self.send_error(404)
+                    return
+                size = os.path.getsize(path)
+                self.send_response(200)
+                if server.send_content_length:
+                    self.send_header("Content-Length", str(size))
+                else:
+                    self.send_header("Connection", "close")
+                self.end_headers()
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         if tls_context is not None:
