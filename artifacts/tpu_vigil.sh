@@ -1,19 +1,22 @@
 #!/bin/bash
 # Waits for the axon tunnel to come back, then runs the round-5 on-chip
-# artifact suite once: gat_bench (config #3, multi-step scan), the
-# config #5 HBM fan-out, and a fused-sampling bench state. Detached so
-# a dead tunnel costs polling, not a wedged session.
+# suite once. Updated after the 2026-07-31 ~01:00-01:27 UTC window (which
+# captured bench_r5_try1 / gat_bench_r5 / hbm_fanout_r5 / gat_sweep_r5):
+# the remaining wants are the GAT bench with the scatter-free gather
+# backward (gat_bench_r5b) and an HBM fan-out rerun over the native C++
+# piece data plane (hbm_fanout_r5b). Detached so a dead tunnel costs
+# polling, not a wedged session.
 LOG=/root/repo/artifacts/tpu_vigil.log
 cd /root/repo
-# Hard deadline (epoch seconds, arg 1; default +100 min): the vigil
-# must never overlap the driver's own round-end bench on the single
-# chip — it exits cleanly at the deadline and scales its suite down
-# when the tunnel returns late.
-DEADLINE=${1:-$(( $(date +%s) + 6000 ))}
+# Hard deadline (epoch seconds, arg 1; default +8h): the vigil must
+# never overlap the driver's own round-end bench on the single chip —
+# it exits cleanly at the deadline and scales its suite down when the
+# tunnel returns late.
+DEADLINE=${1:-$(( $(date +%s) + 28800 ))}
 if [ "$DEADLINE" -le "$(( $(date +%s) + 120 ))" ]; then
-  echo "deadline '$1' is not a future absolute epoch; defaulting +100min" \
+  echo "deadline '$1' is not a future absolute epoch; defaulting +8h" \
     >> "$LOG"
-  DEADLINE=$(( $(date +%s) + 6000 ))
+  DEADLINE=$(( $(date +%s) + 28800 ))
 fi
 echo "$(date -u +%H:%M:%S) vigil start (deadline $(date -u -d @$DEADLINE +%H:%M:%S))" >> "$LOG"
 while true; do
@@ -26,21 +29,19 @@ while true; do
       >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) tunnel UP — running on-chip suite" \
       "(${LEFT}s to deadline)" >> "$LOG"
-    # gat_bench needs its full ~1500s budget; a shorter timeout would
-    # SIGKILL it before it writes anything (JSON lands only at the
-    # end) — skip rather than waste the remaining window on a doomed
-    # run, leaving budget for the cheap bench stage.
-    if [ "$LEFT" -ge 1800 ]; then
-      timeout 1500 python artifacts/gat_bench.py \
-        artifacts/gat_bench_r5.json >> "$LOG" 2>&1
-      echo "$(date -u +%H:%M:%S) gat_bench rc=$?" >> "$LOG"
+    # gat_bench needs its full budget; a shorter timeout would SIGKILL
+    # before the JSON lands — skip rather than waste the window.
+    if [ "$LEFT" -ge 900 ]; then
+      timeout 700 python artifacts/gat_bench.py \
+        artifacts/gat_bench_r5b.json >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) gat_bench(scatter-free) rc=$?" >> "$LOG"
     fi
     LEFT=$(( DEADLINE - $(date +%s) ))
     if [ "$LEFT" -ge 2700 ]; then
       timeout 2400 python -u artifacts/hbm_fanout.py --size-gb 2.1 \
-        --out artifacts/hbm_fanout_r5.json --base /tmp/df2-hbm-tpu \
+        --out artifacts/hbm_fanout_r5b.json --base /tmp/df2-hbm-tpu2 \
         >> "$LOG" 2>&1
-      echo "$(date -u +%H:%M:%S) hbm_fanout rc=$?" >> "$LOG"
+      echo "$(date -u +%H:%M:%S) hbm_fanout(native plane) rc=$?" >> "$LOG"
     fi
     LEFT=$(( DEADLINE - $(date +%s) ))
     if [ "$LEFT" -lt 420 ]; then
@@ -48,21 +49,18 @@ while true; do
       exit 0
     fi
     BENCH_BUDGET_S=240 timeout 300 python bench.py \
-      > artifacts/bench_r5_try1.json.tmp 2>> "$LOG"
+      > artifacts/bench_r5_try2.json.tmp 2>> "$LOG"
     rc=$?
-    # Promote only a clean run whose last line parses as JSON — a
-    # timeout/crash must not leave a truncated artifact masquerading
-    # as a measurement.
-    if [ "$rc" -eq 0 ] && tail -1 artifacts/bench_r5_try1.json.tmp \
+    if [ "$rc" -eq 0 ] && tail -1 artifacts/bench_r5_try2.json.tmp \
         | python -c "import json,sys; json.loads(sys.stdin.read())" \
         2>> "$LOG"; then
-      tail -1 artifacts/bench_r5_try1.json.tmp \
-        > artifacts/bench_r5_try1.json
+      tail -1 artifacts/bench_r5_try2.json.tmp \
+        > artifacts/bench_r5_try2.json
     else
-      mv artifacts/bench_r5_try1.json.tmp \
-        artifacts/bench_r5_try1.failed.txt
+      mv artifacts/bench_r5_try2.json.tmp \
+        artifacts/bench_r5_try2.failed.txt
     fi
-    rm -f artifacts/bench_r5_try1.json.tmp
+    rm -f artifacts/bench_r5_try2.json.tmp
     echo "$(date -u +%H:%M:%S) bench rc=$rc" >> "$LOG"
     echo "$(date -u +%H:%M:%S) vigil DONE" >> "$LOG"
     exit 0

@@ -325,7 +325,91 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
     return run(q, k, v, nbr, val)
 
 
-def gather_graph_attention(q, k, v, nbr, val):
+def build_inverse_index(nbr: np.ndarray) -> np.ndarray:
+    """Host-side transpose of the neighbor lists: ``inv[j]`` lists the
+    flat positions ``i*K + s`` with ``nbr[i, s] == j``, padded with -1
+    to the max in-degree. Lets the neighbor-gather BACKWARD be a gather
+    instead of a scatter-add (see :func:`neighbor_gather`): on TPU the
+    duplicate-index scatter the autodiff transpose emits serializes and
+    dominated the measured train step (backward 5.3× forward, 50 GB
+    accessed/step on config #3); the inverse-index gather is parallel
+    and exact. Capped rows keep symmetrized graphs' in-degree near the
+    cap (measured max 82 at cap 64 on config #3), so D stays small.
+    """
+    n, k_width = nbr.shape
+    rows, slots = np.nonzero(nbr != PAD_ID)
+    cols = nbr[rows, slots]
+    flat = (rows * k_width + slots).astype(np.int64)
+    order = np.argsort(cols, kind="stable")
+    cols, flat = cols[order], flat[order]
+    start = np.flatnonzero(np.r_[True, cols[1:] != cols[:-1]])
+    counts = np.diff(np.r_[start, len(cols)])
+    d_max = max(int(counts.max()) if len(counts) else 1, 1)
+    rank = np.arange(len(cols)) - np.repeat(start, counts)
+    inv = np.full((n, d_max), -1, dtype=np.int64)
+    inv[cols, rank] = flat
+    return inv
+
+
+def _neighbor_gather_impl(table, idx):
+    """[N, h, d] table gathered to [N, K, h, d] by row indices."""
+    if _mesh_empty():
+        return table[idx]
+    # Rows shard over data; head/feature axes keep whatever sharding
+    # the table carries (the 'model' axis under tensor parallelism).
+    tspec = _value_spec(table)
+    spec = P("data", None, *tspec[1:])
+    return table.at[idx].get(out_sharding=spec)
+
+
+@jax.custom_vjp
+def neighbor_gather(table, idx, inv):
+    """Neighbor gather with a scatter-free backward.
+
+    Forward is exactly ``table[idx]``. The custom backward uses the
+    host-built inverse index: ``d_table[j] = Σ_t ct.flat[inv[j, t]]`` —
+    a gather + masked sum, replacing autodiff's duplicate-index
+    scatter-add (the TPU-hostile op). ``inv`` must be the exact
+    transpose of ``idx``'s non-pad entries (:func:`build_inverse_index`
+    over the same padded ``nbr``); pad slots carry zero cotangent in
+    this model (their scores are masked to −inf and their probs are 0),
+    so omitting them from ``inv`` is exact.
+    """
+    return _neighbor_gather_impl(table, idx)
+
+
+def _neighbor_gather_fwd(table, idx, inv):
+    # The cotangent carries the table's dtype and idx's shape, so the
+    # only residual is the inverse index itself.
+    return _neighbor_gather_impl(table, idx), inv
+
+
+def _neighbor_gather_bwd(inv, ct):
+    n, k_width = ct.shape[0], ct.shape[1]
+    flat = ct.reshape(n * k_width, *ct.shape[2:])
+    padmask = inv < 0
+    safe = jnp.where(padmask, 0, inv)
+    if _mesh_empty():
+        contrib = flat[safe]
+    else:
+        fspec = _value_spec(flat)
+        contrib = flat.at[safe].get(
+            out_sharding=P("data", None, *fspec[1:]))
+    contrib = jnp.where(padmask[..., None, None], 0.0,
+                        contrib.astype(jnp.float32))
+    d_table = contrib.sum(axis=1).astype(ct.dtype)
+    # The table is full-width (its cotangent must match): gather the
+    # row-sharded partials back to full width under a mesh.
+    d_table = replicate(d_table)
+    return (d_table,
+            np.zeros((n, k_width), dtype=jax.dtypes.float0),
+            np.zeros(inv.shape, dtype=jax.dtypes.float0))
+
+
+neighbor_gather.defvjp(_neighbor_gather_fwd, _neighbor_gather_bwd)
+
+
+def gather_graph_attention(q, k, v, nbr, val, inv=None):
     """Neighbor-gather attention: each query row attends to exactly its
     ≤K listed neighbors — O(N·K·H) compute AND memory.
 
@@ -345,15 +429,16 @@ def gather_graph_attention(q, k, v, nbr, val):
     scale = 1.0 / np.sqrt(head_dim)
     pad = nbr >= n                     # PAD_ID (and nothing else) is ≥ N
     idx = jnp.where(pad, 0, nbr)
-    if _mesh_empty():
+    if inv is not None:
+        # Scatter-free training path: custom backward via the host-built
+        # inverse index (5.3×-forward backward → ~2× measured on-chip).
+        kg = neighbor_gather(k, idx, inv)
+        vg = neighbor_gather(v, idx, inv)
+    elif _mesh_empty():
         kg, vg = k[idx], v[idx]        # [N, K, heads, d]
     else:
-        # Rows shard over data; the head/feature axes keep whatever
-        # sharding K/V carry (the 'model' axis under tensor parallelism).
-        kspec = _value_spec(k)
-        spec = P("data", None, *kspec[1:])
-        kg = k.at[idx].get(out_sharding=spec)
-        vg = v.at[idx].get(out_sharding=spec)
+        kg = _neighbor_gather_impl(k, idx)
+        vg = _neighbor_gather_impl(v, idx)
     s = jnp.einsum("nhd,nkhd->nhk", q, kg).astype(jnp.float32) * scale
     s = s + val[:, None, :]
     s = jnp.where(pad[:, None, :], NEG_INF, s)
@@ -521,8 +606,10 @@ class GraphAttentionBlock(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, h, nbr, val):
-        # h: [N, H] row-sharded; nbr/val: [N, K] row-sharded
+    def __call__(self, h, nbr, val, inv=None):
+        # h: [N, H] row-sharded; nbr/val: [N, K] row-sharded; inv
+        # [N, D] (optional) = host-built inverse neighbor index enabling
+        # the scatter-free gather backward (gather mode only)
         head_dim = self.hidden // self.heads
         x = nn.LayerNorm(dtype=self.dtype)(h)
         q = TPDense(self.hidden, dtype=self.dtype, name="Dense_0")(x)
@@ -542,7 +629,7 @@ class GraphAttentionBlock(nn.Module):
             # block-by-block.
             q, k, v = split(q), replicate(split(k)), replicate(split(v))
             if self.attention == "gather":
-                out = gather_graph_attention(q, k, v, nbr, val)
+                out = gather_graph_attention(q, k, v, nbr, val, inv)
             elif self.attention == "flash":
                 # Force the pallas kernel (interpret-mode off TPU) —
                 # hermetic kernel tests and A/B benchmarks use this.
@@ -599,11 +686,14 @@ class GraphTransformer(nn.Module):
         self.head_out = nn.Dense(1, dtype=jnp.float32,
                                  param_dtype=jnp.float32)
 
-    def node_embeddings(self, node_features, nbr, val):
-        """[N, F] → [N, E]; exposed for serving (embedding export)."""
+    def node_embeddings(self, node_features, nbr, val, inv=None):
+        """[N, F] → [N, E]; exposed for serving (embedding export).
+        ``inv`` (optional, training) = :func:`build_inverse_index` of the
+        padded ``nbr`` — turns the attention gathers' backward into
+        gathers too."""
         h = self.input_proj(node_features.astype(self.dtype))
         for block in self.blocks:
-            h = block(h, nbr, val)
+            h = block(h, nbr, val, inv)
         return self.embed_proj(self.final_norm(h))
 
     def score_pairs(self, emb, edge_src, edge_dst):
@@ -616,8 +706,9 @@ class GraphTransformer(nn.Module):
         x = nn.relu(self.head_hidden(pair))
         return self.head_out(x)[..., 0]
 
-    def __call__(self, node_features, nbr, val, edge_src, edge_dst):
-        emb = self.node_embeddings(node_features, nbr, val)    # [N, E]
+    def __call__(self, node_features, nbr, val, edge_src, edge_dst,
+                 inv=None):
+        emb = self.node_embeddings(node_features, nbr, val, inv)  # [N, E]
         # One all-gather of the (small) embedding table per step; edge
         # index gathers then stay local.
         emb = replicate(emb)
