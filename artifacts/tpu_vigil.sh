@@ -37,6 +37,12 @@ while true; do
       echo "$(date -u +%H:%M:%S) gat_bench(scatter-free) rc=$?" >> "$LOG"
     fi
     LEFT=$(( DEADLINE - $(date +%s) ))
+    if [ "$LEFT" -ge 900 ]; then
+      timeout 600 python artifacts/gat_probe.py \
+        artifacts/gat_probe_r5c.json >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) gat_probe(wide bwd) rc=$?" >> "$LOG"
+    fi
+    LEFT=$(( DEADLINE - $(date +%s) ))
     if [ "$LEFT" -ge 2700 ]; then
       timeout 2400 python -u artifacts/hbm_fanout.py --size-gb 2.1 \
         --out artifacts/hbm_fanout_r5b.json --base /tmp/df2-hbm-tpu2 \

@@ -386,18 +386,34 @@ def _neighbor_gather_fwd(table, idx, inv):
 
 def _neighbor_gather_bwd(inv, ct):
     n, k_width = ct.shape[0], ct.shape[1]
-    flat = ct.reshape(n * k_width, *ct.shape[2:])
+    heads, width = ct.shape[2], ct.shape[3]
+    # Gather whole [heads*width]-wide rows: at config #3 head_dim is 32,
+    # so per-[h, d]-row picks move 32-lane fragments and ran 2.2× slower
+    # than the very scatter they replace (artifacts/gather_micro_r5.json:
+    # 239 ms vs 143 ms; the flattened 128-lane layout is 111 ms).
     padmask = inv < 0
     safe = jnp.where(padmask, 0, inv)
     if _mesh_empty():
+        flat = ct.reshape(n * k_width, heads * width)
         contrib = flat[safe]
     else:
-        fspec = _value_spec(flat)
-        contrib = flat.at[safe].get(
-            out_sharding=P("data", None, *fspec[1:]))
-    contrib = jnp.where(padmask[..., None, None], 0.0,
-                        contrib.astype(jnp.float32))
-    d_table = contrib.sum(axis=1).astype(ct.dtype)
+        # Explicit-sharding reshape merges one axis group at a time and
+        # wants the output spec spelled out: rows keep the data axis,
+        # and a head axis sharded by tensor parallelism stays the major
+        # half of the merged [heads*width] axis (contiguous per device).
+        cspec = _value_spec(ct)
+        flat = jnp.reshape(ct, (n * k_width, heads, width),
+                           out_sharding=P(cspec[0], *cspec[2:]))
+        flat = jnp.reshape(flat, (n * k_width, heads * width),
+                           out_sharding=P(cspec[0], cspec[2]))
+        contrib = flat.at[safe].get(out_sharding=P("data", None, cspec[2]))
+    contrib = jnp.where(padmask[..., None], 0.0, contrib)
+    d_table = contrib.sum(axis=1, dtype=jnp.float32).astype(ct.dtype)
+    if _mesh_empty():
+        d_table = d_table.reshape(n, heads, width)
+    else:
+        d_table = jnp.reshape(d_table, (n, heads, width),
+                              out_sharding=P("data", cspec[2], None))
     # The table is full-width (its cotangent must match): gather the
     # row-sharded partials back to full width under a mesh.
     d_table = replicate(d_table)
@@ -434,10 +450,8 @@ def gather_graph_attention(q, k, v, nbr, val, inv=None):
         # inverse index (5.3×-forward backward → ~2× measured on-chip).
         kg = neighbor_gather(k, idx, inv)
         vg = neighbor_gather(v, idx, inv)
-    elif _mesh_empty():
-        kg, vg = k[idx], v[idx]        # [N, K, heads, d]
     else:
-        kg = _neighbor_gather_impl(k, idx)
+        kg = _neighbor_gather_impl(k, idx)  # [N, K, heads, d]
         vg = _neighbor_gather_impl(v, idx)
     s = jnp.einsum("nhd,nkhd->nhk", q, kg).astype(jnp.float32) * scale
     s = s + val[:, None, :]

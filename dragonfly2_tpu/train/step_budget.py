@@ -6,7 +6,9 @@ samples until the optional deadline. This helper holds that logic once so
 the accounting can't drift between models.
 
 Progress hooks (the round-2 verdict's "publish throughput incrementally"):
-``on_compile`` fires once when the first step completes (compile captured),
+``on_compile`` fires when the first step completes and again on every
+mid-run new-program exclusion, always passing the CUMULATIVE compile
+seconds so assign-style consumers record the full figure,
 ``on_progress`` fires every ``progress_every`` steps with the current
 steady-state rate — the bench uses these to keep its headline current so a
 watchdog fire emits the latest measured rate instead of zero.
@@ -39,6 +41,7 @@ class StepBudget:
         self._last = self._start
         self._deadline: Optional[float] = None
         self._elapsed: Optional[float] = None
+        self._synced = False
 
     def sync_point(self, prev_output) -> None:
         """Call immediately BEFORE dispatching a program shape that has
@@ -49,6 +52,7 @@ class StepBudget:
             return  # first-step accounting already covers this case
         jax.block_until_ready(prev_output)
         self._last = time.perf_counter()
+        self._synced = True
 
     def tick(self, n_samples: int, first_step_output,
              new_program: bool = False) -> bool:
@@ -79,6 +83,13 @@ class StepBudget:
             if self._on_compile is not None:
                 self._on_compile(self.compile_seconds)
         elif new_program:
+            if not self._synced:
+                # Without the paired sync_point, _last is stale and the
+                # exclusion would swallow the whole steady-state window
+                # since the previous program change, inflating the rate.
+                raise RuntimeError(
+                    "tick(new_program=True) requires sync_point() "
+                    "immediately before the new-program dispatch")
             jax.block_until_ready(first_step_output)
             now = time.perf_counter()
             excluded = now - self._last
@@ -88,10 +99,14 @@ class StepBudget:
             if self._deadline is not None:
                 self._deadline += excluded
             if self._on_compile is not None:
-                self._on_compile(excluded)
+                # Cumulative, matching the first fire: consumers assign
+                # (bench.py gnn_compile_seconds=...), so an increment here
+                # would overwrite the real compile figure with the tail's.
+                self._on_compile(self.compile_seconds)
         else:
             self.samples += n_samples
         self.steps += 1
+        self._synced = False
         if (self._on_progress is not None and self.samples
                 and self.steps % self._progress_every == 0):
             # Block on the CURRENT step so the published rate counts

@@ -1,0 +1,94 @@
+"""StepBudget window accounting — the arithmetic behind every published
+samples/sec number (compile exclusion, mid-run new-program exclusion,
+deadline shifting). Timing uses real sleeps with coarse bounds so the
+assertions hold on a loaded single-core box.
+"""
+import time
+
+import pytest
+
+from dragonfly2_tpu.train.step_budget import StepBudget
+
+
+def run_steps(budget, n, batch=10, dt=0.0):
+    for _ in range(n):
+        if dt:
+            time.sleep(dt)
+        budget.tick(batch, object())
+
+
+class TestCompileExclusion:
+    def test_first_step_excluded(self):
+        b = StepBudget()
+        time.sleep(0.15)          # "compile"
+        b.tick(10, object())      # first step: no samples counted
+        run_steps(b, 5, dt=0.01)
+        b.finish()
+        assert b.compile_seconds >= 0.15
+        assert b.samples == 50
+        # window covers only the 5 steady steps, not the 150ms compile
+        assert b._elapsed < 0.15
+
+    def test_new_program_excluded_and_deadline_shifted(self):
+        b = StepBudget(max_seconds=10.0)
+        b.tick(10, object())
+        run_steps(b, 3, dt=0.01)
+        deadline_before = b._deadline
+        compile_before = b.compile_seconds
+        b.sync_point(object())
+        time.sleep(0.2)           # "tail-scan compile"
+        b.tick(10, object(), new_program=True)
+        run_steps(b, 3, dt=0.01)
+        b.finish()
+        excluded = b.compile_seconds - compile_before
+        assert excluded >= 0.2
+        # the excluded window shifts the deadline by the same amount
+        assert b._deadline == pytest.approx(deadline_before + excluded)
+        # new-program samples are not counted; 6 steady steps are
+        assert b.samples == 60
+        # the throughput window excludes the 200ms compile
+        assert b._elapsed < 0.2
+
+    def test_rate_unaffected_by_mid_run_compile(self):
+        b = StepBudget()
+        b.tick(100, object())
+        run_steps(b, 4, batch=100, dt=0.02)
+        b.sync_point(object())
+        time.sleep(0.3)
+        b.tick(100, object(), new_program=True)
+        run_steps(b, 4, batch=100, dt=0.02)
+        b.finish()
+        rate = b.samples_per_sec(100)
+        # 8 steady steps of ~20ms each -> ~5000 samples/s; a leaked
+        # 300ms exclusion would drag it under 1800
+        assert rate > 1800
+
+
+class TestPairingEnforced:
+    def test_new_program_without_sync_raises(self):
+        b = StepBudget()
+        b.tick(10, object())
+        b.tick(10, object())
+        with pytest.raises(RuntimeError, match="sync_point"):
+            b.tick(10, object(), new_program=True)
+
+    def test_sync_consumed_by_tick(self):
+        b = StepBudget()
+        b.tick(10, object())
+        b.sync_point(object())
+        b.tick(10, object(), new_program=True)
+        with pytest.raises(RuntimeError, match="sync_point"):
+            b.tick(10, object(), new_program=True)
+
+    def test_first_step_needs_no_sync(self):
+        b = StepBudget()
+        b.tick(10, object(), new_program=True)  # steps==0 path wins
+        assert b.steps == 1
+
+
+class TestDeadline:
+    def test_budget_exhaustion(self):
+        b = StepBudget(max_seconds=0.05)
+        b.tick(10, object())
+        time.sleep(0.08)
+        assert b.tick(10, object()) is True
