@@ -331,10 +331,14 @@ def build_inverse_index(nbr: np.ndarray) -> np.ndarray:
     to the max in-degree. Lets the neighbor-gather BACKWARD be a gather
     instead of a scatter-add (see :func:`neighbor_gather`): on TPU the
     duplicate-index scatter the autodiff transpose emits serializes and
-    dominated the measured train step (backward 5.3× forward, 50 GB
-    accessed/step on config #3); the inverse-index gather is parallel
-    and exact. Capped rows keep symmetrized graphs' in-degree near the
-    cap (measured max 82 at cap 64 on config #3), so D stays small.
+    dominated the measured train step (config #3 on-chip probe: forward
+    124 ms, fwd+bwd 424 ms → 271 ms with the inverse gather,
+    ``artifacts/gat_probe_r5b.json``); the inverse-index gather is
+    parallel and exact — PROVIDED the gathered rows are lane-aligned
+    (``_neighbor_gather_bwd`` flattens to [heads*head_dim]-wide rows;
+    the [4, 32]-fragment layout measured SLOWER than the scatter,
+    ``artifacts/gather_micro_r5.json``). Capped rows keep symmetrized
+    graphs' in-degree near the cap (max 82 at cap 64 on config #3).
     """
     n, k_width = nbr.shape
     rows, slots = np.nonzero(nbr != PAD_ID)
@@ -447,7 +451,8 @@ def gather_graph_attention(q, k, v, nbr, val, inv=None):
     idx = jnp.where(pad, 0, nbr)
     if inv is not None:
         # Scatter-free training path: custom backward via the host-built
-        # inverse index (5.3×-forward backward → ~2× measured on-chip).
+        # inverse index (config #3 step 424 ms autodiff → 271 ms,
+        # artifacts/gat_probe_r5b.json).
         kg = neighbor_gather(k, idx, inv)
         vg = neighbor_gather(v, idx, inv)
     else:

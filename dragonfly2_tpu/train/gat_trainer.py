@@ -213,8 +213,8 @@ def train_gat(
 
     # Gather mode trains through the scatter-free backward: the
     # host-built inverse neighbor index turns the attention gathers'
-    # VJP into gathers too (build_inverse_index — measured 5.3×-forward
-    # backward without it on config #3).
+    # VJP into 128-lane-row gathers too (build_inverse_index — config #3
+    # step 424 ms autodiff-scatter → 271 ms, artifacts/gat_probe_r5b.json).
     inv = (build_inverse_index(nbr)
            if config.attention == "gather" else None)
 
