@@ -108,8 +108,24 @@ def fwd_gather_wide(t):
     return t.reshape(n, H * W)[idx_d].reshape(n, k_width, H, W)
 
 
+ct2 = jnp.asarray(rng.standard_normal((n, k_width, H, 2 * W)), jnp.float32)
+table2 = jnp.asarray(rng.standard_normal((n, H, 2 * W)), jnp.float32)
+
+
+@jax.jit
+def inv_gather_fused(ct_):
+    flat = ct_.reshape(n * k_width, H * 2 * W)
+    contrib = flat[safe_d]
+    contrib = jnp.where(invpad_d[..., None], 0.0, contrib)
+    return contrib.sum(axis=1, dtype=jnp.float32).reshape(n, H, 2 * W)
+
+
 out = {"platform": jax.devices()[0].platform,
        "shapes": {"n": int(n), "k": int(k_width), "d_max": int(d_max)}}
+# same gather formulation, double-width [k|v] table (jit retraces on
+# the wider shape): same bytes as two narrow gathers, half the rows
+out["fwd_gather_fused_kv_ms"] = timeit(fwd_gather_current, table2)
+out["inv_fused_kv_ms"] = timeit(inv_gather_fused, ct2)
 out["scatter_add_ms"] = timeit(scatter_add, ct)
 out["inv_current_ms"] = timeit(inv_gather_current, ct)
 out["inv_wide_ms"] = timeit(inv_gather_wide, ct)

@@ -40,7 +40,13 @@ while true; do
     if [ "$LEFT" -ge 900 ]; then
       timeout 600 python artifacts/gat_probe.py \
         artifacts/gat_probe_r5c.json >> "$LOG" 2>&1
-      echo "$(date -u +%H:%M:%S) gat_probe(wide bwd) rc=$?" >> "$LOG"
+      echo "$(date -u +%H:%M:%S) gat_probe(fused kv) rc=$?" >> "$LOG"
+    fi
+    LEFT=$(( DEADLINE - $(date +%s) ))
+    if [ "$LEFT" -ge 900 ]; then
+      timeout 600 python artifacts/gather_micro.py \
+        artifacts/gather_micro_r5b.json >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) gather_micro(fused rows) rc=$?" >> "$LOG"
     fi
     LEFT=$(( DEADLINE - $(date +%s) ))
     if [ "$LEFT" -ge 2700 ]; then

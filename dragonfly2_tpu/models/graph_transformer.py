@@ -449,15 +449,22 @@ def gather_graph_attention(q, k, v, nbr, val, inv=None):
     scale = 1.0 / np.sqrt(head_dim)
     pad = nbr >= n                     # PAD_ID (and nothing else) is ≥ N
     idx = jnp.where(pad, 0, nbr)
+    # ONE gather of the concatenated [k|v] table instead of two: the
+    # neighbor gather is far from byte-bound (a 10 MB table moves at
+    # ~8 GB/s effective, gather_micro_r5.json), so if it is row-count
+    # bound, double-width rows halve the row count in forward AND
+    # backward at identical byte volume — gather_micro's fused_kv rows
+    # quantify this on-chip. Concat along head_dim keeps a
+    # tensor-parallel head axis intact.
+    kv = jnp.concatenate([k, v], axis=-1)  # [N, heads, 2d]
     if inv is not None:
         # Scatter-free training path: custom backward via the host-built
         # inverse index (config #3 step 424 ms autodiff → 271 ms,
         # artifacts/gat_probe_r5b.json).
-        kg = neighbor_gather(k, idx, inv)
-        vg = neighbor_gather(v, idx, inv)
+        kvg = neighbor_gather(kv, idx, inv)
     else:
-        kg = _neighbor_gather_impl(k, idx)  # [N, K, heads, d]
-        vg = _neighbor_gather_impl(v, idx)
+        kvg = _neighbor_gather_impl(kv, idx)  # [N, K, heads, 2d]
+    kg, vg = kvg[..., :head_dim], kvg[..., head_dim:]
     s = jnp.einsum("nhd,nkhd->nhk", q, kg).astype(jnp.float32) * scale
     s = s + val[:, None, :]
     s = jnp.where(pad[:, None, :], NEG_INF, s)
