@@ -9,6 +9,13 @@ annotate, XLA lays out the collectives.
 
 from dragonfly2_tpu.parallel.mesh import MeshContext, data_parallel_mesh
 from dragonfly2_tpu.parallel.moe import moe_apply
+from dragonfly2_tpu.parallel.multihost import (
+    MultihostMeshContext,
+    agree,
+    init_multihost,
+    multihost_mesh,
+    sync,
+)
 from dragonfly2_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
@@ -16,6 +23,7 @@ from dragonfly2_tpu.parallel.pipeline import (
 from dragonfly2_tpu.parallel.ring_attention import ring_attention
 from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["MeshContext", "data_parallel_mesh", "moe_apply",
-           "pipeline_apply", "ring_attention", "stack_stage_params",
-           "ulysses_attention"]
+__all__ = ["MeshContext", "MultihostMeshContext", "agree",
+           "data_parallel_mesh", "init_multihost", "moe_apply",
+           "multihost_mesh", "pipeline_apply", "ring_attention",
+           "stack_stage_params", "sync", "ulysses_attention"]

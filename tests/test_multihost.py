@@ -1,0 +1,123 @@
+"""Multi-host runtime: REAL multi-process proof, not a simulation.
+
+Two OS processes (4 virtual CPU devices each) join one coordinator and
+train over a single 8-device global mesh with gloo cross-process
+collectives — the same code path a multi-host TPU pod takes over DCN.
+Asserts: both processes observe identical losses (one global program),
+the distributed losses match a single-process run of the same problem,
+and `agree` round-trips values across processes.
+
+Reference parity: the reference scales across hosts by replicas
+coordinating through Redis/machinery (`internal/job/job.go:28-60`);
+training-fleet scale-out here is the JAX distributed runtime instead.
+"""
+import json
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = textwrap.dedent("""
+    import json, sys
+    sys.path.insert(0, {repo!r})
+    coordinator, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from dragonfly2_tpu.parallel import (
+        agree, init_multihost, multihost_mesh, sync)
+
+    info = init_multihost(coordinator, nproc, pid,
+                          platform="cpu", local_device_count=4)
+    assert info.global_device_count == 4 * nproc, info
+
+    import jax, jax.numpy as jnp, numpy as np
+    import optax
+
+    mesh = multihost_mesh()
+    assert mesh.n_data == 4 * nproc
+
+    # Deterministic global problem: 32 rows of linear regression; this
+    # process holds rows [pid*32/nproc, (pid+1)*32/nproc).
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((32, 8)).astype(np.float32)
+    y = (X @ rng.standard_normal((8, 1)).astype(np.float32)).ravel()
+    rows = slice(pid * 32 // nproc, (pid + 1) * 32 // nproc)
+
+    params = {{"w": np.zeros((8, 1), np.float32), "b": np.zeros((), np.float32)}}
+    tx = optax.sgd(0.1)
+    opt = tx.init(params)
+    params = mesh.put_replicated(params)
+    opt = mesh.put_replicated(opt)
+    xb, yb = mesh.put_batch(X[rows]), mesh.put_batch(y[rows])
+
+    @jax.jit
+    def step(p, o, xs, ys):
+        def loss_fn(p_):
+            pred = (xs @ p_["w"]).ravel() + p_["b"]
+            return jnp.mean((pred - ys) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        up, o2 = tx.update(g, o, p)
+        return optax.apply_updates(p, up), o2, loss
+
+    losses = []
+    for _ in range(8):
+        params, opt, loss = step(params, opt, xb, yb)
+        losses.append(float(loss))
+
+    sync("after-train")
+    got = agree(np.float32(losses[-1]))
+    assert got.shape[0] == nproc and np.all(got == got[0]), got
+    print("RESULT " + json.dumps({{"pid": pid, "losses": losses}}), flush=True)
+""")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_fleet(tmp_path, nproc):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=str(REPO)))
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), coord, str(nproc), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            assert p.returncode == 0, out[-3000:]
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                results[r["pid"]] = r["losses"]
+    assert len(results) == nproc, outs
+    return results
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    two = _run_fleet(tmp_path / "two", 2)
+    # one global program: both processes saw the same loss trajectory
+    assert two[0] == two[1]
+    # loss actually decreases (training happened)
+    assert two[0][-1] < two[0][0] * 0.5
+    # and matches the single-process run of the same global batch
+    one = _run_fleet(tmp_path / "one", 1)
+    for a, b in zip(two[0], one[0]):
+        assert abs(a - b) < 1e-4, (two[0], one[0])
