@@ -125,6 +125,42 @@ def parse_with_config(parser: argparse.ArgumentParser, argv=None):
     return parser.parse_args(argv)
 
 
+def add_multihost_flags(parser: argparse.ArgumentParser) -> None:
+    """Flags for joining a multi-process training fleet (one global
+    device mesh over DCN; see ``parallel/multihost.py``)."""
+    parser.add_argument("--coordinator", default="",
+                        help="multi-host: coordinator host:port; every "
+                             "process given the same address trains over "
+                             "ONE global device mesh (also via "
+                             "DF2_COORDINATOR_ADDRESS)")
+    parser.add_argument("--num-processes", type=int, default=0,
+                        help="multi-host: total processes in the fleet")
+    parser.add_argument("--process-id", type=int, default=-1,
+                        help="multi-host: this process's id [0, N)")
+
+
+def maybe_init_multihost(args):
+    """Join the distributed runtime when --coordinator (or the env) is
+    set; returns the global MultihostMeshContext, or None for the
+    normal single-process path."""
+    import os
+
+    if not (getattr(args, "coordinator", "")
+            or os.environ.get("DF2_COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")):
+        return None
+    from dragonfly2_tpu.parallel import init_multihost, multihost_mesh
+
+    info = init_multihost(
+        args.coordinator or None,
+        args.num_processes or None,
+        args.process_id if getattr(args, "process_id", -1) >= 0 else None,
+    )
+    print(f"multihost: process {info.process_id}/{info.num_processes}, "
+          f"{info.global_device_count} global devices", flush=True)
+    return multihost_mesh()
+
+
 def start_debug_monitor(args):
     """Start the debug monitor when --pprof-port was given (the
     reference's InitMonitor, cmd/dependency/dependency.go:95-130).

@@ -13,7 +13,9 @@ from dragonfly2_tpu.cmd.common import (
     init_tracing,
     parse_with_config,
     add_common_flags,
+    add_multihost_flags,
     init_logging,
+    maybe_init_multihost,
     start_debug_monitor,
     start_metrics_server,
     wait_for_shutdown,
@@ -36,10 +38,13 @@ def main(argv=None) -> int:
                         help="run train-step loops under "
                              "jax.profiler.trace; XPlane dumps land here "
                              "(inspect with tensorboard/xprof)")
+    add_multihost_flags(parser)
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="trainer")
     init_tracing(args, "trainer")
+    # Joining a fleet must precede any other JAX use in the process.
+    fleet_mesh = maybe_init_multihost(args)
 
     from dragonfly2_tpu import __version__
     from dragonfly2_tpu.rpc import serve
@@ -75,7 +80,7 @@ def main(argv=None) -> int:
     service = TrainerService(
         storage,
         Training(storage, registry, config=training_config,
-                 metrics=metrics),
+                 metrics=metrics, mesh=fleet_mesh),
         metrics=metrics)
     server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
     print(f"trainer serving on {server.target}", flush=True)

@@ -111,6 +111,35 @@ def _run_fleet(tmp_path, nproc):
     return results
 
 
+def test_cli_plumbing(monkeypatch):
+    """--coordinator flags reach init_multihost and the fleet mesh is
+    returned; without them the single-process path (None) is taken."""
+    import argparse
+
+    import dragonfly2_tpu.parallel as par
+    from dragonfly2_tpu.cmd.common import (
+        add_multihost_flags, maybe_init_multihost)
+
+    parser = argparse.ArgumentParser()
+    add_multihost_flags(parser)
+    for var in ("DF2_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert maybe_init_multihost(parser.parse_args([])) is None
+
+    calls = {}
+    monkeypatch.setattr(
+        par, "init_multihost",
+        lambda c, n, p: calls.update(c=c, n=n, p=p) or type(
+            "I", (), {"process_id": p, "num_processes": n,
+                      "global_device_count": 8})())
+    monkeypatch.setattr(par, "multihost_mesh", lambda: "fleet-mesh")
+    args = parser.parse_args(
+        ["--coordinator", "h:1", "--num-processes", "2",
+         "--process-id", "1"])
+    assert maybe_init_multihost(args) == "fleet-mesh"
+    assert calls == {"c": "h:1", "n": 2, "p": 1}
+
+
 def test_two_process_training_matches_single_process(tmp_path):
     two = _run_fleet(tmp_path / "two", 2)
     # one global program: both processes saw the same loss trajectory
