@@ -69,7 +69,26 @@ WORKER = textwrap.dedent("""
     sync("after-train")
     got = agree(np.float32(losses[-1]))
     assert got.shape[0] == nproc and np.all(got == got[0]), got
-    print("RESULT " + json.dumps({{"pid": pid, "losses": losses}}), flush=True)
+
+    # And the REAL MLP trainer, data split across the fleet: each
+    # process feeds its half; loss/eval are global mesh reductions.
+    from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+    from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+    rng2 = np.random.default_rng(11)
+    Xg = rng2.standard_normal((1024, FEATURE_DIM)).astype(np.float32)
+    yg = np.abs(Xg[:, :4].sum(axis=1) * 40.0 + 200.0).astype(np.float32)
+    lo, hi = pid * 1024 // nproc, (pid + 1) * 1024 // nproc
+    res = train_mlp(Xg[lo:hi], yg[lo:hi],
+                    MLPTrainConfig(hidden=(32, 16), epochs=6,
+                                   batch_size=128, eval_fraction=0.1),
+                    mesh)
+    mlp_agree = agree(np.float32(res.history[-1]))
+    assert np.all(mlp_agree == mlp_agree[0]), mlp_agree
+    print("RESULT " + json.dumps(
+        {{"pid": pid, "losses": losses,
+          "mlp_first": res.history[0], "mlp_last": res.history[-1]}}),
+        flush=True)
 """)
 
 
@@ -106,7 +125,7 @@ def _run_fleet(tmp_path, nproc):
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 r = json.loads(line[len("RESULT "):])
-                results[r["pid"]] = r["losses"]
+                results[r["pid"]] = r
     assert len(results) == nproc, outs
     return results
 
@@ -143,10 +162,13 @@ def test_cli_plumbing(monkeypatch):
 def test_two_process_training_matches_single_process(tmp_path):
     two = _run_fleet(tmp_path / "two", 2)
     # one global program: both processes saw the same loss trajectory
-    assert two[0] == two[1]
+    assert two[0]["losses"] == two[1]["losses"]
     # loss actually decreases (training happened)
-    assert two[0][-1] < two[0][0] * 0.5
+    assert two[0]["losses"][-1] < two[0]["losses"][0] * 0.5
+    # the REAL trainer converged across the fleet too
+    assert two[0]["mlp_last"] < two[0]["mlp_first"]
+    assert two[0]["mlp_last"] == two[1]["mlp_last"]
     # and matches the single-process run of the same global batch
     one = _run_fleet(tmp_path / "one", 1)
-    for a, b in zip(two[0], one[0]):
-        assert abs(a - b) < 1e-4, (two[0], one[0])
+    for a, b in zip(two[0]["losses"], one[0]["losses"]):
+        assert abs(a - b) < 1e-4, (two[0]["losses"], one[0]["losses"])
