@@ -60,7 +60,13 @@ while true; do
     if [ "$LEFT" -ge 900 ]; then
       timeout 600 python artifacts/gather_micro.py \
         artifacts/gather_micro_r5b.json >> "$LOG" 2>&1
-      echo "$(date -u +%H:%M:%S) gather_micro(fused rows) rc=$?" >> "$LOG"
+      echo "$(date -u +%H:%M:%S) gather_micro(fused+pallas) rc=$?" >> "$LOG"
+    fi
+    LEFT=$(( DEADLINE - $(date +%s) ))
+    if [ "$LEFT" -ge 1500 ]; then
+      DF2_PALLAS_GATHER=1 timeout 700 python artifacts/gat_bench.py \
+        artifacts/gat_bench_r5_pallas.json >> "$LOG" 2>&1
+      echo "$(date -u +%H:%M:%S) gat_bench(pallas gather) rc=$?" >> "$LOG"
     fi
     LEFT=$(( DEADLINE - $(date +%s) ))
     if [ "$LEFT" -ge 2700 ]; then

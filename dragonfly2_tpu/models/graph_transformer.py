@@ -429,6 +429,32 @@ def _neighbor_gather_bwd(inv, ct):
 neighbor_gather.defvjp(_neighbor_gather_fwd, _neighbor_gather_bwd)
 
 
+def _single_device_tpu() -> bool:
+    """Is this trace a single-device TPU program? (Pallas kernels are
+    per-device; a >1-device mesh keeps the XLA paths that explicit
+    sharding partitions.)"""
+    mesh = jax.sharding.get_abstract_mesh()
+    return ((mesh.empty or mesh.size == 1)
+            and jax.devices()[0].platform == "tpu")
+
+
+def _pallas_gather_enabled(table) -> bool:
+    """Gate for the VMEM-resident pallas gather: explicit opt-in, a
+    single-device TPU program, lane-aligned row width, and BOTH
+    directions' residents (bf16 table forward, column-chunked f32
+    accumulator backward) within the VMEM budget."""
+    import os
+
+    if os.environ.get("DF2_PALLAS_GATHER") != "1":
+        return False
+    if not _single_device_tpu():
+        return False
+    from dragonfly2_tpu.ops.table_gather import pallas_path_feasible
+
+    n, heads, width = table.shape
+    return pallas_path_feasible(n, heads * width, table.dtype)
+
+
 def gather_graph_attention(q, k, v, nbr, val, inv=None):
     """Neighbor-gather attention: each query row attends to exactly its
     ≤K listed neighbors — O(N·K·H) compute AND memory.
@@ -457,7 +483,26 @@ def gather_graph_attention(q, k, v, nbr, val, inv=None):
     # quantify this on-chip. Concat along head_dim keeps a
     # tensor-parallel head axis intact.
     kv = jnp.concatenate([k, v], axis=-1)  # [N, heads, 2d]
-    if inv is not None:
+    if _pallas_gather_enabled(kv):
+        # Opt-in (DF2_PALLAS_GATHER=1) single-device path: both gather
+        # directions are VMEM-resident pallas kernels (the table fits),
+        # replacing XLA's one-HBM-DMA-per-row lowering AND the inverse
+        # index (the backward is a VMEM scatter-add). Default stays XLA
+        # until the on-chip A/B (gather_micro_r5b) proves this faster.
+        from dragonfly2_tpu.ops.table_gather import neighbor_gather_pallas
+
+        wide = 2 * heads * head_dim
+        if _mesh_empty():
+            kv2 = kv.reshape(n, wide)
+        else:
+            kv2 = jnp.reshape(kv, (n, wide), out_sharding=P(None, None))
+        kvg = neighbor_gather_pallas(kv2, idx)
+        if _mesh_empty():
+            kvg = kvg.reshape(n, -1, heads, 2 * head_dim)
+        else:
+            kvg = jnp.reshape(kvg, (n, idx.shape[1], heads, 2 * head_dim),
+                              out_sharding=P(None, None, None, None))
+    elif inv is not None:
         # Scatter-free training path: custom backward via the host-built
         # inverse index (config #3 step 424 ms autodiff → 271 ms,
         # artifacts/gat_probe_r5b.json).
@@ -480,9 +525,7 @@ def blocks_graph_attention(q, k, v, nbr, val, chunk):
     the ``lax.scan`` online-softmax path otherwise."""
     import os
 
-    mesh = jax.sharding.get_abstract_mesh()
-    single_device = mesh.empty or mesh.size == 1
-    if (single_device and jax.devices()[0].platform == "tpu"
+    if (_single_device_tpu()
             and not os.environ.get("DF2_DISABLE_GRAPH_FLASH")):
         from dragonfly2_tpu.ops.flash_attention import graph_flash_attention
 

@@ -1,0 +1,185 @@
+"""Pallas TPU kernels for small-table row gather / scatter-add.
+
+Motivation (config #3, `artifacts/gather_micro_r5.json`): XLA lowers a
+row gather from a 10 MB table to one HBM DMA per row — 1.28 M DMAs
+move 655 MB at ~8 GB/s, DMA-issue-rate bound, and the autodiff
+transpose (duplicate-index scatter-add) is the same op run backwards.
+But a degree-capped probe graph's K/V table FITS IN VMEM (~16 MB/core):
+these kernels pin the table (gather) or the gradient accumulator
+(scatter-add) in VMEM and stream the big side ([M, D] rows) through
+blocked grid steps, so the per-row operation is a VMEM dynamic slice —
+no HBM round trip per row.
+
+Opt-in (`DF2_PALLAS_GATHER=1`) single-device TPU path for
+``gather_graph_attention``; the XLA inverse-index formulation stays the
+default until the on-chip A/B (vigil `gather_micro_r5b.json`) proves
+this faster. Correctness is hermetic: ``interpret=True`` tests compare
+against ``table[idx]`` and autodiff end to end.
+
+Reference hook: SURVEY §2.6 (pallas ops mandate); the consumer is the
+GraphTransformer gather mode (`models/graph_transformer.py`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Rows per grid step of the streamed side. 512 rows × 256 lanes × 4 B
+# = 512 KB per block — small against VMEM after the resident table.
+BLOCK = 512
+
+# Leave headroom beside the resident table: double-buffered row blocks,
+# scratch, and the compiler's own allocations.
+VMEM_TABLE_BUDGET = 12 * 1024 * 1024
+
+
+def fits_vmem(n_rows: int, width: int, dtype) -> bool:
+    return n_rows * width * jnp.dtype(dtype).itemsize <= VMEM_TABLE_BUDGET
+
+
+def _scatter_col_chunk(n_rows: int, d: int) -> int | None:
+    """Widest column chunk (multiple of 128 dividing d) whose f32
+    accumulator [n_rows, chunk] fits the VMEM budget; None if even 128
+    columns don't fit."""
+    dc = (d // 128) * 128
+    while dc >= 128:
+        if d % dc == 0 and n_rows * dc * 4 <= VMEM_TABLE_BUDGET:
+            return dc
+        dc -= 128
+    return None
+
+
+def pallas_path_feasible(n_rows: int, width: int, dtype) -> bool:
+    """Both directions fit: the forward's resident table AND the
+    backward's (column-chunked) f32 accumulator."""
+    return (width % 128 == 0
+            and fits_vmem(n_rows, width, dtype)
+            and _scatter_col_chunk(n_rows, width) is not None)
+
+
+def _gather_kernel(idx_ref, table_ref, out_ref):
+    def body(r, _):
+        j = idx_ref[r]
+        out_ref[pl.ds(r, 1), :] = table_ref[pl.ds(j, 1), :]
+        return 0
+
+    jax.lax.fori_loop(0, out_ref.shape[0], body, 0, unroll=8)
+
+
+@partial(jax.jit, static_argnames=("interpret", "block"))
+def table_gather(table, idx, *, interpret: bool = False,
+                 block: int = BLOCK):
+    """``table[idx]`` with the table resident in VMEM.
+
+    table: [N, D] (D a multiple of 128, N·D·itemsize within the VMEM
+    budget); idx: [M] int32 in [0, N). Returns [M, D] in table's dtype.
+    """
+    n, d = table.shape
+    (m,) = idx.shape
+    assert d % 128 == 0, d
+    m_pad = pl.cdiv(m, block) * block
+    idx_p = jnp.pad(idx.astype(jnp.int32), (0, m_pad - m))
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid=(m_pad // block,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((n, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_pad, d), table.dtype),
+        interpret=interpret,
+    )(idx_p, table)
+    return out[:m]
+
+
+def _scatter_add_kernel(idx_ref, ct_ref, out_ref):
+    # Grid is (column_chunks, row_blocks): the accumulator chunk stays
+    # resident across the inner row sweep; zero it on the sweep's
+    # first step.
+    @pl.when(pl.program_id(1) == 0)
+    def _zero():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    def body(r, _):
+        j = idx_ref[r]
+        out_ref[pl.ds(j, 1), :] += (
+            ct_ref[pl.ds(r, 1), :].astype(jnp.float32))
+        return 0
+
+    jax.lax.fori_loop(0, ct_ref.shape[0], body, 0, unroll=8)
+
+
+@partial(jax.jit, static_argnames=("n_rows", "interpret", "block"))
+def table_scatter_add(ct, idx, n_rows: int, *, interpret: bool = False,
+                      block: int = BLOCK):
+    """``zeros([n_rows, D]).at[idx].add(ct)`` (f32 accumulation) with
+    the accumulator resident in VMEM while ct rows stream through the
+    grid in their OWN dtype (upcast happens per row block inside the
+    kernel — no padded f32 copy of the cotangent in HBM).
+
+    When the full f32 accumulator would bust the VMEM budget, the grid
+    gains an outer dimension over column chunks (each chunk's sweep
+    revisits its own [n_rows, dc] window); duplicate indices accumulate
+    exactly either way. Rows of zeros may be used as padding.
+    """
+    m, d = ct.shape
+    assert d % 128 == 0, d
+    dc = _scatter_col_chunk(n_rows, d)
+    assert dc is not None, (n_rows, d)
+    m_pad = pl.cdiv(m, block) * block
+    idx_p = jnp.pad(idx.astype(jnp.int32), (0, m_pad - m))
+    ct_p = jnp.pad(ct, ((0, m_pad - m), (0, 0)))
+    out = pl.pallas_call(
+        _scatter_add_kernel,
+        grid=(d // dc, m_pad // block),
+        in_specs=[
+            pl.BlockSpec((block,), lambda c, i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, dc), lambda c, i: (i, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_rows, dc), lambda c, i: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_rows, d), jnp.float32),
+        interpret=interpret,
+    )(idx_p, ct_p)
+    return out.astype(ct.dtype)
+
+
+def neighbor_gather_pallas(table, idx, *, interpret: bool = False,
+                           block: int = BLOCK):
+    """[N, K]-indexed row gather with BOTH directions as VMEM-resident
+    pallas kernels: forward gathers rows of ``table`` [N, D]; the
+    backward scatter-adds the cotangent into a VMEM accumulator — no
+    inverse index needed. Numerically exact vs ``table[idx]`` +
+    autodiff (pad rows must carry zero cotangent, which the attention
+    mask guarantees — same contract as the inverse-index path)."""
+
+    @jax.custom_vjp
+    def gather(t, ix):
+        n, k = ix.shape
+        return table_gather(t, ix.reshape(-1), interpret=interpret,
+                            block=block).reshape(n, k, -1)
+
+    def fwd(t, ix):
+        return gather(t, ix), (ix, t.shape[0])
+
+    def bwd(res, ct):
+        ix, n_rows = res
+        n, k = ix.shape
+        d_t = table_scatter_add(ct.reshape(n * k, -1), ix.reshape(-1),
+                                n_rows, interpret=interpret, block=block)
+        return d_t, np.zeros(ix.shape, dtype=jax.dtypes.float0)
+
+    gather.defvjp(fwd, bwd)
+    return gather(table, idx)

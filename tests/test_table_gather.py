@@ -1,0 +1,110 @@
+"""VMEM-resident pallas table gather / scatter-add (ops/table_gather).
+
+Hermetic interpret-mode checks against ``table[idx]`` and autodiff —
+the same exactness contract the inverse-index path carries. Small
+blocks keep interpret tracing fast; block padding paths (M not a
+multiple of the block) are covered explicitly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.ops.table_gather import (
+    _scatter_col_chunk,
+    fits_vmem,
+    neighbor_gather_pallas,
+    pallas_path_feasible,
+    table_gather,
+    table_scatter_add,
+)
+
+B = 16  # tiny blocks: interpret mode traces the whole row loop
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(3)
+
+
+class TestGather:
+    @pytest.mark.parametrize("m", [B, B * 3, B * 2 + 5, 3])
+    def test_matches_plain_indexing(self, rng, m):
+        t = jnp.asarray(rng.standard_normal((50, 128)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, 50, m), jnp.int32)
+        out = table_gather(t, idx, interpret=True, block=B)
+        assert out.shape == (m, 128)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(t)[idx])
+
+    def test_bf16(self, rng):
+        t = jnp.asarray(rng.standard_normal((20, 256)), jnp.bfloat16)
+        idx = jnp.asarray(rng.integers(0, 20, 33), jnp.int32)
+        out = table_gather(t, idx, interpret=True, block=B)
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float32), np.asarray(t, np.float32)[idx])
+
+
+class TestScatterAdd:
+    def test_duplicate_indices_accumulate_exactly(self, rng):
+        # every row hits one of 4 targets — heavy duplication
+        ct = jnp.asarray(rng.standard_normal((B * 2 + 7, 128)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, 4, ct.shape[0]), jnp.int32)
+        out = table_scatter_add(ct, idx, 10, interpret=True, block=B)
+        ref = jnp.zeros((10, 128)).at[idx].add(ct)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_zero_rows_are_inert_padding(self, rng):
+        ct = jnp.zeros((5, 128), jnp.float32)
+        out = table_scatter_add(ct, jnp.zeros(5, jnp.int32), 8,
+                                interpret=True, block=B)
+        assert float(jnp.max(jnp.abs(out))) == 0.0
+
+
+class TestNeighborGatherVJP:
+    def test_grad_matches_autodiff(self, rng):
+        t = jnp.asarray(rng.standard_normal((30, 128)), jnp.float32)
+        ix = jnp.asarray(rng.integers(0, 30, (9, 5)), jnp.int32)
+
+        def f(tt):
+            return jnp.sum(jnp.sin(
+                neighbor_gather_pallas(tt, ix, interpret=True, block=B)))
+
+        def f_ref(tt):
+            return jnp.sum(jnp.sin(tt[ix]))
+
+        ga, gb = jax.grad(f)(t), jax.grad(f_ref)(t)
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_vmem_budget_gate():
+    assert fits_vmem(20_000, 256, jnp.bfloat16)       # config #3 fused kv
+    assert not fits_vmem(100_000, 256, jnp.float32)   # 100k graph: no
+    # feasibility covers BOTH directions: config #3's backward f32
+    # accumulator (20.5 MB full-width) only fits column-chunked
+    assert pallas_path_feasible(20_000, 256, jnp.bfloat16)
+    assert _scatter_col_chunk(20_000, 256) == 128
+    assert not pallas_path_feasible(100_000, 256, jnp.bfloat16)
+    # width that is not lane-aligned is rejected outright
+    assert not pallas_path_feasible(1_000, 192 + 1, jnp.bfloat16)
+
+
+def test_column_chunked_scatter_matches(rng):
+    # n_rows large enough that the module budget forces d-chunking is
+    # impractical in interpret mode; instead exercise the chunked grid
+    # directly by monkeypatching the budget down so dc < d.
+    import dragonfly2_tpu.ops.table_gather as tg
+
+    ct = jnp.asarray(rng.standard_normal((40, 256)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, 12, 40), jnp.int32)
+    old = tg.VMEM_TABLE_BUDGET
+    try:
+        tg.VMEM_TABLE_BUDGET = 12 * 128 * 4  # exactly one 128-col chunk
+        assert tg._scatter_col_chunk(12, 256) == 128
+        out = table_scatter_add(ct, idx, 12, interpret=True, block=B)
+    finally:
+        tg.VMEM_TABLE_BUDGET = old
+    ref = jnp.zeros((12, 256)).at[idx].add(ct)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
