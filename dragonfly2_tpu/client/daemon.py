@@ -24,6 +24,7 @@ from dragonfly2_tpu.client.peer_task import (
     PeerTaskResult,
     SchedulerAPI,
 )
+from dragonfly2_tpu.client.piece import parse_url_range
 from dragonfly2_tpu.client.storage import StorageManager, StorageOptions
 from dragonfly2_tpu.client.traffic_shaper import (
     TrafficShaper,
@@ -187,9 +188,17 @@ class Daemon:
                       request_header: Dict[str, str] | None = None,
                       tag: str = "", application: str = "",
                       filtered_query_params=None,
-                      piece_sink=None) -> PeerTaskResult:
+                      piece_sink=None, url_range: str = "") -> PeerTaskResult:
+        # dfget --range a-b (cmd/dfget/cmd/root.go:195): the ranged
+        # window is its own task — the range participates in the task id
+        # (idgen task_id.go range append), so distinct ranges never share
+        # piece stores with each other or with the whole file. The id
+        # hashes the CANONICAL form, so '2-9', '02-9' and '2 - 9' are one
+        # task (and match what the conductor registers with the scheduler).
+        rng = parse_url_range(url_range) if url_range else None
         task_id = idgen.task_id_v1(
             url, tag=tag, application=application,
+            url_range=f"{rng.start}-{rng.end}" if rng else "",
             filters="&".join(filtered_query_params or []),
         )
         # Reuse fast path (peertask_reuse.go; FindCompletedTask
@@ -225,6 +234,7 @@ class Daemon:
                 is_seed=self.config.host_type.is_seed,
                 piece_sink=piece_sink,
                 metrics=self.metrics,
+                url_range=rng,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
@@ -376,12 +386,15 @@ class SeedPeerDaemonClient:
                 idgen.seed_peer_id_v1(daemon.config.ip)
                 + "-" + uuid.uuid4().hex[:8]
             )
+            seed_range = getattr(task, "url_range", "") or ""
             conductor = PeerTaskConductor(
                 daemon.scheduler, daemon.storage,
                 host_id=daemon.host_id, task_id=task.id, peer_id=peer_id,
                 url=task.url, request_header=dict(task.request_header),
                 shaper=daemon.shaper, options=daemon.config.task_options,
                 is_seed=True,
+                url_range=(parse_url_range(seed_range)
+                           if seed_range else None),
             )
             # Seeds go straight to source (StartSeedTask → back-source);
             # register first so the peer exists in the scheduler's DAG.
@@ -392,6 +405,7 @@ class SeedPeerDaemonClient:
                     host_id=daemon.host_id, task_id=task.id,
                     peer_id=peer_id, url=task.url,
                     request_header=dict(task.request_header),
+                    url_range=seed_range,
                 ),
                 channel=conductor.channel,
             )

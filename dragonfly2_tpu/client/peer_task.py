@@ -40,6 +40,8 @@ from dragonfly2_tpu.client.downloader import (
 )
 from dragonfly2_tpu.client.piece import (
     PieceMetadata,
+    Range,
+    RangeNotSatisfiable,
     compute_piece_count,
     compute_piece_size,
     piece_range,
@@ -210,6 +212,7 @@ class PeerTaskConductor:
         is_seed: bool = False,
         piece_sink=None,
         metrics=None,
+        url_range: "Range | None" = None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -218,6 +221,9 @@ class PeerTaskConductor:
         self.peer_id = peer_id
         self.url = url
         self.request_header = dict(request_header or {})
+        # dfget --range: the task's content IS this byte window of the
+        # source (task id already embeds it — daemon.download_file).
+        self.url_range = url_range
         self.shaper = shaper or PlainTrafficShaper()
         self.opts = options or PeerTaskOptions()
         self.is_seed = is_seed
@@ -271,6 +277,8 @@ class PeerTaskConductor:
                 host_id=self.host_id, task_id=self.task_id,
                 peer_id=self.peer_id, url=self.url,
                 request_header=self.request_header,
+                url_range=(f"{self.url_range.start}-{self.url_range.end}"
+                           if self.url_range else ""),
             )
             try:
                 resp = self.scheduler.register_peer(register, channel=self.channel)
@@ -645,6 +653,20 @@ class PeerTaskConductor:
         client = source_mod.client_for(request)
         length = client.get_content_length(request)
         ranged = length >= 0 and client.is_support_range(request)
+        if self.url_range is not None:
+            # The task's content is the [start, end] window of the source
+            # (dfget --range): piece fetches below shift by the window
+            # start; storage offsets stay task-local. Needs a
+            # range-capable source by construction.
+            if not ranged:
+                raise RuntimeError(
+                    f"--range requires a range-capable source: {self.url}")
+            if self.url_range.start >= length:
+                raise RangeNotSatisfiable(
+                    f"range start {self.url_range.start} beyond "
+                    f"content length {length}")
+            length = min(self.url_range.length,
+                         length - self.url_range.start)
         if not ranged:
             return self._download_source_stream(request)
 
@@ -658,12 +680,14 @@ class PeerTaskConductor:
 
         def fetch(num: int) -> None:
             rng = piece_range(num, self.piece_size, length)
+            src_rng = (Range(self.url_range.start + rng.start, rng.length)
+                       if self.url_range is not None else rng)
             begin = time.monotonic_ns()
             try:
                 self.shaper.wait_n(self.task_id, rng.length)
                 resp = client.download(
                     source_mod.Request(self.url, dict(self.request_header),
-                                       rng=rng))
+                                       rng=src_rng))
                 reader = digestutil.DigestReader(resp.body, "md5")
                 self.store.write_piece(
                     WritePieceRequest(

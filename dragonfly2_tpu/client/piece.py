@@ -45,6 +45,20 @@ class Range:
         return f"bytes={self.start}-{self.end}"
 
 
+def parse_url_range(spec: str) -> Range:
+    """Parse dfget's ``--range a-b`` spec (inclusive byte positions, the
+    reference's `Download range. Like: 0-9` — cmd/dfget/cmd/root.go:195).
+    Distinct from HTTP header parsing: both ends are required and total
+    size is unknown at parse time."""
+    a, sep, b = spec.partition("-")
+    if not sep or not a.strip().isdigit() or not b.strip().isdigit():
+        raise ValueError(f"range must be 'start-end' digits: {spec!r}")
+    start, end = int(a), int(b)
+    if end < start:
+        raise ValueError(f"range end before start: {spec!r}")
+    return Range(start=start, length=end - start + 1)
+
+
 class RangeNotSatisfiable(ValueError):
     """Syntactically valid single range that no byte of the representation
     satisfies — the only case HTTP answers with 416. Malformed or

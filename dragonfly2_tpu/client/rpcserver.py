@@ -48,6 +48,8 @@ class DownloadRequest:
     # When False the daemon downloads/caches but streams no content bytes
     # back (dfget --no-content equivalent for warm-up use).
     want_content: bool = True
+    # dfget --range "a-b": download only this byte window as its own task.
+    url_range: str = ""
 
 
 @message("dfdaemon.DownloadProgress")
@@ -141,6 +143,7 @@ class ObtainSeedsRequest:
     tag: str = ""
     filtered_query_params: list = field(default_factory=list)
     request_header: dict = field(default_factory=dict)
+    url_range: str = ""
 
 
 @message("dfdaemon.ObtainSeedsResponse")
@@ -192,6 +195,7 @@ class _SeedTask:
     tag: str = ""
     filtered_query_params: list = field(default_factory=list)
     request_header: dict = field(default_factory=dict)
+    url_range: str = ""
 
 
 class DaemonRpcService:
@@ -208,6 +212,7 @@ class DaemonRpcService:
             tag=request.tag,
             application=request.application,
             filtered_query_params=list(request.filtered_query_params) or None,
+            url_range=request.url_range,
         )
         if not result.success:
             yield DownloadProgress(
@@ -307,7 +312,8 @@ class DaemonRpcService:
             ok = self.daemon.seed_client().trigger_task(_SeedTask(
                 id=request.task_id, url=request.url, tag=request.tag,
                 filtered_query_params=list(request.filtered_query_params),
-                request_header=dict(request.request_header)))
+                request_header=dict(request.request_header),
+                url_range=request.url_range))
         except SeedBusyError as exc:
             return ObtainSeedsResponse(success=False, error=f"busy: {exc}")
         except Exception as exc:  # noqa: BLE001 — report, don't abort
@@ -362,12 +368,14 @@ class RemoteDaemonClient:
     def download(self, url: str, output_path: Optional[str] = None, *,
                  tag: str = "", application: str = "",
                  filtered_query_params=None, request_header=None,
+                 url_range: str = "",
                  timeout: float = 600.0) -> RemoteDownloadResult:
         stream = self._client.Download(DownloadRequest(
             url=url, tag=tag, application=application,
             filtered_query_params=list(filtered_query_params or []),
             request_header=dict(request_header or {}),
             want_content=output_path is not None,
+            url_range=url_range,
         ), timeout=timeout)
         result = RemoteDownloadResult()
         out = open(output_path, "wb") if output_path else None
@@ -481,7 +489,8 @@ class GrpcSeedPeerClient:
                     filtered_query_params=list(
                         getattr(task, "filtered_query_params", []) or []),
                     request_header=dict(
-                        getattr(task, "request_header", {}) or {})),
+                        getattr(task, "request_header", {}) or {}),
+                    url_range=getattr(task, "url_range", "") or ""),
                 timeout=self.timeout)
         except RpcRetryError as exc:
             logger.warning("seed trigger for %s: %s", task.id, exc)

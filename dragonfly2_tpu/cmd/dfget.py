@@ -41,6 +41,10 @@ def main(argv=None) -> int:
     parser.add_argument("--application", default="")
     parser.add_argument("--header", action="append", default=[],
                         metavar="K:V")
+    parser.add_argument("--range", dest="url_range", default="",
+                        help="download only this byte range, e.g. 0-9 "
+                             "(10 bytes); the range is its own task in "
+                             "the mesh")
     parser.add_argument("--filter", default="",
                         help="'&'-separated query params excluded from the "
                              "task id")
@@ -66,6 +70,16 @@ def main(argv=None) -> int:
     for item in args.header:
         k, _, v = item.partition(":")
         headers[k.strip()] = v.strip()
+
+    if args.url_range:
+        from dragonfly2_tpu.client.piece import parse_url_range
+
+        if args.recursive:
+            parser.error("--range cannot be combined with --recursive")
+        try:
+            parse_url_range(args.url_range)
+        except ValueError as exc:
+            parser.error(str(exc))
 
     if args.recursive:
         return _recursive_download(args, headers)
@@ -95,6 +109,7 @@ def main(argv=None) -> int:
             application=args.application,
             filtered_query_params=(args.filter.split("&")
                                    if args.filter else None),
+            url_range=args.url_range,
         )
     finally:
         daemon.stop()
@@ -237,6 +252,7 @@ def _daemon_download(args, headers):
             tag=args.tag, application=args.application,
             filtered_query_params=(args.filter.split("&")
                                    if args.filter else None),
+            url_range=args.url_range,
         )
     except Exception as exc:  # noqa: BLE001 — daemon down is a soft error
         print(f"daemon {args.daemon} failed: {exc}", file=sys.stderr)

@@ -99,6 +99,7 @@ class Task:
         request_header: Optional[Dict[str, str]] = None,
         piece_length: int = 0,
         back_to_source_limit: int = 3,
+        url_range: str = "",
     ):
         self.id = id
         self.url = url
@@ -109,6 +110,7 @@ class Task:
         self.filtered_query_params = filtered_query_params or []
         self.request_header = request_header or {}
         self.piece_length = piece_length
+        self.url_range = url_range
         self.content_length = -1
         self.total_piece_count = 0
         self.direct_piece = b""  # tiny-task inline payload

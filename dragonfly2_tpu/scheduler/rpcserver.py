@@ -172,6 +172,7 @@ class WireRegisterPeer:
     filtered_query_params: List[str] = field(default_factory=list)
     piece_length: int = 0
     need_back_to_source: bool = False
+    url_range: str = ""
 
 
 @message("scheduler.WirePeerEvent")
@@ -443,6 +444,7 @@ class SchedulerRpcService:
                         filtered_query_params=list(req.filtered_query_params),
                         piece_length=req.piece_length,
                         need_back_to_source=req.need_back_to_source,
+                        url_range=req.url_range,
                     ),
                     channel=channel,
                 )
@@ -616,6 +618,7 @@ class GrpcSchedulerClient:
             filtered_query_params=list(req.filtered_query_params),
             piece_length=req.piece_length,
             need_back_to_source=req.need_back_to_source,
+            url_range=req.url_range,
         ))
         reader = threading.Thread(
             target=self._read_loop, args=(session, channel),

@@ -65,6 +65,9 @@ class RegisterPeerRequest:
     request_header: Dict[str, str] = field(default_factory=dict)
     piece_length: int = 0
     need_back_to_source: bool = False
+    # dfget --range spec ("a-b"); rides to seed triggers so a seed
+    # downloads the same window the task id was derived from.
+    url_range: str = ""
 
 
 @dataclass
@@ -200,7 +203,8 @@ class SchedulerService:
                  application=req.application,
                  filtered_query_params=req.filtered_query_params,
                  request_header=req.request_header,
-                 piece_length=req.piece_length)
+                 piece_length=req.piece_length,
+                 url_range=req.url_range)
         )
         peer = self.resource.peer_manager.load_or_store(
             Peer(req.peer_id, task, host, tag=req.tag,

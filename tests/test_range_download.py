@@ -1,0 +1,144 @@
+"""dfget --range: ranged downloads as first-class tasks.
+
+Reference parity: cmd/dfget/cmd/root.go:195 (`--range "0-9"` downloads
+bytes 0..9 inclusive) with the range participating in the task id
+(pkg/idgen/task_id.go conditional range append), so distinct ranges
+never share piece stores with each other or the whole file.
+"""
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.client.piece import parse_url_range
+from dragonfly2_tpu.utils import idgen
+from tests.fileserver import FileServer
+
+
+@pytest.fixture()
+def origin(tmp_path):
+    root = tmp_path / "origin"
+    root.mkdir()
+    with FileServer(str(root)) as fs:
+        fs.root_dir = root
+        yield fs
+
+
+def make_peer(tmp_path, name="peer"):
+    from tests.test_p2p_e2e import make_scheduler
+
+    scheduler = make_scheduler(tmp_path)
+    daemon = Daemon(scheduler, DaemonConfig(
+        storage_root=str(tmp_path / name), hostname=name))
+    daemon.start()
+    return daemon
+
+
+class TestParse:
+    def test_inclusive_bounds(self):
+        r = parse_url_range("0-9")
+        assert (r.start, r.length, r.end) == (0, 10, 9)
+        assert parse_url_range("5-5").length == 1
+
+    @pytest.mark.parametrize("bad", ["", "5", "a-b", "9-5", "-3", "3-",
+                                     "1-2-3"])
+    def test_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_url_range(bad)
+
+
+class TestTaskIdentity:
+    def test_equivalent_specs_share_one_task(self, tmp_path, origin):
+        content = b"q" * 64
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            a = peer.download_file(origin.url("blob.bin"), url_range="2-9")
+            b = peer.download_file(origin.url("blob.bin"), url_range="02-9")
+            assert a.success and b.success
+            assert a.task_id == b.task_id and b.reused
+        finally:
+            peer.stop()
+
+    def test_cli_rejects_malformed_and_recursive_combo(self, capsys):
+        from dragonfly2_tpu.cmd.dfget import main
+
+        with pytest.raises(SystemExit):
+            main(["http://o/f", "-O", "/tmp/x", "--range", "9"])
+        with pytest.raises(SystemExit):
+            main(["http://o/f", "-O", "/tmp/x", "--range", "0-9",
+                  "--recursive"])
+
+    def test_ranges_are_distinct_tasks(self):
+        url = "http://o/blob.bin"
+        whole = idgen.task_id_v1(url)
+        r1 = idgen.task_id_v1(url, url_range="0-9")
+        r2 = idgen.task_id_v1(url, url_range="10-19")
+        assert len({whole, r1, r2}) == 3
+        # and the parent id of a ranged task is the whole-file task
+        assert idgen.parent_task_id_v1(url, url_range="0-9") == whole
+
+
+class TestRangedBackToSource:
+    def test_exact_window(self, tmp_path, origin):
+        content = bytes(range(256)) * 4
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            out = tmp_path / "out.bin"
+            result = peer.download_file(origin.url("blob.bin"),
+                                        output_path=str(out),
+                                        url_range="2-9")
+            assert result.success, result.error
+            assert out.read_bytes() == content[2:10]
+            assert result.content_length == 8
+        finally:
+            peer.stop()
+
+    def test_range_then_whole_file_do_not_mix(self, tmp_path, origin):
+        content = b"0123456789abcdef" * 64
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            ranged = peer.download_file(origin.url("blob.bin"),
+                                        url_range="4-7")
+            whole = peer.download_file(origin.url("blob.bin"))
+            assert ranged.success and whole.success
+            assert ranged.task_id != whole.task_id
+            assert ranged.content_length == 4
+            assert whole.content_length == len(content)
+            # same range again: served from the ranged task's store
+            again = peer.download_file(origin.url("blob.bin"),
+                                       url_range="4-7")
+            assert again.reused
+        finally:
+            peer.stop()
+
+    def test_end_clamped_to_content_length(self, tmp_path, origin):
+        content = b"x" * 100
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            result = peer.download_file(origin.url("blob.bin"),
+                                        url_range="40-999999")
+            assert result.success, result.error
+            assert result.content_length == 60
+        finally:
+            peer.stop()
+
+    def test_start_beyond_eof_fails(self, tmp_path, origin):
+        (origin.root_dir / "blob.bin").write_bytes(b"short")
+        peer = make_peer(tmp_path)
+        try:
+            result = peer.download_file(origin.url("blob.bin"),
+                                        url_range="100-200")
+            assert not result.success
+            assert "range" in (result.error or "").lower()
+        finally:
+            peer.stop()
+
+    def test_malformed_range_fails_before_any_network(self, tmp_path):
+        peer = make_peer(tmp_path)
+        try:
+            with pytest.raises(ValueError):
+                peer.download_file("http://unused.invalid/f", url_range="z")
+        finally:
+            peer.stop()
