@@ -92,10 +92,33 @@ class RegistryMirror:
 
 
 @dataclass
+class WhiteListEntry:
+    """(client/config WhiteList; proxy.go:343 checkWhiteList) — hosts the
+    proxy may reach. ``host`` is a regex (empty = any host); ``ports``
+    restricts destination ports (empty = any). The regex compiles
+    eagerly so a malformed pattern is a startup/reload config error, not
+    a per-request crash."""
+
+    host: str = ""
+    ports: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._regx = re.compile(self.host) if self.host else None
+        self._ports = {str(p) for p in self.ports}
+
+    def allows(self, host: str, port: int) -> bool:
+        if self._regx is not None and not self._regx.fullmatch(host):
+            return False
+        return not self._ports or str(port) in self._ports
+
+
+@dataclass
 class ProxyConfig:
     rules: List[ProxyRule] = field(default_factory=list)
     registry_mirror: Optional[RegistryMirror] = None
     basic_auth: Optional[tuple] = None  # (user, password)
+    # Empty list = allow all (the reference's no-whitelist default).
+    whitelist: List[WhiteListEntry] = field(default_factory=list)
     max_concurrency: int = 0  # 0 = unlimited
     default_tag: str = ""
     default_filter: str = ""
@@ -178,6 +201,22 @@ class ProxyServer(ThreadedHTTPService):
         req.end_headers()
         return False
 
+    def _check_whitelist(self, req: BaseHTTPRequestHandler,
+                         host: str, port: int,
+                         cfg: ProxyConfig | None = None) -> bool:
+        """proxy.go:343: a non-empty whitelist must match the destination
+        host (regex) and port, for plain requests and CONNECT both;
+        rejected destinations get 403 (the reference's StatusUnauthorized
+        role)."""
+        cfg = cfg or self.config
+        if not cfg.whitelist:
+            return True
+        host = host.lower()
+        if any(entry.allows(host, port) for entry in cfg.whitelist):
+            return True
+        req.send_error(403, f"host {host}:{port} not in proxy whitelist")
+        return False
+
     def _target_url(self, req: BaseHTTPRequestHandler,
                     cfg: ProxyConfig | None = None) -> str:
         """Absolute-form proxy URL, or mirror-mode path rewrite
@@ -223,7 +262,7 @@ class ProxyServer(ThreadedHTTPService):
     _KEEP = object()  # watch(): "option not mentioned in this reload"
 
     def watch(self, rules=_KEEP, registry_mirror=_KEEP,
-              basic_auth=_KEEP) -> None:
+              basic_auth=_KEEP, whitelist=_KEEP) -> None:
         """Hot-swap the reloadable options (proxy_manager.go:157 Watch —
         the reference swaps the rule ladder on config reload). Listener,
         CA, and hijack mode stay fixed. Defaulted (unmentioned) options
@@ -238,6 +277,8 @@ class ProxyServer(ThreadedHTTPService):
             registry_mirror=(old.registry_mirror if registry_mirror is keep
                              else registry_mirror),
             basic_auth=old.basic_auth if basic_auth is keep else basic_auth,
+            whitelist=(old.whitelist if whitelist is keep
+                       else list(whitelist or [])),
             max_concurrency=old.max_concurrency,
             default_tag=old.default_tag,
             default_filter=old.default_filter,
@@ -258,6 +299,13 @@ class ProxyServer(ThreadedHTTPService):
         try:
             url = self._target_url(req, cfg)
             use_p2p, url = self._should_use_p2p(req, url, cfg)
+            # Whitelist the FINAL destination — a rule redirect must not
+            # smuggle the proxy past the whitelist.
+            parts = urllib.parse.urlsplit(url)
+            dest_port = parts.port or (443 if parts.scheme == "https" else 80)
+            if not self._check_whitelist(req, parts.hostname or "",
+                                         dest_port, cfg):
+                return
             metrics = getattr(self.daemon, "metrics", None)
             if metrics:
                 metrics.proxy_request_count.labels(
@@ -403,10 +451,12 @@ class ProxyServer(ThreadedHTTPService):
     def _tunnel(self, req: BaseHTTPRequestHandler) -> None:
         if not self._check_auth(req):
             return
+        host, _, port = req.path.partition(":")
+        if not self._check_whitelist(req, host, int(port or 443)):
+            return
         if self.ca is not None:
             self._mitm(req)
             return
-        host, _, port = req.path.partition(":")
         try:
             upstream = socket.create_connection(
                 (host, int(port or 443)), timeout=10)

@@ -62,6 +62,20 @@ def build_daemon(args):
     return daemon
 
 
+def _parse_whitelist(spec: str):
+    """'host-regex[:port[,port]]' → WhiteListEntry. Ports split off the
+    LAST ':' and only when the suffix is digits/commas, so host regexes
+    containing ':' (e.g. '(?:a|b)\\.example') survive; the entry's
+    eager regex compile turns a malformed pattern into a startup error."""
+    from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+    host, _, ports = spec.rpartition(":")
+    if not host or not all(p.isdigit() for p in ports.split(",")):
+        host, ports = spec, ""
+    return WhiteListEntry(
+        host=host, ports=[p for p in ports.split(",") if p])
+
+
 def main(argv=None) -> int:
     import socket
 
@@ -108,6 +122,10 @@ def main(argv=None) -> int:
                         help="regex of URLs routed through the mesh")
     parser.add_argument("--registry-mirror", default="",
                         help="remote registry base for mirror mode")
+    parser.add_argument("--proxy-whitelist", action="append", default=[],
+                        help="host-regex[:port[,port]] the proxy may "
+                             "reach; repeatable. Unset = allow all "
+                             "(client/config WhiteList)")
     parser.add_argument("--proxy-hijack-https", action="store_true",
                         help="terminate CONNECT TLS with minted per-host "
                              "certs so HTTPS pulls traverse the mesh "
@@ -218,6 +236,7 @@ def main(argv=None) -> int:
             rules=[ProxyRule(regx=r) for r in args.proxy_rule],
             registry_mirror=(RegistryMirror(remote=args.registry_mirror)
                              if args.registry_mirror else None),
+            whitelist=[_parse_whitelist(w) for w in args.proxy_whitelist],
             hijack_https=args.proxy_hijack_https,
             ca_dir=args.proxy_ca_dir,
         ), port=args.proxy_port)
@@ -258,7 +277,8 @@ def main(argv=None) -> int:
                 daemon.upload.limiter.set_rate(
                     float(cfg["upload_rate"]) or INF)
             if proxy is not None and ("proxy_rule" in cfg
-                                      or "registry_mirror" in cfg):
+                                      or "registry_mirror" in cfg
+                                      or "proxy_whitelist" in cfg):
                 from dragonfly2_tpu.client.proxy import (
                     ProxyRule,
                     RegistryMirror,
@@ -274,6 +294,10 @@ def main(argv=None) -> int:
                     kwargs["registry_mirror"] = (
                         RegistryMirror(remote=cfg["registry_mirror"])
                         if cfg.get("registry_mirror") else None)
+                if "proxy_whitelist" in cfg:
+                    kwargs["whitelist"] = [
+                        _parse_whitelist(w)
+                        for w in cfg.get("proxy_whitelist") or []]
                 proxy.watch(**kwargs)
 
         watcher = ConfigWatcher(args.config, _apply_reload,

@@ -330,3 +330,105 @@ class TestGatewayCopy:
         finally:
             gw.stop()
             daemon.stop()
+
+
+class TestProxyWhitelist:
+    """proxy.go:343 checkWhiteList: a non-empty whitelist restricts which
+    destination hosts/ports the proxy will serve at all."""
+
+    def _proxy(self, tmp_path, whitelist):
+        from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+        scheduler = make_scheduler(tmp_path)
+        daemon = make_daemon(scheduler, tmp_path, "wl-peer")
+        proxy = ProxyServer(daemon, ProxyConfig(
+            whitelist=[WhiteListEntry(**w) for w in whitelist]))
+        proxy.start()
+        return proxy, daemon
+
+    def test_unlisted_host_rejected_listed_served(self, tmp_path):
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        (origin_root / "f.txt").write_bytes(b"ok")
+        proxy, daemon = self._proxy(
+            tmp_path, [{"host": r"127\.0\.0\.1"}])
+        try:
+            with FileServer(str(origin_root)) as fs:
+                with proxy_open(proxy.address, fs.url("f.txt")) as resp:
+                    assert resp.read() == b"ok"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                proxy_open(proxy.address, "http://example.org/x")
+            assert err.value.code == 403
+        finally:
+            proxy.stop()
+            daemon.stop()
+
+    def test_port_restriction(self, tmp_path):
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        (origin_root / "f.txt").write_bytes(b"ok")
+        proxy, daemon = self._proxy(
+            tmp_path, [{"host": r"127\.0\.0\.1", "ports": ["1"]}])
+        try:
+            with FileServer(str(origin_root)) as fs:
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    proxy_open(proxy.address, fs.url("f.txt"))
+                assert err.value.code == 403
+        finally:
+            proxy.stop()
+            daemon.stop()
+
+    def test_connect_respects_whitelist(self, tmp_path):
+        import http.client
+
+        proxy, daemon = self._proxy(tmp_path, [{"host": r"allowed\.example"}])
+        try:
+            conn = http.client.HTTPConnection(*proxy.address.split(":"))
+            conn.request("CONNECT", "blocked.example:443")
+            resp = conn.getresponse()
+            assert resp.status == 403
+            conn.close()
+        finally:
+            proxy.stop()
+            daemon.stop()
+
+    def test_hot_reload_updates_whitelist(self, tmp_path):
+        from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        (origin_root / "f.txt").write_bytes(b"ok")
+        proxy, daemon = self._proxy(tmp_path, [{"host": r"nowhere\.example"}])
+        try:
+            with FileServer(str(origin_root)) as fs:
+                with pytest.raises(urllib.error.HTTPError):
+                    proxy_open(proxy.address, fs.url("f.txt"))
+                proxy.watch(whitelist=[WhiteListEntry(host=r"127\.0\.0\.1")])
+                with proxy_open(proxy.address, fs.url("f.txt")) as resp:
+                    assert resp.read() == b"ok"
+                proxy.watch(whitelist=None)  # explicit clear = allow all
+                with proxy_open(proxy.address, fs.url("f.txt")) as resp:
+                    assert resp.read() == b"ok"
+        finally:
+            proxy.stop()
+            daemon.stop()
+
+    def test_rule_redirect_cannot_escape_whitelist(self, tmp_path):
+        """The whitelist applies to the FINAL (post-rewrite) destination:
+        a rule redirect to an unlisted host must be refused."""
+        from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+        scheduler = make_scheduler(tmp_path)
+        daemon = make_daemon(scheduler, tmp_path, "wl-redir-peer")
+        proxy = ProxyServer(daemon, ProxyConfig(
+            rules=[ProxyRule(regx=r"allowed\.example",
+                             redirect="evil.example")],
+            whitelist=[WhiteListEntry(host=r"allowed\.example")]))
+        proxy.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                proxy_open(proxy.address, "http://allowed.example/blob")
+            assert err.value.code == 403
+        finally:
+            proxy.stop()
+            daemon.stop()
