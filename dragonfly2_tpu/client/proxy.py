@@ -301,8 +301,13 @@ class ProxyServer(ThreadedHTTPService):
             use_p2p, url = self._should_use_p2p(req, url, cfg)
             # Whitelist the FINAL destination — a rule redirect must not
             # smuggle the proxy past the whitelist.
-            parts = urllib.parse.urlsplit(url)
-            dest_port = parts.port or (443 if parts.scheme == "https" else 80)
+            try:
+                parts = urllib.parse.urlsplit(url)
+                dest_port = parts.port or (443 if parts.scheme == "https"
+                                           else 80)
+            except ValueError:
+                req.send_error(400, f"bad proxy target: {url[:200]}")
+                return
             if not self._check_whitelist(req, parts.hostname or "",
                                          dest_port, cfg):
                 return
@@ -451,8 +456,18 @@ class ProxyServer(ThreadedHTTPService):
     def _tunnel(self, req: BaseHTTPRequestHandler) -> None:
         if not self._check_auth(req):
             return
-        host, _, port = req.path.partition(":")
-        if not self._check_whitelist(req, host, int(port or 443)):
+        # CONNECT authority form: host:port, where host may be an IPv6
+        # bracket literal — split on the LAST colon and parse defensively
+        # (a malformed port must 400, not kill the handler thread).
+        host, _, port = req.path.rpartition(":")
+        if not host:
+            host, port = req.path, ""
+        try:
+            port_no = int(port or 443)
+        except ValueError:
+            req.send_error(400, f"bad CONNECT target: {req.path[:200]}")
+            return
+        if not self._check_whitelist(req, host.strip("[]"), port_no):
             return
         if self.ca is not None:
             self._mitm(req)

@@ -53,6 +53,28 @@ def main(argv=None) -> int:
                              "(file://, s3://): download every child under "
                              "it into -O as a directory, each through the "
                              "mesh as its own task")
+    parser.add_argument("--list", action="store_true",
+                        help="with --recursive: print the child URLs and "
+                             "exit without downloading (root.go --list)")
+    parser.add_argument("--accept-regex", default="",
+                        help="with --recursive: only fetch children whose "
+                             "URL matches this regex")
+    parser.add_argument("--reject-regex", default="",
+                        help="with --recursive: skip children whose URL "
+                             "matches this regex (applied after "
+                             "--accept-regex)")
+    parser.add_argument("--digest", default="",
+                        help="expected content digest 'md5:<hex>' or "
+                             "'sha256:<hex>'; the output is verified and "
+                             "deleted on mismatch (root.go --digest)")
+    parser.add_argument("--timeout", type=float, default=0.0,
+                        help="seconds for the whole download; 0 (default) "
+                             "= no deadline (root.go --timeout)")
+    parser.add_argument("--original-offset", action="store_true",
+                        help="with --range: write the window at its "
+                             "original byte offset inside -O, so many "
+                             "ranged invocations assemble one file "
+                             "(root.go --original-offset)")
     parser.add_argument("--scheduler-tls-ca", default="",
                         help="trust roots for the scheduler wire (PEM)")
     parser.add_argument("--tls-cert", default="",
@@ -80,6 +102,22 @@ def main(argv=None) -> int:
             parse_url_range(args.url_range)
         except ValueError as exc:
             parser.error(str(exc))
+    elif args.original_offset:
+        parser.error("--original-offset requires --range")
+    if args.digest:
+        from dragonfly2_tpu.utils import digest as digestutil
+
+        try:
+            digestutil.parse(args.digest)
+        except digestutil.InvalidDigestError as exc:
+            # Full validation (algorithm, hex charset, exact length) at
+            # parse time — a typo'd digest must die HERE, not after the
+            # download where the mismatch path deletes the output.
+            parser.error(str(exc))
+    if (args.list or args.accept_regex or args.reject_regex) \
+            and not args.recursive:
+        parser.error("--list/--accept-regex/--reject-regex require "
+                     "--recursive")
 
     if args.recursive:
         return _recursive_download(args, headers)
@@ -94,17 +132,24 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.client.peer_task import PeerTaskOptions
 
     ephemeral = not args.storage_dir
     storage_dir = args.storage_dir or tempfile.mkdtemp(prefix="df2-get-")
     scheduler = _scheduler_client(args)
+    options = PeerTaskOptions()
+    # 0 = no deadline, like the reference; a week stands in for infinity
+    # so internal waits stay finite numbers.
+    options.timeout = args.timeout if args.timeout > 0 else 7 * 86400
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=storage_dir, keep_storage=not ephemeral,
+        task_options=options,
     ))
     daemon.start()
+    out_path = _download_target(args)
     try:
         result = daemon.download_file(
-            args.url, output_path=args.output,
+            args.url, output_path=out_path,
             request_header=headers, tag=args.tag,
             application=args.application,
             filtered_query_params=(args.filter.split("&")
@@ -118,10 +163,71 @@ def main(argv=None) -> int:
 
             shutil.rmtree(storage_dir, ignore_errors=True)
     if not result.success:
+        _discard_window(args, out_path)
         print(f"download failed: {result.error}", file=sys.stderr)
         return 1
+    rc = _finalize_output(args, out_path)
+    if rc:
+        return rc
     print(f"{args.output}: {result.content_length} bytes "
           f"(task {result.task_id[:16]}…)")
+    return 0
+
+
+def _discard_window(args, out_path: str) -> None:
+    """Remove a --original-offset temp window after a failed download."""
+    if out_path != args.output:
+        import contextlib
+        import os
+
+        with contextlib.suppress(OSError):
+            os.unlink(out_path)
+
+
+def _download_target(args) -> str:
+    """Where the raw download lands: a UNIQUE sibling temp file when
+    --original-offset will splice the window into -O afterwards (unique
+    so concurrent ranged invocations assembling one file never collide)."""
+    if args.original_offset:
+        import os
+        import tempfile
+
+        out_dir = os.path.dirname(os.path.abspath(args.output)) or "."
+        fd, path = tempfile.mkstemp(dir=out_dir, prefix=".df2-window-")
+        os.close(fd)
+        return path
+    return args.output
+
+
+def _finalize_output(args, out_path: str) -> int:
+    """Post-download contract flags: --digest verification (delete on
+    mismatch, root.go --digest role) and --original-offset splicing
+    (window bytes written at their source offset inside -O, so many
+    ranged invocations — possibly concurrent — assemble one file)."""
+    import os
+    import shutil
+
+    if args.digest:
+        from dragonfly2_tpu.utils import digest as digestutil
+
+        want = digestutil.parse(args.digest)
+        got = digestutil.hash_file(out_path, want.algorithm)
+        if got != want.encoded:
+            os.unlink(out_path)
+            print(f"digest mismatch: got {want.algorithm}:{got}, "
+                  f"want {args.digest}; output removed", file=sys.stderr)
+            return 1
+    if args.original_offset:
+        from dragonfly2_tpu.client.piece import parse_url_range
+
+        start = parse_url_range(args.url_range).start
+        # O_CREAT without O_TRUNC: concurrent splicers must never zero
+        # each other's already-written windows.
+        fd = os.open(args.output, os.O_CREAT | os.O_RDWR, 0o644)
+        with open(out_path, "rb") as src, os.fdopen(fd, "r+b") as dst:
+            dst.seek(start)
+            shutil.copyfileobj(src, dst, 4 << 20)
+        os.unlink(out_path)
     return 0
 
 
@@ -145,6 +251,25 @@ def _recursive_download(args, headers) -> int:
         print(f"{args.url}: no entries", file=sys.stderr)
         return 1
     base_path = urllib.parse.urlparse(base).path
+    # --accept-regex / --reject-regex (root.go): accept filters first,
+    # reject prunes what survived.
+    if args.accept_regex:
+        import re
+
+        accept = re.compile(args.accept_regex)
+        children = [c for c in children if accept.search(c)]
+    if args.reject_regex:
+        import re
+
+        reject = re.compile(args.reject_regex)
+        children = [c for c in children if not reject.search(c)]
+    if args.list:
+        for child in children:
+            print(child)
+        return 0
+    if not children:
+        print(f"{args.url}: no entries after filters", file=sys.stderr)
+        return 1
     entries = []
     for child in children:
         child_path = urllib.parse.urlparse(child).path
@@ -246,22 +371,29 @@ def _daemon_download(args, headers):
     from dragonfly2_tpu.client.rpcserver import RemoteDaemonClient
 
     client = RemoteDaemonClient(args.daemon)
+    out_path = _download_target(args)
     try:
         result = client.download(
-            args.url, output_path=args.output, request_header=headers,
+            args.url, output_path=out_path, request_header=headers,
             tag=args.tag, application=args.application,
             filtered_query_params=(args.filter.split("&")
                                    if args.filter else None),
             url_range=args.url_range,
+            timeout=args.timeout if args.timeout > 0 else 7 * 86400,
         )
     except Exception as exc:  # noqa: BLE001 — daemon down is a soft error
+        _discard_window(args, out_path)
         print(f"daemon {args.daemon} failed: {exc}", file=sys.stderr)
         return None
     finally:
         client.close()
     if not result.success:
+        _discard_window(args, out_path)
         print(f"download failed: {result.error}", file=sys.stderr)
         return 1
+    rc = _finalize_output(args, out_path)
+    if rc:
+        return rc
     via = "cache" if result.reused else "mesh"
     print(f"{args.output}: {result.content_length} bytes via daemon {via} "
           f"(task {result.task_id[:16]}…)")

@@ -142,3 +142,64 @@ class TestRangedBackToSource:
                 peer.download_file("http://unused.invalid/f", url_range="z")
         finally:
             peer.stop()
+
+
+class TestDfgetFlags:
+    """Reference dfget flag parity: --digest, --original-offset,
+    --accept/--reject-regex, --list (cmd/dfget/cmd/root.go)."""
+
+    def _get(self, argv):
+        from dragonfly2_tpu.cmd.dfget import main
+
+        return main(argv)
+
+    def test_digest_ok_and_mismatch(self, tmp_path, origin):
+        import hashlib
+
+        content = b"digestme" * 100
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        out = tmp_path / "o.bin"
+        good = hashlib.sha256(content).hexdigest()
+        rc = self._get([origin.url("blob.bin"), "-O", str(out),
+                        "--digest", f"sha256:{good}"])
+        assert rc == 0 and out.read_bytes() == content
+        out2 = tmp_path / "o2.bin"
+        rc = self._get([origin.url("blob.bin"), "-O", str(out2),
+                        "--digest", "md5:" + "0" * 32])
+        assert rc == 1
+        assert not out2.exists()  # mismatched output removed
+
+    def test_original_offset_assembles_file(self, tmp_path, origin):
+        content = bytes(range(256))
+        (origin.root_dir / "blob.bin").write_bytes(content)
+        out = tmp_path / "whole.bin"
+        for spec in ("128-255", "0-127"):
+            rc = self._get([origin.url("blob.bin"), "-O", str(out),
+                            "--range", spec, "--original-offset"])
+            assert rc == 0
+        assert out.read_bytes() == content
+        assert not (tmp_path / "whole.bin.df2-window").exists()
+
+    def test_list_and_filters(self, tmp_path, origin, capsys):
+        root = origin.root_dir / "dir"
+        root.mkdir()
+        (root / "a.bin").write_bytes(b"a")
+        (root / "b.txt").write_bytes(b"b")
+        (root / "c.bin").write_bytes(b"c")
+        url = f"file://{root}/"
+        rc = self._get([url, "-O", str(tmp_path / "out"), "--recursive",
+                        "--list", "--accept-regex", r"\.bin$",
+                        "--reject-regex", "c"])
+        assert rc == 0
+        listed = capsys.readouterr().out.strip().splitlines()
+        assert len(listed) == 1 and listed[0].endswith("a.bin")
+
+    def test_flag_preconditions(self):
+        import pytest as _pytest
+
+        with _pytest.raises(SystemExit):
+            self._get(["http://o/f", "-O", "/tmp/x", "--original-offset"])
+        with _pytest.raises(SystemExit):
+            self._get(["http://o/f", "-O", "/tmp/x", "--digest", "crc:1"])
+        with _pytest.raises(SystemExit):
+            self._get(["http://o/f", "-O", "/tmp/x", "--list"])
