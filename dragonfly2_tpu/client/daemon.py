@@ -16,6 +16,7 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
+from dataclasses import replace as dataclasses_replace
 from typing import Dict, Optional
 
 from dragonfly2_tpu.client.peer_task import (
@@ -188,7 +189,9 @@ class Daemon:
                       request_header: Dict[str, str] | None = None,
                       tag: str = "", application: str = "",
                       filtered_query_params=None,
-                      piece_sink=None, url_range: str = "") -> PeerTaskResult:
+                      piece_sink=None, url_range: str = "",
+                      priority: int = 0,
+                      disable_back_source: bool = False) -> PeerTaskResult:
         # dfget --range a-b (cmd/dfget/cmd/root.go:195): the ranged
         # window is its own task — the range participates in the task id
         # (idgen task_id.go range append), so distinct ranges never share
@@ -225,16 +228,20 @@ class Daemon:
         self.shaper.add_task(task_id)
         self.metrics.download_task_count.inc()
         self.metrics.concurrent_tasks.inc()
+        options = self.config.task_options
+        if disable_back_source:
+            options = dataclasses_replace(options, disable_back_source=True)
         try:
             conductor = PeerTaskConductor(
                 self.scheduler, self.storage,
                 host_id=self.host_id, task_id=task_id, peer_id=peer_id,
                 url=url, request_header=request_header, shaper=self.shaper,
-                options=self.config.task_options,
+                options=options,
                 is_seed=self.config.host_type.is_seed,
                 piece_sink=piece_sink,
                 metrics=self.metrics,
                 url_range=rng,
+                priority=priority,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor

@@ -156,6 +156,9 @@ class PeerTaskOptions:
     metadata_poll_interval: float = 0.2
     timeout: float = 120.0
     random_ratio: float = 0.1  # dispatcher exploration
+    # dfget --disable-back-source: this peer must NEVER fetch origin
+    # itself — downloads come from the mesh or fail (root.go flag).
+    disable_back_source: bool = False
     # Use the C++ piece transfer loop (native/pieceio.cpp) when the
     # compiled module is loadable; False pins the pure-Python path.
     native_data_plane: bool = True
@@ -213,6 +216,7 @@ class PeerTaskConductor:
         piece_sink=None,
         metrics=None,
         url_range: "Range | None" = None,
+        priority: int = 0,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -224,6 +228,10 @@ class PeerTaskConductor:
         # dfget --range: the task's content IS this byte window of the
         # source (task id already embeds it — daemon.download_file).
         self.url_range = url_range
+        # Priority ladder value forwarded verbatim to the scheduler
+        # (service.py register_peer: LEVEL1/2 reject, LEVEL3 self
+        # back-source, others warm a seed).
+        self.priority = priority
         self.shaper = shaper or PlainTrafficShaper()
         self.opts = options or PeerTaskOptions()
         self.is_seed = is_seed
@@ -279,6 +287,7 @@ class PeerTaskConductor:
                 request_header=self.request_header,
                 url_range=(f"{self.url_range.start}-{self.url_range.end}"
                            if self.url_range else ""),
+                priority=self.priority,
             )
             try:
                 resp = self.scheduler.register_peer(register, channel=self.channel)
@@ -614,6 +623,22 @@ class PeerTaskConductor:
     # -- back-to-source (pullPiecesFromSource / DownloadSource) ------------
 
     def _run_back_to_source(self, report: bool = True) -> PeerTaskResult:
+        if self.opts.disable_back_source:
+            # Report like every other terminal failure (_fail / the
+            # back-to-source exception path) so the scheduler's peer FSM
+            # fails over and other peers are never scheduled against a
+            # parent that will produce no pieces.
+            if report:
+                try:
+                    self.scheduler.download_peer_failed(self.peer_id)
+                except Exception:
+                    pass
+            self._error = ("back-to-source disabled "
+                           "(--disable-back-source); no mesh parents "
+                           "could serve the task")
+            self._done.set()
+            return PeerTaskResult(self.task_id, self.peer_id, False,
+                                  storage=self.store, error=self._error)
         if self.store is None:
             self.store = self.storage_manager.register_task(
                 self.task_id, self.peer_id

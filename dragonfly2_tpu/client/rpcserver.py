@@ -50,6 +50,10 @@ class DownloadRequest:
     want_content: bool = True
     # dfget --range "a-b": download only this byte window as its own task.
     url_range: str = ""
+    # Scheduler priority ladder value (service_v2.go register) and the
+    # dfget --disable-back-source per-request override.
+    priority: int = 0
+    disable_back_source: bool = False
 
 
 @message("dfdaemon.DownloadProgress")
@@ -213,6 +217,8 @@ class DaemonRpcService:
             application=request.application,
             filtered_query_params=list(request.filtered_query_params) or None,
             url_range=request.url_range,
+            priority=request.priority,
+            disable_back_source=request.disable_back_source,
         )
         if not result.success:
             yield DownloadProgress(
@@ -368,7 +374,8 @@ class RemoteDaemonClient:
     def download(self, url: str, output_path: Optional[str] = None, *,
                  tag: str = "", application: str = "",
                  filtered_query_params=None, request_header=None,
-                 url_range: str = "",
+                 url_range: str = "", priority: int = 0,
+                 disable_back_source: bool = False,
                  timeout: float = 600.0) -> RemoteDownloadResult:
         stream = self._client.Download(DownloadRequest(
             url=url, tag=tag, application=application,
@@ -376,6 +383,8 @@ class RemoteDaemonClient:
             request_header=dict(request_header or {}),
             want_content=output_path is not None,
             url_range=url_range,
+            priority=priority,
+            disable_back_source=disable_back_source,
         ), timeout=timeout)
         result = RemoteDownloadResult()
         out = open(output_path, "wb") if output_path else None

@@ -70,6 +70,15 @@ def main(argv=None) -> int:
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="seconds for the whole download; 0 (default) "
                              "= no deadline (root.go --timeout)")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="scheduler priority ladder value 0-6 "
+                             "(root.go -P: LEVEL1 forbidden, LEVEL2 "
+                             "back-to-source-only, LEVEL3 self "
+                             "back-source first)")
+    parser.add_argument("--disable-back-source", action="store_true",
+                        help="never fetch origin from this client: the "
+                             "mesh serves the task or the download "
+                             "fails (root.go flag)")
     parser.add_argument("--original-offset", action="store_true",
                         help="with --range: write the window at its "
                              "original byte offset inside -O, so many "
@@ -118,6 +127,8 @@ def main(argv=None) -> int:
             and not args.recursive:
         parser.error("--list/--accept-regex/--reject-regex require "
                      "--recursive")
+    if not 0 <= args.priority <= 6:
+        parser.error("--priority must be in the 0-6 ladder")
 
     if args.recursive:
         return _recursive_download(args, headers)
@@ -155,6 +166,8 @@ def main(argv=None) -> int:
             filtered_query_params=(args.filter.split("&")
                                    if args.filter else None),
             url_range=args.url_range,
+            priority=args.priority,
+            disable_back_source=args.disable_back_source,
         )
     finally:
         daemon.stop()
@@ -324,7 +337,11 @@ def _recursive_download(args, headers) -> int:
                     result = client.download(
                         child, out_path(rel), request_header=headers,
                         tag=args.tag, application=args.application,
-                        filtered_query_params=filtered)
+                        filtered_query_params=filtered,
+                        priority=args.priority,
+                        disable_back_source=args.disable_back_source,
+                        timeout=(args.timeout if args.timeout > 0
+                                 else 7 * 86400))
                 except Exception as exc:  # noqa: BLE001 — per-entry
                     failures += 1
                     print(f"{child}: {exc}", file=sys.stderr)
@@ -350,7 +367,9 @@ def _recursive_download(args, headers) -> int:
                     child, output_path=out_path(rel),
                     request_header=headers, tag=args.tag,
                     application=args.application,
-                    filtered_query_params=filtered)
+                    filtered_query_params=filtered,
+                    priority=args.priority,
+                    disable_back_source=args.disable_back_source)
                 if not result.success:
                     failures += 1
                     print(f"{child}: {result.error}", file=sys.stderr)
@@ -379,6 +398,8 @@ def _daemon_download(args, headers):
             filtered_query_params=(args.filter.split("&")
                                    if args.filter else None),
             url_range=args.url_range,
+            priority=args.priority,
+            disable_back_source=args.disable_back_source,
             timeout=args.timeout if args.timeout > 0 else 7 * 86400,
         )
     except Exception as exc:  # noqa: BLE001 — daemon down is a soft error

@@ -203,3 +203,45 @@ class TestDfgetFlags:
             self._get(["http://o/f", "-O", "/tmp/x", "--digest", "crc:1"])
         with _pytest.raises(SystemExit):
             self._get(["http://o/f", "-O", "/tmp/x", "--list"])
+
+
+class TestPriorityAndBackSource:
+    """--priority reaches the scheduler ladder; --disable-back-source
+    makes origin-fetch a hard failure (root.go flags)."""
+
+    def test_priority_level1_rejected_by_scheduler(self, tmp_path, origin):
+        (origin.root_dir / "blob.bin").write_bytes(b"data")
+        peer = make_peer(tmp_path)
+        try:
+            # LEVEL1 registration is forbidden; the conductor degrades to
+            # back-to-source (non-reporting), so the download still works
+            # but the scheduler holds no peer for it.
+            result = peer.download_file(origin.url("blob.bin"), priority=1)
+            assert result.success
+            assert peer.scheduler.resource.peer_manager.load(
+                result.peer_id) is None
+        finally:
+            peer.stop()
+
+    def test_priority_level3_self_back_sources(self, tmp_path, origin):
+        (origin.root_dir / "blob.bin").write_bytes(b"data3")
+        peer = make_peer(tmp_path)
+        try:
+            result = peer.download_file(origin.url("blob.bin"), priority=3)
+            assert result.success
+            stored = peer.scheduler.resource.peer_manager.load(result.peer_id)
+            assert stored is not None and stored.priority == 3
+        finally:
+            peer.stop()
+
+    def test_disable_back_source_fails_without_parents(self, tmp_path,
+                                                       origin):
+        (origin.root_dir / "blob.bin").write_bytes(b"never fetched")
+        peer = make_peer(tmp_path)
+        try:
+            result = peer.download_file(origin.url("blob.bin"),
+                                        disable_back_source=True)
+            assert not result.success
+            assert "back-to-source disabled" in (result.error or "")
+        finally:
+            peer.stop()
