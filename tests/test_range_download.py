@@ -245,3 +245,52 @@ class TestPriorityAndBackSource:
             assert "back-to-source disabled" in (result.error or "")
         finally:
             peer.stop()
+
+
+class TestStreamSources:
+    """Back-to-source without ranges: the close-delimited stream path
+    (_download_source_stream), mirroring the reference's
+    no-content-length fixture tier (test/tools/no-content-length)."""
+
+    def test_no_content_length_origin(self, tmp_path):
+        content = bytes(range(256)) * 5000  # ~1.25 MB, crosses pieces
+        root = tmp_path / "origin"
+        root.mkdir()
+        (root / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            with FileServer(str(root), send_content_length=False) as fs:
+                result = peer.download_file(fs.url("blob.bin"))
+            assert result.success, result.error
+            assert result.content_length == len(content)
+            assert result.read_all() == content
+        finally:
+            peer.stop()
+
+    def test_no_range_support_origin(self, tmp_path):
+        content = b"z" * (1 << 20)
+        root = tmp_path / "origin"
+        root.mkdir()
+        (root / "blob.bin").write_bytes(content)
+        peer = make_peer(tmp_path)
+        try:
+            with FileServer(str(root), support_range=False) as fs:
+                result = peer.download_file(fs.url("blob.bin"))
+            assert result.success, result.error
+            assert result.read_all() == content
+        finally:
+            peer.stop()
+
+    def test_url_range_refused_on_rangeless_source(self, tmp_path):
+        root = tmp_path / "origin"
+        root.mkdir()
+        (root / "blob.bin").write_bytes(b"cannot window this")
+        peer = make_peer(tmp_path)
+        try:
+            with FileServer(str(root), support_range=False) as fs:
+                result = peer.download_file(fs.url("blob.bin"),
+                                            url_range="0-3")
+            assert not result.success
+            assert "range-capable" in (result.error or "")
+        finally:
+            peer.stop()
