@@ -294,3 +294,62 @@ class TestStreamSources:
             assert "range-capable" in (result.error or "")
         finally:
             peer.stop()
+
+
+class TestRangedPeerToPeer:
+    """Ranged tasks ride the mesh unchanged: pieces and parents work on
+    task-local offsets, and a seed trigger downloads the same window."""
+
+    def test_second_peer_gets_window_from_first(self, tmp_path, origin):
+        import os as _os
+
+        from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+        content = _os.urandom(6 * 1024 * 1024 + 13)
+        (origin.root_dir / "c.bin").write_bytes(content)
+        scheduler = make_scheduler(tmp_path)
+        peer_a = make_daemon(scheduler, tmp_path, "peer-a")
+        peer_b = make_daemon(scheduler, tmp_path, "peer-b")
+        try:
+            url = origin.url("c.bin")
+            spec = "1048576-4194303"  # 3 MiB window, piece-unaligned start
+            ra = peer_a.download_file(url, url_range=spec)
+            assert ra.success, ra.error
+            rb = peer_b.download_file(url, url_range=spec)
+            assert rb.success, rb.error
+            assert rb.read_all() == content[1048576:4194304]
+            records = scheduler.storage.list_download()
+            assert records[-1].parents, "peer B should have had parents"
+            assert records[-1].parents[0].id == ra.peer_id
+        finally:
+            peer_a.stop()
+            peer_b.stop()
+
+    def test_seed_trigger_downloads_the_window(self, tmp_path, origin):
+        import os as _os
+
+        from dragonfly2_tpu.utils.hosttypes import HostType
+        from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+        content = _os.urandom(4 * 1024 * 1024 + 7)
+        (origin.root_dir / "d.bin").write_bytes(content)
+        scheduler = make_scheduler(tmp_path)
+        seed = make_daemon(scheduler, tmp_path, "seed-1",
+                           HostType.SUPER_SEED)
+        scheduler.seed_peer_client = seed.seed_client()
+        peer = make_daemon(scheduler, tmp_path, "ranged-peer")
+        try:
+            result = peer.download_file(origin.url("d.bin"),
+                                        url_range="100-2097251")
+            assert result.success, result.error
+            assert result.read_all() == content[100:2097252]
+            # the peer's pieces came from the seed, which must have
+            # fetched the WINDOW (not the whole file) from origin
+            records = scheduler.storage.list_download()
+            mine = [r for r in records
+                    if r.host.hostname == "ranged-peer"]
+            assert mine and mine[-1].parents, \
+                "pieces must have come from the seed"
+        finally:
+            peer.stop()
+            seed.stop()
