@@ -99,20 +99,43 @@ def init_multihost(
 class MultihostMeshContext(MeshContext):
     """MeshContext over a process-spanning mesh.
 
-    ``put_batch`` takes each process's LOCAL batch rows (the global
-    batch is the process-order concatenation) — the multi-host analogue
-    of the single-process leading-axis split. ``put_replicated`` is
-    inherited: ``jax.device_put`` replicates to non-addressable devices
-    when every process supplies the same host array (trainers already
-    feed identical params/ids everywhere).
+    The INHERITED placement methods already carry global-array
+    semantics across processes: ``jax.device_put`` of the same host
+    array to a process-spanning sharding places each process's shards
+    locally (verified by test_multihost), so trainers that feed
+    identical global arrays everywhere — which deterministic-seed
+    batching gives for free — run unchanged; each process computes on
+    its shard and XLA's collectives do the rest. ``put_local_batch``
+    is the alternative for callers that hold ONLY their own rows
+    (real fleets that can't materialize the global batch per host).
     """
 
-    def put_batch(self, batch):
+    def put_local_batch(self, batch):
+        """Place each process's LOCAL batch rows; the global batch is
+        the process-order concatenation."""
         return jax.tree.map(
             lambda a: jax.make_array_from_process_local_data(
                 self.batch_sharding, np.asarray(a)),
             batch,
         )
+
+    def put_replicated(self, tree):
+        """Like the base, but PRNG key arrays travel as their raw
+        uint32 key data: ``device_put`` refuses extended-dtype arrays on
+        non-addressable shardings (jax 0.9), while data-then-wrap
+        produces an identical replicated key on every process (the
+        trainers' ``base_key``/``fold_in`` path)."""
+
+        def put(a):
+            if isinstance(a, jax.Array) and jax.dtypes.issubdtype(
+                    a.dtype, jax.dtypes.prng_key):
+                data = jax.device_put(
+                    np.asarray(jax.random.key_data(a)), self.replicated)
+                return jax.random.wrap_key_data(
+                    data, impl=jax.random.key_impl(a))
+            return jax.device_put(a, self.replicated)
+
+        return jax.tree.map(put, tree)
 
     @property
     def process_id(self) -> int:

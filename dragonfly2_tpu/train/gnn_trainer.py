@@ -326,9 +326,17 @@ def train_gnn(
     budget = StepBudget(config.max_seconds,
                         on_compile=config.compile_callback,
                         on_progress=config.progress_callback)
+    # Multihost: device_put of a host array to a process-spanning
+    # sharding runs a cross-process value-equality collective, so
+    # PLACEMENT ORDER must be deterministic — concurrent prefetch
+    # builds would pair different steps' batches across processes.
+    # One worker still overlaps build with the running step.
+    n_workers = (1 if len({d.process_index
+                           for d in mesh.mesh.devices.flat}) > 1
+                 else config.prefetch_workers)
     stream = prefetch(train_tasks(), build,
                       depth=config.prefetch_depth,
-                      workers=config.prefetch_workers)
+                      workers=n_workers)
     profiler = (jax.profiler.trace(config.profile_dir)
                 if config.profile_dir else contextlib.nullcontext())
     with profiler:
@@ -398,7 +406,7 @@ def train_gnn(
         eval_stream = prefetch(
             padded_chunks(np.arange(eval_sampler.n_edges), batch_size),
             eval_build, depth=config.prefetch_depth,
-            workers=config.prefetch_workers,
+            workers=n_workers,
         )
         for arrays, weights in eval_stream:
             cm += np.asarray(
