@@ -97,13 +97,19 @@ class WhiteListEntry:
     proxy may reach. ``host`` is a regex (empty = any host); ``ports``
     restricts destination ports (empty = any). The regex compiles
     eagerly so a malformed pattern is a startup/reload config error, not
-    a per-request crash."""
+    a per-request crash.
+
+    Matching is case-insensitive: the proxy lowercases destination hosts
+    (DNS names are case-insensitive), so patterns compile with
+    ``re.IGNORECASE`` — an uppercase entry like ``Registry\\.Example``
+    must match the same hosts its lowercase spelling does."""
 
     host: str = ""
     ports: List[str] = field(default_factory=list)
 
     def __post_init__(self):
-        self._regx = re.compile(self.host) if self.host else None
+        self._regx = (re.compile(self.host, re.IGNORECASE)
+                      if self.host else None)
         self._ports = {str(p) for p in self.ports}
 
     def allows(self, host: str, port: int) -> bool:
@@ -462,19 +468,23 @@ class ProxyServer(ThreadedHTTPService):
         host, _, port = req.path.rpartition(":")
         if not host:
             host, port = req.path, ""
+        # One unbracketed host for BOTH the whitelist check and the dial:
+        # getaddrinfo rejects a bracketed IPv6 literal, so dialing with
+        # the raw authority form made every whitelisted IPv6 tunnel fail.
+        host = host.strip("[]")
         try:
             port_no = int(port or 443)
         except ValueError:
             req.send_error(400, f"bad CONNECT target: {req.path[:200]}")
             return
-        if not self._check_whitelist(req, host.strip("[]"), port_no):
+        if not self._check_whitelist(req, host, port_no):
             return
         if self.ca is not None:
-            self._mitm(req)
+            self._mitm(req, host)
             return
         try:
             upstream = socket.create_connection(
-                (host, int(port or 443)), timeout=10)
+                (host, port_no), timeout=10)
         except OSError as exc:
             req.send_error(503, str(exc))
             return
@@ -499,13 +509,15 @@ class ProxyServer(ThreadedHTTPService):
             upstream.close()
         req.close_connection = True
 
-    def _mitm(self, req: BaseHTTPRequestHandler) -> None:
+    def _mitm(self, req: BaseHTTPRequestHandler, host: str) -> None:
         """Terminate the CONNECT with a minted cert and serve the inner
-        HTTPS requests through the normal handler (proxy.go:298-372)."""
+        HTTPS requests through the normal handler (proxy.go:298-372).
+        ``host`` is the caller's parsed, unbracketed CONNECT host — a
+        partition(':') re-parse here would truncate IPv6 literals and
+        mint certs for a garbage name."""
         import ssl
 
         target = req.path  # host:port from the CONNECT line
-        host = target.partition(":")[0]
         req.send_response(200, "Connection Established")
         req.end_headers()
         req.wfile.flush()

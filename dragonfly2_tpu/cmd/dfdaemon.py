@@ -66,11 +66,14 @@ def _parse_whitelist(spec: str):
     """'host-regex[:port[,port]]' → WhiteListEntry. Ports split off the
     LAST ':' and only when the suffix is digits/commas, so host regexes
     containing ':' (e.g. '(?:a|b)\\.example') survive; the entry's
-    eager regex compile turns a malformed pattern into a startup error."""
+    eager regex compile turns a malformed pattern into a startup error.
+    An empty host part (':8080') is the reference's any-host
+    restricted-ports form → WhiteListEntry(host='', ports=[...])."""
     from dragonfly2_tpu.client.proxy import WhiteListEntry
 
-    host, _, ports = spec.rpartition(":")
-    if not host or not all(p.isdigit() for p in ports.split(",")):
+    host, sep, ports = spec.rpartition(":")
+    if not sep or not ports or not all(p.isdigit()
+                                       for p in ports.split(",")):
         host, ports = spec, ""
     return WhiteListEntry(
         host=host, ports=[p for p in ports.split(",") if p])

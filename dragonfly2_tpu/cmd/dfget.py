@@ -169,6 +169,13 @@ def main(argv=None) -> int:
             priority=args.priority,
             disable_back_source=args.disable_back_source,
         )
+    except Exception as exc:  # noqa: BLE001 — mirror _daemon_download:
+        # the --original-offset temp window must not leak in the output
+        # directory when the download path raises instead of returning a
+        # failure result.
+        _discard_window(args, out_path)
+        print(f"download failed: {exc}", file=sys.stderr)
+        return 1
     finally:
         daemon.stop()
         if ephemeral:

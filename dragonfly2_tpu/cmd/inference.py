@@ -26,6 +26,21 @@ def main(argv=None) -> int:
                         help="manager sqlite path (model registry)")
     parser.add_argument("--object-store-dir", default="./manager-objects")
     parser.add_argument("--reload-interval", type=float, default=30.0)
+    parser.add_argument("--no-micro-batch", action="store_true",
+                        help="serve each ModelInfer as its own device "
+                             "dispatch (debugging; loses coalescing)")
+    parser.add_argument("--batch-max-wait-s", type=float, default=0.0,
+                        help="hold every batch open this long for "
+                             "stragglers (remote-device throughput mode; "
+                             "0 = never wait)")
+    parser.add_argument("--batch-adaptive-wait-s", type=float,
+                        default=0.0005,
+                        help="open the batch window this long only when "
+                             "the queue is growing (keeps the idle path "
+                             "zero-wait; 0 = disable)")
+    parser.add_argument("--batch-max-rows", type=int, default=0,
+                        help="rows per coalesced dispatch "
+                             "(0 = the scorer's largest warm bucket)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="inference")
@@ -45,8 +60,13 @@ def main(argv=None) -> int:
     manager = ManagerService(
         Database(args.manager_db),
         FilesystemObjectStore(args.object_store_dir))
-    service = InferenceService(manager=manager,
-                               reload_interval=args.reload_interval)
+    service = InferenceService(
+        manager=manager,
+        reload_interval=args.reload_interval,
+        micro_batch=not args.no_micro_batch,
+        batch_max_wait_s=args.batch_max_wait_s,
+        batch_adaptive_wait_s=args.batch_adaptive_wait_s,
+        batch_max_rows=args.batch_max_rows or None)
     service.reload_from_manager()
     service.serve_watcher()
     server = serve([(INFERENCE_SPEC, service)],

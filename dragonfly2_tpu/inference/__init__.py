@@ -7,6 +7,13 @@ Replaces the reference's *designed but absent* Triton/GPU sidecar
 evaluator.go:48).
 """
 
-from dragonfly2_tpu.inference.scorer import MLEvaluator, ParentScorer
+from dragonfly2_tpu.inference.batcher import MicroBatcher
+from dragonfly2_tpu.inference.scorer import (
+    GATParentScorer,
+    MLEvaluator,
+    ParentScorer,
+    ScoreHandle,
+)
 
-__all__ = ["MLEvaluator", "ParentScorer"]
+__all__ = ["GATParentScorer", "MLEvaluator", "MicroBatcher",
+           "ParentScorer", "ScoreHandle"]

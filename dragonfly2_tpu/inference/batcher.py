@@ -7,7 +7,16 @@ latency scale with queue depth. The batcher coalesces requests that
 arrive while a dispatch is in flight into ONE padded device call, so N
 concurrent requests share a single round trip — the worst-case extra
 latency is one in-flight dispatch, and throughput scales to
-``max_batch`` rows per dispatch.
+``max_rows`` rows per dispatch.
+
+The worker loop is a two-stage pipeline with one in-flight slot: batch N
+is dispatched asynchronously (scorers expose ``score_async`` returning
+an un-materialized device handle), and while the device chews on it the
+worker drains the queue and stages batch N+1 into the scorer's
+preallocated per-bucket host buffers. The worker only blocks on N's
+result after N+1 is staged and dispatched — host-side batch assembly and
+device execution overlap instead of serializing. Scorers without
+``score_async`` still work; they just run the old synchronous path.
 
 Batch close is deadline-aware: by default (``max_wait_s=0``) the worker
 never waits — it blocks for the first request, then drains whatever
@@ -18,6 +27,14 @@ for remote/tunneled devices where dispatches are expensive — but the
 deadline is firm, so the knob bounds queueing delay instead of trading
 it away: worst-case added latency is ``max_wait_s`` plus one in-flight
 dispatch, never "until the batch fills".
+
+``adaptive_wait_s`` is the load-aware version of that knob: the window
+only opens when the queue-depth ladder detects strict growth (depth at
+batch start at or above ``adaptive_open_depth`` AND above the previous
+batch's depth), so the idle path keeps the zero-wait guarantee and a
+steady load pays nothing, while a building backlog gets the few hundred
+microseconds it needs to fill the large warm buckets and push the
+coalesce factor past the request-sized ceiling.
 """
 
 from __future__ import annotations
@@ -25,7 +42,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -40,21 +57,56 @@ class _Pending:
         self.error: Optional[Exception] = None
 
 
+class _Inflight:
+    """A dispatched-but-unmaterialized batch: the request group plus a
+    blocking fetch of the stacked scores (a ScoreHandle.materialize for
+    async scorers, a lambda over the already-computed array for sync
+    ones)."""
+
+    __slots__ = ("group", "fetch")
+
+    def __init__(self, group: List[_Pending],
+                 fetch: Callable[[], np.ndarray]):
+        self.group = group
+        self.fetch = fetch
+
+
 class MicroBatcher:
     """Thread-safe coalescing front for a :class:`ParentScorer`."""
 
     def __init__(self, scorer, max_rows: Optional[int] = None,
-                 max_wait_s: float = 0.0):
+                 max_wait_s: float = 0.0, adaptive_wait_s: float = 0.0,
+                 adaptive_open_depth: int = 2):
         self.scorer = scorer
-        self.max_rows = max_rows or scorer.max_batch
+        # Clamp to the scorer's capacity: a dispatch larger than
+        # max_batch has no bucket and would fail EVERY coalesced request
+        # in it — but only under load, when batches actually fill, which
+        # is exactly when an oversized --batch-max-rows would detonate.
+        self.max_rows = (min(max_rows, scorer.max_batch) if max_rows
+                         else scorer.max_batch)
+        if self.max_rows <= 0:
+            raise ValueError(f"max_rows must be positive, got {max_rows}")
         self.max_wait_s = max_wait_s
+        self.adaptive_wait_s = adaptive_wait_s
+        self.adaptive_open_depth = adaptive_open_depth
         self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
         self._closed = False
         self._close_lock = threading.Lock()
-        self._worker = threading.Thread(target=self._loop, daemon=True,
-                                        name="infer-microbatch")
         self.dispatches = 0
         self.coalesced_requests = 0
+        # Pipeline / controller counters (single-writer: the worker
+        # thread owns every one of these; readers get a snapshot via
+        # stats()).
+        self.pipelined_dispatches = 0   # staged while another was in flight
+        self.stage_overlap_s = 0.0      # assembly time hidden behind the device
+        self.window_wait_s = 0.0        # deliberate batch-window straggler wait
+        self.block_s = 0.0              # time actually blocked on results
+        self.adaptive_opens = 0         # times the adaptive window opened
+        self.max_queue_depth = 0
+        self.bucket_hits: Dict[int, int] = {}
+        self._last_depth = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="infer-microbatch")
         self._worker.start()
 
     def score(self, features: np.ndarray, timeout: float = 30.0) -> np.ndarray:
@@ -85,35 +137,123 @@ class MicroBatcher:
             raise pending.error
         return pending.result
 
+    def stats(self) -> dict:
+        """Snapshot of pipeline counters (overlap_ratio = fraction of
+        result-wait time hidden behind batch assembly)."""
+        # Single read of each counter the worker mutates, so derived
+        # ratios stay internally consistent (reading stage_overlap_s
+        # twice can yield overlap_ratio > 1 mid-update); dict(d) is one
+        # C-level copy under the GIL, safe against a concurrent insert
+        # where iterating self.bucket_hits directly would raise.
+        dispatches = self.dispatches
+        coalesced = self.coalesced_requests
+        pipelined = self.pipelined_dispatches
+        stage_overlap_s = self.stage_overlap_s
+        window_wait_s = self.window_wait_s
+        block_s = self.block_s
+        bucket_hits = dict(self.bucket_hits)
+        busy = stage_overlap_s + block_s
+        return {
+            "dispatches": dispatches,
+            "coalesced_requests": coalesced,
+            "coalesce_factor": round(coalesced / dispatches, 2)
+            if dispatches else 0.0,
+            "pipelined_dispatches": pipelined,
+            "inflight_depth_avg": round(pipelined / dispatches, 3)
+            if dispatches else 0.0,
+            "stage_overlap_s": round(stage_overlap_s, 4),
+            "window_wait_s": round(window_wait_s, 4),
+            "block_s": round(block_s, 4),
+            "overlap_ratio": round(stage_overlap_s / busy, 3)
+            if busy > 0 else 0.0,
+            "adaptive_opens": self.adaptive_opens,
+            "max_queue_depth": self.max_queue_depth,
+            "bucket_hits": dict(sorted(bucket_hits.items())),
+        }
+
+    # -- worker loop: stage half + dispatch half ---------------------------
+
+    def _window_deadline(self) -> float:
+        """Batch-close deadline for the group being assembled, or 0.0
+        for "never wait". A fixed ``max_wait_s`` wins; otherwise the
+        adaptive controller opens a window only on queue growth.
+
+        (An EWMA hold-until-device-done window was tried here and
+        removed: on hosts with noisy device times the predictor
+        systematically overholds, inflating mid-load p50/p99 by more
+        than its coalescing gain is worth.)"""
+        depth = self._queue.qsize()
+        if depth > self.max_queue_depth:
+            self.max_queue_depth = depth
+        # Track depth on EVERY batch regardless of which window source
+        # wins — otherwise the growth test below would compare against a
+        # depth from many batches ago and misread a steady queue as
+        # growing.
+        prev_depth, self._last_depth = self._last_depth, depth
+        if self.max_wait_s > 0:
+            return time.monotonic() + self.max_wait_s
+        if self.adaptive_wait_s > 0:
+            # STRICT growth: a steady queue (light load in equilibrium,
+            # or full saturation where the drain fills the batch anyway)
+            # never pays the window — only a building backlog does, and
+            # there the bigger batch is what drains it.
+            growing = (depth >= self.adaptive_open_depth
+                       and depth > prev_depth)
+            if growing:
+                self.adaptive_opens += 1
+                return time.monotonic() + self.adaptive_wait_s
+        return 0.0
+
     def _loop(self) -> None:
         carry: Optional[_Pending] = None
+        inflight: Optional[_Inflight] = None
         while True:
             if carry is not None:
                 first, carry = carry, None
+            elif inflight is not None:
+                # Stage half: batch N is on the device; grab whatever is
+                # queued for N+1 without blocking. Only when the queue is
+                # empty do we give up the overlap and retire N (its
+                # callers must not wait for traffic that may never come).
+                try:
+                    first = self._queue.get_nowait()
+                except queue.Empty:
+                    inflight = self._retire(inflight)
+                    first = self._queue.get()
             else:
                 first = self._queue.get()
-                if first is None:
-                    # close(): serve everything already queued, then exit
-                    # — callers racing a model reload must never hang.
-                    self._drain_remaining()
-                    return
+            if first is None:
+                # close(): serve everything already queued, then exit
+                # — callers racing a model reload must never hang.
+                inflight = self._retire(inflight)
+                self._drain_remaining()
+                return
+            t_stage = time.monotonic()
+            window_wait = 0.0
             group: List[_Pending] = [first]
             rows = len(first.features)
             saw_sentinel = False
             # Drain whatever is already queued, up to the device batch.
-            # With max_wait_s > 0, also hold the batch open for
-            # stragglers until the deadline — measured from the FIRST
-            # request, so its queueing delay is bounded by max_wait_s
-            # regardless of how many stragglers trickle in.
-            deadline = (time.monotonic() + self.max_wait_s
-                        if self.max_wait_s > 0 else 0.0)
+            # A positive window (fixed or adaptive) also holds the batch
+            # open for stragglers until the deadline — measured from the
+            # FIRST request, so its queueing delay is bounded by the
+            # window regardless of how many stragglers trickle in.
+            deadline = self._window_deadline()
             while rows < self.max_rows:
                 try:
                     if deadline:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
-                        nxt = self._queue.get(timeout=remaining)
+                        # Window wait is accounted separately from
+                        # assembly: it is a deliberate straggler hold,
+                        # and folding it into stage_overlap_s would pin
+                        # overlap_ratio at ~1 whenever a window is on.
+                        t_wait = time.monotonic()
+                        try:
+                            nxt = self._queue.get(timeout=remaining)
+                        finally:
+                            window_wait += time.monotonic() - t_wait
                     else:
                         nxt = self._queue.get_nowait()
                 except queue.Empty:
@@ -129,10 +269,21 @@ class MicroBatcher:
                     break
                 group.append(nxt)
                 rows += len(nxt.features)
-            self._dispatch(group)
+            # Dispatch half: ship N+1 to the device, THEN block for N —
+            # the whole point of the in-flight slot.
+            staged = self._stage_dispatch(group)
+            self.window_wait_s += window_wait
+            if inflight is not None:
+                self.stage_overlap_s += max(
+                    time.monotonic() - t_stage - window_wait, 0.0)
+                if staged is not None:
+                    self.pipelined_dispatches += 1
+                inflight = self._retire(inflight)
+            inflight = staged
             if saw_sentinel:
+                inflight = self._retire(inflight)
                 if carry is not None:
-                    self._dispatch([carry])
+                    inflight = self._retire(self._stage_dispatch([carry]))
                 self._drain_remaining()
                 return
 
@@ -143,25 +294,74 @@ class MicroBatcher:
             except queue.Empty:
                 return
             if pending is not None:
-                self._dispatch([pending])
+                self._retire(self._stage_dispatch([pending]))
 
-    def _dispatch(self, group: List[_Pending]) -> None:
+    def _stage_dispatch(self, group: List[_Pending]) -> Optional[_Inflight]:
+        """Assemble and dispatch one group. Returns the in-flight record,
+        or None when there is nothing left to retire — the sync-scorer
+        path fans results out right here (its scores exist the moment
+        score() returns; parking them in the in-flight slot would make
+        callers wait out the NEXT batch's compute for zero overlap), and
+        so does the error path."""
         self.dispatches += 1
         self.coalesced_requests += len(group)
         try:
-            stacked = np.concatenate([p.features for p in group], axis=0)
-            scores = self.scorer.score(stacked)
-            off = 0
-            for p in group:
-                n = len(p.features)
-                p.result = scores[off:off + n]
-                off += n
+            stacked = (group[0].features if len(group) == 1 else
+                       np.concatenate([p.features for p in group], axis=0))
+            score_async = getattr(self.scorer, "score_async", None)
+            if score_async is not None:
+                handle = score_async(stacked)
+                bucket = getattr(handle, "bucket", len(stacked))
+                self.bucket_hits[bucket] = self.bucket_hits.get(bucket, 0) + 1
+                return _Inflight(group, handle.materialize)
+            self._fan_out(group, self.scorer.score(stacked))
+            return None
         except Exception as exc:  # noqa: BLE001 — fan the error out
             for p in group:
                 p.error = exc
-        finally:
-            for p in group:
                 p.event.set()
+            return None
+
+    def _retire(self, inflight: Optional[_Inflight]) -> None:
+        """Block on an in-flight dispatch and fan its results (or its
+        error) out to the waiting callers. Always returns None so callers
+        can write ``inflight = self._retire(inflight)``."""
+        if inflight is None:
+            return None
+        t0 = time.monotonic()
+        try:
+            scores = inflight.fetch()
+        except Exception as exc:  # noqa: BLE001 — fan the error out
+            for p in inflight.group:
+                p.error = exc
+                p.event.set()
+            return None
+        self.block_s += time.monotonic() - t0
+        try:
+            self._fan_out(inflight.group, scores)
+        except Exception as exc:  # noqa: BLE001 — a malformed result
+            # (wrong shape, non-array) must fan out like any scorer
+            # error; letting it propagate would kill the worker and hang
+            # every later caller until timeout.
+            for p in inflight.group:
+                p.error = exc
+                p.event.set()
+        return None
+
+    @staticmethod
+    def _fan_out(group: List[_Pending], scores: np.ndarray) -> None:
+        # Slice everything BEFORE waking anyone: if the result is
+        # malformed this throws with no events set, so the caller's
+        # error fan-out reaches the whole group cleanly.
+        off = 0
+        outs = []
+        for p in group:
+            n = len(p.features)
+            outs.append(scores[off:off + n])
+            off += n
+        for p, out in zip(group, outs):
+            p.result = out
+            p.event.set()
 
     def close(self) -> None:
         with self._close_lock:

@@ -10,6 +10,12 @@ path a real deployment uses — reference integration point
 scheduler/scheduling/evaluator/evaluator.go:48). Raw per-request
 latencies are reported alongside the dispatch-floor-corrected view so
 tunnel-attached runs stay honest.
+
+Since the batcher went pipelined (stage batch N+1 while N executes), the
+report also carries the pipeline counters — in-flight depth, the
+stage/dispatch overlap ratio, adaptive-window opens, and per-bucket hit
+counts — so a load ladder shows WHERE the coalescing ceiling sits, not
+just that throughput plateaued.
 """
 
 from __future__ import annotations
@@ -38,6 +44,8 @@ def measure_colocated(
     duration_s: float = 3.0,
     max_rows: int | None = None,
     dispatch_floor_ms: float = 0.0,
+    max_wait_s: float = 0.0,
+    adaptive_wait_s: float = 0.0,
 ) -> Dict[str, float]:
     """Drive ``threads`` concurrent request loops through a MicroBatcher
     wrapped around ``scorer`` for ``duration_s`` and return latency and
@@ -46,10 +54,14 @@ def measure_colocated(
     ``dispatch_floor_ms`` — p50 of a blocking no-op device round trip,
     measured by the caller — yields the floor-corrected fields: what the
     same program observes when the device is local instead of tunneled.
+    ``max_wait_s`` / ``adaptive_wait_s`` are the batcher's batch-window
+    knobs, passed through verbatim.
     """
     from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
 
-    batcher = MicroBatcher(scorer, max_rows=max_rows)
+    batcher = MicroBatcher(scorer, max_rows=max_rows,
+                           max_wait_s=max_wait_s,
+                           adaptive_wait_s=adaptive_wait_s)
     feature_dim = FEATURE_DIM
     rng = np.random.default_rng(0)
     features = rng.standard_normal(
@@ -86,8 +98,7 @@ def measure_colocated(
 
     merged = sorted(x for sub in latencies for x in sub)
     n = len(merged)
-    coalesce = (batcher.coalesced_requests / batcher.dispatches
-                if batcher.dispatches else 0.0)
+    pipeline = batcher.stats()
     p50 = _percentile(merged, 0.50)
     p95 = _percentile(merged, 0.95)
     p99 = _percentile(merged, 0.99)
@@ -101,6 +112,12 @@ def measure_colocated(
         "p50_floor_corrected_ms": round(max(p50 - dispatch_floor_ms, 0.0), 4),
         "p99_floor_corrected_ms": round(max(p99 - dispatch_floor_ms, 0.0), 4),
         "dispatch_floor_ms": round(dispatch_floor_ms, 4),
-        "coalesce_factor": round(coalesce, 2),
-        "dispatches": batcher.dispatches,
+        "coalesce_factor": pipeline["coalesce_factor"],
+        "dispatches": pipeline["dispatches"],
+        "inflight_depth_avg": pipeline["inflight_depth_avg"],
+        "overlap_ratio": pipeline["overlap_ratio"],
+        "adaptive_opens": pipeline["adaptive_opens"],
+        "max_queue_depth": pipeline["max_queue_depth"],
+        "bucket_hits": {str(k): v
+                        for k, v in pipeline["bucket_hits"].items()},
     }

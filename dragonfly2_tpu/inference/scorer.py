@@ -21,6 +21,7 @@ matching the reference's degradation path.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Sequence
 
@@ -40,6 +41,84 @@ def _buckets(max_batch: int) -> list[int]:
         b *= 2
     out.append(max_batch)
     return out
+
+
+class ScoreHandle:
+    """An in-flight dispatch: the un-materialized device result plus the
+    valid row count. ``materialize`` blocks on the device and slices the
+    padding off — callers that want stage/dispatch overlap (the
+    double-buffered :class:`~dragonfly2_tpu.inference.batcher.MicroBatcher`)
+    hold the handle while they assemble the next batch and only block
+    when they actually need the numbers."""
+
+    __slots__ = ("_out", "_n", "bucket")
+
+    def __init__(self, out, n: int, bucket: int):
+        self._out = out
+        self._n = n
+        self.bucket = bucket
+
+    def materialize(self) -> np.ndarray:
+        # np.asarray is the synchronization point: jax dispatch is async
+        # on every backend, so this is where the host actually waits.
+        return np.asarray(self._out)[: self._n]
+
+
+class _StagingBuffers:
+    """Preallocated zeroed host buffers per jit bucket, double-buffered.
+
+    Kills the per-call ``np.zeros`` + copy churn on the hot path: a
+    request writes its rows into a preallocated buffer and only re-zeros
+    the rows the previous occupant dirtied. Two buffers per bucket so
+    the pipelined batcher (one dispatch in flight while the next is
+    staged) never waits.
+
+    Safety: jax's host→device transfer is ASYNC — the dispatch can
+    return before the input buffer has been snapshotted (observed as
+    torn batches under CPU contention), so a slot must not be refilled
+    while the dispatch that used it may still read it. Each claim
+    therefore blocks on the slot's previous dispatch (``commit`` records
+    it); by the time that output is ready the input has long been
+    consumed. With the batcher's single in-flight slot this never
+    actually blocks — slot K's previous dispatch was retired a batch
+    ago; only 3+ direct concurrent callers in one bucket serialize here.
+    A PER-BUCKET lock covers claim+fill+dispatch+commit (so a stalled
+    bucket never blocks scoring in the others); materialization happens
+    outside it.
+    """
+
+    def __init__(self, buckets: Sequence[int], make):
+        self._locks = {b: threading.Lock() for b in buckets}
+        self._bufs = {b: [make(b), make(b)] for b in buckets}
+        self._flip = {b: 0 for b in buckets}
+        self._dirty = {b: [0, 0] for b in buckets}
+        self._pending = {b: [None, None] for b in buckets}
+
+    def lock_for(self, bucket: int) -> threading.Lock:
+        return self._locks[bucket]
+
+    def claim(self, bucket: int, n: int) -> tuple:
+        """Under ``lock_for(bucket)``: (slot, buffer) for ``bucket`` with
+        rows ``n:`` guaranteed zero and no dispatch still reading it."""
+        i = self._flip[bucket]
+        self._flip[bucket] = i ^ 1
+        pending = self._pending[bucket][i]
+        if pending is not None:
+            self._pending[bucket][i] = None
+            try:
+                pending.block_until_ready()
+            except Exception:  # noqa: BLE001 — a failed dispatch can't
+                pass           # be reading the buffer either
+        buf = self._bufs[bucket][i]
+        if self._dirty[bucket][i] > n:
+            buf[n:self._dirty[bucket][i]] = 0
+        self._dirty[bucket][i] = n
+        return i, buf
+
+    def commit(self, bucket: int, slot: int, out) -> None:
+        """Under ``self.lock``: record the dispatch that now owns the
+        slot's buffer contents."""
+        self._pending[bucket][slot] = out
 
 
 class ParentScorer:
@@ -72,6 +151,8 @@ class ParentScorer:
         self._forward = jax.jit(forward)
         self.buckets = _buckets(max_batch)
         self.max_batch = max_batch
+        self._staging = _StagingBuffers(
+            self.buckets, lambda b: np.zeros((b, FEATURE_DIM), np.float32))
         # Warm the compile cache for every bucket now — first-request
         # latency must not include XLA compilation.
         for b in self.buckets:
@@ -83,16 +164,28 @@ class ParentScorer:
                 return b
         raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
 
-    def score(self, features: np.ndarray) -> np.ndarray:
-        """Scores for [n, FEATURE_DIM] features; higher is better."""
+    def score_async(self, features: np.ndarray) -> ScoreHandle:
+        """Stage ``[n, FEATURE_DIM]`` features into a preallocated bucket
+        buffer and dispatch; returns without waiting for the device. The
+        handle's ``materialize()`` blocks and yields the ``[n]`` scores."""
         n = len(features)
         if n == 0:
-            return np.zeros(0, np.float32)
+            # Same contract as score(): empty in, empty out, no device
+            # dispatch for a batch with nothing in it.
+            return ScoreHandle(np.zeros(0, np.float32), 0, self.buckets[0])
         b = self._bucket(n)
-        padded = np.zeros((b, FEATURE_DIM), np.float32)
-        padded[:n] = features
-        out = self._forward(self._params, jnp.asarray(padded))
-        return np.asarray(out)[:n]
+        with self._staging.lock_for(b):
+            slot, buf = self._staging.claim(b, n)
+            buf[:n] = features
+            out = self._forward(self._params, buf)
+            self._staging.commit(b, slot, out)
+        return ScoreHandle(out, n, b)
+
+    def score(self, features: np.ndarray) -> np.ndarray:
+        """Scores for [n, FEATURE_DIM] features; higher is better."""
+        if len(features) == 0:
+            return np.zeros(0, np.float32)
+        return self.score_async(features).materialize()
 
     def benchmark(self, batch: int = 16, iters: int = 200) -> dict:
         """Measure steady-state scoring latency; returns percentiles in ms."""
@@ -133,6 +226,14 @@ class MLEvaluator:
     @property
     def has_model(self) -> bool:
         return self._scorer is not None
+
+    def close(self) -> None:
+        """Release the scorer if it owns resources (a micro-batcher's
+        worker thread); scorers without a close are left alone. The
+        evaluator owner calls this on teardown/model swap."""
+        close = getattr(self._scorer, "close", None)
+        if close is not None:
+            close()
 
     def evaluate_parents(
         self, parents: Sequence[PeerLike], child: PeerLike, total_piece_count: int
@@ -206,6 +307,12 @@ class GATParentScorer:
         self._forward = jax.jit(forward)
         self.buckets = _buckets(max_batch)
         self.max_batch = max_batch
+        # Separate src/dst staging (the forward takes two flat [b] index
+        # vectors; a [b, 2] buffer would force a strided copy per call).
+        self._staging_src = _StagingBuffers(
+            self.buckets, lambda b: np.zeros(b, np.int32))
+        self._staging_dst = _StagingBuffers(
+            self.buckets, lambda b: np.zeros(b, np.int32))
         for b in self.buckets:
             zero = jnp.zeros(b, jnp.int32)
             self._forward(self._params, self._emb, zero,
@@ -217,13 +324,13 @@ class GATParentScorer:
                 return b
         raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
 
-    def score(self, pairs: np.ndarray) -> np.ndarray:
-        """Edge logits for [n, 2] (src, dst) host indices; higher is a
-        better parent edge."""
+    def score_async(self, pairs: np.ndarray) -> ScoreHandle:
+        """Stage validated [n, 2] (src, dst) host-index pairs and
+        dispatch without waiting for the device."""
         pairs = np.asarray(pairs)
         n = len(pairs)
         if n == 0:
-            return np.zeros(0, np.float32)
+            return ScoreHandle(np.zeros(0, np.float32), 0, self.buckets[0])
         if pairs.ndim != 2 or pairs.shape[1] != 2:
             raise ValueError(f"expected [n, 2] host-index pairs, "
                              f"got {pairs.shape}")
@@ -231,12 +338,24 @@ class GATParentScorer:
             raise ValueError("host index out of range for the "
                              f"{self.n_real}-host embedding table")
         b = self._bucket(n)
-        padded = np.zeros((b, 2), np.int32)
-        padded[:n] = pairs
-        out = self._forward(self._params, self._emb,
-                            jnp.asarray(padded[:, 0]),
-                            jnp.asarray(padded[:, 1]))
-        return np.asarray(out)[:n]
+        # src-then-dst lock order (always) for the claim+fill+dispatch
+        # window so the two vectors stay paired under concurrent callers.
+        with self._staging_src.lock_for(b), self._staging_dst.lock_for(b):
+            si, src = self._staging_src.claim(b, n)
+            di, dst = self._staging_dst.claim(b, n)
+            src[:n] = pairs[:, 0]
+            dst[:n] = pairs[:, 1]
+            out = self._forward(self._params, self._emb, src, dst)
+            self._staging_src.commit(b, si, out)
+            self._staging_dst.commit(b, di, out)
+        return ScoreHandle(out, n, b)
+
+    def score(self, pairs: np.ndarray) -> np.ndarray:
+        """Edge logits for [n, 2] (src, dst) host indices; higher is a
+        better parent edge."""
+        if len(pairs) == 0:
+            return np.zeros(0, np.float32)
+        return self.score_async(pairs).materialize()
 
     def index_of(self, host_id: str):
         """Embedding-row index for a host ID, or None when the host was

@@ -117,14 +117,26 @@ class InferenceService:
 
     ``micro_batch`` (default on) coalesces concurrent ModelInfer calls
     into one padded device dispatch (SURVEY §7: micro-batch requests so
-    latency doesn't scale with scheduler concurrency)."""
+    latency doesn't scale with scheduler concurrency). The batcher is
+    pipelined — batch N+1 is staged while N executes — and its window
+    knobs thread through here: ``batch_max_wait_s`` holds every batch
+    open (remote-device throughput mode), ``batch_adaptive_wait_s``
+    opens the window only under detected queue growth (the default:
+    idle requests keep the zero-wait path), ``batch_max_rows`` caps rows
+    per dispatch (None = the scorer's largest warm bucket)."""
 
     def __init__(self, manager=None, scheduler_id: int = 0,
-                 reload_interval: float = 30.0, micro_batch: bool = True):
+                 reload_interval: float = 30.0, micro_batch: bool = True,
+                 batch_max_wait_s: float = 0.0,
+                 batch_adaptive_wait_s: float = 0.0005,
+                 batch_max_rows: Optional[int] = None):
         self.manager = manager  # ManagerService or None (push-only mode)
         self.scheduler_id = scheduler_id
         self.reload_interval = reload_interval
         self.micro_batch = micro_batch
+        self.batch_max_wait_s = batch_max_wait_s
+        self.batch_adaptive_wait_s = batch_adaptive_wait_s
+        self.batch_max_rows = batch_max_rows
         self._models: Dict[str, _LoadedModel] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -140,7 +152,12 @@ class InferenceService:
         if self.micro_batch:
             from dragonfly2_tpu.inference.batcher import MicroBatcher
 
-            batcher = MicroBatcher(scorer)
+            batcher = MicroBatcher(
+                scorer,
+                max_rows=self.batch_max_rows,
+                max_wait_s=self.batch_max_wait_s,
+                adaptive_wait_s=self.batch_adaptive_wait_s,
+            )
         with self._lock:
             old = self._models.get(name)
             self._models[name] = _LoadedModel(version, scorer, batcher)
@@ -155,6 +172,16 @@ class InferenceService:
             timer.daemon = True
             self._grace_timers.append(timer)
             timer.start()
+
+    def batcher_stats(self) -> Dict[str, dict]:
+        """Per-model micro-batcher pipeline counters (coalesce factor,
+        in-flight depth, stage/dispatch overlap, per-bucket hits) for
+        operators chasing the serving path's latency budget."""
+        with self._lock:
+            models = dict(self._models)
+        return {name: model.batcher.stats()
+                for name, model in models.items()
+                if model.batcher is not None}
 
     def reload_from_manager(self) -> bool:
         """Pull every servable model type whose active version changed.
@@ -215,6 +242,11 @@ class InferenceService:
         for timer in self._grace_timers:
             timer.cancel()
         self._grace_timers.clear()
+        stats = self.batcher_stats()
+        if stats:
+            # The operators' record of how the serving pipeline behaved
+            # this run (coalesce factor, overlap, bucket hits).
+            logger.info("inference micro-batch pipeline stats: %s", stats)
         with self._lock:
             models = list(self._models.values())
         for model in models:

@@ -26,14 +26,25 @@ ALGORITHM_PLUGIN = "plugin"
 
 
 def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
-                  sidecar_target: str | None = None):
+                  sidecar_target: str | None = None,
+                  micro_batch: bool = False,
+                  batch_adaptive_wait_s: float = 0.0005):
     """Evaluator factory (evaluator.go:36-57 New).
 
     ``ml``: in-process :class:`MLEvaluator` when a scorer is handed over
     directly, or the sidecar-backed :class:`RemoteMLEvaluator` when a
-    gRPC target is given. ``plugin``: loaded from the
-    ``dragonfly2_tpu.evaluator`` entry-point group (the reference loads
-    ``d7y-evaluator-plugin-*.so``, evaluator/plugin.go:30-45).
+    gRPC target is given. ``micro_batch`` fronts an in-process scorer
+    with the pipelined :class:`~dragonfly2_tpu.inference.batcher.
+    MicroBatcher`, so concurrent scheduling threads coalesce into shared
+    device dispatches instead of serializing on the jit call — the same
+    serving path the sidecar uses, minus the RPC hop. It only applies to
+    the programmatic ``scorer=`` handoff (the scheduler CLI has no
+    in-process scorer path; its production route is the sidecar, which
+    owns its own batcher), and the caller owns the batcher's lifecycle:
+    call ``evaluator.close()`` on teardown or model swap. ``plugin``:
+    loaded from the ``dragonfly2_tpu.evaluator`` entry-point group (the
+    reference loads ``d7y-evaluator-plugin-*.so``,
+    evaluator/plugin.go:30-45).
     """
     if algorithm == ALGORITHM_ML:
         if sidecar_target:
@@ -45,6 +56,11 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
             return RemoteMLEvaluator(InferenceClient(sidecar_target))
         from dragonfly2_tpu.inference.scorer import MLEvaluator
 
+        if micro_batch and scorer is not None:
+            from dragonfly2_tpu.inference.batcher import MicroBatcher
+
+            scorer = MicroBatcher(
+                scorer, adaptive_wait_s=batch_adaptive_wait_s)
         return MLEvaluator(scorer)
     if algorithm == ALGORITHM_PLUGIN:
         from importlib.metadata import entry_points

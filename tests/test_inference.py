@@ -61,6 +61,54 @@ class TestParentScorer:
         b = scorer.benchmark(batch=16, iters=20)
         assert 0 < b["p50_ms"] <= b["p95_ms"] <= b["p99_ms"]
 
+    def test_score_async_matches_score(self, scorer):
+        rng = np.random.default_rng(3)
+        feats = rng.uniform(0, 50, (11, FEATURE_DIM)).astype(np.float32)
+        handle = scorer.score_async(feats)
+        assert handle.bucket == 16
+        np.testing.assert_allclose(handle.materialize(),
+                                   scorer.score(feats), rtol=1e-6)
+
+    def test_staging_reuse_does_not_leak_rows(self, scorer):
+        """The preallocated staging buffers are reused across calls: a
+        small batch after a big one in the same bucket must see zeroed
+        padding, not the big batch's stale rows."""
+        rng = np.random.default_rng(4)
+        big = rng.uniform(0, 50, (15, FEATURE_DIM)).astype(np.float32)
+        small = rng.uniform(0, 50, (9, FEATURE_DIM)).astype(np.float32)
+        fresh = scorer.score(small)
+        # Dirty both double buffers of the 16-bucket, then rescore.
+        scorer.score(big)
+        scorer.score(big)
+        np.testing.assert_allclose(scorer.score(small), fresh, rtol=1e-6)
+
+    def test_concurrent_score_stays_request_aligned(self, scorer):
+        """Direct concurrent scorer use (no batcher): double-buffered
+        staging must keep every caller's rows intact."""
+        import threading
+
+        rng = np.random.default_rng(5)
+        inputs = [rng.uniform(0, 50, (n, FEATURE_DIM)).astype(np.float32)
+                  for n in (3, 5, 7, 9, 12, 15)]
+        want = [scorer.score(f) for f in inputs]
+        errors = []
+
+        def call(i):
+            try:
+                for _ in range(10):
+                    np.testing.assert_allclose(
+                        scorer.score(inputs[i]), want[i], rtol=1e-5)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors
+
 
 @dataclass
 class FakeHost:
@@ -117,3 +165,23 @@ class TestMLEvaluator:
 
     def test_empty(self, scorer):
         assert MLEvaluator(scorer).evaluate_parents([], FakePeer(), 0) == []
+
+    def test_micro_batch_glue_and_lifecycle(self, scorer):
+        """new_evaluator(micro_batch=True) fronts the scorer with a
+        MicroBatcher; evaluator.close() releases its worker."""
+        import pytest as _pytest
+
+        from dragonfly2_tpu.inference.batcher import MicroBatcher
+        from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+        ev = new_evaluator("ml", scorer=scorer, micro_batch=True)
+        assert isinstance(ev._scorer, MicroBatcher)
+        child = FakePeer("c", FakeHost(idc="a", location="r0|z0|k0"))
+        ranked = ev.evaluate_parents(
+            [FakePeer("a"), FakePeer("b")], child, 64)
+        assert len(ranked) == 2
+        ev.close()
+        with _pytest.raises(RuntimeError, match="closed"):
+            ev._scorer.score(np.zeros((1, FEATURE_DIM), np.float32))
+        # A plain evaluator (no close on the raw scorer) is a no-op.
+        MLEvaluator(scorer=None).close()

@@ -332,6 +332,94 @@ class TestGatewayCopy:
             daemon.stop()
 
 
+class TestConnectTargetAndWhitelistRules:
+    """Regression coverage for CONNECT host handling and whitelist
+    matching rules (ADVICE r05 items)."""
+
+    class _FakeConnectReq:
+        """Just enough of BaseHTTPRequestHandler for _tunnel: the
+        CONNECT authority line plus response recording."""
+
+        def __init__(self, path):
+            self.path = path
+            self.headers = {}
+            self.responses = []
+
+        def send_error(self, code, message=None):
+            self.responses.append(code)
+
+        def send_response(self, code, message=None):
+            self.responses.append(code)
+
+        def end_headers(self):
+            pass
+
+    def _tunnel_dial_host(self, connect_path, whitelist, monkeypatch):
+        """Drive _tunnel with a fake CONNECT and capture what host the
+        proxy tried to dial (dial errors → 503, which is fine: the dial
+        argument is the thing under test)."""
+        import socket as socket_mod
+
+        from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+        proxy = ProxyServer(None, ProxyConfig(
+            whitelist=[WhiteListEntry(**w) for w in whitelist]))
+        dialed = []
+
+        def fake_create_connection(addr, timeout=None):
+            dialed.append(addr)
+            raise OSError("test: no upstream")
+
+        monkeypatch.setattr(socket_mod, "create_connection",
+                            fake_create_connection)
+        req = self._FakeConnectReq(connect_path)
+        try:
+            proxy._tunnel(req)
+        finally:
+            proxy._server.server_close()
+        return dialed, req.responses
+
+    def test_connect_dials_unbracketed_ipv6(self, monkeypatch):
+        """A whitelisted IPv6 literal must be dialed WITHOUT brackets —
+        getaddrinfo rejects '[::1]', so the bracketed form made every
+        whitelisted IPv6 tunnel fail (ADVICE r05 proxy.py:476)."""
+        dialed, responses = self._tunnel_dial_host(
+            "[::1]:443", [{"host": "::1"}], monkeypatch)
+        assert dialed == [("::1", 443)]
+        assert responses == [503]  # dial refused by the fake, not a 403
+
+    def test_connect_whitelist_rejects_before_dial(self, monkeypatch):
+        dialed, responses = self._tunnel_dial_host(
+            "[::1]:443", [{"host": r"allowed\.example"}], monkeypatch)
+        assert dialed == []
+        assert responses == [403]
+
+    def test_whitelist_matching_is_case_insensitive(self):
+        """_check_whitelist lowercases the destination host; an
+        uppercase pattern must still match (ADVICE r05 proxy.py:214)."""
+        from dragonfly2_tpu.client.proxy import WhiteListEntry
+
+        entry = WhiteListEntry(host=r"Registry\.Example")
+        assert entry.allows("registry.example", 443)
+        assert entry.allows("REGISTRY.EXAMPLE", 443)
+        assert not entry.allows("other.example", 443)
+
+    def test_parse_whitelist_empty_host_means_any(self):
+        """':8080' is the reference's any-host restricted-ports spec
+        (ADVICE r05 dfdaemon.py:73)."""
+        from dragonfly2_tpu.cmd.dfdaemon import _parse_whitelist
+
+        entry = _parse_whitelist(":8080")
+        assert entry.host == "" and entry.ports == ["8080"]
+        assert entry.allows("anything.example", 8080)
+        assert not entry.allows("anything.example", 80)
+        # Existing forms keep their meaning.
+        entry = _parse_whitelist(r"foo\.example:80,443")
+        assert entry.host == r"foo\.example"
+        assert entry.ports == ["80", "443"]
+        assert _parse_whitelist(r"foo\.example").ports == []
+
+
 class TestProxyWhitelist:
     """proxy.go:343 checkWhiteList: a non-empty whitelist restricts which
     destination hosts/ports the proxy will serve at all."""

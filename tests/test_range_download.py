@@ -194,6 +194,27 @@ class TestDfgetFlags:
         listed = capsys.readouterr().out.strip().splitlines()
         assert len(listed) == 1 and listed[0].endswith("a.bin")
 
+    def test_window_file_cleaned_up_when_download_raises(
+            self, tmp_path, origin, monkeypatch):
+        """ADVICE r05 dfget.py:160: when download_file RAISES (instead
+        of returning a failure result) in the local-daemon path, the
+        --original-offset .df2-window-* temp file must not leak in the
+        output directory."""
+        from dragonfly2_tpu.client.daemon import Daemon
+
+        (origin.root_dir / "blob.bin").write_bytes(b"x" * 64)
+
+        def boom(self, *args, **kwargs):
+            raise RuntimeError("simulated daemon crash")
+
+        monkeypatch.setattr(Daemon, "download_file", boom)
+        out = tmp_path / "whole.bin"
+        rc = self._get([origin.url("blob.bin"), "-O", str(out),
+                        "--range", "0-31", "--original-offset"])
+        assert rc == 1
+        leaked = list(tmp_path.glob(".df2-window-*"))
+        assert leaked == [], leaked
+
     def test_flag_preconditions(self):
         import pytest as _pytest
 
