@@ -49,10 +49,27 @@ import time
 
 TARGET_GNN_SAMPLES_PER_SEC_PER_CHIP = 100_000.0
 TARGET_P50_MS = 1.0
-# Round-5 latency budget (verdict item 6): colocated parent-selection p99
-# under 8 scheduler threads must stay under 2 ms on the CPU device — the
-# micro-batcher owes a tail bound, not just an idle p50.
+# Round-5 latency budget (verdict item 6), extended at round 6 from 8 to
+# 32 scheduler threads: colocated parent-selection p99 must stay under
+# 2 ms on the CPU device at BOTH rungs — the lane-sharded micro-batcher
+# owes a tail bound under real announce concurrency (the reference
+# scheduler is per-stream concurrent, service_v2.go:88), not just at the
+# 8-thread comfort point. The 128-thread rung is bounded by admission
+# control: p99 within 2× the 32-thread row, shed rate reported.
 COLOCATED_P99_TARGET_MS = 2.0
+COLOCATED_P99_TARGET_THREADS = 32
+# Lane-sharded serving config for the ladder: 2 independent pipelined
+# lanes with a 32-deep admission cap each, load-aware activation
+# (lane_grow_depth defaults to max_rows/16 = 32 requests — one full
+# 512-row dispatch). Measured shape on the 2-core dev box: 8/32 threads
+# stay on ONE active lane (full coalescing, zero sheds — identical to
+# the pre-lane pipeline), 128 threads activate the second lane and the
+# caps bound every lane's backlog to one large dispatch of waiting work,
+# shedding the rest to the (counted) rule fallback — p99 within 2× the
+# 32-thread row versus ~8× unbounded. 4 lanes measured worse here
+# (fragmented coalescing + XLA CPU contention); raise on bigger hosts.
+COLOCATED_LANES = 2
+COLOCATED_LANE_DEPTH = 32
 
 # Total wall budget. The driver's observed kill horizon is >240 s; leave
 # margin so the watchdog always wins the race against SIGKILL.
@@ -230,12 +247,14 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
             TARGET_P50_MS / max(latency["p50_ms"], 1e-9), 3),
     )
 
-    # (b) colocated: concurrent scheduler threads → MicroBatcher → one
-    # padded dispatch per in-flight window. parent_select_colocated_*
-    # fields are the deliverable named by the round-3 verdict; the
-    # 8/32/128-thread ladder and the explicit p99 budget are round 5's
-    # (verdict item 6) — p99 must hold under load, not just p50 when
-    # idle. Target: p99 < 2 ms CPU-colocated at 8 threads (BASELINE.md).
+    # (b) colocated: concurrent scheduler threads → lane-sharded
+    # MicroBatcher → one padded dispatch per lane in-flight window.
+    # parent_select_colocated_* fields are the deliverable named by the
+    # round-3 verdict; the 8/32/128-thread ladder is round 5's (verdict
+    # item 6); round 6 shards the batcher into lanes with bounded
+    # admission and moves the stated p99 < 2 ms target out to 32
+    # threads, with the 128-thread rung bounded (p99 ≤ 2× the 32-thread
+    # row) by shedding — the shed rate is reported, never dropped.
     colo_secs = max(min((scorer_budget
                          - (time.perf_counter() - scorer_t0)) / 3, 4.0), 1.0)
     load_ladder = {}
@@ -244,7 +263,9 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
                                  rows_per_request=16,
                                  duration_s=colo_secs,
                                  dispatch_floor_ms=floor_p50,
-                                 adaptive_wait_s=0.0005)
+                                 adaptive_wait_s=0.0005,
+                                 lanes=COLOCATED_LANES,
+                                 queue_depth=COLOCATED_LANE_DEPTH)
         load_ladder[n_threads] = colo
         if n_threads == 8:
             state.record(
@@ -258,16 +279,35 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
                 parent_select_colocated_coalesce_factor=colo[
                     "coalesce_factor"],
                 parent_select_colocated_threads=colo["threads"],
+                parent_select_colocated_sheds=colo["sheds"],
+            )
+        elif n_threads == COLOCATED_P99_TARGET_THREADS:
+            state.record(
+                parent_select_colocated32_p99_ms=colo["p99_ms"],
+                parent_select_colocated32_shed_rate=colo["shed_rate"],
                 parent_select_colocated_p99_target_ms=COLOCATED_P99_TARGET_MS,
+                parent_select_colocated_p99_target_threads=(
+                    COLOCATED_P99_TARGET_THREADS),
                 parent_select_colocated_p99_vs_target=round(
                     COLOCATED_P99_TARGET_MS / max(colo["p99_ms"], 1e-9), 3),
             )
+    p99_32 = load_ladder[32]["p99_ms"]
+    state.record(
+        parent_select_colocated_lanes=COLOCATED_LANES,
+        parent_select_colocated_lane_depth=COLOCATED_LANE_DEPTH,
+        parent_select_colocated128_p99_over_32=round(
+            load_ladder[128]["p99_ms"] / max(p99_32, 1e-9), 3),
+        parent_select_colocated128_shed_rate=load_ladder[128]["shed_rate"],
+    )
     state.record(parent_select_colocated_load_ladder={
         str(k): {f: v[f] for f in ("p50_ms", "p95_ms", "p99_ms",
                                    "requests_per_sec", "coalesce_factor",
                                    "requests", "inflight_depth_avg",
                                    "overlap_ratio", "adaptive_opens",
-                                   "max_queue_depth", "bucket_hits")}
+                                   "max_queue_depth", "lanes",
+                                   "active_lanes", "lane_activations",
+                                   "queue_depth_cap", "sheds", "shed_rate",
+                                   "per_lane", "bucket_hits")}
         for k, v in load_ladder.items()})
     state.stage_done("scorer")
 
@@ -421,11 +461,33 @@ def read_state(path: str) -> dict | None:
         return None
 
 
-def merge(state: BenchState, cpu_path: str, tpu_path: str) -> None:
+def persist_tpu_run(tpu_path: str, run_tag: str) -> None:
+    """Copy a successful on-chip worker state into a per-run file under
+    BENCH_STATE_DIR, so future runs that lose the tunnel can report the
+    best RECORDED on-chip result instead of only the CPU fallback.
+    Called on every merge; atomic overwrite of this run's own file."""
+    tpu = read_state(tpu_path)
+    if not tpu or tpu.get("value", 0) <= 0:
+        return
+    if tpu.get("extras", {}).get("platform") != "tpu":
+        return  # a worker that silently fell back to CPU is not on-chip
+    dest = os.path.join(STATE_DIR, f"tpu_run_{run_tag}.json")
+    tmp = dest + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(tpu, f)
+        os.replace(tmp, dest)
+    except OSError:
+        pass
+
+
+def merge(state: BenchState, cpu_path: str, tpu_path: str,
+          run_tag: str = "current") -> None:
     """Fold worker files into the orchestrator's result. TPU wins the
     headline the moment it has a nonzero value; CPU is insurance."""
     tpu = read_state(tpu_path)
     cpu = read_state(cpu_path)
+    persist_tpu_run(tpu_path, run_tag)
     chosen, source = None, None
     if tpu and tpu.get("value", 0) > 0:
         chosen, source = tpu, "tpu_worker"
@@ -455,11 +517,17 @@ def merge(state: BenchState, cpu_path: str, tpu_path: str) -> None:
                 "stages_completed": other.get("extras", {}).get(
                     "stages_completed", []),
             }
+        if chosen is None:
+            # Nothing measured at all yet — still say so explicitly; a
+            # reader of the official JSON must never have to infer where
+            # the headline came from.
+            state.result["extras"]["headline_source"] = "none"
         if source != "tpu_worker":
             # The headline stays whatever THIS run measured — but when
-            # the tunnel is down for the whole run, point the record at
-            # the best checked-in on-chip artifact so a reader of the
-            # official JSON can find the chip capability evidence.
+            # the tunnel is down for the whole run (probe timeout), point
+            # the record at the best RECORDED on-chip result — persisted
+            # bench_state runs and checked-in artifacts — so a reader of
+            # the official JSON can find the chip capability evidence.
             best = best_recorded_tpu_artifact()
             if best is not None:
                 state.result["extras"]["best_recorded_tpu_artifact"] = best
@@ -467,16 +535,20 @@ def merge(state: BenchState, cpu_path: str, tpu_path: str) -> None:
 
 
 def best_recorded_tpu_artifact():
-    """Scan checked-in bench artifacts for the highest on-chip headline
-    (clearly labeled as a PRIOR run — never substituted for the
-    measured value)."""
+    """Scan checked-in bench artifacts AND persisted bench_state runs
+    (``tpu_run_*.json``, written by :func:`persist_tpu_run` on every
+    successful on-chip run) for the highest on-chip headline (clearly
+    labeled as a PRIOR run — never substituted for the measured
+    value)."""
     import glob
     import json as _json
 
     art_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "artifacts")
     best = None
-    for path in glob.glob(os.path.join(art_dir, "bench_r*_try*.json")):
+    candidates = (glob.glob(os.path.join(art_dir, "bench_r*_try*.json"))
+                  + glob.glob(os.path.join(STATE_DIR, "tpu_run_*.json")))
+    for path in candidates:
         try:
             with open(path) as f:
                 data = _json.load(f)
@@ -487,9 +559,9 @@ def best_recorded_tpu_artifact():
             best = {"file": os.path.relpath(path, art_dir),
                     "value": data["value"],
                     "vs_baseline": data.get("vs_baseline"),
-                    "note": "prior on-chip run checked into artifacts/; "
-                            "this run's headline above was measured "
-                            "without the chip"}
+                    "note": "prior on-chip run recorded in artifacts/ or "
+                            "bench_state/; this run's headline above was "
+                            "measured without the chip"}
     return best
 
 
@@ -504,13 +576,16 @@ def main() -> None:
             pass
 
     state = BenchState(os.path.join(STATE_DIR, "merged.json"))
+    # One persisted tpu_run_<tag>.json per orchestrator run: every merge
+    # overwrites this run's own file, never a prior run's record.
+    run_tag = time.strftime("%Y%m%d_%H%M%S")
 
     def watchdog() -> None:
         while remaining() > 0:
             if state.emitted:
                 return
             time.sleep(min(1.0, max(remaining(), 0.01)))
-        merge(state, cpu_path, tpu_path)
+        merge(state, cpu_path, tpu_path, run_tag)
         state.record(orchestrator_watchdog_fired=True)
         state.emit()
         os._exit(0)
@@ -588,9 +663,9 @@ def main() -> None:
         for proc in (cpu_proc, tpu_proc):
             if proc is not None and proc.poll() is None:
                 proc.terminate()
-        merge(state, cpu_path, tpu_path)
+        merge(state, cpu_path, tpu_path, run_tag)
     finally:
-        merge(state, cpu_path, tpu_path)
+        merge(state, cpu_path, tpu_path, run_tag)
         state.emit()
 
 

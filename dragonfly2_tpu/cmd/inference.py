@@ -41,6 +41,17 @@ def main(argv=None) -> int:
     parser.add_argument("--batch-max-rows", type=int, default=0,
                         help="rows per coalesced dispatch "
                              "(0 = the scorer's largest warm bucket)")
+    parser.add_argument("--batch-lanes", type=int, default=2,
+                        help="independent micro-batch lanes (queue + "
+                             "worker + in-flight slot each); >1 removes "
+                             "the single-worker serialization point "
+                             "under concurrent scheduler load")
+    parser.add_argument("--batch-queue-depth", type=int, default=32,
+                        help="per-lane admission cap: a request whose "
+                             "round-robin lane has this many queued "
+                             "requests is shed with RESOURCE_EXHAUSTED "
+                             "(scheduler degrades to rule scoring); "
+                             "0 = unbounded")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="inference")
@@ -66,9 +77,17 @@ def main(argv=None) -> int:
         micro_batch=not args.no_micro_batch,
         batch_max_wait_s=args.batch_max_wait_s,
         batch_adaptive_wait_s=args.batch_adaptive_wait_s,
-        batch_max_rows=args.batch_max_rows or None)
+        batch_max_rows=args.batch_max_rows or None,
+        batch_lanes=args.batch_lanes,
+        batch_queue_depth=args.batch_queue_depth)
     service.reload_from_manager()
     service.serve_watcher()
+    # Live per-lane serving counters (dispatches, coalesce, sheds, lane
+    # p99) on the debug monitor's /debug/vars for operators chasing the
+    # serving-path latency budget under load.
+    from dragonfly2_tpu.utils.debugmon import register_debug_var
+
+    register_debug_var("inference_batcher_stats", service.batcher_stats)
     server = serve([(INFERENCE_SPEC, service)],
                    host=args.host, port=args.port)
     print(f"inference sidecar serving on {server.target}", flush=True)

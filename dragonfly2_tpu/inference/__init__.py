@@ -7,7 +7,7 @@ Replaces the reference's *designed but absent* Triton/GPU sidecar
 evaluator.go:48).
 """
 
-from dragonfly2_tpu.inference.batcher import MicroBatcher
+from dragonfly2_tpu.inference.batcher import BatcherSaturatedError, MicroBatcher
 from dragonfly2_tpu.inference.scorer import (
     GATParentScorer,
     MLEvaluator,
@@ -15,5 +15,5 @@ from dragonfly2_tpu.inference.scorer import (
     ScoreHandle,
 )
 
-__all__ = ["GATParentScorer", "MLEvaluator", "MicroBatcher",
-           "ParentScorer", "ScoreHandle"]
+__all__ = ["BatcherSaturatedError", "GATParentScorer", "MLEvaluator",
+           "MicroBatcher", "ParentScorer", "ScoreHandle"]

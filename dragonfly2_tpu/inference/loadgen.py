@@ -11,11 +11,20 @@ scheduler/scheduling/evaluator/evaluator.go:48). Raw per-request
 latencies are reported alongside the dispatch-floor-corrected view so
 tunnel-attached runs stay honest.
 
-Since the batcher went pipelined (stage batch N+1 while N executes), the
-report also carries the pipeline counters — in-flight depth, the
-stage/dispatch overlap ratio, adaptive-window opens, and per-bucket hit
-counts — so a load ladder shows WHERE the coalescing ceiling sits, not
-just that throughput plateaued.
+Since the batcher went pipelined (stage batch N+1 while N executes) and
+then lane-sharded with bounded admission, the report also carries the
+pipeline counters — in-flight depth, the stage/dispatch overlap ratio,
+adaptive-window opens, per-bucket hit counts, and the per-lane
+breakdown (dispatches, coalesce, sheds, lane p99) — so a load ladder
+shows WHERE the coalescing ceiling sits and which lanes shed, not just
+that throughput plateaued.
+
+Shed semantics: a request rejected with
+:class:`~dragonfly2_tpu.inference.batcher.BatcherSaturatedError` is
+counted (never folded into the latency distribution — it was not
+served) and the driving thread pays ``shed_fallback_s`` before its next
+request, modeling the rule-based fallback scoring a real scheduler runs
+for that decision instead.
 """
 
 from __future__ import annotations
@@ -26,7 +35,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from dragonfly2_tpu.inference.batcher import MicroBatcher
+from dragonfly2_tpu.inference.batcher import BatcherSaturatedError, MicroBatcher
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -46,6 +55,10 @@ def measure_colocated(
     dispatch_floor_ms: float = 0.0,
     max_wait_s: float = 0.0,
     adaptive_wait_s: float = 0.0,
+    lanes: int = 1,
+    queue_depth: int = 0,
+    lane_grow_depth: int | None = None,
+    shed_fallback_s: float = 0.0005,
 ) -> Dict[str, float]:
     """Drive ``threads`` concurrent request loops through a MicroBatcher
     wrapped around ``scorer`` for ``duration_s`` and return latency and
@@ -55,13 +68,17 @@ def measure_colocated(
     measured by the caller — yields the floor-corrected fields: what the
     same program observes when the device is local instead of tunneled.
     ``max_wait_s`` / ``adaptive_wait_s`` are the batcher's batch-window
-    knobs, passed through verbatim.
+    knobs, ``lanes`` / ``queue_depth`` its sharding and admission knobs,
+    all passed through verbatim. ``shed_fallback_s`` is the simulated
+    cost of the rule-based fallback a shed request degrades to.
     """
     from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
 
     batcher = MicroBatcher(scorer, max_rows=max_rows,
                            max_wait_s=max_wait_s,
-                           adaptive_wait_s=adaptive_wait_s)
+                           adaptive_wait_s=adaptive_wait_s,
+                           lanes=lanes, queue_depth=queue_depth,
+                           lane_grow_depth=lane_grow_depth)
     feature_dim = FEATURE_DIM
     rng = np.random.default_rng(0)
     features = rng.standard_normal(
@@ -71,6 +88,7 @@ def measure_colocated(
     batcher.score(features[0])
 
     latencies: List[List[float]] = [[] for _ in range(threads)]
+    shed_counts = [0] * threads
     stop = threading.Event()
     start_barrier = threading.Barrier(threads + 1)
 
@@ -80,7 +98,16 @@ def measure_colocated(
         start_barrier.wait()
         while not stop.is_set():
             t = time.perf_counter()
-            batcher.score(mine)
+            try:
+                batcher.score(mine)
+            except BatcherSaturatedError:
+                # Shed: this decision degrades to rule scoring — model
+                # its cost, count it, and keep offering load. The shed
+                # request is NOT a served latency sample.
+                shed_counts[tid] += 1
+                if shed_fallback_s > 0:
+                    time.sleep(shed_fallback_s)
+                continue
             out.append((time.perf_counter() - t) * 1e3)
 
     workers = [threading.Thread(target=loop, args=(i,), daemon=True)
@@ -98,6 +125,8 @@ def measure_colocated(
 
     merged = sorted(x for sub in latencies for x in sub)
     n = len(merged)
+    sheds = sum(shed_counts)
+    offered = n + sheds
     pipeline = batcher.stats()
     p50 = _percentile(merged, 0.50)
     p95 = _percentile(merged, 0.95)
@@ -118,6 +147,13 @@ def measure_colocated(
         "overlap_ratio": pipeline["overlap_ratio"],
         "adaptive_opens": pipeline["adaptive_opens"],
         "max_queue_depth": pipeline["max_queue_depth"],
+        "lanes": pipeline["lanes"],
+        "active_lanes": pipeline["active_lanes"],
+        "lane_activations": pipeline["lane_activations"],
+        "queue_depth_cap": pipeline["queue_depth_cap"],
+        "sheds": sheds,
+        "shed_rate": round(sheds / offered, 4) if offered else 0.0,
+        "per_lane": pipeline["per_lane"],
         "bucket_hits": {str(k): v
                         for k, v in pipeline["bucket_hits"].items()},
     }

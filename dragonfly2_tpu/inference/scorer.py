@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator, PeerLike, pair_features
 from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
@@ -65,13 +66,16 @@ class ScoreHandle:
 
 
 class _StagingBuffers:
-    """Preallocated zeroed host buffers per jit bucket, double-buffered.
+    """Preallocated zeroed host buffers per jit bucket, ``depth`` deep
+    (default 2: double-buffered for one pipelined worker).
 
     Kills the per-call ``np.zeros`` + copy churn on the hot path: a
     request writes its rows into a preallocated buffer and only re-zeros
-    the rows the previous occupant dirtied. Two buffers per bucket so
+    the rows the previous occupant dirtied. Two buffers per bucket let
     the pipelined batcher (one dispatch in flight while the next is
-    staged) never waits.
+    staged) never wait; a LANE-SHARDED batcher (N workers, each with its
+    own in-flight slot) grows the pool to ``2 × lanes`` via
+    ``ensure_depth`` so concurrent lanes keep the same no-wait property.
 
     Safety: jax's host→device transfer is ASYNC — the dispatch can
     return before the input buffer has been snapshotted (observed as
@@ -79,20 +83,38 @@ class _StagingBuffers:
     while the dispatch that used it may still read it. Each claim
     therefore blocks on the slot's previous dispatch (``commit`` records
     it); by the time that output is ready the input has long been
-    consumed. With the batcher's single in-flight slot this never
-    actually blocks — slot K's previous dispatch was retired a batch
-    ago; only 3+ direct concurrent callers in one bucket serialize here.
+    consumed. With ``depth ≥ 2 × in-flight dispatchers`` this never
+    actually blocks — slot K's previous dispatch was retired long ago;
+    only an over-subscribed pool (more direct concurrent callers than
+    depth in one bucket) serializes here.
     A PER-BUCKET lock covers claim+fill+dispatch+commit (so a stalled
     bucket never blocks scoring in the others); materialization happens
     outside it.
     """
 
-    def __init__(self, buckets: Sequence[int], make):
+    def __init__(self, buckets: Sequence[int], make, depth: int = 2):
+        self._make = make
         self._locks = {b: threading.Lock() for b in buckets}
-        self._bufs = {b: [make(b), make(b)] for b in buckets}
+        self._bufs = {b: [make(b) for _ in range(depth)] for b in buckets}
         self._flip = {b: 0 for b in buckets}
-        self._dirty = {b: [0, 0] for b in buckets}
-        self._pending = {b: [None, None] for b in buckets}
+        self._dirty = {b: [0] * depth for b in buckets}
+        self._pending = {b: [None] * depth for b in buckets}
+
+    @property
+    def depth(self) -> int:
+        return len(next(iter(self._bufs.values())))
+
+    def ensure_depth(self, depth: int) -> None:
+        """Grow every bucket's pool to at least ``depth`` slots. Growing
+        only appends fresh zeroed buffers under the bucket lock — slots
+        already committed to in-flight dispatches keep their guards, so
+        this is safe while the scorer is serving."""
+        for b, lock in self._locks.items():
+            with lock:
+                for _ in range(len(self._bufs[b]), depth):
+                    self._bufs[b].append(self._make(b))
+                    self._dirty[b].append(0)
+                    self._pending[b].append(None)
 
     def lock_for(self, bucket: int) -> threading.Lock:
         return self._locks[bucket]
@@ -101,7 +123,7 @@ class _StagingBuffers:
         """Under ``lock_for(bucket)``: (slot, buffer) for ``bucket`` with
         rows ``n:`` guaranteed zero and no dispatch still reading it."""
         i = self._flip[bucket]
-        self._flip[bucket] = i ^ 1
+        self._flip[bucket] = (i + 1) % len(self._bufs[bucket])
         pending = self._pending[bucket][i]
         if pending is not None:
             self._pending[bucket][i] = None
@@ -132,6 +154,7 @@ class ParentScorer:
         target_norm: Normalizer,
         max_batch: int = 64,
         device=None,
+        staging_depth: int = 2,
     ):
         self._device = device or jax.devices()[0]
         self._params = jax.device_put(params, self._device)
@@ -152,7 +175,8 @@ class ParentScorer:
         self.buckets = _buckets(max_batch)
         self.max_batch = max_batch
         self._staging = _StagingBuffers(
-            self.buckets, lambda b: np.zeros((b, FEATURE_DIM), np.float32))
+            self.buckets, lambda b: np.zeros((b, FEATURE_DIM), np.float32),
+            depth=max(staging_depth, 2))
         # Warm the compile cache for every bucket now — first-request
         # latency must not include XLA compilation.
         for b in self.buckets:
@@ -163,6 +187,12 @@ class ParentScorer:
             if n <= b:
                 return b
         raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+
+    def ensure_staging_depth(self, depth: int) -> None:
+        """Grow the per-bucket staging pool to at least ``depth`` slots —
+        a lane-sharded batcher needs 2 buffers per concurrently
+        pipelining lane so the completion guard never blocks."""
+        self._staging.ensure_depth(max(depth, 2))
 
     def score_async(self, features: np.ndarray) -> ScoreHandle:
         """Stage ``[n, FEATURE_DIM]`` features into a preallocated bucket
@@ -219,8 +249,13 @@ class MLEvaluator:
         self._fallback = BaseEvaluator()
         # Operators must be able to tell "model live" from "model silently
         # failing": count scores and fallbacks, log the first failure loudly.
+        # Sheds (BatcherSaturatedError — the batcher's bounded-admission
+        # fail-fast) are counted separately from failures: a saturated
+        # serving plane degrading to rule scoring is expected overload
+        # behavior, not a fault, so it is never exception-logged.
         self.scored_count = 0
         self.fallback_count = 0
+        self.shed_count = 0
         self._logged_failure = False
 
     @property
@@ -247,6 +282,10 @@ class MLEvaluator:
         )
         try:
             scores = self._scorer.score(features)
+        except BatcherSaturatedError:
+            self.shed_count += 1
+            self.fallback_count += 1
+            return self._fallback.evaluate_parents(parents, child, total_piece_count)
         except Exception:
             self.fallback_count += 1
             if not self._logged_failure:
@@ -277,7 +316,7 @@ class GATParentScorer:
 
     def __init__(self, model, params, node_features, neighbors,
                  neighbor_vals, max_batch: int = 64, device=None,
-                 node_ids=None):
+                 node_ids=None, staging_depth: int = 2):
         self._device = device or jax.devices()[0]
         self._params = jax.device_put(params, self._device)
         self.n_nodes = int(np.asarray(node_features).shape[0])
@@ -310,9 +349,11 @@ class GATParentScorer:
         # Separate src/dst staging (the forward takes two flat [b] index
         # vectors; a [b, 2] buffer would force a strided copy per call).
         self._staging_src = _StagingBuffers(
-            self.buckets, lambda b: np.zeros(b, np.int32))
+            self.buckets, lambda b: np.zeros(b, np.int32),
+            depth=max(staging_depth, 2))
         self._staging_dst = _StagingBuffers(
-            self.buckets, lambda b: np.zeros(b, np.int32))
+            self.buckets, lambda b: np.zeros(b, np.int32),
+            depth=max(staging_depth, 2))
         for b in self.buckets:
             zero = jnp.zeros(b, jnp.int32)
             self._forward(self._params, self._emb, zero,
@@ -323,6 +364,12 @@ class GATParentScorer:
             if n <= b:
                 return b
         raise ValueError(f"batch {n} exceeds max_batch {self.max_batch}")
+
+    def ensure_staging_depth(self, depth: int) -> None:
+        """Grow both (src, dst) staging pools for lane-sharded serving;
+        see :meth:`ParentScorer.ensure_staging_depth`."""
+        self._staging_src.ensure_depth(max(depth, 2))
+        self._staging_dst.ensure_depth(max(depth, 2))
 
     def score_async(self, pairs: np.ndarray) -> ScoreHandle:
         """Stage validated [n, 2] (src, dst) host-index pairs and

@@ -26,6 +26,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
 from dragonfly2_tpu.inference.scorer import MLEvaluator, ParentScorer
 from dragonfly2_tpu.rpc.codec import message
 from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
@@ -107,6 +108,16 @@ class _LoadedModel:
     scorer: ParentScorer
     batcher: object = None  # MicroBatcher when micro_batch enabled
 
+    @property
+    def max_rows(self) -> int:
+        """The EFFECTIVE per-request row limit: the batcher clamps to
+        ``min(batch_max_rows, scorer.max_batch)``, so gRPC validation
+        must check the same number — a request sized between the two
+        would otherwise pass the scorer check and surface as an internal
+        ValueError from the batcher instead of INVALID_ARGUMENT."""
+        return (self.batcher.max_rows if self.batcher is not None
+                else self.scorer.max_batch)
+
     def score(self, inputs):
         return (self.batcher.score(inputs) if self.batcher is not None
                 else self.scorer.score(inputs))
@@ -118,18 +129,25 @@ class InferenceService:
     ``micro_batch`` (default on) coalesces concurrent ModelInfer calls
     into one padded device dispatch (SURVEY §7: micro-batch requests so
     latency doesn't scale with scheduler concurrency). The batcher is
-    pipelined — batch N+1 is staged while N executes — and its window
-    knobs thread through here: ``batch_max_wait_s`` holds every batch
-    open (remote-device throughput mode), ``batch_adaptive_wait_s``
-    opens the window only under detected queue growth (the default:
-    idle requests keep the zero-wait path), ``batch_max_rows`` caps rows
-    per dispatch (None = the scorer's largest warm bucket)."""
+    pipelined — batch N+1 is staged while N executes — and sharded into
+    ``batch_lanes`` independent lanes (queue + worker + in-flight slot
+    each) with per-lane bounded admission: ``batch_queue_depth`` caps
+    each lane's queue, and a request whose lane is full is shed with
+    RESOURCE_EXHAUSTED so the scheduler degrades to rule scoring instead
+    of queueing multi-ms. Window knobs thread through here:
+    ``batch_max_wait_s`` holds every batch open (remote-device
+    throughput mode), ``batch_adaptive_wait_s`` opens the window only
+    under detected queue growth (the default: idle requests keep the
+    zero-wait path), ``batch_max_rows`` caps rows per dispatch (None =
+    the scorer's largest warm bucket)."""
 
     def __init__(self, manager=None, scheduler_id: int = 0,
                  reload_interval: float = 30.0, micro_batch: bool = True,
                  batch_max_wait_s: float = 0.0,
                  batch_adaptive_wait_s: float = 0.0005,
-                 batch_max_rows: Optional[int] = None):
+                 batch_max_rows: Optional[int] = None,
+                 batch_lanes: int = 2,
+                 batch_queue_depth: int = 32):
         self.manager = manager  # ManagerService or None (push-only mode)
         self.scheduler_id = scheduler_id
         self.reload_interval = reload_interval
@@ -137,6 +155,8 @@ class InferenceService:
         self.batch_max_wait_s = batch_max_wait_s
         self.batch_adaptive_wait_s = batch_adaptive_wait_s
         self.batch_max_rows = batch_max_rows
+        self.batch_lanes = batch_lanes
+        self.batch_queue_depth = batch_queue_depth
         self._models: Dict[str, _LoadedModel] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -157,21 +177,29 @@ class InferenceService:
                 max_rows=self.batch_max_rows,
                 max_wait_s=self.batch_max_wait_s,
                 adaptive_wait_s=self.batch_adaptive_wait_s,
+                lanes=self.batch_lanes,
+                queue_depth=self.batch_queue_depth,
             )
         with self._lock:
             old = self._models.get(name)
             self._models[name] = _LoadedModel(version, scorer, batcher)
-        if old is not None and old.batcher is not None:
-            # Grace-close: a ModelInfer thread may have grabbed the old
-            # model just before the swap; keep its batcher serving until
-            # any such in-flight request has comfortably finished, like
-            # the pre-batcher code kept serving on the old scorer. The
-            # timer is daemonized and tracked so shutdown neither waits
-            # out the grace nor leaks it.
-            timer = threading.Timer(35.0, old.batcher.close)
-            timer.daemon = True
-            self._grace_timers.append(timer)
-            timer.start()
+            # Prune fired (or cancelled) grace timers on every install:
+            # a long-lived sidecar hot-reloads periodically, and keeping
+            # every spent Timer until stop() grows the list unboundedly.
+            self._grace_timers = [t for t in self._grace_timers
+                                  if not t.finished.is_set()]
+            if old is not None and old.batcher is not None:
+                # Grace-close: a ModelInfer thread may have grabbed the
+                # old model just before the swap; keep its batcher
+                # serving until any such in-flight request has
+                # comfortably finished, like the pre-batcher code kept
+                # serving on the old scorer. The timer is daemonized and
+                # tracked so shutdown neither waits out the grace nor
+                # leaks it.
+                timer = threading.Timer(35.0, old.batcher.close)
+                timer.daemon = True
+                self._grace_timers.append(timer)
+                timer.start()
 
     def batcher_stats(self) -> Dict[str, dict]:
         """Per-model micro-batcher pipeline counters (coalesce factor,
@@ -311,12 +339,24 @@ class InferenceService:
                     f"inputs must be [batch, {FEATURE_DIM}], "
                     f"got {inputs.shape}",
                 )
-        if inputs.shape[0] > model.scorer.max_batch:
+        # Validate against the EFFECTIVE limit (the batcher's clamped
+        # max_rows when micro-batching, the scorer's max_batch
+        # otherwise): a request sized between batch_max_rows and
+        # scorer.max_batch must fail INVALID_ARGUMENT here, not surface
+        # as an internal ValueError from MicroBatcher.score.
+        if inputs.shape[0] > model.max_rows:
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"batch {inputs.shape[0]} exceeds max {model.scorer.max_batch}",
+                f"batch {inputs.shape[0]} exceeds max {model.max_rows}",
             )
-        scores = model.score(inputs)
+        try:
+            scores = model.score(inputs)
+        except BatcherSaturatedError as exc:
+            # Bounded admission shed: the assigned lane's queue is at
+            # its depth cap. RESOURCE_EXHAUSTED tells the scheduler-side
+            # evaluator to degrade to rule scoring for this decision
+            # instead of queueing behind a saturated serving plane.
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
         return ModelInferResponse(
             model_name=request.model_name, model_version=model.version,
             outputs=np.asarray(scores),
@@ -427,6 +467,21 @@ class CircuitOpenError(RuntimeError):
     """Raised instead of a remote call while the breaker cools down."""
 
 
+def _is_resource_exhausted(exc: Exception) -> bool:
+    """True when a gRPC error carries RESOURCE_EXHAUSTED (the sidecar's
+    bounded-admission shed status). Lazy grpc import keeps the client
+    importable without grpc installed."""
+    code = getattr(exc, "code", None)
+    if not callable(code):
+        return False
+    try:
+        import grpc
+
+        return code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+    except Exception:  # noqa: BLE001 — anything odd is "not a shed"
+        return False
+
+
 class _RemoteScorer:
     """Sidecar-backed ``score()`` with an open-after-failure circuit
     breaker: while open, calls fail instantly (→ rule fallback) instead of
@@ -449,7 +504,16 @@ class _RemoteScorer:
         try:
             scores = self.client.model_infer(
                 self.model_name, np.asarray(features, dtype=np.float32))
-        except Exception:
+        except Exception as exc:
+            if _is_resource_exhausted(exc):
+                # The sidecar is alive but shedding (bounded admission):
+                # surface it as the batcher's own saturation error so
+                # MLEvaluator counts a shed and rule-falls-back, and do
+                # NOT open the breaker — the next decision may land on a
+                # lane with room.
+                raise BatcherSaturatedError(
+                    "inference sidecar saturated (lane queue at depth "
+                    "cap)") from exc
             with self._lock:
                 self._open_until = time.monotonic() + self.cooldown
             raise

@@ -28,7 +28,9 @@ ALGORITHM_PLUGIN = "plugin"
 def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
                   sidecar_target: str | None = None,
                   micro_batch: bool = False,
-                  batch_adaptive_wait_s: float = 0.0005):
+                  batch_adaptive_wait_s: float = 0.0005,
+                  batch_lanes: int = 1,
+                  batch_queue_depth: int = 0):
     """Evaluator factory (evaluator.go:36-57 New).
 
     ``ml``: in-process :class:`MLEvaluator` when a scorer is handed over
@@ -37,13 +39,18 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
     with the pipelined :class:`~dragonfly2_tpu.inference.batcher.
     MicroBatcher`, so concurrent scheduling threads coalesce into shared
     device dispatches instead of serializing on the jit call — the same
-    serving path the sidecar uses, minus the RPC hop. It only applies to
-    the programmatic ``scorer=`` handoff (the scheduler CLI has no
-    in-process scorer path; its production route is the sidecar, which
-    owns its own batcher), and the caller owns the batcher's lifecycle:
-    call ``evaluator.close()`` on teardown or model swap. ``plugin``:
-    loaded from the ``dragonfly2_tpu.evaluator`` entry-point group (the
-    reference loads ``d7y-evaluator-plugin-*.so``,
+    serving path the sidecar uses, minus the RPC hop. ``batch_lanes``
+    shards that batcher into independent pipelined lanes and
+    ``batch_queue_depth`` bounds each lane's queue (0 = unbounded); a
+    shed request (``BatcherSaturatedError``) is absorbed by the
+    evaluator's rule-based fallback and counted in ``shed_count``.
+    These knobs only apply to the programmatic ``scorer=`` handoff (the
+    scheduler CLI has no in-process scorer path; its production route is
+    the sidecar, which owns its own batcher — ``df2-inference
+    --batch-lanes --batch-queue-depth``), and the caller owns the
+    batcher's lifecycle: call ``evaluator.close()`` on teardown or model
+    swap. ``plugin``: loaded from the ``dragonfly2_tpu.evaluator``
+    entry-point group (the reference loads ``d7y-evaluator-plugin-*.so``,
     evaluator/plugin.go:30-45).
     """
     if algorithm == ALGORITHM_ML:
@@ -60,7 +67,8 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
             from dragonfly2_tpu.inference.batcher import MicroBatcher
 
             scorer = MicroBatcher(
-                scorer, adaptive_wait_s=batch_adaptive_wait_s)
+                scorer, adaptive_wait_s=batch_adaptive_wait_s,
+                lanes=batch_lanes, queue_depth=batch_queue_depth)
         return MLEvaluator(scorer)
     if algorithm == ALGORITHM_PLUGIN:
         from importlib.metadata import entry_points

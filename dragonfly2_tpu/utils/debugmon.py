@@ -80,6 +80,20 @@ def sample_profile(seconds: float, hz: float = 100.0) -> str:
     return "\n".join(lines)
 
 
+# Service-registered live vars (expvar.Publish analogue): name →
+# zero-arg callable returning a JSON-serializable value, evaluated per
+# /debug/vars request. The inference sidecar registers its
+# batcher_stats here so operators can watch per-lane dispatch/coalesce/
+# shed counters on a live process.
+_VARS: dict = {}
+_VARS_LOCK = threading.Lock()
+
+
+def register_debug_var(name: str, fn) -> None:
+    with _VARS_LOCK:
+        _VARS[name] = fn
+
+
 def debug_vars() -> dict:
     out = {
         "uptime_seconds": round(time.time() - _START_TIME, 1),
@@ -97,6 +111,13 @@ def debug_vars() -> dict:
         pass
     if "jax" in sys.modules:
         out["jax"] = sys.modules["jax"].__version__
+    with _VARS_LOCK:
+        published = list(_VARS.items())
+    for name, fn in published:
+        try:
+            out[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — one bad var must not
+            out[name] = f"<error: {exc}>"  # take down the whole page
     return out
 
 

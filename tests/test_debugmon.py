@@ -10,6 +10,7 @@ import time
 import urllib.request
 
 import numpy as np
+import pytest
 
 from dragonfly2_tpu.utils.debugmon import DebugMonitor, sample_profile
 
@@ -51,6 +52,26 @@ class TestDebugMonitor:
         finally:
             mon.stop()
 
+    def test_registered_vars_served_and_isolated(self):
+        """Service-published vars (the sidecar registers batcher_stats
+        here) appear on /debug/vars, and one failing var must not take
+        down the page."""
+        from dragonfly2_tpu.utils.debugmon import register_debug_var
+
+        register_debug_var(
+            "test_batcher_stats",
+            lambda: {"mlp": {"sheds": 3, "per_lane": [{"lane": 0}]}})
+        register_debug_var("test_broken_var", lambda: 1 / 0)
+        mon = DebugMonitor(port=0)
+        mon.start()
+        try:
+            code, body = get(f"http://{mon.address}/debug/vars")
+            vars_ = json.loads(body)
+            assert vars_["test_batcher_stats"]["mlp"]["sheds"] == 3
+            assert "error" in vars_["test_broken_var"]
+        finally:
+            mon.stop()
+
     def test_sampling_profiler_catches_hot_thread(self):
         stop = threading.Event()
 
@@ -79,6 +100,7 @@ class TestDebugMonitor:
             mon.stop()
 
 
+@pytest.mark.slow  # real XLA profiler session writing xplane.pb (~20 s)
 class TestTrainerProfileDir:
     def test_mlp_profile_dir_writes_xplane(self, tmp_path):
         """profile_dir on the train config produces an XPlane dump the

@@ -49,6 +49,7 @@ class TestFedMath:
         np.testing.assert_allclose(feat.std, exact.std, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # multi-cluster training rounds (~20 s of MLP fits)
 class TestFederatedTraining:
     def test_rounds_and_lineage(self):
         datasets = make_datasets(3)

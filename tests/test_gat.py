@@ -440,6 +440,7 @@ class TestInverseIndex:
         self._assert_close(g0, g1)
 
 
+@pytest.mark.slow  # 16k-100k-node scale runs; minutes on a small box
 class TestScale:
     def test_100k_node_train_step(self):
         """The round-4 scale mandate: a 100k-node full-topology graph —

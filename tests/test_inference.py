@@ -109,6 +109,65 @@ class TestParentScorer:
             t.join(timeout=60)
         assert not errors
 
+    def test_ensure_staging_depth_grows_pool(self, scorer):
+        """Lane-sharded serving grows the staging pool to 2× lanes; the
+        grown pool must keep the zero-padding and request-alignment
+        contracts while cycling through every slot."""
+        scorer.ensure_staging_depth(6)
+        assert scorer._staging.depth >= 6
+        # Growing is idempotent and never shrinks.
+        scorer.ensure_staging_depth(2)
+        assert scorer._staging.depth >= 6
+        rng = np.random.default_rng(6)
+        small = rng.uniform(0, 50, (9, FEATURE_DIM)).astype(np.float32)
+        big = rng.uniform(0, 50, (15, FEATURE_DIM)).astype(np.float32)
+        fresh = scorer.score(small)
+        # Dirty EVERY slot of the 16-bucket, then rescore the small
+        # batch through each slot: stale rows anywhere would skew it.
+        for _ in range(scorer._staging.depth):
+            scorer.score(big)
+        for _ in range(scorer._staging.depth):
+            np.testing.assert_allclose(scorer.score(small), fresh,
+                                       rtol=1e-6)
+
+    def test_multilane_batcher_no_torn_batches(self, scorer):
+        """Staging isolation under lane contention: ≥2 lanes dispatching
+        concurrently into shared buckets must never tear a batch — every
+        response matches the single-threaded scorer exactly."""
+        import threading
+
+        from dragonfly2_tpu.inference.batcher import MicroBatcher
+
+        rng = np.random.default_rng(7)
+        inputs = [rng.uniform(0, 50, (n, FEATURE_DIM)).astype(np.float32)
+                  for n in (1, 3, 5, 7, 9, 12, 15, 16)]
+        want = [scorer.score(f) for f in inputs]
+        batcher = MicroBatcher(scorer, lanes=4, queue_depth=64,
+                               adaptive_wait_s=0.0005, lane_grow_depth=0)
+        errors = []
+
+        def call(i):
+            try:
+                for _ in range(15):
+                    np.testing.assert_allclose(
+                        batcher.score(inputs[i]), want[i], rtol=1e-5)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(inputs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stats = batcher.stats()
+        batcher.close()
+        assert not errors
+        assert stats["sheds"] == 0
+        # The contention actually happened: more than one lane dispatched.
+        active = [s for s in stats["per_lane"] if s["dispatches"] > 0]
+        assert len(active) >= 2, stats["per_lane"]
+
 
 @dataclass
 class FakeHost:

@@ -529,6 +529,7 @@ def _wait_http(url: str, timeout: float = 20.0) -> None:
     raise TimeoutError(f"{url} never came up")
 
 
+@pytest.mark.slow  # manager + scheduler + seed as real OS processes
 class TestThreeProcessPreheat:
     def test_manager_scheduler_seed_processes(self, tmp_path):
         """df2-manager, df2-scheduler, df2-dfdaemon(seed) as separate OS

@@ -1,4 +1,5 @@
-"""Inference micro-batching: coalescing, correctness, error fan-out."""
+"""Inference micro-batching: coalescing, correctness, error fan-out,
+lane sharding, and bounded admission."""
 
 from __future__ import annotations
 
@@ -8,7 +9,7 @@ import time
 import numpy as np
 import pytest
 
-from dragonfly2_tpu.inference.batcher import MicroBatcher
+from dragonfly2_tpu.inference.batcher import BatcherSaturatedError, MicroBatcher
 
 
 class SlowScorer:
@@ -303,10 +304,20 @@ class TestPipelinedBatcher:
         for key in ("dispatches", "coalesced_requests", "coalesce_factor",
                     "pipelined_dispatches", "inflight_depth_avg",
                     "stage_overlap_s", "block_s", "overlap_ratio",
-                    "adaptive_opens", "max_queue_depth", "bucket_hits"):
+                    "adaptive_opens", "max_queue_depth", "bucket_hits",
+                    "lanes", "active_lanes", "lane_activations",
+                    "lane_grow_depth", "queue_depth_cap", "sheds",
+                    "shed_rate", "per_lane"):
             assert key in stats, key
         assert stats["dispatches"] == 1
         assert stats["bucket_hits"] == {8: 1}
+        assert stats["lanes"] == 1
+        assert stats["sheds"] == 0
+        assert len(stats["per_lane"]) == 1
+        for key in ("lane", "dispatches", "coalesced_requests",
+                    "coalesce_factor", "sheds", "max_queue_depth",
+                    "p99_ms"):
+            assert key in stats["per_lane"][0], key
 
     def test_async_error_fans_out(self):
         """An error surfacing at MATERIALIZE (device-side failure) must
@@ -336,19 +347,335 @@ class TestPipelinedBatcher:
         b.close()
 
 
+class GatedScorer:
+    """Scorer whose score() blocks until released — wedges a lane's
+    worker so its queue fills deterministically. ``gate_first_only``
+    blocks only the first dispatch (whichever lane makes it), leaving
+    every later dispatch fast."""
+
+    max_batch = 64
+
+    def __init__(self, gate_first_only: bool = False):
+        self.release = threading.Event()
+        self.gate_first_only = gate_first_only
+        self._gated_once = False
+        self.calls = 0
+
+    def score(self, features: np.ndarray) -> np.ndarray:
+        self.calls += 1
+        if not self.gate_first_only or not self._gated_once:
+            self._gated_once = True
+            self.release.wait(timeout=10)
+        return features.sum(axis=1).astype(np.float32)
+
+
+class TestLaneSharding:
+    """Multi-lane serving: per-request correctness across lanes, bounded
+    admission with fail-fast sheds, and close() draining every lane."""
+
+    def test_multilane_concurrent_correctness(self):
+        """32 threads through 4 lanes: every response carries ITS
+        request's rows (same contract as the sync scorer), work spreads
+        across all lanes, and nothing sheds below the caps."""
+        scorer = AsyncScorer(device_s=0.002)
+        b = MicroBatcher(scorer, lanes=4, queue_depth=64,
+                         adaptive_wait_s=0.0005, lane_grow_depth=0)
+        n_threads, per_thread = 32, 20
+        errors: list = []
+        start_barrier = threading.Barrier(n_threads)
+
+        def call(tid):
+            rng = np.random.default_rng(tid)
+            start_barrier.wait()
+            for _ in range(per_thread):
+                n = int(rng.integers(1, 5))
+                feats = rng.uniform(1, 100, (n, 4)).astype(np.float32)
+                try:
+                    got = b.score(feats)
+                    np.testing.assert_allclose(
+                        got, feats.sum(axis=1), rtol=1e-6)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+                    return
+
+        threads = [threading.Thread(target=call, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stats = b.stats()
+        b.close()
+        assert not errors
+        assert stats["lanes"] == 4
+        assert stats["sheds"] == 0
+        assert b.coalesced_requests == n_threads * per_thread
+        # Round-robin assignment must actually exercise every lane.
+        for lane in stats["per_lane"]:
+            assert lane["dispatches"] > 0, stats["per_lane"]
+
+    def test_admission_cap_sheds_fail_fast(self):
+        """lanes=1, depth cap 1: with the worker wedged and one request
+        queued, the next arrival fails immediately with
+        BatcherSaturatedError instead of queueing — and the queued
+        request is never dropped."""
+        scorer = GatedScorer()
+        b = MicroBatcher(scorer, lanes=1, queue_depth=1)
+        results: dict = {}
+
+        def call(key, feats):
+            results[key] = b.score(feats)
+
+        in_service = np.full((1, 4), 1.0, np.float32)
+        queued = np.full((1, 4), 2.0, np.float32)
+        t1 = threading.Thread(target=call, args=("in_service", in_service))
+        t1.start()
+        time.sleep(0.1)  # worker took it and is wedged in score()
+        t2 = threading.Thread(target=call, args=("queued", queued))
+        t2.start()
+        time.sleep(0.1)  # fills the single queue slot
+        t_shed = time.monotonic()
+        with pytest.raises(BatcherSaturatedError, match="depth cap"):
+            b.score(np.full((1, 4), 3.0, np.float32))
+        shed_latency = time.monotonic() - t_shed
+        scorer.release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        stats = b.stats()
+        b.close()
+        # Fail-fast: the shed decision must not wait out the wedge.
+        assert shed_latency < 1.0, shed_latency
+        assert stats["sheds"] == 1
+        assert stats["shed_rate"] > 0
+        np.testing.assert_allclose(results["in_service"], [4.0])
+        np.testing.assert_allclose(results["queued"], [8.0])
+
+    def test_idle_traffic_never_sheds(self):
+        scorer = SlowScorer(delay=0.0)
+        b = MicroBatcher(scorer, lanes=2, queue_depth=2)
+        for i in range(20):
+            b.score(np.full((2, 4), float(i), np.float32))
+        stats = b.stats()
+        b.close()
+        assert stats["sheds"] == 0
+
+    def test_saturated_lane_sheds_while_others_serve(self):
+        """The acceptance-criteria proof at the batcher level: wedge
+        lane 0 (first dispatch blocks), fill its queue, and every
+        request round-robined to lane 0 sheds while lane 1 keeps
+        serving. No spill: a stuck lane must not back-pressure healthy
+        ones."""
+        scorer = GatedScorer(gate_first_only=True)
+        b = MicroBatcher(scorer, lanes=2, queue_depth=1,
+                         lane_grow_depth=0)
+        results: dict = {}
+
+        def call(key, feats):
+            results[key] = b.score(feats)
+
+        # RR#0 → lane 0: dispatched, wedged in the scorer's gate.
+        t_wedged = threading.Thread(
+            target=call, args=("wedged", np.full((1, 4), 9.0, np.float32)))
+        t_wedged.start()
+        time.sleep(0.1)
+        # RR#1 → lane 1: serves fine while lane 0 is stuck.
+        np.testing.assert_allclose(
+            b.score(np.full((1, 4), 1.0, np.float32)), [4.0])
+        # RR#2 → lane 0: occupies its single queue slot.
+        t_queued = threading.Thread(
+            target=call, args=("queued", np.full((1, 4), 5.0, np.float32)))
+        t_queued.start()
+        time.sleep(0.1)
+        # RR#3 → lane 1: still serving.
+        np.testing.assert_allclose(
+            b.score(np.full((1, 4), 2.0, np.float32)), [8.0])
+        # RR#4 → lane 0: full → shed, instantly.
+        with pytest.raises(BatcherSaturatedError):
+            b.score(np.full((1, 4), 3.0, np.float32))
+        # RR#5 → lane 1: the shed next door changed nothing here.
+        np.testing.assert_allclose(
+            b.score(np.full((1, 4), 4.0, np.float32)), [16.0])
+        stats = b.stats()
+        scorer.release.set()
+        t_wedged.join(timeout=10)
+        t_queued.join(timeout=10)
+        b.close()
+        per_lane = {s["lane"]: s for s in stats["per_lane"]}
+        assert per_lane[0]["sheds"] == 1, stats
+        assert per_lane[1]["sheds"] == 0, stats
+        assert per_lane[1]["coalesced_requests"] >= 3, stats
+        np.testing.assert_allclose(results["wedged"], [36.0])
+        np.testing.assert_allclose(results["queued"], [20.0])
+
+    def test_close_drains_all_lanes(self):
+        """close() must serve everything already queued on EVERY lane —
+        callers racing a model reload never hang or lose requests."""
+        scorer = SlowScorer(delay=0.01)
+        b = MicroBatcher(scorer, lanes=4, queue_depth=16,
+                         lane_grow_depth=0)
+        results: dict = {}
+        errors: list = []
+
+        def call(i):
+            try:
+                results[i] = b.score(
+                    np.full((2, 4), float(i), np.float32))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let every request reach its lane queue
+        b.close()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert len(results) == 16
+        for i in range(16):
+            np.testing.assert_allclose(results[i], [4.0 * i] * 2)
+
+    def test_lane_and_depth_validation(self):
+        with pytest.raises(ValueError, match="lanes"):
+            MicroBatcher(SlowScorer(), lanes=0)
+        with pytest.raises(ValueError, match="queue_depth"):
+            MicroBatcher(SlowScorer(), queue_depth=-1)
+
+    def test_lane_activation_grows_under_backlog_and_reconsolidates(self):
+        """Load-aware activation: a lone lane serves light traffic (no
+        fragmentation of coalescing), a backlog past lane_grow_depth
+        activates more lanes, and a sustained idle run shrinks the
+        active set back to one."""
+        scorer = GatedScorer()
+        b = MicroBatcher(scorer, lanes=4, queue_depth=0,
+                         lane_grow_depth=2)
+        assert b.stats()["active_lanes"] == 1
+        results: dict = {}
+
+        def call(i):
+            results[i] = b.score(np.full((1, 4), float(i), np.float32))
+
+        # Wedge lane 0's worker, then build a backlog on lane 0: the
+        # 3rd queued request sees depth ≥ 2 and activates lane 1.
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        stats_loaded = b.stats()
+        scorer.release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert stats_loaded["active_lanes"] > 1, stats_loaded
+        assert stats_loaded["lane_activations"] >= 1
+        for i in range(6):
+            np.testing.assert_allclose(results[i], [4.0 * i])
+        # Sustained idle traffic re-consolidates to one lane (the
+        # shrink threshold is SHRINK_AFTER_IDLE_ADMITS consecutive
+        # empty-queue admissions per step down).
+        for _ in range(3 * MicroBatcher.SHRINK_AFTER_IDLE_ADMITS + 3):
+            b.score(np.ones((1, 4), np.float32))
+        assert b.stats()["active_lanes"] == 1
+        b.close()
+
+    def test_shed_fallback_counted_by_ml_evaluator(self):
+        """The acceptance-criteria proof at the evaluator level: a
+        saturated lane degrades THAT decision to rule-based fallback
+        (counted as a shed, not logged as a failure) while decisions
+        landing on healthy lanes keep getting model-ranked."""
+        from dragonfly2_tpu.inference.scorer import MLEvaluator
+        from tests.test_inference import FakeHost, FakePeer
+
+        child = FakePeer("child", FakeHost(idc="a"))
+        parents = [
+            FakePeer(f"p{i}", FakeHost(idc="a", upload_count=10 * i),
+                     _finished=i + 1)
+            for i in range(6)
+        ]
+        scorer = GatedScorer(gate_first_only=True)
+        scorer.max_batch = 64
+        batcher = MicroBatcher(scorer, lanes=2, queue_depth=1,
+                               lane_grow_depth=0)
+        evaluator = MLEvaluator(batcher)
+        done: dict = {}
+
+        def rank(key):
+            done[key] = evaluator.evaluate_parents(parents, child, 10)
+
+        # RR#0 → lane 0: wedged on the gate.
+        t_wedged = threading.Thread(target=rank, args=("wedged",))
+        t_wedged.start()
+        time.sleep(0.1)
+        # RR#1 → lane 1: model-ranked.
+        ranked = evaluator.evaluate_parents(parents, child, 10)
+        assert sorted(p.id for p in ranked) == sorted(p.id for p in parents)
+        assert evaluator.scored_count == 1
+        # RR#2 → lane 0: fills the queue slot.
+        t_queued = threading.Thread(target=rank, args=("queued",))
+        t_queued.start()
+        time.sleep(0.1)
+        # RR#3 → lane 1: still model-ranked.
+        evaluator.evaluate_parents(parents, child, 10)
+        assert evaluator.scored_count == 2
+        # RR#4 → lane 0: shed → rule-based fallback, counted.
+        ranked_fallback = evaluator.evaluate_parents(parents, child, 10)
+        assert sorted(p.id for p in ranked_fallback) == sorted(
+            p.id for p in parents)
+        assert evaluator.shed_count == 1
+        assert evaluator.fallback_count == 1
+        # RR#5 → lane 1: the healthy lane never noticed.
+        evaluator.evaluate_parents(parents, child, 10)
+        assert evaluator.scored_count == 3
+        scorer.release.set()
+        t_wedged.join(timeout=10)
+        t_queued.join(timeout=10)
+        assert len(done) == 2
+        evaluator.close()
+
+
+class TestLoadgenLanes:
+    def test_measure_colocated_reports_lane_and_shed_stats(self):
+        """The ladder harness must carry the lane/admission story:
+        per-lane counters, shed counts, and the activation state —
+        and shed requests must never pollute the latency samples."""
+        from dragonfly2_tpu.inference.loadgen import measure_colocated
+
+        result = measure_colocated(
+            SlowScorer(delay=0.001), threads=4, rows_per_request=2,
+            duration_s=0.4, lanes=2, queue_depth=8, shed_fallback_s=0.0)
+        for key in ("lanes", "active_lanes", "lane_activations",
+                    "queue_depth_cap", "sheds", "shed_rate", "per_lane",
+                    "p99_ms", "coalesce_factor"):
+            assert key in result, key
+        assert result["lanes"] == 2
+        assert result["queue_depth_cap"] == 8
+        assert result["requests"] > 0
+        assert len(result["per_lane"]) == 2
+
+
+class _Abort(Exception):
+    def __init__(self, code, details):
+        super().__init__(f"{code}: {details}")
+        self.code = code
+        self.details = details
+
+
+class FakeContext:
+    """Stand-in for a grpc.ServicerContext whose abort raises (like the
+    real one) so tests can assert the mapped status code in-process."""
+
+    def abort(self, code, details):
+        raise _Abort(code, details)
+
+
 class TestSidecarMicroBatch:
     def test_model_infer_through_batcher(self):
         from dragonfly2_tpu.inference.sidecar import InferenceService
         from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
 
-        class FakeScorer:
-            max_batch = 64
-
-            def score(self, features):
-                return features.sum(axis=1).astype(np.float32)
-
         service = InferenceService(micro_batch=True)
-        service.install_scorer("mlp", FakeScorer())
+        service.install_scorer("mlp", SlowScorer(delay=0.0))
         model = service._models["mlp"]
         assert model.batcher is not None
         feats = np.ones((4, FEATURE_DIM), np.float32)
@@ -360,5 +687,115 @@ class TestSidecarMicroBatch:
         assert stats["mlp"]["coalesced_requests"] >= 1
         # Reinstall drains the old batcher and builds a fresh one.
         old_batcher = model.batcher
-        service.install_scorer("mlp", FakeScorer(), version="v2")
+        service.install_scorer("mlp", SlowScorer(delay=0.0), version="v2")
         assert service._models["mlp"].batcher is not old_batcher
+
+    def test_max_rows_validation_uses_effective_batcher_limit(self):
+        """Regression: ModelInfer used to validate against
+        scorer.max_batch while the batcher clamps to min(batch_max_rows,
+        max_batch) — a request sized between the two passed the gRPC
+        check and surfaced as an internal ValueError from
+        MicroBatcher.score instead of INVALID_ARGUMENT."""
+        import grpc
+
+        from dragonfly2_tpu.inference.sidecar import (
+            InferenceService,
+            ModelInferRequest,
+        )
+        from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+        service = InferenceService(micro_batch=True, batch_max_rows=8)
+        service.install_scorer("mlp", SlowScorer(delay=0.0))
+        try:
+            # 16 rows: inside scorer.max_batch, past the batcher clamp.
+            req = ModelInferRequest(
+                model_name="mlp",
+                inputs=np.ones((16, FEATURE_DIM), np.float32))
+            with pytest.raises(_Abort) as exc_info:
+                service.ModelInfer(req, FakeContext())
+            assert exc_info.value.code == grpc.StatusCode.INVALID_ARGUMENT
+            assert "exceeds max 8" in exc_info.value.details
+            # At the effective limit the request still serves.
+            ok = service.ModelInfer(
+                ModelInferRequest(
+                    model_name="mlp",
+                    inputs=np.ones((8, FEATURE_DIM), np.float32)),
+                FakeContext())
+            assert ok.outputs.shape == (8,)
+        finally:
+            service.stop()
+
+    def test_saturation_maps_to_resource_exhausted(self):
+        """A shed (lane queue at depth cap) must reach gRPC callers as
+        RESOURCE_EXHAUSTED — the status RemoteMLEvaluator translates
+        back into a counted rule-based fallback — not as an internal
+        error."""
+        import grpc
+
+        from dragonfly2_tpu.inference.sidecar import (
+            InferenceService,
+            ModelInferRequest,
+        )
+        from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+        scorer = GatedScorer()
+        scorer.max_batch = 64
+        service = InferenceService(micro_batch=True, batch_lanes=1,
+                                   batch_queue_depth=1)
+        service.install_scorer("mlp", scorer)
+        results: list = []
+
+        def infer():
+            results.append(service.ModelInfer(
+                ModelInferRequest(
+                    model_name="mlp",
+                    inputs=np.ones((2, FEATURE_DIM), np.float32)),
+                FakeContext()))
+
+        try:
+            t1 = threading.Thread(target=infer)
+            t1.start()
+            time.sleep(0.1)  # worker wedged on the gate
+            t2 = threading.Thread(target=infer)
+            t2.start()
+            time.sleep(0.1)  # queue slot filled
+            with pytest.raises(_Abort) as exc_info:
+                service.ModelInfer(
+                    ModelInferRequest(
+                        model_name="mlp",
+                        inputs=np.ones((2, FEATURE_DIM), np.float32)),
+                    FakeContext())
+            assert (exc_info.value.code
+                    == grpc.StatusCode.RESOURCE_EXHAUSTED)
+            scorer.release.set()
+            t1.join(timeout=10)
+            t2.join(timeout=10)
+            assert len(results) == 2
+            stats = service.batcher_stats()["mlp"]
+            assert stats["sheds"] == 1
+        finally:
+            scorer.release.set()
+            service.stop()
+
+    def test_grace_timers_pruned_on_install(self):
+        """Regression: fired grace-close timers were appended on every
+        install_scorer swap and never pruned until stop(), so periodic
+        hot-reloads grew the list unboundedly."""
+        from dragonfly2_tpu.inference.sidecar import InferenceService
+
+        service = InferenceService(micro_batch=True)
+        try:
+            service.install_scorer("mlp", SlowScorer(delay=0.0), version="v1")
+            assert len(service._grace_timers) == 0
+            service.install_scorer("mlp", SlowScorer(delay=0.0), version="v2")
+            assert len(service._grace_timers) == 1
+            # Simulate the grace timer having fired (cancel sets the
+            # same `finished` event firing does).
+            for t in service._grace_timers:
+                t.cancel()
+            service.install_scorer("mlp", SlowScorer(delay=0.0), version="v3")
+            # Without pruning this would be 2 and grow forever.
+            assert len(service._grace_timers) == 1
+            assert not service._grace_timers[0].finished.is_set()
+        finally:
+            service.stop()

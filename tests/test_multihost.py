@@ -189,6 +189,7 @@ def test_cli_plumbing(monkeypatch):
     assert calls == {"c": "h:1", "n": 2, "p": 1}
 
 
+@pytest.mark.slow  # spawns a 2-process jax fleet; ~10 s on 2 cores
 def test_two_process_training_matches_single_process(tmp_path):
     two = _run_fleet(tmp_path / "two", 2)
     # one global program: both processes saw the same loss trajectory
@@ -207,6 +208,7 @@ def test_two_process_training_matches_single_process(tmp_path):
 @pytest.mark.skipif(os.environ.get("DF2_MULTIHOST_GNN") != "1",
                     reason="several minutes of single-core compile per "
                            "process; set DF2_MULTIHOST_GNN=1 to run")
+@pytest.mark.slow  # spawns a 2-process jax fleet
 def test_gnn_fleet(tmp_path):
     """The flagship GraphSAGE trainer (fused on-device sampling) over
     the two-process mesh: f1 agrees across processes. Needs the

@@ -158,6 +158,73 @@ class TestRemoteMLEvaluator:
         finally:
             service.stop()
 
+    def test_resource_exhausted_becomes_shed_not_breaker(self):
+        """A RESOURCE_EXHAUSTED reply (the sidecar's bounded-admission
+        shed) must surface as BatcherSaturatedError — counted by
+        MLEvaluator as a shed with rule fallback — and must NOT open the
+        circuit breaker: the sidecar is alive, and the next decision may
+        land on a lane with room."""
+        import grpc
+
+        from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
+        from dragonfly2_tpu.inference.scorer import MLEvaluator
+        from dragonfly2_tpu.inference.sidecar import _RemoteScorer
+
+        class FakeRpcError(Exception):
+            def code(self):
+                return grpc.StatusCode.RESOURCE_EXHAUSTED
+
+        class FakeClient:
+            def __init__(self):
+                self.calls = 0
+                self.fail_next = True
+
+            def model_infer(self, name, inputs):
+                self.calls += 1
+                if self.fail_next:
+                    self.fail_next = False
+                    raise FakeRpcError()
+                return np.zeros(len(inputs), np.float32)
+
+        client = FakeClient()
+        remote = _RemoteScorer(client, "mlp", cooldown=60.0)
+        with pytest.raises(BatcherSaturatedError):
+            remote.score(np.zeros((2, FEATURE_DIM), np.float32))
+        # Breaker stayed closed: the next call reaches the sidecar
+        # instead of failing instantly for the whole cooldown.
+        assert remote.score(
+            np.zeros((2, FEATURE_DIM), np.float32)).shape == (2,)
+        assert client.calls == 2
+
+        # Through the evaluator: the shed is a counted rule fallback.
+        client2 = FakeClient()
+        evaluator = MLEvaluator(_RemoteScorer(client2, "mlp",
+                                              cooldown=60.0))
+        parents, child = self._peers()
+        ranked = evaluator.evaluate_parents(parents, child, 10)
+        assert sorted(p.id for p in ranked) == sorted(p.id for p in parents)
+        assert evaluator.shed_count == 1
+        assert evaluator.fallback_count == 1
+        evaluator.evaluate_parents(parents, child, 10)
+        assert evaluator.scored_count == 1
+        assert evaluator.shed_count == 1
+
+    def test_other_rpc_errors_still_open_breaker(self):
+        from dragonfly2_tpu.inference.sidecar import (
+            CircuitOpenError,
+            _RemoteScorer,
+        )
+
+        class DeadClient:
+            def model_infer(self, name, inputs):
+                raise ConnectionError("sidecar unreachable")
+
+        remote = _RemoteScorer(DeadClient(), "mlp", cooldown=60.0)
+        with pytest.raises(ConnectionError):
+            remote.score(np.zeros((2, FEATURE_DIM), np.float32))
+        with pytest.raises(CircuitOpenError):
+            remote.score(np.zeros((2, FEATURE_DIM), np.float32))
+
     def test_parent_select_p50_under_1ms(self, registered_model):
         """BASELINE.md target: parent-selection p50 < 1 ms through the
         TPU-backed scorer (in-process scorer path, the deployment the
