@@ -389,6 +389,42 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
         )
         state.stage_done("mlp")
 
+    # Stage 4: data plane — loopback back-to-source throughput with the
+    # PR-3 amortization counters (range coalescing, keep-alive pools,
+    # batched reports). Pure CPU + loopback, a few seconds; the run=1
+    # rung is the one-GET-per-piece baseline the coalesced rung is
+    # measured against. MB/s is informational — the counters are the
+    # asserted contract (tests/test_dataplane.py).
+    if left() > 12.0:
+        try:
+            from dragonfly2_tpu.client.dataplane import run_loopback_bench
+
+            ladder = {}
+            for run in (1, 8):
+                ladder[run] = run_loopback_bench(
+                    64 << 20, coalesce_run=run, workers=4)
+            best = ladder[8]
+            state.record(
+                dataplane_loopback_mb_per_s=best["mb_per_s"],
+                dataplane_pieces=best["pieces"],
+                dataplane_requests_saved=best["requests_saved"],
+                dataplane_connections_opened=best["connections_opened"],
+                dataplane_connections_reused=best["connections_reused"],
+                dataplane_coalesce_run_p50=best["coalesce_run_p50"],
+                dataplane_report_rpcs_saved=best["report_rpcs_saved"],
+                dataplane_ladder={
+                    str(run): {k: v[k] for k in (
+                        "mb_per_s", "seconds", "source_requests",
+                        "source_pieces", "requests_saved",
+                        "connections_opened", "connections_reused",
+                        "server_connections", "server_requests",
+                        "coalesce_run_p50")}
+                    for run, v in ladder.items()},
+            )
+            state.stage_done("dataplane")
+        except Exception as exc:  # noqa: BLE001 — informational stage
+            state.record(dataplane_error=f"{type(exc).__name__}: {exc}")
+
 
 def worker_main(platform: str, out_path: str, budget: float) -> None:
     state = BenchState(out_path)

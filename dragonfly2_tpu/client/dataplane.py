@@ -1,0 +1,373 @@
+"""Data-plane amortization counters + loopback micro-benchmark.
+
+The byte-moving path (PR 3) amortizes three per-piece costs — TCP
+connects (keep-alive pools in ``downloader.PieceDownloader`` and
+``source.HTTPSourceClient``), HTTP requests (range-coalesced
+back-to-source runs in ``peer_task.PeerTaskConductor._download_source``)
+and scheduler RPCs (``piece_reporter.PieceReportBatcher``). Each
+amortization is OBSERVABLE here: components tick a
+:class:`DataPlaneStats` (their own, or the process-wide :data:`STATS`),
+and the snapshot is published on ``/debug/vars`` as ``data_plane`` via
+:func:`dragonfly2_tpu.utils.debugmon.register_debug_var`.
+
+Counter semantics (see docs/DATAPLANE.md):
+
+- ``connections_opened`` / ``connections_reused`` — pooled-transport
+  checkouts that dialed a fresh socket vs rode an existing keep-alive
+  connection. A reuse is counted per REQUEST served over an old
+  connection, so ``reused / (opened + reused)`` is the hit rate.
+- ``source_requests`` / ``source_pieces`` — ranged GETs issued on
+  back-to-source vs pieces those GETs produced. ``requests_saved =
+  source_pieces - source_requests`` is the coalescing win (0 when every
+  piece pays its own request).
+- ``coalesce_run_p50`` — median pieces-per-GET over the last 1024 runs.
+- ``report_batches`` / ``reports_batched`` — SUCCESSFUL batched
+  piece-finished flushes vs pieces they carried (the legacy per-piece
+  fallback and failed flushes save nothing and count nothing);
+  ``report_rpcs_saved`` is the delta.
+
+The loopback benchmark (:func:`run_loopback_bench`) drives a real
+back-to-source download against an in-memory range server on 127.0.0.1
+and reports MB/s plus the counters — the bench's ``dataplane`` stage and
+the ``slow``-marked throughput ladder both call it.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import os
+import shutil
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from dragonfly2_tpu.utils.debugmon import register_debug_var
+
+
+class DataPlaneStats:
+    """Thread-safe amortization counters for one data-plane scope.
+
+    Components default to the process-wide :data:`STATS` instance (what
+    ``/debug/vars`` shows); tests inject a fresh instance for hermetic
+    assertions.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.connections_opened = 0
+        self.connections_reused = 0
+        self.source_requests = 0
+        self.source_pieces = 0
+        self.source_bytes = 0
+        self.parent_requests = 0
+        self.parent_bytes = 0
+        self.report_batches = 0
+        self.reports_batched = 0
+        self._runs: collections.deque = collections.deque(maxlen=1024)
+
+    # -- ticks -------------------------------------------------------------
+
+    def connection(self, reused: bool) -> None:
+        with self._lock:
+            if reused:
+                self.connections_reused += 1
+            else:
+                self.connections_opened += 1
+
+    def source_run(self, pieces: int, nbytes: int = 0) -> None:
+        """One ranged back-to-source GET that produced ``pieces``
+        COMPLETED pieces (callers count what actually landed, so failed
+        runs never inflate requests_saved). A run that produced nothing
+        still counts the request but stays out of the p50 ring."""
+        with self._lock:
+            self.source_requests += 1
+            self.source_pieces += pieces
+            self.source_bytes += nbytes
+            if pieces > 0:
+                self._runs.append(pieces)
+
+    def parent_request(self, nbytes: int = 0) -> None:
+        with self._lock:
+            self.parent_requests += 1
+            self.parent_bytes += nbytes
+
+    def report_flush(self, pieces: int) -> None:
+        with self._lock:
+            self.report_batches += 1
+            self.reports_batched += pieces
+
+    # -- read side ---------------------------------------------------------
+
+    def coalesce_run_p50(self) -> float:
+        with self._lock:
+            runs = sorted(self._runs)
+        if not runs:
+            return 0.0
+        return float(runs[len(runs) // 2])
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "connections_opened": self.connections_opened,
+                "connections_reused": self.connections_reused,
+                "source_requests": self.source_requests,
+                "source_pieces": self.source_pieces,
+                "source_bytes": self.source_bytes,
+                "parent_requests": self.parent_requests,
+                "parent_bytes": self.parent_bytes,
+                "report_batches": self.report_batches,
+                "reports_batched": self.reports_batched,
+                "requests_saved": self.source_pieces - self.source_requests,
+                "report_rpcs_saved": (self.reports_batched
+                                      - self.report_batches),
+            }
+        out["coalesce_run_p50"] = self.coalesce_run_p50()
+        return out
+
+
+#: Process-wide default scope — what ``/debug/vars`` publishes.
+STATS = DataPlaneStats()
+
+register_debug_var("data_plane", STATS.snapshot)
+
+
+class HTTPConnectionPool:
+    """Per-(scheme, host, port) keep-alive connection stacks — the ONE
+    pool implementation behind both keep-alive transports
+    (``source.HTTPSourceClient`` and ``downloader.PieceDownloader``),
+    so checkout/checkin/flush semantics can't silently diverge."""
+
+    def __init__(self, per_host: int = 4, timeout: float = 30.0):
+        self.per_host = per_host
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._pool: Dict[Tuple, List[http.client.HTTPConnection]] = {}
+        self._closed = False
+
+    def checkout(self, key: Tuple) -> Tuple[http.client.HTTPConnection, bool]:
+        """(connection, was_pooled); dials fresh when the stack is empty.
+        Raises OSError/HTTPException on connect failure."""
+        with self._lock:
+            stack = self._pool.get(key)
+            if stack:
+                return stack.pop(), True
+        scheme, host, port = key
+        cls = (http.client.HTTPSConnection if scheme == "https"
+               else http.client.HTTPConnection)
+        conn = cls(host, port, timeout=self.timeout)
+        conn.connect()
+        return conn, False
+
+    def checkin(self, key: Tuple, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if not self._closed:
+                stack = self._pool.setdefault(key, [])
+                if len(stack) < self.per_host:
+                    stack.append(conn)
+                    return
+        conn.close()
+
+    def request(self, key: Tuple, method: str, path: str,
+                headers: Dict[str, str], stats=None):
+        """checkout → request → getresponse with the stale-keep-alive
+        discipline: a request that fails over a POOLED connection
+        retries ONCE on a fresh one, flushing the (equally stale)
+        pooled siblings first. Returns ``(conn, resp)``; the caller
+        owns validation and eventual checkin/close. Raises
+        OSError/HTTPException when the fresh attempt fails too. Ticks
+        ``stats.connection`` only for the checkout that actually served
+        the request (a stale socket that produced nothing is neither a
+        reuse nor an open worth counting)."""
+        last_exc: Exception | None = None
+        for _attempt in range(2):
+            conn, was_pooled = self.checkout(key)
+            try:
+                conn.request(method, path, headers=headers)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as exc:
+                conn.close()
+                last_exc = exc
+                if was_pooled:
+                    self.flush(key)
+                    continue
+                raise
+            if stats is not None:
+                stats.connection(reused=was_pooled)
+            return conn, resp
+        raise last_exc
+
+    def flush(self, key: Tuple) -> None:
+        """Drop every pooled connection for a host (stale keep-alive:
+        its siblings were opened to the same now-dead server)."""
+        with self._lock:
+            stack = self._pool.pop(key, [])
+        for conn in stack:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            pools, self._pool = self._pool, {}
+        for stack in pools.values():
+            for conn in stack:
+                conn.close()
+
+
+# ----------------------------------------------------------------------
+# Loopback benchmark
+# ----------------------------------------------------------------------
+
+
+class BlobRangeServer:
+    """Minimal in-memory range-capable HTTP server with connection and
+    request counters — the loopback 'origin' for the data-plane bench
+    (tests use tests/fileserver.py, which serves directories; the bench
+    must not import the test package)."""
+
+    def __init__(self, blob: bytes, host: str = "127.0.0.1", port: int = 0):
+        self.blob = blob
+        self.connection_count = 0
+        self.request_count = 0
+        self._count_lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def handle(self):
+                with server._count_lock:
+                    server.connection_count += 1
+                super().handle()
+
+            def do_GET(self):  # noqa: N802
+                from dragonfly2_tpu.client.piece import parse_http_range
+
+                with server._count_lock:
+                    server.request_count += 1
+                blob = server.blob
+                rng_header = self.headers.get("Range")
+                if rng_header:
+                    rng = parse_http_range(rng_header, len(blob))
+                    data = blob[rng.start:rng.start + rng.length]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {rng.start}-{rng.end}/{len(blob)}")
+                else:
+                    data = blob
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/blob"
+
+    def __enter__(self) -> "BlobRangeServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="blob-range-server")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class _NullScheduler:
+    """SchedulerAPI no-op — the loopback bench measures bytes, not
+    scheduling; register_peer raising pushes the conductor straight to
+    its non-reporting back-to-source path."""
+
+    def __getattr__(self, name):
+        def method(*a, **k):
+            return None
+        return method
+
+
+def run_loopback_bench(size_bytes: int = 64 << 20, *, coalesce_run: int = 8,
+                       workers: int = 4, root: str | None = None,
+                       seed: int = 0) -> Dict[str, float]:
+    """One counter-verified back-to-source download over loopback.
+
+    Returns MB/s plus the amortization counters from a FRESH
+    :class:`DataPlaneStats` scope (the process-wide one is untouched, so
+    concurrent downloads don't pollute the measurement) and the
+    server-side connection/request counts.
+    """
+    from dragonfly2_tpu.client import source as source_mod
+    from dragonfly2_tpu.client.peer_task import (
+        PeerTaskConductor,
+        PeerTaskOptions,
+    )
+    from dragonfly2_tpu.client.storage import StorageManager, StorageOptions
+
+    # Deterministic but incompressible-enough payload without the
+    # os.urandom cost dominating small runs.
+    import numpy as np
+
+    blob = np.random.default_rng(seed).bytes(size_bytes)
+    tmp = root or tempfile.mkdtemp(prefix="df2-dataplane-")
+    stats = DataPlaneStats()
+    # The registry's default http client ticks the process-global STATS;
+    # the measurement wants ITS OWN connection counters, so scope a
+    # pooled client to this run and restore the default after.
+    prev_http = source_mod.client_for(source_mod.Request("http://x/"))
+    scoped_client = source_mod.HTTPSourceClient(stats=stats)
+    source_mod.register("http", scoped_client, replace=True)
+    conductor = None
+    try:
+        with BlobRangeServer(blob) as server:
+            storage = StorageManager(StorageOptions(
+                root=os.path.join(tmp, "storage"), keep_storage=False))
+            conductor = PeerTaskConductor(
+                _NullScheduler(), storage,
+                host_id="bench-host", task_id="dataplane-bench-task-0",
+                peer_id="bench-peer-0", url=server.url(),
+                options=PeerTaskOptions(
+                    back_source_concurrency=workers,
+                    coalesce_run=coalesce_run),
+                dataplane_stats=stats,
+            )
+            begin = time.perf_counter()
+            result = conductor._run_back_to_source(report=False)
+            seconds = time.perf_counter() - begin
+            if not result.success:
+                raise RuntimeError(f"loopback bench failed: {result.error}")
+            out = stats.snapshot()
+            out.update(
+                mb_per_s=round(size_bytes / (1 << 20) / max(seconds, 1e-9),
+                               1),
+                seconds=round(seconds, 3),
+                bytes=size_bytes,
+                pieces=conductor.total_pieces,
+                coalesce_run=coalesce_run,
+                workers=workers,
+                server_connections=server.connection_count,
+                server_requests=server.request_count,
+            )
+            return out
+    finally:
+        source_mod.register("http", prev_http, replace=True)
+        scoped_client.close()  # don't leave sockets to a dead server
+        if conductor is not None:
+            conductor.reporter.close()
+            conductor.downloader.close()
+        if root is None:
+            shutil.rmtree(tmp, ignore_errors=True)

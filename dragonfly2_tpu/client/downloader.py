@@ -12,17 +12,19 @@ Reference counterparts:
 
 from __future__ import annotations
 
+import hashlib
+import http.client
+import os
 import random
 import socket
 import struct
 import threading
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from dragonfly2_tpu import native
+from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
 from dragonfly2_tpu.client.piece import PieceMetadata
 
 MAX_SCORE_NS = 0                     # best (lower is better)
@@ -144,29 +146,150 @@ def piece_request_path(task_id: str, peer_id: str) -> str:
 
 
 class PieceDownloader:
-    """HTTP piece fetch from a parent's upload server
-    (piece_downloader.go:165-225)."""
+    """Keep-alive HTTP piece fetch from a parent's upload server —
+    the pure-Python data plane (piece_downloader.go:165-225 over the
+    reference's pooled keep-alive ``http.Client`` transport,
+    piece_manager.go:791-891).
 
-    def __init__(self, timeout: float = 30.0, scheme: str = "http"):
+    One persistent connection pool per parent address; ``fetch`` streams
+    the response body chunk-by-chunk into the task file via ``pwrite``
+    at the piece offset with an incremental md5 — a piece is never
+    materialized whole in Python memory. ``download_piece`` keeps the
+    buffered return-bytes form for callers without a file (same pool).
+
+    A pooled connection may have been closed by the parent's keep-alive
+    timeout; requests over a pooled connection retry ONCE on a fresh
+    one, flushing the (equally stale) pooled siblings first — the same
+    discipline as :class:`NativePieceFetcher`.
+    """
+
+    def __init__(self, timeout: float = 30.0, scheme: str = "http",
+                 pool_per_addr: int = 4, chunk_size: int = 64 * 1024,
+                 stats=None):
         self.timeout = timeout
         self.scheme = scheme
+        self.chunk_size = chunk_size
+        if stats is None:
+            from dragonfly2_tpu.client.dataplane import STATS as stats
+        self.stats = stats
+        # Test instrumentation: called with each body chunk's size, so a
+        # test can prove no read ever materializes a whole piece.
+        self.chunk_hook: Optional[Callable[[int], None]] = None
+        self._pool = HTTPConnectionPool(per_host=pool_per_addr,
+                                        timeout=timeout)
+
+    # -- connection pool (shared HTTPConnectionPool, keyed per parent) -----
+
+    def _key(self, addr: str) -> Tuple[str, str, int]:
+        host, sep, port = addr.rpartition(":")
+        if not sep or not port.isdigit():
+            # Malformed parent address from scheduler/peer metadata must
+            # surface as a piece failure (retried on another parent),
+            # not a ValueError that kills the worker thread.
+            raise DownloadPieceError(f"malformed parent address {addr!r}")
+        return (self.scheme, host, int(port))
+
+    def _checkin(self, addr: str, conn: http.client.HTTPConnection) -> None:
+        self._pool.checkin(self._key(addr), conn)
+
+    def close(self) -> None:
+        self._pool.close()
+
+    # -- request plumbing --------------------------------------------------
+
+    def _open(self, req: DownloadPieceRequest):
+        """(conn, resp) with the pool's stale-keep-alive retry applied;
+        the response status/length are validated by the caller."""
+        path = piece_request_path(req.task_id, req.dst_peer_id)
+        try:
+            return self._pool.request(
+                self._key(req.dst_addr), "GET", path,
+                headers={
+                    "Range": req.piece.range.http_header(),
+                    "Connection": "keep-alive",
+                },
+                stats=self.stats,
+            )
+        except (OSError, http.client.HTTPException) as exc:
+            raise DownloadPieceError(
+                f"{req.dst_addr} piece {req.piece.num}: {exc}") from exc
+
+    def _finish(self, addr: str, conn, resp) -> None:
+        """Park the connection for reuse iff the response was fully
+        consumed and the server didn't ask to close."""
+        if resp.will_close or not resp.isclosed():
+            conn.close()
+        else:
+            self._checkin(addr, conn)
+
+    def _validate(self, req: DownloadPieceRequest, conn, resp) -> None:
+        piece = req.piece
+        if resp.status != 206 or (resp.length is not None
+                                  and resp.length != piece.length):
+            conn.close()  # unknown body framing — don't try to realign
+            raise DownloadPieceError(
+                f"{req.dst_addr} piece {piece.num}: status {resp.status}, "
+                f"body {resp.length}/{piece.length}"
+            )
+
+    # -- fetch -------------------------------------------------------------
+
+    def fetch(self, req: DownloadPieceRequest, file_fd: int) -> str:
+        """Stream one piece into ``file_fd`` at the piece's offset
+        (position-independent pwrite; incremental md5); returns the md5
+        hex. Unrecorded bytes from a failed attempt are overwritten by
+        the next one — identical contract to NativePieceFetcher.fetch."""
+        piece = req.piece
+        conn, resp = self._open(req)
+        self._validate(req, conn, resp)
+        digest = hashlib.md5()
+        offset = piece.offset
+        remaining = piece.length
+        try:
+            while remaining > 0:
+                chunk = resp.read(min(self.chunk_size, remaining))
+                if not chunk:
+                    break
+                if self.chunk_hook is not None:
+                    self.chunk_hook(len(chunk))
+                os.pwrite(file_fd, chunk, offset)
+                digest.update(chunk)
+                offset += len(chunk)
+                remaining -= len(chunk)
+        except (OSError, http.client.HTTPException) as exc:
+            conn.close()
+            raise DownloadPieceError(
+                f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+        if remaining:
+            conn.close()
+            raise DownloadPieceError(
+                f"piece {piece.num}: got {piece.length - remaining} bytes, "
+                f"want {piece.length}"
+            )
+        self.stats.parent_request(piece.length)
+        self._finish(req.dst_addr, conn, resp)
+        return digest.hexdigest()
 
     def download_piece(self, req: DownloadPieceRequest) -> bytes:
-        path = piece_request_path(req.task_id, req.dst_peer_id)
-        url = f"{self.scheme}://{req.dst_addr}{path}"
-        http_req = urllib.request.Request(
-            url, headers={"Range": req.piece.range.http_header()}
-        )
+        """Buffered form (callers without a destination file); still
+        rides the keep-alive pool."""
+        piece = req.piece
+        conn, resp = self._open(req)
+        self._validate(req, conn, resp)
         try:
-            with urllib.request.urlopen(http_req, timeout=self.timeout) as resp:
-                data = resp.read()
-        except urllib.error.URLError as exc:
-            raise DownloadPieceError(f"{url}: {exc}") from exc
-        if len(data) != req.piece.length:
+            data = resp.read(piece.length)
+        except (OSError, http.client.HTTPException) as exc:
+            conn.close()
             raise DownloadPieceError(
-                f"piece {req.piece.num}: got {len(data)} bytes, "
-                f"want {req.piece.length}"
+                f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+        if len(data) != piece.length:
+            conn.close()
+            raise DownloadPieceError(
+                f"piece {piece.num}: got {len(data)} bytes, "
+                f"want {piece.length}"
             )
+        self.stats.parent_request(piece.length)
+        self._finish(req.dst_addr, conn, resp)
         return data
 
 
@@ -186,9 +309,13 @@ class NativePieceFetcher:
     ``record_piece``.
     """
 
-    def __init__(self, timeout: float = 30.0, pool_per_addr: int = 4):
+    def __init__(self, timeout: float = 30.0, pool_per_addr: int = 4,
+                 stats=None):
         self.timeout = timeout
         self.pool_per_addr = pool_per_addr
+        if stats is None:
+            from dragonfly2_tpu.client.dataplane import STATS as stats
+        self.stats = stats
         self._pool: Dict[str, List[socket.socket]] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -292,6 +419,10 @@ class NativePieceFetcher:
                     continue
                 raise DownloadPieceError(
                     f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+            # Count only the checkout that actually SERVED the request
+            # (a stale pooled socket that failed above must not count a
+            # reuse — it produced nothing; the fresh retry counts).
+            self.stats.connection(reused=was_pooled)
             if res.status != 206 or res.body_len != piece.length:
                 if res.keep_alive:
                     self._checkin(req.dst_addr, sock)
@@ -305,6 +436,7 @@ class NativePieceFetcher:
                 self._checkin(req.dst_addr, sock)
             else:
                 sock.close()
+            self.stats.parent_request(piece.length)
             return res.md5_hex
         raise DownloadPieceError(
             f"{req.dst_addr} piece {piece.num}: {last_exc}")

@@ -46,6 +46,7 @@ from dragonfly2_tpu.client.piece import (
     compute_piece_size,
     piece_range,
 )
+from dragonfly2_tpu.client.piece_reporter import PieceReportBatcher
 from dragonfly2_tpu.client.storage import (
     StorageManager,
     TaskStorage,
@@ -75,6 +76,9 @@ class SchedulerAPI(Protocol):
     def download_peer_started(self, peer_id: str) -> None: ...
     def download_peer_back_to_source_started(self, peer_id: str) -> None: ...
     def download_piece_finished(self, report: PieceFinished) -> None: ...
+    # Schedulers MAY also expose download_pieces_finished(reports) — the
+    # batched form PieceReportBatcher prefers (it feature-detects with
+    # getattr and falls back to per-piece calls).
     def download_piece_failed(self, peer_id: str, parent_id: str, piece_number: int) -> None: ...
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None: ...
     def download_peer_back_to_source_finished(
@@ -162,6 +166,17 @@ class PeerTaskOptions:
     # Use the C++ piece transfer loop (native/pieceio.cpp) when the
     # compiled module is loadable; False pins the pure-Python path.
     native_data_plane: bool = True
+    # Back-to-source range coalescing: each worker claims up to this many
+    # CONTIGUOUS missing pieces and fetches the run with ONE ranged GET,
+    # splitting the stream into pieces on the fly (piece digests,
+    # metadata, shaper and report semantics unchanged). 1 = one GET per
+    # piece (the old behavior).
+    coalesce_run: int = 8
+    # Piece-finished report batching: flush to the scheduler when this
+    # many reports are buffered or the deadline (seconds) passes since
+    # the first buffered one. Task end always flushes.
+    report_flush_count: int = 16
+    report_flush_deadline: float = 0.05
 
 
 @dataclass
@@ -217,6 +232,7 @@ class PeerTaskConductor:
         metrics=None,
         url_range: "Range | None" = None,
         priority: int = 0,
+        dataplane_stats=None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -242,14 +258,20 @@ class PeerTaskConductor:
         # bypassing storage.
         self.piece_sink = piece_sink
 
+        if dataplane_stats is None:
+            from dragonfly2_tpu.client.dataplane import STATS as dataplane_stats
+        self.stats = dataplane_stats
         self.channel = QueueChannel()
         self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
-        self.downloader = PieceDownloader()
+        self.downloader = PieceDownloader(stats=self.stats)
         self.native_fetcher = (
-            NativePieceFetcher()
+            NativePieceFetcher(stats=self.stats)
             if self.opts.native_data_plane and NativePieceFetcher.supported()
             else None
         )
+        self.reporter = PieceReportBatcher(
+            scheduler, flush_count=self.opts.report_flush_count,
+            flush_deadline=self.opts.report_flush_deadline, stats=self.stats)
         self.store: Optional[TaskStorage] = None
         self.content_length = -1
         self.total_pieces = -1
@@ -454,12 +476,18 @@ class PeerTaskConductor:
                     continue
             self.shaper.wait_n(self.task_id, req.piece.length)
             begin = time.monotonic_ns()
-            native_md5: str | None = None
+            fetched_md5: str | None = None
             try:
-                if (self.native_fetcher is not None
-                        and self.store is not None
+                if (self.store is not None
                         and not self.store.has_piece(req.piece.num)):
-                    native_md5 = self._download_piece_native(req)
+                    # Streaming data plane (C++ when available, pooled
+                    # keep-alive Python otherwise): socket → pwrite at
+                    # the piece offset → incremental md5, never a whole
+                    # piece in a Python bytes object.
+                    if self.native_fetcher is not None:
+                        fetched_md5 = self._download_piece_native(req)
+                    else:
+                        fetched_md5 = self._download_piece_streamed(req)
                     data = None
                 else:
                     data = self.downloader.download_piece(req)
@@ -476,8 +504,8 @@ class PeerTaskConductor:
             cost = time.monotonic_ns() - begin
             self.dispatcher.report(DownloadPieceResult(
                 req.dst_peer_id, req.piece.num, fail=False, cost_ns=cost))
-            if native_md5 is not None:
-                self._record_native_piece(req, native_md5, cost)
+            if fetched_md5 is not None:
+                self._record_fetched_piece(req, fetched_md5, cost)
             else:
                 self._store_piece(req, data, cost)
 
@@ -497,8 +525,21 @@ class PeerTaskConductor:
         finally:
             os.close(fd)
 
-    def _record_native_piece(self, req: DownloadPieceRequest, md5_hex: str,
-                             cost_ns: int) -> None:
+    def _download_piece_streamed(self, req: DownloadPieceRequest) -> str:
+        """Pure-Python mirror of the native path: the pooled keep-alive
+        downloader streams the body chunkwise into the data file
+        (pwrite at the piece offset, incremental md5)."""
+        try:
+            fd = self.store.data_write_fd()
+        except OSError as exc:
+            raise DownloadPieceError(f"data file unavailable: {exc}") from exc
+        try:
+            return self.downloader.fetch(req, fd)
+        finally:
+            os.close(fd)
+
+    def _record_fetched_piece(self, req: DownloadPieceRequest, md5_hex: str,
+                              cost_ns: int) -> None:
         piece = req.piece
         try:
             self.store.record_piece(piece, piece.length, md5_hex, cost_ns)
@@ -535,15 +576,12 @@ class PeerTaskConductor:
         self.shaper.record(self.task_id, piece.length)
         if self.metrics:
             self.metrics.download_traffic.labels(type="p2p").inc(piece.length)
-        try:
-            self.scheduler.download_piece_finished(PieceFinished(
-                peer_id=self.peer_id, piece_number=piece.num,
-                parent_id=req.dst_peer_id, offset=piece.offset,
-                length=piece.length, digest=f"md5:{piece.md5}" if piece.md5 else "",
-                cost_ns=cost_ns, traffic_type=TRAFFIC_REMOTE_PEER,
-            ))
-        except Exception:
-            logger.debug("piece finished report failed", exc_info=True)
+        self.reporter.report(PieceFinished(
+            peer_id=self.peer_id, piece_number=piece.num,
+            parent_id=req.dst_peer_id, offset=piece.offset,
+            length=piece.length, digest=f"md5:{piece.md5}" if piece.md5 else "",
+            cost_ns=cost_ns, traffic_type=TRAFFIC_REMOTE_PEER,
+        ))
         self._check_finished()
 
     def _notify_piece_sink(self, piece_num: int) -> None:
@@ -590,6 +628,10 @@ class PeerTaskConductor:
             self._fail(f"finalize failed: {exc}")
             return
         cost = time.monotonic() - self._started_at
+        # Every buffered piece report must land before the peer flips to
+        # Succeeded — the scheduler's finished_piece_count and download
+        # record are built from them.
+        self.reporter.flush()
         try:
             self.scheduler.download_peer_finished(self.peer_id, cost)
         except Exception:
@@ -601,6 +643,7 @@ class PeerTaskConductor:
         self._error = error
         self._success = False
         self._done.set()
+        self.reporter.flush()  # pieces that DID finish still count
         try:
             self.scheduler.download_peer_failed(self.peer_id)
         except Exception:
@@ -619,6 +662,11 @@ class PeerTaskConductor:
             t.join(timeout=2)
         for t in self._syncers.values():
             t.join(timeout=2)
+        # After the workers are down: drop the keep-alive pool and make
+        # the exactly-once guarantee on buffered reports (close flushes;
+        # stragglers from a timed-out join deliver synchronously).
+        self.downloader.close()
+        self.reporter.close()
 
     # -- back-to-source (pullPiecesFromSource / DownloadSource) ------------
 
@@ -651,6 +699,7 @@ class PeerTaskConductor:
         try:
             content_length, total = self._download_source()
         except Exception as exc:
+            self.reporter.flush()  # pieces that DID land still count
             if report:
                 try:
                     self.scheduler.download_peer_back_to_source_failed(self.peer_id)
@@ -660,6 +709,10 @@ class PeerTaskConductor:
             return PeerTaskResult(self.task_id, self.peer_id, False,
                                   storage=self.store, error=self._error)
         cost = time.monotonic() - self._started_at
+        # Deliver every piece before the task-level success report: the
+        # scheduler promotes back-source pieces into task metadata other
+        # peers sync, and report_success reads the piece set.
+        self.reporter.flush()
         if report:
             try:
                 self.scheduler.download_peer_back_to_source_finished(
@@ -697,62 +750,124 @@ class PeerTaskConductor:
 
         self._learn_length(length, -1)
         total = self.total_pieces
-        piece_queue: "queue.Queue[int]" = queue.Queue()
-        for num in range(total):
-            piece_queue.put(num)
+        run_len = max(int(self.opts.coalesce_run), 1)
         errors: List[str] = []
         lock = threading.Lock()
+        cursor = [0]
+        # First error aborts the REMAINING work (workers stop claiming
+        # runs): a dead source fails in seconds instead of grinding
+        # through N doomed fetches before anyone looks at `errors`.
+        abort = threading.Event()
 
-        def fetch(num: int) -> None:
-            rng = piece_range(num, self.piece_size, length)
-            src_rng = (Range(self.url_range.start + rng.start, rng.length)
-                       if self.url_range is not None else rng)
-            begin = time.monotonic_ns()
+        def claim() -> "tuple[int, int] | None":
+            """Next run of ≤run_len CONTIGUOUS missing pieces (pieces
+            already stored — e.g. partial p2p progress before the
+            back-to-source decision — break runs rather than being
+            re-fetched)."""
+            with lock:
+                if abort.is_set():
+                    return None
+                while (cursor[0] < total
+                       and self.store.has_piece(cursor[0])):
+                    cursor[0] += 1
+                if cursor[0] >= total:
+                    return None
+                start = cursor[0]
+                n = 0
+                while (n < run_len and start + n < total
+                       and not self.store.has_piece(start + n)):
+                    n += 1
+                cursor[0] = start + n
+                return start, n
+
+        def fetch_run(first: int, count: int) -> None:
+            """ONE ranged GET covering pieces [first, first+count), split
+            into pieces as the stream arrives. Per-piece semantics are
+            identical to the old one-GET-per-piece loop: incremental
+            wire md5 via DigestReader → set_piece_digest, write_piece
+            offsets/lengths, shaper wait/record per piece, per-piece
+            finished report (batched)."""
+            first_rng = piece_range(first, self.piece_size, length)
+            last_rng = piece_range(first + count - 1, self.piece_size, length)
+            run_rng = Range(first_rng.start,
+                            last_rng.start + last_rng.length - first_rng.start)
+            src_rng = (Range(self.url_range.start + run_rng.start,
+                             run_rng.length)
+                       if self.url_range is not None else run_rng)
+            num = first
+            # Shape the WHOLE run before the GET is issued (the old code
+            # waited before each per-piece GET): blocking between pieces
+            # of one open response would leave the source connection
+            # idle mid-body, and origin/proxy send-timeouts would kill
+            # the run. Per-piece `record` below still feeds the sampling
+            # shaper's demand estimate at piece granularity.
+            self.shaper.wait_n(self.task_id, run_rng.length)
             try:
-                self.shaper.wait_n(self.task_id, rng.length)
                 resp = client.download(
                     source_mod.Request(self.url, dict(self.request_header),
                                        rng=src_rng))
-                reader = digestutil.DigestReader(resp.body, "md5")
-                self.store.write_piece(
-                    WritePieceRequest(
-                        self.task_id, self.peer_id,
-                        PieceMetadata(num=num, md5="", offset=rng.start,
-                                      start=rng.start, length=rng.length),
-                    ),
-                    reader,
-                )
-                resp.close()
+            except Exception as exc:
+                with lock:
+                    errors.append(
+                        f"pieces {first}-{first + count - 1}: {exc}")
+                abort.set()
+                # The GET was issued even though nothing landed — the
+                # request counters must not flatter failed runs.
+                self.stats.source_run(0, 0)
+                return
+            completed = 0
+            completed_bytes = 0
+            try:
+                for num in range(first, first + count):
+                    rng = piece_range(num, self.piece_size, length)
+                    begin = time.monotonic_ns()
+                    reader = digestutil.DigestReader(resp.body, "md5")
+                    # write_piece reads EXACTLY rng.length bytes from the
+                    # reader, so consecutive pieces split the run stream
+                    # without any intermediate buffering.
+                    self.store.write_piece(
+                        WritePieceRequest(
+                            self.task_id, self.peer_id,
+                            PieceMetadata(num=num, md5="", offset=rng.start,
+                                          start=rng.start, length=rng.length),
+                        ),
+                        reader,
+                    )
+                    cost = time.monotonic_ns() - begin
+                    # Record the piece md5 observed on the wire so
+                    # children can verify (back-source pieces define the
+                    # task's truth).
+                    self.store.set_piece_digest(num, reader.hexdigest(), cost)
+                    self._notify_piece_sink(num)
+                    self.shaper.record(self.task_id, rng.length)
+                    if self.metrics:
+                        self.metrics.download_traffic.labels(
+                            type="back_to_source").inc(rng.length)
+                    self.reporter.report(PieceFinished(
+                        peer_id=self.peer_id, piece_number=num, parent_id="",
+                        offset=rng.start, length=rng.length,
+                        digest=f"md5:{reader.hexdigest()}", cost_ns=cost,
+                        traffic_type=TRAFFIC_BACK_TO_SOURCE,
+                    ))
+                    completed += 1
+                    completed_bytes += rng.length
             except Exception as exc:
                 with lock:
                     errors.append(f"piece {num}: {exc}")
-                return
-            cost = time.monotonic_ns() - begin
-            # Record the piece md5 observed on the wire so children can
-            # verify (back-source pieces define the task's truth).
-            self.store.set_piece_digest(num, reader.hexdigest(), cost)
-            self._notify_piece_sink(num)
-            self.shaper.record(self.task_id, rng.length)
-            if self.metrics:
-                self.metrics.download_traffic.labels(
-                    type="back_to_source").inc(rng.length)
-            try:
-                self.scheduler.download_piece_finished(PieceFinished(
-                    peer_id=self.peer_id, piece_number=num, parent_id="",
-                    offset=rng.start, length=rng.length,
-                    digest=f"md5:{reader.hexdigest()}", cost_ns=cost,
-                    traffic_type=TRAFFIC_BACK_TO_SOURCE,
-                ))
-            except Exception:
-                logger.debug("piece report failed", exc_info=True)
+                abort.set()
+            finally:
+                resp.close()
+                # Counters record what actually LANDED: a run that died
+                # mid-body must not claim its unwritten tail as saved
+                # requests (the acceptance contract is counter-verified).
+                self.stats.source_run(completed, completed_bytes)
 
         def worker() -> None:
             while True:
-                try:
-                    num = piece_queue.get_nowait()
-                except queue.Empty:
+                claimed = claim()
+                if claimed is None:
                     return
-                fetch(num)
+                fetch_run(*claimed)
 
         threads = [
             threading.Thread(target=worker, daemon=True,
@@ -779,6 +894,12 @@ class PeerTaskConductor:
             data = resp.body.read(piece_size)
             if not data:
                 break
+            # Shaper parity with the ranged path: the stream length is
+            # unknown up front, so the wait debits the bytes actually
+            # read for this piece (the token bucket enforces the same
+            # aggregate rate either way), and record feeds the sampling
+            # shaper's demand estimate.
+            self.shaper.wait_n(self.task_id, len(data))
             md5 = digestutil.hash_bytes(data, "md5")
             self.store.write_piece(
                 WritePieceRequest(
@@ -788,17 +909,15 @@ class PeerTaskConductor:
                 ),
                 io.BytesIO(data),
             )
+            self.shaper.record(self.task_id, len(data))
             if self.metrics:
                 self.metrics.download_traffic.labels(
                     type="back_to_source").inc(len(data))
-            try:
-                self.scheduler.download_piece_finished(PieceFinished(
-                    peer_id=self.peer_id, piece_number=num, parent_id="",
-                    offset=offset, length=len(data), digest=f"md5:{md5}",
-                    traffic_type=TRAFFIC_BACK_TO_SOURCE,
-                ))
-            except Exception:
-                logger.debug("piece report failed", exc_info=True)
+            self.reporter.report(PieceFinished(
+                peer_id=self.peer_id, piece_number=num, parent_id="",
+                offset=offset, length=len(data), digest=f"md5:{md5}",
+                traffic_type=TRAFFIC_BACK_TO_SOURCE,
+            ))
             self._notify_piece_sink(num)
             offset += len(data)
             num += 1

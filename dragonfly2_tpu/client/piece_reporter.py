@@ -1,0 +1,139 @@
+"""Batched piece-finished reporting to the scheduler.
+
+Per-piece ``download_piece_finished`` RPCs are the scheduler-facing
+analogue of the per-piece TCP connect the data plane just amortized: a
+1000-piece task used to make 1000 synchronous scheduler calls from the
+piece workers' hot path. :class:`PieceReportBatcher` coalesces them
+through a small bounded-flush buffer:
+
+- flush when ``flush_count`` reports are buffered (bounds batch size),
+- flush when ``flush_deadline`` elapses since the first buffered report
+  (bounds staleness — scheduling decisions that read parent
+  ``piece_updated_at`` stay ≤ one deadline behind), and
+- flush on ``close()`` (task end, success OR failure), so every
+  reported piece is delivered exactly once even on early exit.
+
+Delivery prefers the scheduler's native batched form
+(``download_pieces_finished``, scheduler/service.py and the DF2 wire's
+``WirePiecesFinished``) and falls back to per-piece calls for schedulers
+that predate it. Delivery failures are swallowed-and-logged exactly like
+the old inline reports — piece reporting has always been best-effort
+telemetry for the scheduler's DAG, not a correctness dependency of the
+download itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class PieceReportBatcher:
+    """Coalesces PieceFinished reports; thread-safe; one per conductor."""
+
+    def __init__(self, scheduler, flush_count: int = 16,
+                 flush_deadline: float = 0.05, stats=None):
+        self.scheduler = scheduler
+        self.flush_count = max(int(flush_count), 1)
+        self.flush_deadline = flush_deadline
+        if stats is None:
+            from dragonfly2_tpu.client.dataplane import STATS as stats
+        self.stats = stats
+        self._buf: List = []
+        self._lock = threading.Lock()
+        # Serializes deliveries: flush()/close() must not return while a
+        # deadline-timer delivery is still in flight, or the conductor's
+        # task-level "finished" report could overtake the final pieces.
+        self._deliver_lock = threading.Lock()
+        self._timer: Optional[threading.Timer] = None
+        self._closed = False
+
+    # -- producer side -----------------------------------------------------
+
+    def report(self, piece_finished) -> None:
+        """Buffer one report; may flush inline (count trigger) or arm the
+        deadline timer. After ``close()`` a straggler report (a worker
+        finishing its last piece during shutdown) is delivered
+        immediately instead of being silently dropped."""
+        straggler = None
+        trigger = False
+        with self._lock:
+            if self._closed:
+                straggler = [piece_finished]
+            else:
+                self._buf.append(piece_finished)
+                if len(self._buf) >= self.flush_count:
+                    trigger = True
+                elif self._timer is None and self.flush_deadline > 0:
+                    self._timer = threading.Timer(self.flush_deadline,
+                                                  self.flush)
+                    self._timer.daemon = True
+                    self._timer.start()
+        if trigger:
+            # Drained under flush()'s deliver-lock-first discipline (a
+            # concurrent flush may win the race and deliver it — fine,
+            # someone delivers it exactly once).
+            self.flush()
+        elif straggler:
+            with self._deliver_lock:
+                self._deliver_locked(straggler)
+
+    def flush(self) -> None:
+        """Deliver everything buffered AND wait out any in-flight
+        delivery (a deadline timer mid-RPC) — when flush returns, every
+        report made before it has reached the scheduler (or been
+        dropped by its best-effort error handling). The deliver lock is
+        taken BEFORE the buffer is drained: a batch is never in limbo
+        (taken from the buffer but not yet under the lock), so this
+        barrier cannot be overtaken by a concurrent timer delivery."""
+        with self._deliver_lock:
+            with self._lock:
+                batch = self._take_locked()
+            if batch:
+                self._deliver_locked(batch)
+
+    def close(self) -> None:
+        """Final flush (same in-flight barrier); subsequent reports
+        deliver synchronously."""
+        with self._deliver_lock:
+            with self._lock:
+                self._closed = True
+                batch = self._take_locked()
+            if batch:
+                self._deliver_locked(batch)
+
+    # -- internals ---------------------------------------------------------
+
+    def _take_locked(self) -> List:
+        batch, self._buf = self._buf, []
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        return batch
+
+    def _deliver_locked(self, batch: List) -> None:
+        """Send one batch; caller holds ``_deliver_lock``."""
+        batched = getattr(self.scheduler, "download_pieces_finished", None)
+        if batched is not None:
+            try:
+                batched(batch)
+            except Exception:
+                logger.debug("batched piece report failed (%d pieces)",
+                             len(batch), exc_info=True)
+                return
+            # Count only batched deliveries that actually landed: the
+            # report_rpcs_saved counter is the amortization contract,
+            # and neither a failed flush nor the per-piece fallback
+            # below saves any RPCs.
+            self.stats.report_flush(len(batch))
+            return
+        # Legacy scheduler: per-piece calls, per-piece error isolation.
+        for report in batch:
+            try:
+                self.scheduler.download_piece_finished(report)
+            except Exception:
+                logger.debug("piece finished report failed",
+                             exc_info=True)

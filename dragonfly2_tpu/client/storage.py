@@ -102,9 +102,19 @@ class TaskStorage:
             duplicate = self.meta.pieces.get(piece.num)
         if duplicate is not None:
             # Duplicate of an already-verified piece: drain and ignore
-            # (outside the lock — the reader may be a slow network stream).
-            while reader.read(1 << 20):
-                pass
+            # (outside the lock — the reader may be a slow network
+            # stream). Drain exactly this piece's span when the length
+            # is known: the reader may be a shared coalesced-run stream
+            # (peer_task._download_source) that later pieces continue
+            # from — draining to EOF would eat their bytes.
+            remaining = None if req.unknown_length else piece.length
+            while remaining is None or remaining > 0:
+                n = 1 << 20 if remaining is None else min(1 << 20, remaining)
+                chunk = reader.read(n)
+                if not chunk:
+                    break
+                if remaining is not None:
+                    remaining -= len(chunk)
             return duplicate.length
         src = (
             digestutil.DigestReader(reader, digestutil.ALGORITHM_MD5,

@@ -32,7 +32,15 @@ class TrafficShaper:
         """Account ``n`` bytes downloaded for the task."""
 
     def wait_n(self, task_id: str, n: int) -> None:
-        """Block until the task may transfer ``n`` bytes."""
+        """Block until the task may transfer ``n`` bytes.
+
+        Granularity contract: p2p workers and the unknown-length stream
+        path wait once per piece; the coalesced back-to-source path
+        waits once per RUN, BEFORE its single ranged GET is issued —
+        waiting between pieces of one open response would idle the
+        source connection mid-body into origin send-timeouts. ``record``
+        is per piece on every path, so demand sampling sees the same
+        signal regardless of how many pieces share one request."""
 
 
 class PlainTrafficShaper(TrafficShaper):

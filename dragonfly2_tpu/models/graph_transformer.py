@@ -62,6 +62,11 @@ PAD_ID = np.int32(2**30)
 
 
 def _mesh_empty() -> bool:
+    # jax ≤0.4.x has no abstract-mesh / explicit-sharding API at all, so
+    # no ambient mesh can exist — every sharding-aware branch below must
+    # take its plain (single-program, GSPMD-inferred) path there.
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        return True
     return jax.sharding.get_abstract_mesh().empty
 
 
@@ -357,7 +362,9 @@ def build_inverse_index(nbr: np.ndarray) -> np.ndarray:
 
 def _neighbor_gather_impl(table, idx):
     """[N, h, d] table gathered to [N, K, h, d] by row indices."""
-    if _mesh_empty():
+    from dragonfly2_tpu.parallel import supports_out_sharding
+
+    if _mesh_empty() or not supports_out_sharding():
         return table[idx]
     # Rows shard over data; head/feature axes keep whatever sharding
     # the table carries (the 'model' axis under tensor parallelism).

@@ -7,7 +7,11 @@ annotations. No explicit allreduce calls anywhere in the framework — we
 annotate, XLA lays out the collectives.
 """
 
-from dragonfly2_tpu.parallel.mesh import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.parallel.mesh import (
+    MeshContext,
+    data_parallel_mesh,
+    supports_out_sharding,
+)
 from dragonfly2_tpu.parallel.moe import moe_apply
 from dragonfly2_tpu.parallel.multihost import (
     MultihostMeshContext,
@@ -26,4 +30,5 @@ from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 __all__ = ["MeshContext", "MultihostMeshContext", "agree",
            "data_parallel_mesh", "init_multihost", "moe_apply",
            "multihost_mesh", "pipeline_apply", "ring_attention",
+           "supports_out_sharding",
            "stack_stage_params", "sync", "ulysses_attention"]

@@ -56,6 +56,29 @@ class MeshContext:
         return jax.tree.map(lambda a: jax.device_put(a, self.replicated), tree)
 
 
+_OUT_SHARDING_SUPPORTED: bool | None = None
+
+
+def supports_out_sharding() -> bool:
+    """True when this jax exposes the explicit-sharding gather keyword
+    (``x.at[idx].get(out_sharding=...)``). Probed ONCE with a trivial
+    eager gather — older jax (≤0.4.x) raises TypeError on the unknown
+    keyword, in which case callers fall back to plain ``table[idx]``
+    under the mesh context and let GSPMD infer the output sharding.
+    The fallback is semantically identical; the explicit form only
+    pins the no-collective local-gather partitioning."""
+    global _OUT_SHARDING_SUPPORTED
+    if _OUT_SHARDING_SUPPORTED is None:
+        import jax.numpy as jnp
+
+        try:
+            jnp.zeros(2).at[jnp.zeros((1,), jnp.int32)].get(out_sharding=None)
+            _OUT_SHARDING_SUPPORTED = True
+        except TypeError:
+            _OUT_SHARDING_SUPPORTED = False
+    return _OUT_SHARDING_SUPPORTED
+
+
 def data_parallel_mesh(
     devices: Sequence[Any] | None = None, model_parallel: int = 1
 ) -> MeshContext:

@@ -201,6 +201,16 @@ class WirePieceFinished:
     traffic_type: str = "remote_peer"
 
 
+@message("scheduler.WirePiecesFinished")
+@dataclass
+class WirePiecesFinished:
+    """Batched piece-finished reports — one stream message for a whole
+    PieceReportBatcher flush (the wire half of
+    SchedulerService.download_pieces_finished)."""
+
+    pieces: List[WirePieceFinished] = field(default_factory=list)
+
+
 @message("scheduler.WirePieceFailed")
 @dataclass
 class WirePieceFailed:
@@ -463,6 +473,16 @@ class SchedulerRpcService:
                     length=req.length, digest=req.digest,
                     cost_ns=req.cost_ns, traffic_type=req.traffic_type,
                 ))
+            elif isinstance(req, WirePiecesFinished):
+                svc.download_pieces_finished([
+                    PieceFinished(
+                        peer_id=p.peer_id, piece_number=p.piece_number,
+                        parent_id=p.parent_id, offset=p.offset,
+                        length=p.length, digest=p.digest,
+                        cost_ns=p.cost_ns, traffic_type=p.traffic_type,
+                    )
+                    for p in req.pieces
+                ])
             elif isinstance(req, WirePieceFailed):
                 svc.download_piece_failed(
                     req.peer_id, req.parent_id, req.piece_number)
@@ -722,12 +742,27 @@ class GrpcSchedulerClient:
 
     def download_piece_finished(self, report: PieceFinished) -> None:
         session = self._require_session(report.peer_id)
-        session.send(WirePieceFinished(
+        session.send(self._wire_piece(report))
+
+    def download_pieces_finished(self, reports) -> None:
+        """Batched flush → ONE stream message (WirePiecesFinished). All
+        reports in one flush belong to one conductor, hence one peer
+        session."""
+        reports = list(reports)
+        if not reports:
+            return
+        session = self._require_session(reports[0].peer_id)
+        session.send(WirePiecesFinished(
+            pieces=[self._wire_piece(r) for r in reports]))
+
+    @staticmethod
+    def _wire_piece(report: PieceFinished) -> WirePieceFinished:
+        return WirePieceFinished(
             peer_id=report.peer_id, piece_number=report.piece_number,
             parent_id=report.parent_id, offset=report.offset,
             length=report.length, digest=report.digest,
             cost_ns=report.cost_ns, traffic_type=report.traffic_type,
-        ))
+        )
 
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:
@@ -939,6 +974,13 @@ class BalancedSchedulerClient:
 
     def download_piece_finished(self, report: PieceFinished) -> None:
         self._owner(report.peer_id).download_piece_finished(report)
+
+    def download_pieces_finished(self, reports) -> None:
+        reports = list(reports)
+        if not reports:
+            return
+        # One flush = one conductor = one peer = one owning scheduler.
+        self._owner(reports[0].peer_id).download_pieces_finished(reports)
 
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:

@@ -333,6 +333,50 @@ class SchedulerService:
         if parent is not None:
             parent.piece_updated_at = time.time()
 
+    def download_pieces_finished(self,
+                                 reports: Sequence[PieceFinished]) -> None:
+        """Batched ``download_piece_finished`` — the native form the
+        client's :class:`~dragonfly2_tpu.client.piece_reporter.
+        PieceReportBatcher` flushes (one RPC, N pieces). Peer/parent
+        lookups are amortized across the batch; per-piece semantics are
+        identical to N individual calls. A piece whose peer vanished
+        mid-batch is skipped (its NOT_FOUND would otherwise drop the
+        rest of the batch) — matching the per-call form, where each
+        report fails independently."""
+        peers: Dict[str, Optional[Peer]] = {}
+        parents: Dict[str, Optional[Peer]] = {}
+        for report in reports:
+            if report.peer_id in peers:
+                peer = peers[report.peer_id]
+            else:
+                try:
+                    peer = peers[report.peer_id] = self._peer(report.peer_id)
+                except ServiceError:
+                    # Negative-cache the vanished peer: ONE lookup (and
+                    # one log line) for the whole batch, not one per
+                    # report.
+                    peer = peers[report.peer_id] = None
+                    logger.debug("batched piece report for unknown peer %s",
+                                 report.peer_id)
+            if peer is None:
+                continue
+            piece = Piece(
+                number=report.piece_number, parent_id=report.parent_id,
+                offset=report.offset, length=report.length,
+                digest=report.digest, cost=report.cost_ns / 1e9,
+                traffic_type=report.traffic_type,
+            )
+            peer.store_piece(piece)
+            if not report.parent_id:
+                peer.task.store_piece(piece)
+            elif report.parent_id not in parents:
+                parents[report.parent_id] = self.resource.peer_manager.load(
+                    report.parent_id)
+        now = time.time()
+        for parent in parents.values():
+            if parent is not None:
+                parent.piece_updated_at = now
+
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:
         """(service_v2.go handleDownloadPieceFailedRequest) — block the

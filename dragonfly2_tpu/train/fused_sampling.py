@@ -34,7 +34,7 @@ import optax
 
 from dragonfly2_tpu.data.graph_sampler import CSRGraph
 from dragonfly2_tpu.models.graphsage import GraphSAGE
-from dragonfly2_tpu.parallel import MeshContext
+from dragonfly2_tpu.parallel import MeshContext, supports_out_sharding
 
 
 class GraphTables(NamedTuple):
@@ -77,7 +77,10 @@ def put_edge_tables(src: np.ndarray, dst: np.ndarray, labels: np.ndarray,
 
 
 def _gather(table: jax.Array, idx: jax.Array, out_sharding) -> jax.Array:
-    if out_sharding is None:
+    # Older jax (≤0.4.x) lacks the explicit out_sharding keyword; the
+    # plain gather under the same in_shardings lets GSPMD infer the
+    # identical local-gather partitioning (see supports_out_sharding).
+    if out_sharding is None or not supports_out_sharding():
         return table[idx]
     return table.at[idx].get(out_sharding=out_sharding)
 

@@ -135,7 +135,9 @@ def apply_indexed(model: GraphSAGE, params, node_features, center_idx,
     own index shard locally — no collective); single-device jit leaves
     ``out_sharding`` None.
     """
-    if out_sharding is None:
+    from dragonfly2_tpu.parallel import supports_out_sharding
+
+    if out_sharding is None or not supports_out_sharding():
         def gather(idx):
             return node_features[idx]
     else:

@@ -84,7 +84,11 @@ def sample_profile(seconds: float, hz: float = 100.0) -> str:
 # zero-arg callable returning a JSON-serializable value, evaluated per
 # /debug/vars request. The inference sidecar registers its
 # batcher_stats here so operators can watch per-lane dispatch/coalesce/
-# shed counters on a live process.
+# shed counters on a live process, and the client data plane registers
+# "data_plane" (client/dataplane.py): requests_saved /
+# connections_reused / coalesce_run_p50 / report_rpcs_saved — the
+# amortization counters behind the keep-alive pools, range coalescing
+# and batched piece reporting (docs/DATAPLANE.md).
 _VARS: dict = {}
 _VARS_LOCK = threading.Lock()
 

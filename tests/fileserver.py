@@ -3,6 +3,12 @@
 Test-infra counterpart of the reference's e2e file-server pod
 (test/testdata/k8s file-server) — serves a directory with single-range
 support so back-to-source and proxy paths can be exercised hermetically.
+
+Keep-alive aware: HTTP/1.1 with Content-Length on every response, so
+pooled clients reuse connections, and the server counts BOTH accepted
+TCP connections (``connection_count``) and requests served
+(``request_count``) — the counters the data-plane amortization tests
+assert against (connections ≤ workers, requests ≤ probes + ⌈pieces/run⌉).
 """
 
 from __future__ import annotations
@@ -22,6 +28,9 @@ class FileServer:
         self.support_range = support_range
         self.send_content_length = send_content_length
         self.tls = tls_context is not None
+        self.connection_count = 0
+        self.request_count = 0
+        self._count_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -30,7 +39,19 @@ class FileServer:
             def log_message(self, fmt, *args):
                 pass
 
+            def handle(self):
+                # One handle() per accepted TCP connection; the base
+                # class then loops handle_one_request over keep-alive.
+                with server._count_lock:
+                    server.connection_count += 1
+                super().handle()
+
             def do_GET(self):  # noqa: N802
+                with server._count_lock:
+                    server.request_count += 1
+                self._serve()
+
+            def _serve(self):
                 path = os.path.join(server.root, self.path.lstrip("/"))
                 if not os.path.isfile(path):
                     self.send_error(404)
@@ -62,6 +83,8 @@ class FileServer:
             def do_HEAD(self):  # noqa: N802 — headers only, no body
                 # (aliasing do_GET would write a body, which corrupts
                 # keep-alive framing for any pooled client)
+                with server._count_lock:
+                    server.request_count += 1
                 path = os.path.join(server.root, self.path.lstrip("/"))
                 if not os.path.isfile(path):
                     self.send_error(404)
@@ -79,6 +102,11 @@ class FileServer:
             self._server.socket = tls_context.wrap_socket(
                 self._server.socket, server_side=True)
         self._thread: threading.Thread | None = None
+
+    def reset_counters(self) -> None:
+        with self._count_lock:
+            self.connection_count = 0
+            self.request_count = 0
 
     @property
     def port(self) -> int:

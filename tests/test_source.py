@@ -71,3 +71,36 @@ class TestHTTPSource:
             cli = HTTPSourceClient()
             with pytest.raises(SourceError):
                 cli.download(Request(fs.url("f.bin"), rng=Range(10, 10)))
+
+    def test_proxied_and_credentialed_urls_keep_urllib_path(self, served,
+                                                            monkeypatch):
+        """The pooled transport dials origins directly; URLs that need
+        proxy env vars or carry userinfo must keep the legacy urllib
+        path (which honors both)."""
+        import urllib.request
+
+        fs, content = served
+        cli = HTTPSourceClient()
+        calls = []
+        real_urlopen = urllib.request.urlopen
+
+        def spy(req, timeout=None):
+            calls.append(req.full_url)
+            return real_urlopen(req, timeout=timeout)
+
+        monkeypatch.setattr(urllib.request, "urlopen", spy)
+        # Credentialed URL → urllib (even with no proxy configured).
+        assert cli._needs_urllib("http://user:pw@127.0.0.1/x")
+        # Proxy env var → urllib, unless no_proxy bypasses the host.
+        monkeypatch.setenv("http_proxy", "http://proxy.invalid:3128")
+        monkeypatch.setenv("no_proxy", "")
+        assert cli._needs_urllib(fs.url("blob.bin"))
+        monkeypatch.setenv("no_proxy", "127.0.0.1")
+        assert not cli._needs_urllib(fs.url("blob.bin"))
+        # And the bypassed direct fetch still works end to end without
+        # touching urllib.
+        resp = cli.download(Request(fs.url("blob.bin"), rng=Range(0, 10)))
+        body = resp.body.read()
+        resp.close()
+        assert body == content[:10]
+        assert calls == []
