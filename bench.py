@@ -425,6 +425,50 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
         except Exception as exc:  # noqa: BLE001 — informational stage
             state.record(dataplane_error=f"{type(exc).__name__}: {exc}")
 
+    # Stage 5: scheduler control plane — in-process swarm load ladder
+    # against the real SchedulerService (sharded managers + incremental
+    # GC + O(1) peer statistics). Pure CPU, no device. Reports
+    # announce→first-decision p50/p99, decisions/sec, piece-reports/sec
+    # and GC pause p99 per swarm size; the documented bound
+    # (docs/SCHEDULER.md) is largest-rung decision p99 within
+    # LADDER_P99_BOUND× of the smallest rung.
+    if left() > 15.0:
+        try:
+            from dragonfly2_tpu.scheduler.loadbench import run_swarm_ladder
+
+            sizes = (100, 1000, 5000) if left() > 30.0 else (100, 500, 1500)
+            sched = run_swarm_ladder(sizes, workers=8)
+            ladder = sched["ladder"]
+            largest = ladder[str(sizes[-1])]
+            state.record(
+                scheduler_swarm_sizes=list(sizes),
+                scheduler_announce_p50_ms=largest["announce_p50_ms"],
+                scheduler_announce_p99_ms=largest["announce_p99_ms"],
+                scheduler_decisions_per_sec=largest["decisions_per_sec"],
+                scheduler_piece_reports_per_sec=largest[
+                    "piece_reports_per_sec"],
+                scheduler_gc_pause_p99_ms=largest["gc_pause_p99_ms"],
+                scheduler_gc_budget_overruns=largest["gc_budget_overruns"],
+                scheduler_bad_node_fast=largest["bad_node_fast"],
+                scheduler_bad_node_slow=largest["bad_node_slow"],
+                scheduler_decision_p99_ratio=sched["decision_p99_ratio"],
+                scheduler_ladder_p99_bound=sched["ladder_p99_bound"],
+                scheduler_p99_within_bound=sched["p99_within_bound"],
+                scheduler_ladder={
+                    size: {k: v[k] for k in (
+                        "seconds", "announce_p50_ms", "announce_p99_ms",
+                        "decisions", "decisions_per_sec", "piece_reports",
+                        "piece_reports_per_sec", "back_to_source",
+                        "filter_ms_p99", "evaluate_ms_p99", "gc_ticks",
+                        "gc_pause_p50_ms", "gc_pause_p99_ms",
+                        "gc_budget_overruns", "gc_reclaimed", "tasks",
+                        "workers", "errors")}
+                    for size, v in ladder.items()},
+            )
+            state.stage_done("scheduler")
+        except Exception as exc:  # noqa: BLE001 — informational stage
+            state.record(scheduler_error=f"{type(exc).__name__}: {exc}")
+
 
 def worker_main(platform: str, out_path: str, budget: float) -> None:
     state = BenchState(out_path)

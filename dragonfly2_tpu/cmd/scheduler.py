@@ -29,7 +29,10 @@ def build_scheduler(args):
         NetworkTopologyConfig,
         NetworkTopologyStore,
     )
-    from dragonfly2_tpu.scheduler.resource.resource import Resource
+    from dragonfly2_tpu.scheduler.resource.resource import (
+        Resource,
+        ResourceConfig,
+    )
     from dragonfly2_tpu.scheduler.rpcserver import (
         SCHEDULER_SPEC,
         SchedulerRpcService,
@@ -41,7 +44,9 @@ def build_scheduler(args):
     from dragonfly2_tpu import __version__
     from dragonfly2_tpu.scheduler.metrics import SchedulerMetrics
 
-    resource = Resource()
+    resource = Resource(ResourceConfig(
+        shard_count=args.resource_shards,
+        gc_budget_s=args.gc_budget_ms / 1e3))
     storage = Storage(args.data_dir)
     evaluator = new_evaluator(
         args.algorithm,
@@ -108,6 +113,14 @@ def main(argv=None) -> int:
                         help="dataset sink directory")
     parser.add_argument("--algorithm", default="default",
                         choices=["default", "ml", "plugin"])
+    parser.add_argument("--resource-shards", type=int, default=8,
+                        help="shards per resource-manager map; announce "
+                             "lookups and GC snapshots contend per shard "
+                             "(docs/SCHEDULER.md)")
+    parser.add_argument("--gc-budget-ms", type=float, default=50.0,
+                        help="incremental-GC sweep budget per tick; the "
+                             "longest announce-path stall one reclaim "
+                             "tick may cause")
     parser.add_argument("--inference-sidecar", default="",
                         help="host:port of the TPU inference sidecar "
                              "(with --algorithm ml)")

@@ -36,13 +36,7 @@ from typing import Dict, List
 import numpy as np
 
 from dragonfly2_tpu.inference.batcher import BatcherSaturatedError, MicroBatcher
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
-    return sorted_vals[idx]
+from dragonfly2_tpu.utils.percentile import percentile as _percentile
 
 
 def measure_colocated(

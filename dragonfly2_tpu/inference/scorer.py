@@ -31,7 +31,11 @@ import numpy as np
 
 from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
-from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator, PeerLike, pair_features
+from dragonfly2_tpu.scheduler.evaluator.base import (
+    BaseEvaluator,
+    PeerLike,
+    build_feature_matrix,
+)
 from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
 
 
@@ -277,9 +281,10 @@ class MLEvaluator:
             return []
         if self._scorer is None:
             return self._fallback.evaluate_parents(parents, child, total_piece_count)
-        features = np.stack(
-            [pair_features(p, child, total_piece_count) for p in parents]
-        )
+        # One-pass fill into a fresh matrix (value-identical to stacking
+        # pair_features rows). Fresh, not staged: the micro-batcher may
+        # hold the rows across an async dispatch window.
+        features = build_feature_matrix(parents, child, total_piece_count)
         try:
             scores = self._scorer.score(features)
         except BatcherSaturatedError:

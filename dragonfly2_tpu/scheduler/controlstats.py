@@ -1,0 +1,165 @@
+"""Scheduler control-plane counters + latency rings.
+
+The control plane (announce → filter → evaluate → decision, plus the
+resource-manager GC sweeps) is the third measured hot path of the request
+ladder, next to serving (``batcher_stats``) and the client data plane
+(``data_plane``). Components tick a :class:`ControlPlaneStats` — their
+own, or the process-wide :data:`STATS` instance — and the snapshot is
+published on ``/debug/vars`` as ``"scheduler"`` via
+:func:`dragonfly2_tpu.utils.debugmon.register_debug_var`.
+
+Counter semantics (see docs/SCHEDULER.md):
+
+- ``schedules`` / ``decisions`` / ``back_to_source`` — announce-path
+  scheduling attempts vs candidate-parent decisions delivered vs
+  back-to-source verdicts. ``schedule_ms_p50/p99`` come from a ring of
+  the last 4096 announce→decision latencies.
+- ``filter_ms_*`` / ``evaluate_ms_*`` — the two phases of
+  ``find_candidate_parents`` (candidate filtering vs batched scoring).
+- ``piece_reports`` / ``report_batches`` — piece-finished reports
+  processed vs batched RPCs that carried them (PR 3's
+  ``download_pieces_finished`` form).
+- ``bad_node_fast`` / ``bad_node_slow`` — ``is_bad_node`` verdicts
+  served from the O(1) windowed Welford aggregates vs the legacy
+  numpy-over-history path (duck-typed peers without stats). On the real
+  resource model this must stay ~100% fast: the slow counter existing is
+  what lets a regression be SEEN.
+- ``gc_pause_ms_*`` / ``gc_budget_overruns`` / ``gc_reclaimed`` — per
+  ``run_gc`` tick pause times (the pauses the incremental sweep bounds),
+  ticks that overran their time budget, and items reclaimed.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict
+
+from dragonfly2_tpu.utils.debugmon import register_debug_var
+from dragonfly2_tpu.utils.percentile import percentile
+
+
+class _Ring:
+    """Bounded sample ring with p50/p99 readout."""
+
+    __slots__ = ("_vals", "count")
+
+    def __init__(self, maxlen: int = 4096):
+        self._vals: deque = deque(maxlen=maxlen)
+        self.count = 0
+
+    def add(self, v: float) -> None:
+        self._vals.append(v)
+        self.count += 1
+
+    def percentiles(self) -> tuple[float, float]:
+        vals = sorted(self._vals)
+        return percentile(vals, 0.50), percentile(vals, 0.99)
+
+
+class ControlPlaneStats:
+    """Thread-safe control-plane counters for one scheduler scope.
+
+    Components default to the process-wide :data:`STATS` instance (what
+    ``/debug/vars`` shows); the bench and tests inject a fresh instance
+    for hermetic measurement.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.schedules = 0
+        self.decisions = 0
+        self.back_to_source = 0
+        self.piece_reports = 0
+        self.report_batches = 0
+        self.bad_node_fast = 0
+        self.bad_node_slow = 0
+        self.gc_ticks = 0
+        self.gc_budget_overruns = 0
+        self.gc_reclaimed = 0
+        self._schedule_ms = _Ring(4096)
+        self._filter_ms = _Ring(2048)
+        self._evaluate_ms = _Ring(2048)
+        self._gc_pause_ms = _Ring(2048)
+
+    # -- ticks -------------------------------------------------------------
+
+    def observe_schedule(self, ms: float, *, decided: bool) -> None:
+        with self._lock:
+            self.schedules += 1
+            if decided:
+                self.decisions += 1
+            self._schedule_ms.add(ms)
+
+    def observe_back_to_source(self) -> None:
+        with self._lock:
+            self.back_to_source += 1
+
+    def observe_filter(self, ms: float) -> None:
+        with self._lock:
+            self._filter_ms.add(ms)
+
+    def observe_evaluate(self, ms: float) -> None:
+        with self._lock:
+            self._evaluate_ms.add(ms)
+
+    def observe_piece_reports(self, n: int, *, batched: bool = False) -> None:
+        with self._lock:
+            self.piece_reports += n
+            if batched:
+                self.report_batches += 1
+
+    def observe_bad_node(self, *, fast: bool) -> None:
+        # Lock-free: this fires once per CANDIDATE inside the filter hot
+        # loop — taking the shared stats lock there would re-introduce
+        # the cross-thread contention the sharded managers remove. A
+        # rare lost increment under preemption is acceptable for a
+        # monitoring counter (same stance as racecheck.acquire_count).
+        if fast:
+            self.bad_node_fast += 1
+        else:
+            self.bad_node_slow += 1
+
+    def observe_gc(self, ms: float, *, overran: bool, reclaimed: int) -> None:
+        with self._lock:
+            self.gc_ticks += 1
+            if overran:
+                self.gc_budget_overruns += 1
+            self.gc_reclaimed += reclaimed
+            self._gc_pause_ms.add(ms)
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            sched_p50, sched_p99 = self._schedule_ms.percentiles()
+            filt_p50, filt_p99 = self._filter_ms.percentiles()
+            ev_p50, ev_p99 = self._evaluate_ms.percentiles()
+            gc_p50, gc_p99 = self._gc_pause_ms.percentiles()
+            return {
+                "schedules": self.schedules,
+                "decisions": self.decisions,
+                "back_to_source": self.back_to_source,
+                "schedule_ms_p50": round(sched_p50, 4),
+                "schedule_ms_p99": round(sched_p99, 4),
+                "filter_ms_p50": round(filt_p50, 4),
+                "filter_ms_p99": round(filt_p99, 4),
+                "evaluate_ms_p50": round(ev_p50, 4),
+                "evaluate_ms_p99": round(ev_p99, 4),
+                "piece_reports": self.piece_reports,
+                "report_batches": self.report_batches,
+                "bad_node_fast": self.bad_node_fast,
+                "bad_node_slow": self.bad_node_slow,
+                "gc_ticks": self.gc_ticks,
+                "gc_budget_overruns": self.gc_budget_overruns,
+                "gc_reclaimed": self.gc_reclaimed,
+                "gc_pause_ms_p50": round(gc_p50, 4),
+                "gc_pause_ms_p99": round(gc_p99, 4),
+            }
+
+
+# Process-wide instance, published as the "scheduler" block on
+# /debug/vars (mirrors client/dataplane.py's "data_plane" block).
+STATS = ControlPlaneStats()
+
+register_debug_var("scheduler", STATS.snapshot)

@@ -10,10 +10,12 @@ scorer can never drift apart.
 
 from __future__ import annotations
 
-from typing import Protocol, Sequence
+import threading
+from typing import Optional, Protocol, Sequence
 
 import numpy as np
 
+from dragonfly2_tpu.scheduler import controlstats
 from dragonfly2_tpu.scheduler.evaluator import scoring
 
 # Peer FSM state names (reference: scheduler/resource/peer.go:53-81).
@@ -93,8 +95,87 @@ def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> 
     )
 
 
+# Feature-row indices hoisted from the canonical layout so the one-pass
+# fill below can never silently reorder against pack_features.
+_I_PARENT_FIN = scoring.FEATURE_NAMES.index("parent_finished_pieces")
+_I_CHILD_FIN = scoring.FEATURE_NAMES.index("child_finished_pieces")
+_I_TOTAL = scoring.FEATURE_NAMES.index("total_pieces")
+_I_UPLOADS = scoring.FEATURE_NAMES.index("upload_count")
+_I_UPLOAD_FAILED = scoring.FEATURE_NAMES.index("upload_failed_count")
+_I_FREE_UPLOAD = scoring.FEATURE_NAMES.index("free_upload_count")
+_I_UPLOAD_LIMIT = scoring.FEATURE_NAMES.index("concurrent_upload_limit")
+_I_IS_SEED = scoring.FEATURE_NAMES.index("is_seed")
+_I_SEED_READY = scoring.FEATURE_NAMES.index("seed_ready")
+_I_IDC = scoring.FEATURE_NAMES.index("idc_match")
+_I_LOCATION = scoring.FEATURE_NAMES.index("location_matches")
+
+_SEED_READY_STATES = (PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING)
+
+
+def build_feature_matrix(
+    parents: Sequence[PeerLike], child: PeerLike, total_piece_count: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Fill the ``[len(parents), FEATURE_DIM]`` feature matrix in ONE
+    pass, value-identical to stacking :func:`pair_features` rows.
+
+    Child-side features (finished count, idc, location) are derived once
+    per announce instead of once per candidate, and each row is written
+    straight into ``out`` (or a fresh matrix) — no per-candidate
+    11-float temporary, no ``np.stack`` copy. Callers that reuse a
+    staging buffer pass ``out``; it must be float32 with at least
+    ``len(parents)`` rows, and the filled view is returned.
+    """
+    n = len(parents)
+    if out is None:
+        out = np.empty((n, scoring.FEATURE_DIM), dtype=np.float32)
+    m = out[:n]
+    child_finished = child.finished_piece_count()
+    child_host = child.host
+    child_idc = child_host.idc
+    child_location = child_host.location
+    for i, parent in enumerate(parents):
+        host = parent.host
+        is_seed = bool(getattr(host.type, "is_seed", bool(host.type)))
+        row = m[i]
+        row[_I_PARENT_FIN] = parent.finished_piece_count()
+        row[_I_CHILD_FIN] = child_finished
+        row[_I_TOTAL] = total_piece_count
+        row[_I_UPLOADS] = host.upload_count
+        row[_I_UPLOAD_FAILED] = host.upload_failed_count
+        row[_I_FREE_UPLOAD] = host.free_upload_count()
+        row[_I_UPLOAD_LIMIT] = host.concurrent_upload_limit
+        row[_I_IS_SEED] = 1.0 if is_seed else 0.0
+        row[_I_SEED_READY] = (
+            1.0 if is_seed and parent.state() in _SEED_READY_STATES else 0.0)
+        row[_I_IDC] = scoring.idc_match(host.idc, child_idc)
+        row[_I_LOCATION] = scoring.location_matches(
+            host.location, child_location)
+    return m
+
+
 class BaseEvaluator:
     """The ``default`` algorithm (evaluator.go:44-46)."""
+
+    def __init__(self, stats: Optional[controlstats.ControlPlaneStats] = None):
+        # Per-thread staging for the candidate feature matrix: the
+        # scheduler filters/evaluates from concurrent announce threads,
+        # and the matrix only lives within one evaluate_parents call, so
+        # thread-local reuse is both safe and allocation-free on the
+        # steady state (same staging-reuse discipline as the inference
+        # scorer pool, inference/scorer.py).
+        self._tls = threading.local()
+        self._stats = stats if stats is not None else controlstats.STATS
+
+    def _staging(self, n: int) -> np.ndarray:
+        buf = getattr(self._tls, "buf", None)
+        if buf is None or buf.shape[0] < n:
+            rows = 16
+            while rows < n:
+                rows *= 2
+            buf = np.empty((rows, scoring.FEATURE_DIM), dtype=np.float32)
+            self._tls.buf = buf
+        return buf
 
     def evaluate(self, parent: PeerLike, child: PeerLike, total_piece_count: int) -> float:
         features = pair_features(parent, child, total_piece_count)
@@ -106,12 +187,14 @@ class BaseEvaluator:
         """Sort candidate parents best-first (evaluator_base.go:80-90).
 
         Scores the whole candidate set as one batched feature matrix —
-        O(n) feature extraction + one vectorized evaluation, instead of the
-        reference's O(n log n) re-evaluation inside a sort comparator.
+        one-pass extraction into preallocated thread-local staging + one
+        vectorized evaluation, instead of the reference's O(n log n)
+        re-evaluation inside a sort comparator.
         """
         if not parents:
             return []
-        features = np.stack([pair_features(p, child, total_piece_count) for p in parents])
+        features = build_feature_matrix(
+            parents, child, total_piece_count, out=self._staging(len(parents)))
         scores = scoring.rule_scores(features)
         # Stable descending sort keeps the reference's tie behavior
         # (sort.Slice with strict '>' keeps equal-score input order).
@@ -125,10 +208,28 @@ class BaseEvaluator:
         piece cost is an outlier: >20x the mean of prior costs when the
         sample is small (<30), or outside mean+3*sigma once the sample is
         large enough to assume normality.
+
+        Peers that carry incremental statistics (the real resource
+        model's ``piece_cost_stats``) are judged from the O(1) windowed
+        Welford aggregates — constant work regardless of history length.
+        Duck-typed peers without stats fall back to the original numpy
+        formulas over ``piece_costs()``; both paths are counted so a
+        silent fallback regression is visible on /debug/vars.
         """
         if peer.state() in _BAD_STATES:
             return True
 
+        stats_of = getattr(peer, "piece_cost_stats", None)
+        if stats_of is not None:
+            n, last, prior_mean, prior_pstd = stats_of().snapshot()
+            self._stats.observe_bad_node(fast=True)
+            if n < MIN_AVAILABLE_COST_LEN:
+                return False
+            if n < NORMAL_DISTRIBUTION_LEN:
+                return last > prior_mean * 20
+            return last > prior_mean + 3 * prior_pstd
+
+        self._stats.observe_bad_node(fast=False)
         costs = np.asarray(peer.piece_costs(), dtype=np.float64)
         if len(costs) < MIN_AVAILABLE_COST_LEN:
             return False

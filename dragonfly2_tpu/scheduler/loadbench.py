@@ -1,0 +1,293 @@
+"""In-process swarm load benchmark for the scheduler control plane.
+
+Drives the REAL :class:`~dragonfly2_tpu.scheduler.service.SchedulerService`
+— sharded resource managers, scheduling core, rule evaluator — with N
+hosts × M concurrent worker threads, each peer walking the full announce
+ladder (register → download_started → schedule_candidate_parents →
+batched piece reports, PR-3 form → finished), while an optional GC-churn
+thread hammers the incremental sweeps. This is the control-plane sibling
+of the serving ladder (``measure_colocated``) and the data plane's
+loopback bench (``run_loopback_bench``): ``bench.py``'s ``scheduler``
+stage runs it over a swarm-size ladder, and the tier-1 smoke test runs a
+tiny swarm asserting counters only.
+
+What a rung reports (all measured, no synthetic sleeps):
+
+- ``announce_p50_ms`` / ``announce_p99_ms`` — register→first-decision
+  latency per peer (the announce→decision number the ladder bounds).
+- ``decisions_per_sec`` / ``piece_reports_per_sec`` — control-plane
+  throughput over the driven phase.
+- ``gc_pause_p50_ms`` / ``gc_pause_p99_ms`` / ``gc_budget_overruns`` —
+  incremental-GC tick pauses under announce load.
+- the hermetic :class:`~dragonfly2_tpu.scheduler.controlstats.
+  ControlPlaneStats` snapshot (filter/evaluate timings, bad-node
+  fast/slow split, back-to-source verdicts).
+
+Swarm shape: peers are spread over tasks at ``peers_per_task`` so the
+per-announce candidate work (a filter over one task's DAG) stays
+constant across rungs — the ladder measures control-plane CONTENTION
+(locks, GC interference, shared state) at growing swarm sizes, not
+growing per-task DAGs. Each task is pre-seeded with ``seeds_per_task``
+seed peers via the real back-to-source path so candidates exist from the
+first announce. A ``leave_fraction`` of peers drops without a leave RPC
+(FSM → Leave, the same state a stale host cascade produces) so the GC
+sweeps have real reclaim work, not just scan work.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import perf_counter
+from typing import Dict, List, Optional
+
+from dragonfly2_tpu.scheduler.controlstats import ControlPlaneStats
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.resource import Resource, ResourceConfig
+from dragonfly2_tpu.scheduler.scheduling.core import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    RegisterPeerRequest,
+    SchedulerService,
+)
+from dragonfly2_tpu.utils.hosttypes import HostType
+from dragonfly2_tpu.utils.percentile import percentile
+
+DEFAULT_PEERS_PER_TASK = 500
+
+
+class _DecisionRecorder:
+    """Announce channel double: stamps each peer's FIRST decision."""
+
+    def __init__(self) -> None:
+        self.decided_at: Dict[str, float] = {}
+        self.parents: Dict[str, List[str]] = {}
+        self.back_to_source: set[str] = set()
+
+    def send_candidate_parents(self, peer, parents) -> bool:
+        self.decided_at.setdefault(peer.id, perf_counter())
+        self.parents[peer.id] = [p.id for p in parents]
+        return True
+
+    def send_need_back_to_source(self, peer, description) -> bool:
+        self.decided_at.setdefault(peer.id, perf_counter())
+        self.back_to_source.add(peer.id)
+        return True
+
+
+def run_swarm_bench(
+    n_peers: int = 1000,
+    *,
+    workers: int = 8,
+    n_hosts: Optional[int] = None,
+    peers_per_task: int = DEFAULT_PEERS_PER_TASK,
+    pieces_per_peer: int = 4,
+    piece_length: int = 4 << 20,
+    seeds_per_task: int = 3,
+    leave_fraction: float = 0.25,
+    shard_count: int = 8,
+    gc_budget_s: float = 0.005,
+    gc_churn: bool = True,
+) -> Dict[str, object]:
+    """One swarm rung against a fresh SchedulerService; returns metrics."""
+    if n_hosts is None:
+        n_hosts = n_peers  # one dfdaemon per peer, the common shape
+    n_tasks = max(1, n_peers // peers_per_task)
+
+    stats = ControlPlaneStats()  # hermetic: not the process-global block
+    resource = Resource(
+        ResourceConfig(shard_count=shard_count, gc_budget_s=gc_budget_s),
+        stats=stats)
+    scheduling = Scheduling(
+        BaseEvaluator(stats=stats),
+        SchedulingConfig(retry_interval=0.002), stats=stats)
+    svc = SchedulerService(resource, scheduling, stats=stats)
+    recorder = _DecisionRecorder()
+
+    hosts = [
+        Host(id=f"bench-host-{i:06d}", hostname=f"bh{i}", ip="10.1.0.1",
+             port=65001, download_port=65002)
+        for i in range(n_hosts)
+    ]
+
+    # -- pre-seed every task through the real back-to-source path ----------
+    content_length = pieces_per_peer * piece_length
+    for t in range(n_tasks):
+        task_id = f"bench-task-{t:04d}"
+        for s in range(seeds_per_task):
+            host = Host(id=f"bench-seed-host-{t:04d}-{s}", hostname="seed",
+                        ip="10.2.0.1", port=65001, download_port=65002,
+                        type=HostType.SUPER_SEED)
+            svc.announce_host(host)
+            seed_id = f"bench-seed-{t:04d}-{s}"
+            svc.register_peer(
+                RegisterPeerRequest(host_id=host.id, task_id=task_id,
+                                    peer_id=seed_id,
+                                    url=f"https://bench/{task_id}",
+                                    piece_length=piece_length),
+                channel=recorder)
+            svc.download_peer_back_to_source_started(seed_id)
+            svc.download_pieces_finished([
+                PieceFinished(peer_id=seed_id, piece_number=k,
+                              offset=k * piece_length, length=piece_length,
+                              cost_ns=20_000_000,
+                              traffic_type="back_to_source")
+                for k in range(pieces_per_peer)
+            ])
+            svc.download_peer_back_to_source_finished(
+                seed_id, content_length, pieces_per_peer)
+
+    # -- concurrent announce workers ---------------------------------------
+    latencies: List[float] = []
+    latencies_lock = threading.Lock()
+    next_peer = [0]
+    claim_lock = threading.Lock()
+    errors: List[str] = []
+
+    def drive_one(i: int) -> None:
+        task_id = f"bench-task-{i % n_tasks:04d}"
+        host = hosts[i % n_hosts]
+        peer_id = f"bench-peer-{i:06d}"
+        t0 = perf_counter()
+        svc.announce_host(host)
+        svc.register_peer(
+            RegisterPeerRequest(host_id=host.id, task_id=task_id,
+                                peer_id=peer_id,
+                                url=f"https://bench/{task_id}",
+                                piece_length=piece_length),
+            channel=recorder)
+        svc.download_peer_started(peer_id)
+        decided = recorder.decided_at.get(peer_id)
+        if decided is not None:
+            with latencies_lock:
+                latencies.append((decided - t0) * 1e3)
+        if peer_id in recorder.back_to_source:
+            svc.download_peer_back_to_source_started(peer_id)
+            parent_id = ""
+        else:
+            parents = recorder.parents.get(peer_id) or []
+            parent_id = parents[0] if parents else ""
+        svc.download_pieces_finished([
+            PieceFinished(peer_id=peer_id, piece_number=k,
+                          parent_id=parent_id, offset=k * piece_length,
+                          length=piece_length, cost_ns=20_000_000)
+            for k in range(pieces_per_peer)
+        ])
+        if peer_id in recorder.back_to_source:
+            svc.download_peer_back_to_source_finished(
+                peer_id, content_length, pieces_per_peer)
+        else:
+            svc.download_peer_finished(peer_id, cost_seconds=0.1)
+        if leave_fraction > 0 and i % max(int(1 / leave_fraction), 1) == 0:
+            # Drop without a leave RPC — the FSM state a stale-host
+            # cascade produces — so the GC sweep has reclaim work.
+            peer = resource.peer_manager.load(peer_id)
+            if peer is not None:
+                peer.leave()
+
+    def worker() -> None:
+        while True:
+            with claim_lock:
+                i = next_peer[0]
+                if i >= n_peers:
+                    return
+                next_peer[0] += 1
+            try:
+                drive_one(i)
+            except Exception as exc:  # noqa: BLE001 — bench must report
+                if len(errors) < 8:
+                    errors.append(f"peer {i}: {type(exc).__name__}: {exc}")
+
+    stop_gc = threading.Event()
+
+    def gc_loop() -> None:
+        managers = (resource.host_manager, resource.task_manager,
+                    resource.peer_manager)
+        while not stop_gc.is_set():
+            for manager in managers:
+                manager.run_gc()
+            stop_gc.wait(0.002)
+
+    gc_thread = None
+    if gc_churn:
+        gc_thread = threading.Thread(target=gc_loop, name="bench-gc",
+                                     daemon=True)
+        gc_thread.start()
+
+    t_start = perf_counter()
+    threads = [threading.Thread(target=worker, name=f"bench-announce-{w}")
+               for w in range(min(workers, n_peers))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = perf_counter() - t_start
+
+    if gc_thread is not None:
+        stop_gc.set()
+        gc_thread.join(timeout=5)
+
+    snap = stats.snapshot()
+    lat = sorted(latencies)
+    return {
+        "peers": n_peers,
+        "hosts": n_hosts,
+        "tasks": n_tasks,
+        "workers": len(threads),
+        "seconds": round(wall, 3),
+        "announce_p50_ms": round(percentile(lat, 0.50), 4),
+        "announce_p99_ms": round(percentile(lat, 0.99), 4),
+        "decisions": snap["decisions"],
+        "decisions_per_sec": round(snap["decisions"] / max(wall, 1e-9), 1),
+        "piece_reports": snap["piece_reports"],
+        "piece_reports_per_sec": round(
+            snap["piece_reports"] / max(wall, 1e-9), 1),
+        "back_to_source": snap["back_to_source"],
+        "schedules": snap["schedules"],
+        "filter_ms_p99": snap["filter_ms_p99"],
+        "evaluate_ms_p99": snap["evaluate_ms_p99"],
+        "bad_node_fast": snap["bad_node_fast"],
+        "bad_node_slow": snap["bad_node_slow"],
+        "gc_ticks": snap["gc_ticks"],
+        "gc_budget_overruns": snap["gc_budget_overruns"],
+        "gc_reclaimed": snap["gc_reclaimed"],
+        "gc_pause_p50_ms": snap["gc_pause_ms_p50"],
+        "gc_pause_p99_ms": snap["gc_pause_ms_p99"],
+        "errors": errors,
+    }
+
+
+# The documented ladder bound (docs/SCHEDULER.md): the largest rung's
+# announce→decision p99 must stay within this factor of the smallest
+# rung's. Per-task DAGs are capped (peers_per_task), so growth past the
+# bound means control-plane contention — shard locks, GC pauses — is
+# scaling with swarm size, which is exactly the regression this ladder
+# exists to catch.
+LADDER_P99_BOUND = 4.0
+
+
+def run_swarm_ladder(sizes=(100, 1000, 5000), **kwargs) -> Dict[str, object]:
+    """The bench stage's ladder: one rung per swarm size + the p99 bound
+    verdict comparing the largest rung against the smallest."""
+    # Per-task DAG size must be EQUAL across rungs or the ratio compares
+    # per-announce work, not contention: cap peers_per_task at the
+    # smallest rung so every rung runs tasks of identical size.
+    kwargs.setdefault("peers_per_task",
+                      min(DEFAULT_PEERS_PER_TASK, min(sizes)))
+    # Warmup rung (discarded): first-call numpy/evaluator costs would
+    # otherwise land entirely in the smallest rung's p99 and flatter the
+    # ladder ratio.
+    run_swarm_bench(32, workers=2, gc_churn=False)
+    ladder = {}
+    for n in sizes:
+        ladder[str(n)] = run_swarm_bench(n, **kwargs)
+    smallest, largest = str(sizes[0]), str(sizes[-1])
+    p99_small = ladder[smallest]["announce_p99_ms"]
+    p99_large = ladder[largest]["announce_p99_ms"]
+    ratio = round(p99_large / max(p99_small, 1e-9), 3)
+    return {
+        "ladder": ladder,
+        "decision_p99_ratio": ratio,
+        "ladder_p99_bound": LADDER_P99_BOUND,
+        "p99_within_bound": ratio <= LADDER_P99_BOUND,
+    }

@@ -12,11 +12,18 @@ from dragonfly2_tpu.scheduler.resource.host import (
     Host,
 )
 from dragonfly2_tpu.scheduler.resource.managers import (
+    DEFAULT_GC_BUDGET_S,
+    DEFAULT_SHARD_COUNT,
     HostManager,
     PeerManager,
     TaskManager,
+    shard_index,
 )
 from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerEvent, PeerState
+from dragonfly2_tpu.scheduler.resource.piecestats import (
+    DEFAULT_PIECE_COST_WINDOW,
+    PieceCostStats,
+)
 from dragonfly2_tpu.scheduler.resource.resource import Resource
 from dragonfly2_tpu.scheduler.resource.task import (
     Piece,
@@ -28,8 +35,11 @@ from dragonfly2_tpu.scheduler.resource.task import (
 )
 
 __all__ = [
+    "DEFAULT_GC_BUDGET_S",
     "DEFAULT_PEER_CONCURRENT_UPLOAD_LIMIT",
+    "DEFAULT_PIECE_COST_WINDOW",
     "DEFAULT_SEED_PEER_CONCURRENT_UPLOAD_LIMIT",
+    "DEFAULT_SHARD_COUNT",
     "Host",
     "HostManager",
     "Peer",
@@ -37,6 +47,7 @@ __all__ = [
     "PeerManager",
     "PeerState",
     "Piece",
+    "PieceCostStats",
     "Resource",
     "SizeScope",
     "Task",
@@ -44,4 +55,5 @@ __all__ = [
     "TaskManager",
     "TaskState",
     "TaskType",
+    "shard_index",
 ]

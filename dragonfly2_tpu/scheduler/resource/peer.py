@@ -4,6 +4,11 @@ Reference counterpart: scheduler/resource/peer.go. Tracks finished pieces
 (bitset), per-piece costs (bad-node statistics input), the lifecycle FSM,
 blocked parents, and back-to-source intent. Satisfies the evaluator's
 PeerLike protocol.
+
+Piece costs are retained in a bounded window backed by O(1) running
+mean/M2 aggregates (:class:`~dragonfly2_tpu.scheduler.resource.piecestats.
+PieceCostStats`), so long-lived seed peers stop growing without bound and
+the evaluator's ``is_bad_node`` never re-materializes a history.
 """
 
 from __future__ import annotations
@@ -13,6 +18,10 @@ import time
 from typing import Dict, List, Optional
 
 from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.piecestats import (
+    DEFAULT_PIECE_COST_WINDOW,
+    PieceCostStats,
+)
 from dragonfly2_tpu.scheduler.resource.task import Piece, Task
 from dragonfly2_tpu.utils.fsm import FSM
 
@@ -82,7 +91,8 @@ _PEER_EVENTS = {
 class Peer:
     def __init__(self, id: str, task: Task, host: Host, *,
                  tag: str = "", application: str = "", priority: int = 0,
-                 range_header: str = ""):
+                 range_header: str = "",
+                 piece_cost_window: int = DEFAULT_PIECE_COST_WINDOW):
         self.id = id
         self.task = task
         self.host = host
@@ -92,7 +102,7 @@ class Peer:
         self.range_header = range_header
         self.finished_pieces: set[int] = set()
         self.pieces: Dict[int, Piece] = {}
-        self._piece_costs: List[float] = []
+        self._piece_costs = PieceCostStats(piece_cost_window)
         self.cost: float = 0.0
         self.block_parents: set[str] = set()
         self.need_back_to_source = False
@@ -116,13 +126,18 @@ class Peer:
         return len(self.finished_pieces)
 
     def piece_costs(self) -> List[float]:
-        return list(self._piece_costs)
+        """Windowed cost history (bounded copy, newest last). The
+        evaluator's fast path never calls this — it reads the O(1)
+        aggregates via :meth:`piece_cost_stats`."""
+        return self._piece_costs.values()
+
+    def piece_cost_stats(self) -> PieceCostStats:
+        return self._piece_costs
 
     # -- piece bookkeeping ----------------------------------------------------
 
     def append_piece_cost(self, cost: float) -> None:
-        with self._lock:
-            self._piece_costs.append(cost)
+        self._piece_costs.append(cost)
 
     def store_piece(self, piece: Piece) -> None:
         with self._lock:

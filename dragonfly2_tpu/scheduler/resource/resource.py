@@ -10,6 +10,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from dragonfly2_tpu.scheduler.resource.managers import (
+    DEFAULT_GC_BUDGET_S,
+    DEFAULT_SHARD_COUNT,
     HostManager,
     PeerManager,
     TaskManager,
@@ -23,16 +25,29 @@ class ResourceConfig:
     task_ttl: float = 30 * 60.0
     peer_ttl: float = 24 * 60 * 60.0
     gc_interval: float = 60.0
+    # Swarm-scale knobs (docs/SCHEDULER.md): shards per manager map and
+    # the per-tick incremental-GC sweep budget (seconds).
+    shard_count: int = DEFAULT_SHARD_COUNT
+    gc_budget_s: float = DEFAULT_GC_BUDGET_S
 
 
 class Resource:
     def __init__(self, config: ResourceConfig | None = None,
-                 seed_peer_client=None):
+                 seed_peer_client=None, stats=None):
         config = config or ResourceConfig()
         self.gc = GC()
-        self.host_manager = HostManager(config.host_ttl, self.gc, config.gc_interval)
-        self.task_manager = TaskManager(config.task_ttl, self.gc, config.gc_interval)
-        self.peer_manager = PeerManager(config.peer_ttl, self.gc, config.gc_interval)
+        self.host_manager = HostManager(
+            config.host_ttl, self.gc, config.gc_interval,
+            shard_count=config.shard_count, gc_budget_s=config.gc_budget_s,
+            stats=stats)
+        self.task_manager = TaskManager(
+            config.task_ttl, self.gc, config.gc_interval,
+            shard_count=config.shard_count, gc_budget_s=config.gc_budget_s,
+            stats=stats)
+        self.peer_manager = PeerManager(
+            config.peer_ttl, self.gc, config.gc_interval,
+            shard_count=config.shard_count, gc_budget_s=config.gc_budget_s,
+            stats=stats)
         self.seed_peer_client = seed_peer_client
 
     def serve(self) -> None:

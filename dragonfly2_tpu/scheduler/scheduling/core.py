@@ -18,9 +18,12 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass
+from time import perf_counter
 from typing import List, Optional, Protocol, Sequence
 
+from dragonfly2_tpu.scheduler import controlstats
 from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerState
+from dragonfly2_tpu.utils.dag import CycleError, VertexNotFoundError
 from dragonfly2_tpu.utils.hosttypes import HostType
 
 logger = logging.getLogger(__name__)
@@ -56,9 +59,13 @@ class SchedulingConfig:
 
 
 class Scheduling:
-    def __init__(self, evaluator, config: SchedulingConfig | None = None):
+    def __init__(self, evaluator, config: SchedulingConfig | None = None,
+                 stats: controlstats.ControlPlaneStats | None = None):
         self.evaluator = evaluator
         self.config = config or SchedulingConfig()
+        # Control-plane counters (/debug/vars "scheduler"): filter and
+        # evaluate phase timings land here per find_candidate_parents.
+        self.stats = stats if stats is not None else controlstats.STATS
 
     def apply_dynconfig(self, cfg: dict) -> None:
         """Manager-pushed overrides for the dynconfig-tunable limits
@@ -71,7 +78,7 @@ class Scheduling:
 
     # -- v2 entry point -------------------------------------------------------
 
-    def schedule_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> None:
+    def schedule_candidate_parents(self, peer: Peer, blocklist: set[str] | None = None) -> bool:
         """The v2 retry loop (scheduling.go:80-214).
 
         Ladder per iteration:
@@ -82,6 +89,10 @@ class Scheduling:
         3. retries exceeded retry_limit → ScheduleError
         4. candidates found AND channel accepts them → done (DAG edges added)
         else: sleep retry_interval, retry.
+
+        Returns True when candidate parents were delivered, False when
+        the verdict was back-to-source (the service layer's latency ring
+        distinguishes the two).
         """
         blocklist = blocklist or set()
         cfg = self.config
@@ -94,12 +105,12 @@ class Scheduling:
                         f"peer need_back_to_source={peer.need_back_to_source} "
                         f"schedule_count={peer.schedule_count}",
                     )
-                    return
+                    return False
                 if n >= cfg.retry_back_to_source_limit:
                     self._send_back_to_source(
                         peer, "scheduling exceeded RetryBackToSourceLimit"
                     )
-                    return
+                    return False
 
             if n >= cfg.retry_limit:
                 raise ScheduleError(
@@ -116,10 +127,16 @@ class Scheduling:
                     raise ScheduleError(f"peer {peer.id} has no announce channel")
                 if channel.send_candidate_parents(peer, candidates):
                     for parent in candidates:
-                        if peer.task.can_add_peer_edge(parent.id, peer.id):
-                            peer.task.add_peer_edge(parent, peer)
+                        try:
+                            if peer.task.can_add_peer_edge(parent.id, peer.id):
+                                peer.task.add_peer_edge(parent, peer)
+                        except (CycleError, VertexNotFoundError):
+                            # The parent was reclaimed (GC) between the
+                            # check and the edge add; the client will
+                            # report a piece failure and reschedule.
+                            continue
                     peer.schedule_count += 1
-                    return
+                    return True
                 logger.warning("peer %s channel rejected candidates", peer.id)
 
             n += 1
@@ -165,12 +182,16 @@ class Scheduling:
         if not peer.fsm.is_state(PeerState.RUNNING):
             logger.debug("peer %s state %s cannot schedule", peer.id, peer.fsm.current)
             return []
+        t0 = perf_counter()
         candidates = self._filter_candidate_parents(peer, blocklist)
+        t1 = perf_counter()
+        self.stats.observe_filter((t1 - t0) * 1e3)
         if not candidates:
             return []
         ranked = self.evaluator.evaluate_parents(
             candidates, peer, peer.task.total_piece_count
         )
+        self.stats.observe_evaluate((perf_counter() - t1) * 1e3)
         return list(ranked[: self.config.candidate_parent_limit])
 
     def find_success_parent(self, peer: Peer, blocklist: set[str]) -> Optional[Peer]:
@@ -190,25 +211,40 @@ class Scheduling:
 
     def _filter_candidate_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
         """(scheduling.go:465-536) — the six filters, applied to a random
-        sample of filter_parent_limit peers from the task DAG."""
+        sample of filter_parent_limit peers from the task DAG.
+
+        Child-side (per-announce) values — host id, DAG handle, the
+        evaluator's bad-node check — are bound once outside the loop so
+        every candidate pays only its own per-parent work.
+        """
         task = peer.task
+        dag = task.dag
+        peer_id = peer.id
+        peer_host_id = peer.host.id
+        can_add_peer_edge = task.can_add_peer_edge
+        is_bad_node = self.evaluator.is_bad_node
         out = []
-        for candidate in task.dag.random_vertices(self.config.filter_parent_limit):
+        for candidate in dag.random_vertices(self.config.filter_parent_limit):
             if candidate.id in blocklist:
                 continue
             # Cycle-safe (also rejects self and duplicate edges).
-            if not task.can_add_peer_edge(candidate.id, peer.id):
+            if not can_add_peer_edge(candidate.id, peer_id):
                 continue
             # Same host cannot serve itself (dfdaemon cannot express mutual
             # downloads between two local tasks).
-            if candidate.host.id == peer.host.id:
+            if candidate.host.id == peer_host_id:
                 continue
-            if self.evaluator.is_bad_node(candidate):
+            if is_bad_node(candidate):
                 continue
             # A normal-host parent must itself have a source of pieces:
             # a parent, back-to-source, or completed download. Seeds are
             # exempt (they fetch on demand).
-            in_degree = task.dag.vertex(candidate.id).in_degree
+            try:
+                in_degree = dag.vertex(candidate.id).in_degree
+            except VertexNotFoundError:
+                # Sampled, then reclaimed by a concurrent GC sweep —
+                # a vanished candidate is just a filtered candidate.
+                continue
             if (
                 candidate.host.type == HostType.NORMAL
                 and in_degree == 0
@@ -229,3 +265,4 @@ class Scheduling:
         if not channel.send_need_back_to_source(peer, description):
             raise ScheduleError(f"peer {peer.id} channel closed")
         peer.task.back_to_source_peers.add(peer.id)
+        self.stats.observe_back_to_source()

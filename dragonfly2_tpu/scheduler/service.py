@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from dragonfly2_tpu.schema import records as schema
+from dragonfly2_tpu.scheduler import controlstats
 from dragonfly2_tpu.scheduler.networktopology.store import NetworkTopologyStore, Probe
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerEvent, PeerState
@@ -112,6 +113,7 @@ class SchedulerService:
         network_topology: Optional[NetworkTopologyStore] = None,
         seed_peer_client=None,
         metrics=None,
+        stats: Optional[controlstats.ControlPlaneStats] = None,
     ):
         self.resource = resource
         self.scheduling = scheduling
@@ -123,6 +125,9 @@ class SchedulerService:
         # SchedulerMetrics (scheduler/metrics.py) or None — instrumentation
         # is optional so unit tests and embedded uses stay dependency-free.
         self.metrics = metrics
+        # Control-plane counters (/debug/vars "scheduler" block):
+        # announce→decision latency ring + piece-report throughput.
+        self.stats = stats if stats is not None else controlstats.STATS
 
     # ------------------------------------------------------------------
     # Host lifecycle (service_v2.go:AnnounceHost at 594, LeaveHost at 658)
@@ -324,6 +329,7 @@ class SchedulerService:
             traffic_type=report.traffic_type,
         )
         peer.store_piece(piece)
+        self.stats.observe_piece_reports(1)
         # Back-to-source pieces become task pieces (the metadata other
         # peers will sync).
         if not report.parent_id:
@@ -345,6 +351,7 @@ class SchedulerService:
         report fails independently."""
         peers: Dict[str, Optional[Peer]] = {}
         parents: Dict[str, Optional[Peer]] = {}
+        stored = 0
         for report in reports:
             if report.peer_id in peers:
                 peer = peers[report.peer_id]
@@ -367,6 +374,7 @@ class SchedulerService:
                 traffic_type=report.traffic_type,
             )
             peer.store_piece(piece)
+            stored += 1
             if not report.parent_id:
                 peer.task.store_piece(piece)
             elif report.parent_id not in parents:
@@ -376,6 +384,10 @@ class SchedulerService:
         for parent in parents.values():
             if parent is not None:
                 parent.piece_updated_at = now
+        # Count STORED reports only, matching the per-call form (whose
+        # NOT_FOUND path never reaches its observe call); the batch RPC
+        # itself is counted regardless.
+        self.stats.observe_piece_reports(stored, batched=True)
 
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:
@@ -388,13 +400,15 @@ class SchedulerService:
 
     def _schedule_timed(self, peer: Peer) -> None:
         start = time.perf_counter()
+        decided = False
         try:
-            self.scheduling.schedule_candidate_parents(
+            decided = self.scheduling.schedule_candidate_parents(
                 peer, set(peer.block_parents))
         finally:
+            elapsed = time.perf_counter() - start
+            self.stats.observe_schedule(elapsed * 1e3, decided=bool(decided))
             if self.metrics:
-                self.metrics.schedule_duration.observe(
-                    time.perf_counter() - start)
+                self.metrics.schedule_duration.observe(elapsed)
 
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)

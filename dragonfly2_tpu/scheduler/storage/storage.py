@@ -52,7 +52,17 @@ class _RotatingDataset:
         self.config = config
         self._buffer: List = []
         self._count = 0
+        # _lock guards ONLY the in-memory buffer and counters — it is the
+        # lock the announce path touches, and it is never held across
+        # file IO. _io_lock serializes every file operation (flush write,
+        # rotation, removal); a flush swaps the buffer out under _lock and
+        # writes under _io_lock, so concurrent create() calls block for a
+        # list-append, not a disk write.
         self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        # Records swapped out of the buffer but not yet written — keeps
+        # count() exact while a flush is in flight.
+        self._inflight = 0
         # Records flushed per live file; keeps count() consistent when
         # snapshots/backup-eviction remove individual files.
         self._file_counts: dict = {}
@@ -75,27 +85,47 @@ class _RotatingDataset:
         return files
 
     def create(self, record) -> None:
+        """Buffered append. When the buffer fills, the CSV flush happens
+        OUTSIDE the record lock (buffer swapped under lock, written
+        after) — a full buffer on the announce path costs the announcing
+        thread one serialized write, and every other creator only a
+        list append."""
         with self._lock:
             self._buffer.append(record)
-            if len(self._buffer) >= self.config.buffer_size:
-                self._flush_locked()
+            flush_needed = len(self._buffer) >= self.config.buffer_size
+        if flush_needed:
+            self.flush()
 
     def flush(self) -> None:
-        with self._lock:
-            self._flush_locked()
+        with self._io_lock:
+            self._flush_io_locked()
 
-    def _flush_locked(self) -> None:
-        if not self._buffer:
+    def _flush_io_locked(self) -> None:
+        """Swap the buffer out under _lock, write it under _io_lock only.
+        Caller must hold _io_lock."""
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+            self._inflight += len(batch)
+        if not batch:
             return
-        self._maybe_rotate()
-        with CsvRecordWriter(self.record_type, self.active_path) as w:
-            for r in self._buffer:
-                w.write(r)
-        self._count += len(self._buffer)
-        self._file_counts[self.active_path] = (
-            self._file_counts.get(self.active_path, 0) + len(self._buffer)
-        )
-        self._buffer = []
+        try:
+            self._maybe_rotate()
+            with CsvRecordWriter(self.record_type, self.active_path) as w:
+                for r in batch:
+                    w.write(r)
+        except BaseException:
+            # Put the batch back (order preserved) so a transient IO
+            # failure retries on the next flush instead of losing data.
+            with self._lock:
+                self._inflight -= len(batch)
+                self._buffer[:0] = batch
+            raise
+        with self._lock:
+            self._inflight -= len(batch)
+            self._count += len(batch)
+            self._file_counts[self.active_path] = (
+                self._file_counts.get(self.active_path, 0) + len(batch)
+            )
 
     def _maybe_rotate(self) -> None:
         path = self.active_path
@@ -105,7 +135,9 @@ class _RotatingDataset:
         while len(backups) + 1 > self.config.max_backups:
             victim = backups.pop(0)
             os.remove(victim)
-            self._count = max(self._count - self._file_counts.pop(victim, 0), 0)
+            with self._lock:
+                self._count = max(
+                    self._count - self._file_counts.pop(victim, 0), 0)
 
     def _rotate_locked(self, path: str) -> None:
         stamp = time.strftime("%Y-%m-%dT%H-%M-%S")
@@ -118,7 +150,7 @@ class _RotatingDataset:
 
     def count(self) -> int:
         with self._lock:
-            return self._count + len(self._buffer)
+            return self._count + len(self._buffer) + self._inflight
 
     def records(self) -> Iterator:
         self.flush()
@@ -131,8 +163,8 @@ class _RotatingDataset:
         to a fresh active file and are NOT part of the snapshot — so the
         announcer can stream for minutes while appends continue, then
         delete exactly what it sent (remove_files)."""
-        with self._lock:
-            self._flush_locked()
+        with self._io_lock:
+            self._flush_io_locked()
             path = self.active_path
             if os.path.exists(path) and os.path.getsize(path) > 0:
                 self._rotate_locked(path)
@@ -140,7 +172,7 @@ class _RotatingDataset:
 
     def remove_files(self, paths: List[str]) -> None:
         removed = 0
-        with self._lock:
+        with self._io_lock:
             for path in paths:
                 if path == self.active_path:
                     raise ValueError("cannot remove the active file; snapshot first")
@@ -149,12 +181,14 @@ class _RotatingDataset:
                     removed += self._file_counts.pop(path, 0)
                 except FileNotFoundError:
                     pass
-            self._count = max(self._count - removed, 0)
+            with self._lock:
+                self._count = max(self._count - removed, 0)
 
     def clear(self) -> None:
-        with self._lock:
-            self._buffer = []
-            self._count = 0
+        with self._io_lock:
+            with self._lock:
+                self._buffer = []
+                self._count = 0
             self._file_counts.clear()
             for path in self.all_files():
                 os.remove(path)

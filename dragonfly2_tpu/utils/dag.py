@@ -148,8 +148,13 @@ class DAG(Generic[T]):
 
     def random_vertices(self, n: int, rng: random.Random | None = None) -> List[T]:
         """Up to n distinct random vertex values (reference:
-        GetRandomVertices — the scheduling core's candidate pre-sample)."""
+        GetRandomVertices — the scheduling core's candidate pre-sample).
+
+        ``random.sample`` instead of shuffle-then-slice: same uniform
+        without-replacement draw with O(n) random-number work (the id
+        materialization ``list(self._vertices)`` remains O(V) under the
+        DAG lock — still a per-announce O(V) cost on large DAGs)."""
         with self._lock:
             ids = list(self._vertices)
-            (rng or random).shuffle(ids)
-            return [self._vertices[i].value for i in ids[:n]]
+            picked = (rng or random).sample(ids, min(n, len(ids)))
+            return [self._vertices[i].value for i in picked]

@@ -1,0 +1,19 @@
+"""Shared sorted-sample percentile readout.
+
+One implementation for every latency ring/ladder in the repo (inference
+loadgen, control-plane stats, the scheduler swarm bench) so the index
+math can never drift between the numbers operators compare.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an ALREADY-SORTED sample; 0.0 when
+    empty."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return float(sorted_vals[idx])

@@ -49,6 +49,41 @@ class TestStorage:
         assert s.open_download() == []
         assert len(s.open_network_topology()) == 1  # untouched
 
+    def test_concurrent_create_no_loss_no_dup_across_rotation(self, tmp_path):
+        """The flush happens OUTSIDE the record lock (buffer swapped
+        under lock, written after) — under concurrent creators forcing
+        many flushes AND rotations, every record must land exactly
+        once."""
+        import threading
+
+        s = Storage(str(tmp_path), StorageConfig(buffer_size=7,
+                                                 max_size=4000,
+                                                 max_backups=1000))
+        n_threads, per_thread = 8, 250
+
+        def creator(t):
+            for i in range(per_thread):
+                s.create_download(make_download(t * per_thread + i))
+
+        threads = [threading.Thread(target=creator, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread
+        assert s.download_count() == total
+        ids = [d.id for d in s.list_download()]
+        assert len(ids) == total
+        assert len(set(ids)) == total  # no duplicates
+        assert len(s.download.backups()) > 1  # rotations really happened
+
+    def test_create_count_exact_during_inflight_flush(self, tmp_path):
+        s = Storage(str(tmp_path), StorageConfig(buffer_size=5))
+        for i in range(12):
+            s.create_download(make_download(i))
+        assert s.download_count() == 12
+
     def test_export_parquet(self, tmp_path):
         s = Storage(str(tmp_path / "data"), StorageConfig(buffer_size=1))
         for i in range(4):
