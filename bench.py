@@ -469,6 +469,56 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
         except Exception as exc:  # noqa: BLE001 — informational stage
             state.record(scheduler_error=f"{type(exc).__name__}: {exc}")
 
+    # Stage 6: chaos — deterministic fault-injection ladder over the
+    # loopback swarm (scheduler + two peers + origin, client/
+    # chaosbench.py). Seeded FaultPlan rungs at 0%/1%/5% inject
+    # corruption / resets / refused dials / truncated bodies /
+    # scheduler UNAVAILABLE; the documented bound (docs/CHAOS.md) is
+    # 100% task success at every rung and ≥70% goodput retention at
+    # the 5% rung — the verdict lands in the bench JSON, and a passing
+    # run persists into artifacts/bench_state/ like the TPU runs do.
+    if left() > 15.0:
+        try:
+            from dragonfly2_tpu.client.chaosbench import run_chaos_ladder
+
+            chaos = run_chaos_ladder(seed=0)
+            top = chaos["ladder"][str(max(chaos["rates"]))]
+            state.record(
+                chaos_rates=chaos["rates"],
+                chaos_success_rate_at_max=top["success_rate"],
+                chaos_goodput_retention_at_max=chaos[
+                    "goodput_retention_at_max"],
+                chaos_goodput_retention_bound=chaos[
+                    "goodput_retention_bound"],
+                chaos_recovery_p50_ms=top["recovery_p50_ms"],
+                chaos_recovery_p99_ms=top["recovery_p99_ms"],
+                chaos_recovery_events=top["recovery_events"],
+                chaos_all_rungs_full_success=chaos[
+                    "all_rungs_full_success"],
+                chaos_verdict_pass=chaos["verdict_pass"],
+                chaos_ladder={
+                    rate: {k: v[k] for k in (
+                        "success_rate", "downloads", "mb_per_s",
+                        "seconds", "recovery_events", "recovery_p50_ms",
+                        "recovery_p99_ms", "download_p99_s")}
+                    for rate, v in chaos["ladder"].items()},
+            )
+            state.stage_done("chaos")
+            if chaos["verdict_pass"]:
+                dest = os.path.join(
+                    STATE_DIR,
+                    f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json")
+                tmp_path_ = dest + ".tmp"
+                try:
+                    os.makedirs(STATE_DIR, exist_ok=True)
+                    with open(tmp_path_, "w") as f:
+                        json.dump(chaos, f)
+                    os.replace(tmp_path_, dest)
+                except OSError:
+                    pass
+        except Exception as exc:  # noqa: BLE001 — informational stage
+            state.record(chaos_error=f"{type(exc).__name__}: {exc}")
+
 
 def worker_main(platform: str, out_path: str, budget: float) -> None:
     state = BenchState(out_path)

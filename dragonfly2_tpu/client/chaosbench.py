@@ -1,0 +1,270 @@
+"""Chaos ladder: a loopback swarm under seeded fault injection.
+
+``bench.py``'s ``chaos`` stage (and the ``slow``+``chaos``-marked e2e
+test) drive a REAL in-process swarm — scheduler service + two peer
+daemons + an HTTP origin on 127.0.0.1 — through a fault-rate ladder
+(default 0 % / 1 % / 5 %). At each rung a seeded :class:`FaultPlan`
+injects byte corruption, mid-stream resets, connect-refused dials,
+truncated source bodies, and scheduler ``UNAVAILABLE`` across the
+compiled-in sites (docs/CHAOS.md), and the rung reports:
+
+- **task success rate** — every download must finish md5-exact,
+- **goodput retention** — rung MB/s over the 0 % rung's MB/s,
+- **recovery p50/p99** — piece-recovery latency (first failed attempt →
+  successful store) from the rung's injected ``RecoveryStats``,
+- the recovery counters and per-site fault fire counts.
+
+The documented bound (the stage's verdict in the bench JSON): **100 %
+task success at every rung and ≥ 70 % goodput retention at the highest
+rung**. ``ENOSPC`` is deliberately absent from the ladder — it is a
+fail-FAST contract (tests/test_chaos_recovery.py), not a recover-and-
+retain one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shutil
+import tempfile
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, Sequence
+
+from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils.faultplan import FaultKind, FaultPlan
+from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
+from dragonfly2_tpu.utils.percentile import percentile
+
+#: The documented ladder bound (ISSUE 5 acceptance).
+SUCCESS_BOUND = 1.0
+GOODPUT_RETENTION_BOUND = 0.70
+DEFAULT_RATES = (0.0, 0.01, 0.05)
+
+
+class MultiBlobServer(ThreadedHTTPService):
+    """Range-capable loopback origin serving one blob per path — the
+    chaos swarm needs DISTINCT tasks (distinct URLs), which the
+    single-blob bench server can't provide. Rides the shared
+    ThreadedHTTPService shell (quiet per-request errors: injected
+    resets make clients vanish mid-request by design)."""
+
+    def __init__(self, blobs: Dict[str, bytes], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.blobs = dict(blobs)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                from dragonfly2_tpu.client.piece import parse_http_range
+
+                blob = server.blobs.get(self.path.split("?", 1)[0])
+                if blob is None:
+                    self.send_error(404)
+                    return
+                rng_header = self.headers.get("Range")
+                if rng_header:
+                    rng = parse_http_range(rng_header, len(blob))
+                    data = blob[rng.start:rng.start + rng.length]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range",
+                        f"bytes {rng.start}-{rng.end}/{len(blob)}")
+                else:
+                    data = blob
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        super().__init__(Handler, host=host, port=port, name="chaos-origin")
+
+    def url(self, path: str) -> str:
+        return f"http://127.0.0.1:{self.port}{path}"
+
+    def __enter__(self) -> "MultiBlobServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def build_fault_plan(rate: float, seed: int) -> FaultPlan:
+    """The ladder's fault mix at one rung: every RECOVERABLE kind on
+    every data/control site, probabilities scaled off the rung rate."""
+    plan = FaultPlan(seed=seed)
+    plan.add("piece.body", FaultKind.CORRUPT, probability=rate)
+    plan.add("piece.body", FaultKind.RESET, probability=rate / 2)
+    plan.add("source.body", FaultKind.TRUNCATE, probability=rate / 2)
+    plan.add("source.body", FaultKind.RESET, probability=rate / 2)
+    plan.add("pool.connect", FaultKind.CONNECT_REFUSED, probability=rate)
+    plan.add("scheduler.rpc", FaultKind.UNAVAILABLE, probability=rate)
+    return plan
+
+
+def _chaos_task_options():
+    from dragonfly2_tpu.client.peer_task import PeerTaskOptions
+
+    return PeerTaskOptions(
+        # The injection sites live on the pure-Python data plane; the
+        # native C++ loop has no chunk hook to corrupt through.
+        native_data_plane=False,
+        timeout=60.0,
+        scheduler_grace=2.0,
+        metadata_timeout=2.0,
+        backoff_base=0.01,
+        backoff_cap=0.2,
+        piece_retry_limit=12,
+        source_retry_limit=4,
+        corrupt_blacklist_threshold=4,
+    )
+
+
+def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
+              tmp: str) -> dict:
+    import os
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.client.recovery import RecoveryStats
+    from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+    from dragonfly2_tpu.scheduler.resource.resource import Resource
+    from dragonfly2_tpu.scheduler.scheduling.core import (
+        Scheduling,
+        SchedulingConfig,
+    )
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+    recovery = RecoveryStats()
+    service = SchedulerService(
+        resource=Resource(),
+        scheduling=Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.01,
+                             retry_back_to_source_limit=2),
+        ),
+        storage=Storage(os.path.join(tmp, "datasets")),
+    )
+    # The conductors hold the scheduler by direct reference; the proxy
+    # compiles the SAME "scheduler.rpc" site the gRPC adapters carry.
+    scheduler = faultplan.RpcFaultProxy(service)
+    options = _chaos_task_options()
+    daemons = [
+        Daemon(scheduler, DaemonConfig(
+            storage_root=os.path.join(tmp, name), hostname=name,
+            keep_storage=False, task_options=options,
+            recovery_stats=recovery,
+        ))
+        for name in ("chaos-a", "chaos-b")
+    ]
+    plan = build_fault_plan(rate, seed) if rate > 0 else None
+    downloads = 0
+    failures = []
+    bytes_ok = 0
+    durations = []
+    wall0 = time.perf_counter()
+    try:
+        for d in daemons:
+            d.start()
+        if plan is not None:
+            faultplan.install(plan)
+        with MultiBlobServer(blobs) as origin:
+            for path, blob in blobs.items():
+                want = hashlib.md5(blob).hexdigest()
+                for daemon in daemons:
+                    begin = time.perf_counter()
+                    result = daemon.download_file(origin.url(path))
+                    durations.append(time.perf_counter() - begin)
+                    downloads += 1
+                    if not result.success:
+                        failures.append(f"{path}: {result.error}")
+                        continue
+                    got = hashlib.md5(result.read_all()).hexdigest()
+                    if got != want:
+                        failures.append(f"{path}: md5 {got} != {want}")
+                        continue
+                    bytes_ok += len(blob)
+    finally:
+        faultplan.uninstall()
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+    wall = time.perf_counter() - wall0
+    recoveries = sorted(recovery.recovery_samples())
+    out = {
+        "fault_rate": rate,
+        "downloads": downloads,
+        "failures": failures[:5],
+        "success_rate": round(
+            (downloads - len(failures)) / max(downloads, 1), 4),
+        "bytes_ok": bytes_ok,
+        "seconds": round(wall, 3),
+        "mb_per_s": round(bytes_ok / (1 << 20) / max(wall, 1e-9), 2),
+        "download_p50_s": round(percentile(sorted(durations), 0.50), 3),
+        "download_p99_s": round(percentile(sorted(durations), 0.99), 3),
+        "recovery_events": len(recoveries),
+        "recovery_p50_ms": round(percentile(recoveries, 0.50) * 1e3, 1),
+        "recovery_p99_ms": round(percentile(recoveries, 0.99) * 1e3, 1),
+        "recovery_counters": recovery.snapshot(),
+    }
+    if plan is not None:
+        out["faults"] = plan.snapshot()
+    return out
+
+
+def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
+                     tasks: int = 3, size_bytes: int = 3 << 20,
+                     piece_size: int = 256 << 10, seed: int = 0,
+                     root: str | None = None) -> dict:
+    """Run the ladder; returns per-rung results + the verdict.
+
+    The piece size is shrunk (module-level patch of the conductor's
+    ``compute_piece_size`` binding, same technique as the data-plane
+    test fixtures) so each task spans many pieces without multi-GB
+    blobs — fault/recovery behavior is per-piece, so piece COUNT is
+    what the ladder needs.
+    """
+    import numpy as np
+
+    from dragonfly2_tpu.client import peer_task as peer_task_mod
+
+    blobs = {
+        f"/chaos/blob-{i}": np.random.default_rng(seed + i).bytes(size_bytes)
+        for i in range(tasks)
+    }
+    tmp = root or tempfile.mkdtemp(prefix="df2-chaos-")
+    prev_piece_size = peer_task_mod.compute_piece_size
+    peer_task_mod.compute_piece_size = lambda content_length: piece_size
+    ladder: Dict[str, dict] = {}
+    try:
+        for idx, rate in enumerate(rates):
+            rung_tmp = tempfile.mkdtemp(prefix=f"rung{idx}-", dir=tmp)
+            ladder[str(rate)] = _run_rung(
+                rate, blobs=blobs, seed=seed * 1000 + idx, tmp=rung_tmp)
+    finally:
+        peer_task_mod.compute_piece_size = prev_piece_size
+        if root is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    base = ladder[str(rates[0])]["mb_per_s"] or 1e-9
+    top = ladder[str(max(rates))]
+    retention = round(top["mb_per_s"] / base, 3)
+    all_success = all(r["success_rate"] >= SUCCESS_BOUND
+                      for r in ladder.values())
+    verdict = all_success and retention >= GOODPUT_RETENTION_BOUND
+    return {
+        "rates": list(rates),
+        "ladder": ladder,
+        "pieces_per_task": size_bytes // piece_size,
+        "goodput_retention_at_max": retention,
+        "goodput_retention_bound": GOODPUT_RETENTION_BOUND,
+        "success_bound": SUCCESS_BOUND,
+        "all_rungs_full_success": all_success,
+        "verdict_pass": verdict,
+    }

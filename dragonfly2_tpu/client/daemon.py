@@ -62,6 +62,10 @@ class DaemonConfig:
     # Re-announce ticker (announcer.go AnnounceHost loop): refreshes the
     # host telemetry snapshot at the scheduler. 0 = announce once only.
     announce_interval: float = 0.0
+    # RecoveryStats scope for this daemon's conductors (None = the
+    # process-wide /debug/vars "recovery" block); the chaos bench
+    # injects a per-rung instance.
+    recovery_stats: object = None
 
 
 class Daemon:
@@ -242,6 +246,7 @@ class Daemon:
                 metrics=self.metrics,
                 url_range=rng,
                 priority=priority,
+                recovery_stats=self.config.recovery_stats,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
@@ -402,6 +407,7 @@ class SeedPeerDaemonClient:
                 is_seed=True,
                 url_range=(parse_url_range(seed_range)
                            if seed_range else None),
+                recovery_stats=daemon.config.recovery_stats,
             )
             # Seeds go straight to source (StartSeedTask → back-source);
             # register first so the peer exists in the scheduler's DAG.

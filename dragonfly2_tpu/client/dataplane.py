@@ -44,6 +44,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from dragonfly2_tpu.utils import faultplan
 from dragonfly2_tpu.utils.debugmon import register_debug_var
 
 
@@ -155,6 +156,14 @@ class HTTPConnectionPool:
             if stack:
                 return stack.pop(), True
         scheme, host, port = key
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            # Only fresh dials can be connect-refused; pooled checkouts
+            # above already hold an established socket.
+            rule = plan.check("pool.connect", context=f"{host}:{port}")
+            if rule is not None:
+                faultplan.raise_connect(rule, "pool.connect",
+                                        f"{host}:{port}")
         cls = (http.client.HTTPSConnection if scheme == "https"
                else http.client.HTTPConnection)
         conn = cls(host, port, timeout=self.timeout)

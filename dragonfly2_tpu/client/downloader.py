@@ -12,6 +12,7 @@ Reference counterparts:
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import http.client
 import os
@@ -26,13 +27,20 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from dragonfly2_tpu import native
 from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
 from dragonfly2_tpu.client.piece import PieceMetadata
+from dragonfly2_tpu.utils import faultplan
 
 MAX_SCORE_NS = 0                     # best (lower is better)
 MIN_SCORE_NS = 60 * 1_000_000_000    # failure penalty pole
 
 
 class DownloadPieceError(Exception):
-    pass
+    """A piece fetch failed. ``fatal`` marks failures no other parent
+    can fix (disk full): the conductor fails the task instead of
+    burning the retry budget."""
+
+    def __init__(self, message: str, fatal: bool = False):
+        super().__init__(message)
+        self.fatal = fatal
 
 
 class DispatcherClosedError(Exception):
@@ -65,6 +73,13 @@ class PieceDispatcher:
         self._requests: Dict[str, List[DownloadPieceRequest]] = {}
         self._score: Dict[str, int] = {}
         self._downloaded: Set[int] = set()
+        # (piece → parents that served it corrupt): steer the re-fetch to
+        # a DIFFERENT parent; falls back to an avoided pair only when no
+        # other parent offers the piece (single-parent swarms must still
+        # converge on transient corruption).
+        self._avoid: Dict[int, Set[str]] = {}
+        # Parents blacklisted for this task (repeat corruption).
+        self._banned: Set[str] = set()
         self._sum = 0
         self._closed = False
         self._lock = threading.Lock()
@@ -72,12 +87,18 @@ class PieceDispatcher:
         self.random_ratio = random_ratio
         self._rand = random.Random(seed)
 
-    def put(self, req: DownloadPieceRequest) -> None:
+    def put(self, req: DownloadPieceRequest) -> bool:
+        """False when the request was REFUSED (blacklisted parent) — the
+        caller must roll back its own enqueue bookkeeping, or the piece
+        is stranded (marked enqueued but queued nowhere)."""
         with self._cond:
+            if req.dst_peer_id in self._banned:
+                return False
             self._requests.setdefault(req.dst_peer_id, []).append(req)
             self._score.setdefault(req.dst_peer_id, MAX_SCORE_NS)
             self._sum += 1
             self._cond.notify_all()
+            return True
 
     def get(self, timeout: float | None = None) -> Optional[DownloadPieceRequest]:
         """Next request from the best (or ε-randomly shuffled) parent; None
@@ -94,20 +115,41 @@ class PieceDispatcher:
             return self._get_desired()
 
     def _get_desired(self) -> Optional[DownloadPieceRequest]:
-        peers = list(self._score)
+        peers = [p for p in self._score if p not in self._banned]
         if self._rand.random() < self.random_ratio:
             self._rand.shuffle(peers)
         else:
             peers.sort(key=lambda p: self._score[p])
+        fallback: "tuple[str, DownloadPieceRequest] | None" = None
         for peer in peers:
             queue = self._requests.get(peer) or []
-            while queue:
-                n = self._rand.randrange(len(queue))
-                req = queue.pop(n)
-                self._sum -= 1
-                if req.piece.num in self._downloaded:
+            # Purge already-downloaded entries first (the old loop did
+            # this lazily while popping).
+            if queue:
+                kept = [r for r in queue
+                        if r.piece.num not in self._downloaded]
+                self._sum -= len(queue) - len(kept)
+                queue[:] = kept
+            if not queue:
+                continue
+            order = list(range(len(queue)))
+            self._rand.shuffle(order)
+            for i in order:
+                req = queue[i]
+                if peer in self._avoid.get(req.piece.num, ()):
+                    # This parent already served this piece corrupt —
+                    # keep it as a last resort only.
+                    if fallback is None:
+                        fallback = (peer, req)
                     continue
+                queue.pop(i)
+                self._sum -= 1
                 return req
+        if fallback is not None:
+            peer, req = fallback
+            self._requests[peer].remove(req)
+            self._sum -= 1
+            return req
         return None
 
     def report(self, result: DownloadPieceResult) -> None:
@@ -120,6 +162,31 @@ class PieceDispatcher:
             else:
                 self._downloaded.add(result.piece_num)
                 self._score[result.dst_peer_id] = (last + result.cost_ns) // 2
+
+    def report_corrupt(self, peer_id: str, piece_num: int) -> None:
+        """A piece from this parent failed its md5: re-fetch must prefer
+        a different parent (the avoid map), and the parent's score takes
+        the same failure penalty as a transport error."""
+        with self._lock:
+            self._avoid.setdefault(piece_num, set()).add(peer_id)
+            last = self._score.get(peer_id, MAX_SCORE_NS)
+            self._score[peer_id] = (last + MIN_SCORE_NS) // 2
+
+    def ban(self, peer_id: str) -> List[DownloadPieceRequest]:
+        """Blacklist a parent for the task: drop its queue (returning the
+        still-wanted requests so the conductor can re-open them for
+        other parents) and refuse future puts."""
+        with self._cond:
+            self._banned.add(peer_id)
+            dropped = self._requests.pop(peer_id, [])
+            self._sum -= len(dropped)
+            self._score.pop(peer_id, None)
+            return [r for r in dropped
+                    if r.piece.num not in self._downloaded]
+
+    def is_banned(self, peer_id: str) -> bool:
+        with self._lock:
+            return peer_id in self._banned
 
     def is_downloaded(self, piece_num: int) -> bool:
         with self._lock:
@@ -242,12 +309,18 @@ class PieceDownloader:
         piece = req.piece
         conn, resp = self._open(req)
         self._validate(req, conn, resp)
+        plan = faultplan.ACTIVE
+        flt = (faultplan.body_filter(
+                   plan.check("piece.body", context=req.dst_addr))
+               if plan is not None else None)
         digest = hashlib.md5()
         offset = piece.offset
         remaining = piece.length
         try:
             while remaining > 0:
                 chunk = resp.read(min(self.chunk_size, remaining))
+                if flt is not None:
+                    chunk = flt(chunk)
                 if not chunk:
                     break
                 if self.chunk_hook is not None:
@@ -259,7 +332,9 @@ class PieceDownloader:
         except (OSError, http.client.HTTPException) as exc:
             conn.close()
             raise DownloadPieceError(
-                f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+                f"{req.dst_addr} piece {piece.num}: {exc}",
+                fatal=getattr(exc, "errno", None) == errno.ENOSPC,
+            ) from exc
         if remaining:
             conn.close()
             raise DownloadPieceError(
@@ -276,8 +351,14 @@ class PieceDownloader:
         piece = req.piece
         conn, resp = self._open(req)
         self._validate(req, conn, resp)
+        plan = faultplan.ACTIVE
+        flt = (faultplan.body_filter(
+                   plan.check("piece.body", context=req.dst_addr))
+               if plan is not None else None)
         try:
             data = resp.read(piece.length)
+            if flt is not None:
+                data = flt(data)
         except (OSError, http.client.HTTPException) as exc:
             conn.close()
             raise DownloadPieceError(
@@ -339,6 +420,11 @@ class NativePieceFetcher:
             # surface as a piece failure (retried on another parent),
             # not a ValueError that kills the worker thread.
             raise DownloadPieceError(f"malformed parent address {addr!r}")
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            rule = plan.check("pool.connect", context=addr)
+            if rule is not None:
+                faultplan.raise_connect(rule, "pool.connect", addr)
         sock = socket.create_connection((host, int(port)),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -418,7 +504,9 @@ class NativePieceFetcher:
                     self._flush(req.dst_addr)
                     continue
                 raise DownloadPieceError(
-                    f"{req.dst_addr} piece {piece.num}: {exc}") from exc
+                    f"{req.dst_addr} piece {piece.num}: {exc}",
+                    fatal=getattr(exc, "errno", None) == errno.ENOSPC,
+                ) from exc
             # Count only the checkout that actually SERVED the request
             # (a stale pooled socket that failed above must not count a
             # reuse — it produced nothing; the fresh retry counts).
@@ -439,4 +527,5 @@ class NativePieceFetcher:
             self.stats.parent_request(piece.length)
             return res.md5_hex
         raise DownloadPieceError(
-            f"{req.dst_addr} piece {piece.num}: {last_exc}")
+            f"{req.dst_addr} piece {piece.num}: {last_exc}",
+            fatal=getattr(last_exc, "errno", None) == errno.ENOSPC)

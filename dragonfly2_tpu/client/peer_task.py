@@ -22,6 +22,7 @@ import os
 import json
 import logging
 import queue
+import random
 import threading
 import time
 import urllib.request
@@ -47,7 +48,10 @@ from dragonfly2_tpu.client.piece import (
     piece_range,
 )
 from dragonfly2_tpu.client.piece_reporter import PieceReportBatcher
+from dragonfly2_tpu.client.recovery import RECOVERY
 from dragonfly2_tpu.client.storage import (
+    DiskFullError,
+    InvalidPieceDigestError,
     StorageManager,
     TaskStorage,
     WritePieceRequest,
@@ -59,6 +63,7 @@ from dragonfly2_tpu.scheduler.service import (
     RegisterPeerResponse,
 )
 from dragonfly2_tpu.utils import digest as digestutil
+from dragonfly2_tpu.utils.backoff import full_jitter
 from dragonfly2_tpu.utils.hosttypes import HostType
 
 logger = logging.getLogger(__name__)
@@ -177,6 +182,42 @@ class PeerTaskOptions:
     # the first buffered one. Task end always flushes.
     report_flush_count: int = 16
     report_flush_deadline: float = 0.05
+    # -- failure-recovery budgets (ISSUE 5) -------------------------------
+    # Every retry loop below replaces a magic constant with a
+    # configurable budget + exponential backoff with full jitter
+    # (utils/backoff.py); recovery events count in the /debug/vars
+    # "recovery" block (client/recovery.py).
+    #
+    # Metadata-sync poll: give up on a parent after this many
+    # CONSECUTIVE failures (was the hard-coded 3), each retried after a
+    # jittered backoff on top of the poll interval; per-poll HTTP
+    # timeout (was the hard-coded urlopen timeout=5).
+    metadata_retry_limit: int = 3
+    metadata_timeout: float = 5.0
+    # Shared backoff shape for metadata/piece/source/report retries:
+    # attempt k sleeps uniform[0, min(cap, base * 2**k)].
+    backoff_base: float = 0.05
+    backoff_cap: float = 2.0
+    # Per-piece fetch budget: a piece that fails this many times stops
+    # spinning on the mesh and degrades the task to back-to-source
+    # (partial p2p progress is kept — stored pieces are skipped).
+    piece_retry_limit: int = 16
+    # Back-to-source coalesced-run budget: transient stream failures
+    # retry the run this many times before failing the task (a dead
+    # source still fails fast: every retry re-dials the same origin).
+    source_retry_limit: int = 3
+    # Parents whose pieces fail md5 this many times are blacklisted for
+    # the rest of the task (the dispatcher drops + refuses their queue).
+    corrupt_blacklist_threshold: int = 3
+    # A scheduler that stops answering mid-task: after this many seconds
+    # with failing scheduler RPCs AND no piece progress, degrade to
+    # back-to-source instead of burning the full task timeout.
+    # 0 disables the grace degradation.
+    scheduler_grace: float = 10.0
+    # Piece-report flush retry ladder + bounded pending queue
+    # (client/piece_reporter.py).
+    report_retry_limit: int = 2
+    report_pending_cap: int = 1024
 
 
 @dataclass
@@ -233,6 +274,7 @@ class PeerTaskConductor:
         url_range: "Range | None" = None,
         priority: int = 0,
         dataplane_stats=None,
+        recovery_stats=None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -261,6 +303,9 @@ class PeerTaskConductor:
         if dataplane_stats is None:
             from dragonfly2_tpu.client.dataplane import STATS as dataplane_stats
         self.stats = dataplane_stats
+        # Module-level import (not lazy): any process that CAN download
+        # publishes the "recovery" debug block from startup.
+        self.recovery = recovery_stats if recovery_stats is not None else RECOVERY
         self.channel = QueueChannel()
         self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
         self.downloader = PieceDownloader(stats=self.stats)
@@ -271,7 +316,13 @@ class PeerTaskConductor:
         )
         self.reporter = PieceReportBatcher(
             scheduler, flush_count=self.opts.report_flush_count,
-            flush_deadline=self.opts.report_flush_deadline, stats=self.stats)
+            flush_deadline=self.opts.report_flush_deadline, stats=self.stats,
+            retry_limit=self.opts.report_retry_limit,
+            retry_base=self.opts.backoff_base,
+            retry_cap=self.opts.backoff_cap,
+            pending_cap=self.opts.report_pending_cap,
+            on_delivery=self._note_scheduler,
+            recovery=self.recovery)
         self.store: Optional[TaskStorage] = None
         self.content_length = -1
         self.total_pieces = -1
@@ -287,6 +338,22 @@ class PeerTaskConductor:
         self._syncers: Dict[str, threading.Thread] = {}
         self._workers: List[threading.Thread] = []
         self._started_at = 0.0
+        self._rng = random.Random()
+        # Failure-recovery bookkeeping (all under _written_lock):
+        # per-piece failed-fetch attempts, first-failure timestamps (for
+        # the recovery-latency ring), pieces that EVER failed md5, and
+        # per-parent corruption counts feeding the blacklist.
+        self._piece_attempts: Dict[int, int] = {}
+        self._first_failure_at: Dict[int, float] = {}
+        self._corrupt_pieces: set[int] = set()
+        self._corrupt_counts: Dict[str, int] = {}
+        self._banned_parents: set[str] = set()
+        # Scheduler-health window for the bounded-grace degradation:
+        # when RPCs started failing (None = healthy) and the last time
+        # the task made progress (piece stored / decision received).
+        self._sched_lock = threading.Lock()
+        self._sched_fail_since: Optional[float] = None
+        self._last_progress_at = time.monotonic()
 
     # -- public entry ------------------------------------------------------
 
@@ -361,7 +428,18 @@ class PeerTaskConductor:
                 decision = self.channel.decisions.get(timeout=min(remaining, 0.5))
             except queue.Empty:
                 self._check_finished()
+                if not self._done.is_set() and self._scheduler_stalled():
+                    # Scheduler went UNAVAILABLE mid-task and nothing is
+                    # progressing: degrade after the bounded grace
+                    # instead of burning the full task deadline.
+                    self.recovery.tick("scheduler_degraded_to_source")
+                    logger.warning(
+                        "peer %s: scheduler unresponsive past %.1fs grace; "
+                        "degrading to back-to-source", self.peer_id,
+                        self.opts.scheduler_grace)
+                    return self._run_back_to_source(report=False)
                 continue
+            self._touch_progress()
             if isinstance(decision, NeedBackToSource):
                 logger.info("peer %s told to back-to-source: %s",
                             self.peer_id, decision.reason)
@@ -381,10 +459,54 @@ class PeerTaskConductor:
         return PeerTaskResult(self.task_id, self.peer_id, False,
                               storage=self.store, error=self._error)
 
+    # -- scheduler health (bounded-grace degradation) ----------------------
+
+    def _note_scheduler(self, ok: bool) -> None:
+        """Observed outcome of a scheduler RPC (reports, batched
+        flushes): opens/closes the grace window for mid-task
+        degradation."""
+        with self._sched_lock:
+            if ok:
+                self._sched_fail_since = None
+            elif self._sched_fail_since is None:
+                self._sched_fail_since = time.monotonic()
+
+    def _touch_progress(self) -> None:
+        with self._sched_lock:
+            self._last_progress_at = time.monotonic()
+
+    def _scheduler_stalled(self) -> bool:
+        """True when the scheduler grace has run out: RPCs have been
+        failing (or the scheduler has been silent since registration —
+        no decision, no parents) AND no piece progress for the whole
+        grace window. Progress without a scheduler (parents already
+        syncing) never degrades — the mesh can finish the task alone."""
+        grace = self.opts.scheduler_grace
+        if grace <= 0:
+            return False
+        now = time.monotonic()
+        with self._sched_lock:
+            failing_since = self._sched_fail_since
+            last_progress = self._last_progress_at
+        if now - last_progress <= grace:
+            return False
+        if failing_since is not None and now - failing_since > grace:
+            return True
+        # Silent scheduler: registered + started fine, then nothing — no
+        # LIVE parent is feeding us (dead syncer threads stay in the map
+        # forever, so emptiness alone would mask an offered-then-died
+        # parent) and the scheduler isn't rescheduling.
+        feeding = any(t.is_alive() for t in self._syncers.values())
+        return not feeding and now - self._started_at > grace
+
     # -- piece metadata sync per parent (synchronizer role) ----------------
 
     def _start_syncer(self, parent: ParentInfo) -> None:
         if parent.peer_id == self.peer_id:
+            return
+        if parent.peer_id in self._banned_parents:
+            # Blacklisted for repeat corruption: a reschedule may
+            # re-offer the parent, but this task wants nothing from it.
             return
         # Replace dead syncers: a reschedule may re-offer a parent whose
         # previous sync thread already exited, and a failed piece can only
@@ -406,8 +528,12 @@ class PeerTaskConductor:
         )
         failures = 0
         while not self._sync_stop.is_set():
+            if parent.peer_id in self._banned_parents:
+                return  # blacklisted mid-sync (repeat corruption)
+            backoff = 0.0
             try:
-                with urllib.request.urlopen(url, timeout=5) as resp:
+                with urllib.request.urlopen(
+                        url, timeout=self.opts.metadata_timeout) as resp:
                     meta = json.loads(resp.read())
                 failures = 0
                 if meta.get("contentLength", -1) >= 0:
@@ -428,12 +554,18 @@ class PeerTaskConductor:
                 failures += 1
                 logger.debug("metadata sync %s failed (%d): %s",
                              parent.addr, failures, exc)
-                if failures >= 3:
+                if failures > self.opts.metadata_retry_limit:
                     # Watchdog gives up on the parent
                     # (peertask_piecetask_synchronizer.go:70 watchdog).
+                    self.recovery.tick("metadata_sync_giveups")
                     self._report_piece_failed(parent.peer_id, -1)
                     return
-            self._sync_stop.wait(self.opts.metadata_poll_interval)
+                # Budgeted retry with full jitter instead of hammering
+                # a flapping parent at the poll interval.
+                self.recovery.tick("metadata_retries")
+                backoff = full_jitter(failures - 1, self.opts.backoff_base,
+                                      self.opts.backoff_cap, self._rng)
+            self._sync_stop.wait(self.opts.metadata_poll_interval + backoff)
 
     def _all_written(self) -> bool:
         if self.total_pieces < 0:
@@ -442,16 +574,25 @@ class PeerTaskConductor:
             return len(self._written) >= self.total_pieces
 
     def _enqueue_piece(self, parent: ParentInfo, piece: PieceMetadata) -> None:
+        if parent.peer_id in self._banned_parents:
+            return
         with self._written_lock:
             # Dedup on _enqueued alone: retry re-entry happens by the
             # failure path discarding the piece from _enqueued.
             if piece.num in self._enqueued or piece.num in self._written:
                 return
             self._enqueued.add(piece.num)
-        self.dispatcher.put(DownloadPieceRequest(
+        accepted = self.dispatcher.put(DownloadPieceRequest(
             task_id=self.task_id, src_peer_id=self.peer_id,
             dst_peer_id=parent.peer_id, dst_addr=parent.addr, piece=piece,
         ))
+        if not accepted:
+            # Parent was blacklisted between the check above and the put
+            # (concurrent _on_piece_corrupt): un-mark the piece so a
+            # healthy parent's syncer can still enqueue it — otherwise
+            # it is stranded until the task deadline.
+            with self._written_lock:
+                self._enqueued.discard(piece.num)
 
     # -- piece download workers (downloadPieceWorker) ----------------------
 
@@ -494,12 +635,19 @@ class PeerTaskConductor:
             except DownloadPieceError as exc:
                 logger.debug("piece %d from %s failed: %s",
                              req.piece.num, req.dst_peer_id, exc)
+                if exc.fatal:
+                    # Disk full: no other parent can fix this — fail the
+                    # task fast instead of hanging workers on a doomed
+                    # requeue loop.
+                    self.recovery.tick("enospc_fail_fast")
+                    self._fail(f"disk full: {exc}")
+                    return
                 self.dispatcher.report(DownloadPieceResult(
                     req.dst_peer_id, req.piece.num, fail=True))
                 self._report_piece_failed(req.dst_peer_id, req.piece.num)
-                # Requeue for another parent (or the same one later).
-                with self._written_lock:
-                    self._enqueued.discard(req.piece.num)
+                # Requeue for another parent (or the same one later),
+                # under the per-piece retry budget + jittered backoff.
+                self._note_piece_failure(req.piece.num)
                 continue
             cost = time.monotonic_ns() - begin
             self.dispatcher.report(DownloadPieceResult(
@@ -543,11 +691,17 @@ class PeerTaskConductor:
         piece = req.piece
         try:
             self.store.record_piece(piece, piece.length, md5_hex, cost_ns)
+        except InvalidPieceDigestError as exc:
+            self._on_piece_corrupt(req, exc)
+            return
+        except DiskFullError as exc:
+            self.recovery.tick("enospc_fail_fast")
+            self._fail(f"disk full: {exc}")
+            return
         except Exception as exc:
             logger.warning("store piece %d failed: %s", piece.num, exc)
             self._report_piece_failed(req.dst_peer_id, piece.num)
-            with self._written_lock:
-                self._enqueued.discard(piece.num)
+            self._note_piece_failure(piece.num)
             return
         self._after_piece_stored(req, cost_ns)
 
@@ -559,19 +713,93 @@ class PeerTaskConductor:
                 WritePieceRequest(self.task_id, self.peer_id, piece),
                 io.BytesIO(data),
             )
+        except InvalidPieceDigestError as exc:
+            self._on_piece_corrupt(req, exc)
+            return
+        except DiskFullError as exc:
+            self.recovery.tick("enospc_fail_fast")
+            self._fail(f"disk full: {exc}")
+            return
         except Exception as exc:
             logger.warning("store piece %d failed: %s", piece.num, exc)
             self._report_piece_failed(req.dst_peer_id, piece.num)
-            with self._written_lock:
-                self._enqueued.discard(piece.num)
+            self._note_piece_failure(piece.num)
             return
         self._after_piece_stored(req, cost_ns)
+
+    def _note_piece_failure(self, piece_num: int) -> None:
+        """Count one failed attempt at a piece, re-open it for (other)
+        syncers, and enforce the per-piece retry budget: an exhausted
+        piece degrades the task to back-to-source instead of spinning on
+        the mesh until the task deadline."""
+        now = time.monotonic()
+        with self._written_lock:
+            attempts = self._piece_attempts.get(piece_num, 0) + 1
+            self._piece_attempts[piece_num] = attempts
+            self._first_failure_at.setdefault(piece_num, now)
+            self._enqueued.discard(piece_num)
+        self.recovery.tick("piece_retries")
+        if attempts >= self.opts.piece_retry_limit > 0:
+            self.recovery.tick("piece_retry_exhausted")
+            self.channel.decisions.put(NeedBackToSource(
+                f"piece {piece_num} exhausted its "
+                f"{self.opts.piece_retry_limit}-attempt retry budget"))
+            return
+        # Jittered backoff before this worker grabs more work: a dead
+        # parent no longer gets hammered in a tight requeue loop.
+        self._done.wait(full_jitter(attempts - 1, self.opts.backoff_base,
+                                    self.opts.backoff_cap, self._rng))
+
+    def _on_piece_corrupt(self, req: DownloadPieceRequest, exc) -> None:
+        """md5 mismatch at store time: steer the re-fetch to a DIFFERENT
+        parent (dispatcher avoid map) and blacklist a parent that keeps
+        serving corrupt bytes — today's behavior was to loop on the same
+        parent forever."""
+        piece = req.piece
+        parent = req.dst_peer_id
+        logger.warning("piece %d from %s corrupt: %s", piece.num, parent, exc)
+        self.recovery.tick("md5_mismatch_pieces")
+        with self._written_lock:
+            self._corrupt_pieces.add(piece.num)
+            count = self._corrupt_counts.get(parent, 0) + 1
+            self._corrupt_counts[parent] = count
+            self._first_failure_at.setdefault(piece.num, time.monotonic())
+        self.dispatcher.report(DownloadPieceResult(
+            parent, piece.num, fail=True))
+        self.dispatcher.report_corrupt(parent, piece.num)
+        self._report_piece_failed(parent, piece.num)
+        if (count >= self.opts.corrupt_blacklist_threshold > 0
+                and parent not in self._banned_parents):
+            self._banned_parents.add(parent)
+            self.recovery.tick("parents_blacklisted")
+            logger.warning("parent %s blacklisted for task %s after %d "
+                           "corrupt pieces", parent, self.task_id[:16], count)
+            dropped = self.dispatcher.ban(parent)
+            with self._written_lock:
+                for r in dropped:
+                    self._enqueued.discard(r.piece.num)
+        self._note_piece_failure(piece.num)
+
+    def _observe_piece_recovered(self, piece_num: int) -> None:
+        """A piece that previously FAILED just stored successfully:
+        record the recovery latency (first failure → stored) and, when
+        the failure was corruption, the successful re-fetch."""
+        with self._written_lock:
+            first_failure = self._first_failure_at.pop(piece_num, None)
+            recovered_corrupt = piece_num in self._corrupt_pieces
+            self._corrupt_pieces.discard(piece_num)
+        if first_failure is not None:
+            self.recovery.observe_recovery(time.monotonic() - first_failure)
+        if recovered_corrupt:
+            self.recovery.tick("corrupt_refetched")
 
     def _after_piece_stored(self, req: DownloadPieceRequest,
                             cost_ns: int) -> None:
         piece = req.piece
         with self._written_lock:
             self._written.add(piece.num)
+        self._touch_progress()
+        self._observe_piece_recovered(piece.num)
         self._notify_piece_sink(piece.num)
         self.shaper.record(self.task_id, piece.length)
         if self.metrics:
@@ -594,11 +822,24 @@ class PeerTaskConductor:
             logger.exception("piece sink failed for piece %d", piece_num)
 
     def _report_piece_failed(self, parent_id: str, piece_number: int) -> None:
-        try:
-            self.scheduler.download_piece_failed(
-                self.peer_id, parent_id, piece_number)
-        except Exception:
-            logger.debug("piece failed report failed", exc_info=True)
+        """Tell the scheduler a piece (or a whole parent, number=-1)
+        failed. Retried ONCE; a report dropped after the retry is
+        counted (``reports_dropped``) instead of vanishing at debug
+        level, and either outcome feeds the scheduler-health window."""
+        for attempt in (0, 1):
+            try:
+                self.scheduler.download_piece_failed(
+                    self.peer_id, parent_id, piece_number)
+                self._note_scheduler(True)
+                return
+            except Exception:
+                if attempt == 0:
+                    self.recovery.tick("piece_failed_report_retries")
+                    continue
+                self.recovery.tick("reports_dropped")
+                self._note_scheduler(False)
+                logger.debug("piece failed report dropped after retry",
+                             exc_info=True)
 
     # -- completion --------------------------------------------------------
 
@@ -780,13 +1021,16 @@ class PeerTaskConductor:
                 cursor[0] = start + n
                 return start, n
 
-        def fetch_run(first: int, count: int) -> None:
+        def fetch_run(first: int, count: int) -> "Exception | None":
             """ONE ranged GET covering pieces [first, first+count), split
             into pieces as the stream arrives. Per-piece semantics are
             identical to the old one-GET-per-piece loop: incremental
             wire md5 via DigestReader → set_piece_digest, write_piece
             offsets/lengths, shaper wait/record per piece, per-piece
-            finished report (batched)."""
+            finished report (batched). Returns the failure (None on
+            success) — the WORKER owns the retry budget; pieces that
+            landed before a mid-run failure stay stored, and a retry of
+            the same run drains them as span-bounded duplicates."""
             first_rng = piece_range(first, self.piece_size, length)
             last_rng = piece_range(first + count - 1, self.piece_size, length)
             run_rng = Range(first_rng.start,
@@ -807,16 +1051,13 @@ class PeerTaskConductor:
                     source_mod.Request(self.url, dict(self.request_header),
                                        rng=src_rng))
             except Exception as exc:
-                with lock:
-                    errors.append(
-                        f"pieces {first}-{first + count - 1}: {exc}")
-                abort.set()
                 # The GET was issued even though nothing landed — the
                 # request counters must not flatter failed runs.
                 self.stats.source_run(0, 0)
-                return
+                return exc
             completed = 0
             completed_bytes = 0
+            run_exc: "Exception | None" = None
             try:
                 for num in range(first, first + count):
                     rng = piece_range(num, self.piece_size, length)
@@ -838,6 +1079,7 @@ class PeerTaskConductor:
                     # children can verify (back-source pieces define the
                     # task's truth).
                     self.store.set_piece_digest(num, reader.hexdigest(), cost)
+                    self._observe_piece_recovered(num)
                     self._notify_piece_sink(num)
                     self.shaper.record(self.task_id, rng.length)
                     if self.metrics:
@@ -852,22 +1094,59 @@ class PeerTaskConductor:
                     completed += 1
                     completed_bytes += rng.length
             except Exception as exc:
-                with lock:
-                    errors.append(f"piece {num}: {exc}")
-                abort.set()
+                run_exc = exc
             finally:
                 resp.close()
                 # Counters record what actually LANDED: a run that died
                 # mid-body must not claim its unwritten tail as saved
                 # requests (the acceptance contract is counter-verified).
                 self.stats.source_run(completed, completed_bytes)
+            return run_exc
 
         def worker() -> None:
+            """Claims runs; transient run failures retry under the
+            source_retry_limit budget with full jitter (the pre-ISSUE-5
+            behavior — first error fails the task — made every blip on
+            the origin fatal). Disk-full is terminal immediately, and an
+            exhausted budget aborts the remaining claims so a DEAD
+            source still fails in ~retry_limit runs per worker."""
             while True:
                 claimed = claim()
                 if claimed is None:
                     return
-                fetch_run(*claimed)
+                first, count = claimed
+                attempts = 0
+                while not abort.is_set():
+                    err = fetch_run(first, count)
+                    if err is None:
+                        break
+                    attempts += 1
+                    # Pieces still missing from the failed run opened
+                    # their recovery window now (closed when the retry
+                    # stores them — the recovery-latency ring).
+                    now = time.monotonic()
+                    with self._written_lock:
+                        for num in range(first, first + count):
+                            if not self.store.has_piece(num):
+                                self._first_failure_at.setdefault(num, now)
+                    # Retry the SAME run (the claim cursor has moved on):
+                    # pieces that landed before the failure are drained
+                    # as duplicates by write_piece's span-bounded dedup.
+                    if isinstance(err, DiskFullError):
+                        self.recovery.tick("enospc_fail_fast")
+                        attempts = None  # terminal — no retry can help
+                    if attempts is None or attempts > self.opts.source_retry_limit:
+                        with lock:
+                            errors.append(
+                                f"pieces {first}-{first + count - 1}: {err}")
+                        abort.set()
+                        return
+                    self.recovery.tick("source_run_retries")
+                    logger.debug("source run %d-%d failed (attempt %d): %s",
+                                 first, first + count - 1, attempts, err)
+                    self._done.wait(full_jitter(
+                        attempts - 1, self.opts.backoff_base,
+                        self.opts.backoff_cap, self._rng))
 
         threads = [
             threading.Thread(target=worker, daemon=True,

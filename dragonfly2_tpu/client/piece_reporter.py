@@ -16,17 +16,28 @@ through a small bounded-flush buffer:
 Delivery prefers the scheduler's native batched form
 (``download_pieces_finished``, scheduler/service.py and the DF2 wire's
 ``WirePiecesFinished``) and falls back to per-piece calls for schedulers
-that predate it. Delivery failures are swallowed-and-logged exactly like
-the old inline reports — piece reporting has always been best-effort
-telemetry for the scheduler's DAG, not a correctness dependency of the
-download itself.
+that predate it.
+
+Flush failures are NOT silently dropped (they were, pre-ISSUE-5): a
+failed batched flush retries inline with full-jitter backoff up to
+``retry_limit`` attempts, then parks the reports in a bounded pending
+queue redelivered ahead of the next flush. Only pending-queue overflow
+and a close() whose final attempt still fails drop reports — and both
+count the drop in the ``"recovery"`` debug block
+(``report_flush_dropped``) instead of losing them without a trace.
+``on_delivery(ok)`` tells the owning conductor how the scheduler is
+responding, feeding its bounded-grace degradation decision.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
+
+from dragonfly2_tpu.utils.backoff import full_jitter
 
 logger = logging.getLogger(__name__)
 
@@ -35,19 +46,34 @@ class PieceReportBatcher:
     """Coalesces PieceFinished reports; thread-safe; one per conductor."""
 
     def __init__(self, scheduler, flush_count: int = 16,
-                 flush_deadline: float = 0.05, stats=None):
+                 flush_deadline: float = 0.05, stats=None,
+                 retry_limit: int = 2, retry_base: float = 0.05,
+                 retry_cap: float = 0.5, pending_cap: int = 1024,
+                 on_delivery: Optional[Callable[[bool], None]] = None,
+                 recovery=None):
         self.scheduler = scheduler
         self.flush_count = max(int(flush_count), 1)
         self.flush_deadline = flush_deadline
+        self.retry_limit = max(int(retry_limit), 0)
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self.pending_cap = max(int(pending_cap), 1)
+        self.on_delivery = on_delivery
         if stats is None:
             from dragonfly2_tpu.client.dataplane import STATS as stats
         self.stats = stats
+        if recovery is None:
+            from dragonfly2_tpu.client.recovery import RECOVERY as recovery
+        self.recovery = recovery
         self._buf: List = []
         self._lock = threading.Lock()
         # Serializes deliveries: flush()/close() must not return while a
         # deadline-timer delivery is still in flight, or the conductor's
         # task-level "finished" report could overtake the final pieces.
+        # Also guards ``_pending`` (only touched during deliveries).
         self._deliver_lock = threading.Lock()
+        self._pending: List = []
+        self._rng = random.Random()
         self._timer: Optional[threading.Timer] = None
         self._closed = False
 
@@ -82,27 +108,30 @@ class PieceReportBatcher:
                 self._deliver_locked(straggler)
 
     def flush(self) -> None:
-        """Deliver everything buffered AND wait out any in-flight
-        delivery (a deadline timer mid-RPC) — when flush returns, every
-        report made before it has reached the scheduler (or been
-        dropped by its best-effort error handling). The deliver lock is
-        taken BEFORE the buffer is drained: a batch is never in limbo
-        (taken from the buffer but not yet under the lock), so this
-        barrier cannot be overtaken by a concurrent timer delivery."""
+        """Deliver everything buffered (and anything parked pending from
+        earlier failed flushes) AND wait out any in-flight delivery (a
+        deadline timer mid-RPC) — when flush returns, every report made
+        before it has reached the scheduler, is parked in the bounded
+        pending queue for the next attempt, or has been dropped WITH a
+        ``report_flush_dropped`` count. The deliver lock is taken BEFORE
+        the buffer is drained: a batch is never in limbo (taken from the
+        buffer but not yet under the lock), so this barrier cannot be
+        overtaken by a concurrent timer delivery."""
         with self._deliver_lock:
             with self._lock:
                 batch = self._take_locked()
-            if batch:
+            if batch or self._pending:
                 self._deliver_locked(batch)
 
     def close(self) -> None:
         """Final flush (same in-flight barrier); subsequent reports
-        deliver synchronously."""
+        deliver synchronously. Reports still undeliverable after the
+        final retry ladder are dropped and counted."""
         with self._deliver_lock:
             with self._lock:
                 self._closed = True
                 batch = self._take_locked()
-            if batch:
+            if batch or self._pending:
                 self._deliver_locked(batch)
 
     # -- internals ---------------------------------------------------------
@@ -114,26 +143,68 @@ class PieceReportBatcher:
             self._timer = None
         return batch
 
-    def _deliver_locked(self, batch: List) -> None:
-        """Send one batch; caller holds ``_deliver_lock``."""
-        batched = getattr(self.scheduler, "download_pieces_finished", None)
-        if batched is not None:
+    def _notify(self, ok: bool) -> None:
+        if self.on_delivery is not None:
             try:
-                batched(batch)
+                self.on_delivery(ok)
+            except Exception:  # noqa: BLE001 — observer must not break delivery
+                logger.debug("on_delivery hook failed", exc_info=True)
+
+    def _deliver_locked(self, batch: List) -> None:
+        """Send pending + one batch; caller holds ``_deliver_lock``."""
+        batched = getattr(self.scheduler, "download_pieces_finished", None)
+        if batched is None:
+            # Legacy scheduler: per-piece calls, per-piece error
+            # isolation (no batched flush to retry).
+            for report in self._pending + batch:
+                try:
+                    self.scheduler.download_piece_finished(report)
+                except Exception:
+                    logger.debug("piece finished report failed",
+                                 exc_info=True)
+            self._pending = []
+            return
+        # Pending-first preserves report order across a recovery.
+        pending_count = len(self._pending)
+        todo = self._pending + batch
+        self._pending = []
+        if not todo:
+            return
+        retried = False
+        for attempt in range(self.retry_limit + 1):
+            try:
+                batched(todo)
             except Exception:
-                logger.debug("batched piece report failed (%d pieces)",
-                             len(batch), exc_info=True)
-                return
+                logger.debug("batched piece report failed (%d pieces, "
+                             "attempt %d)", len(todo), attempt + 1,
+                             exc_info=True)
+                self.recovery.tick("report_flush_retries")
+                self._notify(False)
+                if attempt < self.retry_limit:
+                    retried = True
+                    time.sleep(full_jitter(attempt, self.retry_base,
+                                           self.retry_cap, self._rng))
+                continue
             # Count only batched deliveries that actually landed: the
             # report_rpcs_saved counter is the amortization contract,
-            # and neither a failed flush nor the per-piece fallback
-            # below saves any RPCs.
-            self.stats.report_flush(len(batch))
+            # and a failed flush saves nothing.
+            self.stats.report_flush(len(todo))
+            # Reports that landed after ≥1 failure: the whole batch when
+            # an inline retry saved it, else just the parked reports a
+            # later flush carried through.
+            redelivered = len(todo) if retried else pending_count
+            if redelivered:
+                self.recovery.tick("report_flush_redelivered", redelivered)
+            self._notify(True)
             return
-        # Legacy scheduler: per-piece calls, per-piece error isolation.
-        for report in batch:
-            try:
-                self.scheduler.download_piece_finished(report)
-            except Exception:
-                logger.debug("piece finished report failed",
-                             exc_info=True)
+        # Retry ladder exhausted. After close() there is no later flush
+        # to redeliver from — drop and count. Mid-task, park in the
+        # bounded pending queue (oldest dropped on overflow, counted).
+        if self._closed:
+            self.recovery.tick("report_flush_dropped", len(todo))
+            return
+        self._pending = todo
+        overflow = len(self._pending) - self.pending_cap
+        if overflow > 0:
+            del self._pending[:overflow]
+            self.recovery.tick("report_flush_dropped", overflow)

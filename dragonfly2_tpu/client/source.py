@@ -22,6 +22,7 @@ from typing import BinaryIO, Dict, Optional
 
 from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
 from dragonfly2_tpu.client.piece import Range
+from dragonfly2_tpu.utils import faultplan
 
 UNKNOWN_SOURCE_FILE_LEN = -2
 
@@ -336,8 +337,14 @@ class HTTPSourceClient(ResourceClient):
                 f"{request.url}: server ignored Range (status {resp.status})"
             )
         length = resp.headers.get("Content-Length")
+        body = resp
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            rule = plan.check("source.body", context=request.url)
+            if rule is not None:
+                body = faultplan.FaultingBody(resp, rule)
         return Response(
-            body=resp,
+            body=body,
             content_length=int(length) if length is not None else -1,
             status=resp.status,
             header={k: v for k, v in resp.headers.items()},

@@ -11,6 +11,7 @@ plus ``metadata.json``; md5-per-piece verification happens at write time via
 
 from __future__ import annotations
 
+import errno
 import json
 import logging
 import os
@@ -23,6 +24,7 @@ from typing import BinaryIO, Dict, Iterable, List, Optional, Tuple
 
 from dragonfly2_tpu.client.piece import PieceMetadata, Range
 from dragonfly2_tpu.utils import digest as digestutil
+from dragonfly2_tpu.utils import faultplan
 
 logger = logging.getLogger(__name__)
 
@@ -36,6 +38,12 @@ class StorageError(Exception):
 
 class InvalidPieceDigestError(StorageError):
     """Piece payload did not match its announced md5."""
+
+
+class DiskFullError(StorageError):
+    """ENOSPC on a piece write. Terminal for the task: retrying a full
+    disk from another parent just hangs workers, so conductors fail the
+    task fast when they see this."""
 
 
 @dataclass
@@ -116,24 +124,37 @@ class TaskStorage:
                 if remaining is not None:
                     remaining -= len(chunk)
             return duplicate.length
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            rule = plan.check("storage.write", context=self.meta.task_id)
+            if rule is not None and rule.kind is faultplan.FaultKind.ENOSPC:
+                raise DiskFullError(
+                    f"piece {piece.num}: injected ENOSPC")
         src = (
             digestutil.DigestReader(reader, digestutil.ALGORITHM_MD5,
                                     expected=piece.md5)
             if piece.md5 else None
         )
         written = 0
-        with open(self.data_path, "r+b") as f:
-            f.seek(piece.offset)
-            remaining = None if req.unknown_length else piece.length
-            while remaining is None or remaining > 0:
-                n = 1 << 20 if remaining is None else min(1 << 20, remaining)
-                chunk = (src or reader).read(n)
-                if not chunk:
-                    break
-                f.write(chunk)
-                written += len(chunk)
-                if remaining is not None:
-                    remaining -= len(chunk)
+        try:
+            with open(self.data_path, "r+b") as f:
+                f.seek(piece.offset)
+                remaining = None if req.unknown_length else piece.length
+                while remaining is None or remaining > 0:
+                    n = (1 << 20 if remaining is None
+                         else min(1 << 20, remaining))
+                    chunk = (src or reader).read(n)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    written += len(chunk)
+                    if remaining is not None:
+                        remaining -= len(chunk)
+        except OSError as exc:
+            if exc.errno == errno.ENOSPC:
+                raise DiskFullError(
+                    f"piece {piece.num}: {exc}") from exc
+            raise
         if not req.unknown_length and written != piece.length:
             raise StorageError(
                 f"piece {piece.num}: wrote {written}, expected {piece.length}"

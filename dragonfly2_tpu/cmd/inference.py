@@ -90,9 +90,12 @@ def main(argv=None) -> int:
     register_debug_var("inference_batcher_stats", service.batcher_stats)
     server = serve([(INFERENCE_SPEC, service)],
                    host=args.host, port=args.port)
+    # Share the server's health service: hot-reload grace windows flip
+    # it NOT_SERVING so health-aware clients drain to a replica.
+    service.set_health(server.health)
     print(f"inference sidecar serving on {server.target}", flush=True)
     wait_for_shutdown()
-    service.stop()
+    service.stop()  # marks NOT_SERVING before the listener dies
     server.stop()
     return 0
 

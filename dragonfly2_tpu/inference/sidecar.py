@@ -147,7 +147,8 @@ class InferenceService:
                  batch_adaptive_wait_s: float = 0.0005,
                  batch_max_rows: Optional[int] = None,
                  batch_lanes: int = 2,
-                 batch_queue_depth: int = 32):
+                 batch_queue_depth: int = 32,
+                 reload_grace_s: float = 35.0):
         self.manager = manager  # ManagerService or None (push-only mode)
         self.scheduler_id = scheduler_id
         self.reload_interval = reload_interval
@@ -157,11 +158,18 @@ class InferenceService:
         self.batch_max_rows = batch_max_rows
         self.batch_lanes = batch_lanes
         self.batch_queue_depth = batch_queue_depth
+        self.reload_grace_s = reload_grace_s
         self._models: Dict[str, _LoadedModel] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._grace_timers: list = []
+        # DF2 HealthService (rpc/health.py) shared with the hosting
+        # RpcServer: NOT_SERVING while any hot-reload grace window is
+        # open, so health-aware clients drain to a replica instead of
+        # racing the batcher swap.
+        self._health = None
+        self._grace_active = 0
 
     # -- model management --------------------------------------------------
 
@@ -195,11 +203,37 @@ class InferenceService:
                 # comfortably finished, like the pre-batcher code kept
                 # serving on the old scorer. The timer is daemonized and
                 # tracked so shutdown neither waits out the grace nor
-                # leaks it.
-                timer = threading.Timer(35.0, old.batcher.close)
+                # leaks it. While ANY grace window is open the health
+                # service reports NOT_SERVING (drain signal for
+                # health-aware clients); SERVING returns when the last
+                # window closes.
+                self._grace_active += 1
+                if self._health is not None:
+                    from dragonfly2_tpu.rpc.health import NOT_SERVING
+
+                    self._health.set_status("", NOT_SERVING)
+                timer = threading.Timer(self.reload_grace_s,
+                                        self._end_grace, args=(old.batcher,))
                 timer.daemon = True
                 self._grace_timers.append(timer)
                 timer.start()
+
+    def set_health(self, health) -> None:
+        """Bind the hosting server's HealthService so hot-reload grace
+        windows surface as NOT_SERVING."""
+        self._health = health
+
+    def _end_grace(self, batcher) -> None:
+        try:
+            batcher.close()
+        finally:
+            with self._lock:
+                self._grace_active = max(self._grace_active - 1, 0)
+                last = self._grace_active == 0
+            if last and self._health is not None and not self._stop.is_set():
+                from dragonfly2_tpu.rpc.health import SERVING
+
+                self._health.set_status("", SERVING)
 
     def batcher_stats(self) -> Dict[str, dict]:
         """Per-model micro-batcher pipeline counters (coalesce factor,
@@ -267,9 +301,15 @@ class InferenceService:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._health is not None:
+            from dragonfly2_tpu.rpc.health import NOT_SERVING
+
+            self._health.set_status("", NOT_SERVING)
         for timer in self._grace_timers:
             timer.cancel()
         self._grace_timers.clear()
+        with self._lock:
+            self._grace_active = 0
         stats = self.batcher_stats()
         if stats:
             # The operators' record of how the serving pipeline behaved
@@ -300,7 +340,23 @@ class InferenceService:
         import grpc
 
         from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+        from dragonfly2_tpu.utils import faultplan
 
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            rule = plan.check("infer.model_infer",
+                              context=request.model_name)
+            if rule is not None:
+                if rule.kind is faultplan.FaultKind.STALL:
+                    import time as _time
+
+                    _time.sleep(rule.delay_s)
+                elif rule.kind is faultplan.FaultKind.UNAVAILABLE:
+                    context.abort(grpc.StatusCode.UNAVAILABLE,
+                                  "injected UNAVAILABLE (fault plan)")
+                elif rule.kind is faultplan.FaultKind.DEADLINE:
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  "injected DEADLINE_EXCEEDED (fault plan)")
         with self._lock:
             model = self._models.get(request.model_name)
         if model is None:

@@ -138,12 +138,21 @@ class ServerTLS:
 class RpcServer:
     server: grpc.Server
     port: int
+    # The auto-mounted DF2 health service: callers flip per-service
+    # statuses (e.g. the sidecar's hot-reload grace window); stop()
+    # drains through NOT_SERVING so health-aware clients stop routing
+    # here before the listener dies.
+    health: Any = None
 
     @property
     def target(self) -> str:
         return f"127.0.0.1:{self.port}"
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
+        if self.health is not None:
+            from dragonfly2_tpu.rpc.health import NOT_SERVING
+
+            self.health.set_status("", NOT_SERVING)
         self.server.stop(grace).wait()
 
 
@@ -154,8 +163,14 @@ def serve(
     max_workers: int = 16,
     options: Optional[Iterable[tuple[str, Any]]] = None,
     tls: Optional[ServerTLS] = None,
+    health: Any = None,
 ) -> RpcServer:
-    """Bind and start a server hosting the given (spec, impl) pairs."""
+    """Bind and start a server hosting the given (spec, impl) pairs.
+
+    A DF2 health service is always mounted (pass ``health`` to share an
+    instance the caller also flips, e.g. for drain windows); every
+    hosted service is marked SERVING at start, and ``RpcServer.stop``
+    flips the whole server to NOT_SERVING before the listener dies."""
     opts = list(
         options
         or [
@@ -166,13 +181,14 @@ def serve(
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers), options=opts
     )
-    from dragonfly2_tpu.rpc.health import HEALTH_SPEC, HealthService
+    from dragonfly2_tpu.rpc.health import SERVING, HEALTH_SPEC, HealthService
 
-    health = HealthService()
+    health = health or HealthService()
     for spec, impl in list(services) + [(HEALTH_SPEC, health)]:
         server.add_generic_rpc_handlers((generic_handler(spec, impl),))
         if spec is not HEALTH_SPEC:
-            health.set_status(spec.name, "SERVING")
+            health.set_status(spec.name, SERVING)
+    health.set_status("", SERVING)
     if tls is not None:
         bound = server.add_secure_port(f"{host}:{port}", tls.credentials())
     else:
@@ -180,4 +196,4 @@ def serve(
     if bound == 0:
         raise OSError(f"cannot bind {host}:{port}")
     server.start()
-    return RpcServer(server=server, port=bound)
+    return RpcServer(server=server, port=bound, health=health)

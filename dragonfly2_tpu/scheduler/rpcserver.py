@@ -25,8 +25,11 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from dragonfly2_tpu.utils import faultplan
 
 from dragonfly2_tpu.rpc.codec import message
 from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
@@ -583,6 +586,16 @@ class GrpcSchedulerClient:
         self._sessions: Dict[str, _AnnounceSession] = {}
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _inject(method: str) -> None:
+        """Chaos hook: when a FaultPlan is installed, the scheduler.rpc
+        site can turn this call into UNAVAILABLE / DEADLINE_EXCEEDED
+        (raised as ServiceError, what the failover paths key on) or an
+        injected stall. A single None check when no plan is installed."""
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            faultplan.maybe_raise_rpc(plan, "scheduler.rpc", context=method)
+
     def probe_sync(self, host_id: str = ""):
         """Probe-loop adapter for the daemon's Prober (SyncProbes stream).
 
@@ -596,6 +609,7 @@ class GrpcSchedulerClient:
     # -- host lifecycle --------------------------------------------------
 
     def announce_host(self, host: Host) -> None:
+        self._inject("announce_host")
         self._client.AnnounceHost(AnnounceHostRequest.from_host(host),
                                   timeout=10)
 
@@ -618,6 +632,7 @@ class GrpcSchedulerClient:
 
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:
+        self._inject("register_peer")
         send_queue: "queue.Queue" = queue.Queue()
 
         def requests():
@@ -726,6 +741,7 @@ class GrpcSchedulerClient:
     def _send_event(self, peer_id: str, event: str, *, cost: float = 0.0,
                     content_length: int = -1, total: int = 0,
                     final: bool = False) -> None:
+        self._inject(event)
         session = self._require_session(peer_id)
         session.send(WirePeerEvent(
             peer_id=peer_id, event=event, cost_seconds=cost,
@@ -741,6 +757,7 @@ class GrpcSchedulerClient:
         self._send_event(peer_id, "back_to_source_started")
 
     def download_piece_finished(self, report: PieceFinished) -> None:
+        self._inject("download_piece_finished")
         session = self._require_session(report.peer_id)
         session.send(self._wire_piece(report))
 
@@ -748,6 +765,7 @@ class GrpcSchedulerClient:
         """Batched flush → ONE stream message (WirePiecesFinished). All
         reports in one flush belong to one conductor, hence one peer
         session."""
+        self._inject("download_pieces_finished")
         reports = list(reports)
         if not reports:
             return
@@ -766,6 +784,7 @@ class GrpcSchedulerClient:
 
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:
+        self._inject("download_piece_failed")
         session = self._require_session(peer_id)
         session.send(WirePieceFailed(
             peer_id=peer_id, parent_id=parent_id, piece_number=piece_number))
@@ -812,9 +831,21 @@ class BalancedSchedulerClient:
     to every replica (each replica keeps its own resource view).
 
     ``update_targets`` is the dynconfig observer hook.
+
+    Target selection is health-aware: before walking the ring, each
+    candidate's DF2 health service (rpc/health.py, auto-mounted on every
+    server) is consulted through a short-TTL cache, and targets that
+    report NOT_SERVING (draining for shutdown, hot-reload grace) are
+    DEPRIORITIZED — tried only after every SERVING target failed, so a
+    fleet that is entirely draining still gets a best-effort attempt
+    instead of an instant "no schedulers".
     """
 
-    def __init__(self, targets, client_factory=None, tls=None):
+    #: How long a per-target health verdict is trusted before re-probing.
+    HEALTH_TTL = 5.0
+
+    def __init__(self, targets, client_factory=None, tls=None,
+                 health_probe=None):
         from dragonfly2_tpu.rpc.client import HashRing
 
         self._factory = client_factory or (
@@ -827,6 +858,55 @@ class BalancedSchedulerClient:
         # closed when their last peer finalizes.
         self._retired: set = set()
         self._lock = threading.Lock()
+        self._tls = tls
+        # target → health status string; tests inject a fake probe.
+        self._health_probe = health_probe or self._grpc_health_probe
+        self._health_clients: Dict[str, object] = {}
+        self._health_cache: Dict[str, tuple[bool, float]] = {}
+
+    # -- health-aware target ordering -----------------------------------
+
+    def _grpc_health_probe(self, target: str) -> str:
+        from dragonfly2_tpu.rpc.client import ServiceClient
+        from dragonfly2_tpu.rpc.health import HEALTH_SPEC, HealthCheckRequest
+
+        with self._lock:
+            cli = self._health_clients.get(target)
+            if cli is None:
+                cli = ServiceClient(target, HEALTH_SPEC, tls=self._tls,
+                                    retries=0)
+                self._health_clients[target] = cli
+        return cli.Check(HealthCheckRequest(service=""), timeout=1.0).status
+
+    def _serving(self, target: str) -> bool:
+        """False only when the target AFFIRMATIVELY reports NOT_SERVING;
+        probe errors (no health service, network blip) leave the target
+        in the normal walk — the walk's own error handling decides."""
+        now = time.monotonic()
+        cached = self._health_cache.get(target)
+        if cached is not None and now - cached[1] < self.HEALTH_TTL:
+            return cached[0]
+        from dragonfly2_tpu.rpc.health import NOT_SERVING
+
+        try:
+            serving = self._health_probe(target) != NOT_SERVING
+        except Exception:  # noqa: BLE001 — absence of proof isn't proof
+            serving = True
+        self._health_cache[target] = (serving, now)
+        return serving
+
+    def _walk_healthy(self, key: str):
+        """Ring order with NOT_SERVING targets moved to the back. Lazy:
+        each target is probed only when the walk reaches it, so a
+        first-target success never pays for probing the rest of the
+        fleet (cold-cache probes cost up to 1 s each)."""
+        drained = []
+        for target in self.ring.walk(key):
+            if self._serving(target):
+                yield target
+            else:
+                drained.append(target)
+        yield from drained
 
     # -- target management (dynconfig observer) ------------------------
 
@@ -837,7 +917,15 @@ class BalancedSchedulerClient:
         for t in self.ring.targets - desired:
             self.ring.remove(t)
             with self._lock:
+                self._health_cache.pop(t, None)
+                health = self._health_clients.pop(t, None)
                 old = self._clients.pop(t, None)
+            if health is not None:
+                try:
+                    health.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            with self._lock:
                 if old is None:
                     continue
                 if old in self._peer_owner.values():
@@ -880,7 +968,7 @@ class BalancedSchedulerClient:
 
     def stat_task(self, task_id: str):
         last: Optional[Exception] = None
-        for target in self.ring.walk(task_id):
+        for target in self._walk_healthy(task_id):
             try:
                 return self._client_at(target).stat_task(task_id)
             except (ConnectionError, OSError) as exc:
@@ -899,7 +987,7 @@ class BalancedSchedulerClient:
         """Probe stream to this host's ring-stable replica — hashing the
         daemon's host_id spreads the fleet's probe load across replicas
         while keeping each daemon's stream sticky."""
-        for target in self.ring.walk(host_id or "probes"):
+        for target in self._walk_healthy(host_id or "probes"):
             return self._client_at(target).probe_sync(host_id)
         raise ConnectionError("no schedulers")
 
@@ -908,7 +996,7 @@ class BalancedSchedulerClient:
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:
         last: Optional[Exception] = None
-        for target in self.ring.walk(req.task_id):
+        for target in self._walk_healthy(req.task_id):
             cli = self._client_at(target)
             try:
                 resp = cli.register_peer(req, channel=channel)
@@ -1021,5 +1109,13 @@ class BalancedSchedulerClient:
             clients = list(self._clients.values())
             self._clients.clear()
             self._peer_owner.clear()
+            health_clients = list(self._health_clients.values())
+            self._health_clients.clear()
+            self._health_cache.clear()
         for cli in clients:
             cli.close()
+        for cli in health_clients:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001 — shutdown best effort
+                pass

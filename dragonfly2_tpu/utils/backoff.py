@@ -1,0 +1,20 @@
+"""Exponential backoff with full jitter.
+
+One implementation for every retry loop that used to carry a magic
+constant (metadata sync's fixed poll, piece-fetch hot requeue, report
+flush retries): delay for attempt *k* is uniform in
+``[0, min(cap, base * 2**k)]`` — the "full jitter" scheme, which
+decorrelates retry storms better than equal or decorrelated jitter at
+the same mean cost.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def full_jitter(attempt: int, base: float, cap: float,
+                rng: "random.Random | None" = None) -> float:
+    """Delay (seconds) for a 0-indexed retry attempt."""
+    upper = min(cap, base * (2 ** max(attempt, 0)))
+    return (rng or random).uniform(0.0, upper)

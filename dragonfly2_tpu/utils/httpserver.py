@@ -7,15 +7,29 @@ REST shell — shutdown ordering and join timeouts live here once.
 
 from __future__ import annotations
 
+import logging
 import threading
 from http.server import ThreadingHTTPServer
 from typing import Optional, Type
+
+logger = logging.getLogger(__name__)
+
+
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose per-request errors go to the logger
+    instead of a raw stderr traceback. Clients vanishing mid-request
+    (resets, refused continuations — routine under churn and by DESIGN
+    under fault injection) are debug noise, not operator pages."""
+
+    def handle_error(self, request, client_address):
+        logger.debug("request from %s failed", client_address,
+                     exc_info=True)
 
 
 class ThreadedHTTPService:
     def __init__(self, handler_cls: Type, host: str = "127.0.0.1",
                  port: int = 0, name: str = "http-service"):
-        self._server = ThreadingHTTPServer((host, port), handler_cls)
+        self._server = QuietThreadingHTTPServer((host, port), handler_cls)
         self._thread: Optional[threading.Thread] = None
         self._name = name
 

@@ -1,0 +1,422 @@
+"""Per-site failure-recovery behavior under the fault-injection plane.
+
+ISSUE 5's hardening contract, each path provoked on demand:
+corrupt-piece re-fetch steering + parent blacklist, scheduler-flap →
+bounded-grace back-to-source, piece-report flush retry/park/drop
+accounting, ENOSPC fail-fast, and the jittered metadata-sync budget.
+The ``slow``+``chaos``-marked ladder e2e at the bottom runs the real
+loopback swarm at a 1 % fault rate and must end with md5-correct files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+from dragonfly2_tpu.client.downloader import (
+    DownloadPieceRequest,
+    PieceDispatcher,
+)
+from dragonfly2_tpu.client.peer_task import (
+    PeerTaskConductor,
+    PeerTaskOptions,
+)
+from dragonfly2_tpu.client.piece import PieceMetadata
+from dragonfly2_tpu.client.piece_reporter import PieceReportBatcher
+from dragonfly2_tpu.client.recovery import RecoveryStats
+from dragonfly2_tpu.client.storage import StorageManager, StorageOptions
+from dragonfly2_tpu.scheduler.resource.task import SizeScope
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    RegisterPeerResponse,
+)
+from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils.faultplan import FaultKind, FaultPlan
+from tests.fileserver import FileServer
+from tests.test_p2p_e2e import make_scheduler
+
+PIECE = 64 * 1024
+
+
+@pytest.fixture(autouse=True)
+def clean_plan():
+    yield
+    faultplan.uninstall()
+
+
+@pytest.fixture()
+def small_pieces(monkeypatch):
+    monkeypatch.setattr(
+        "dragonfly2_tpu.client.peer_task.compute_piece_size",
+        lambda content_length: PIECE)
+
+
+@pytest.fixture()
+def origin(tmp_path):
+    root = tmp_path / "origin"
+    root.mkdir()
+    with FileServer(str(root)) as fs:
+        fs.root_dir = root
+        yield fs
+
+
+def chaos_options(**kw) -> PeerTaskOptions:
+    base = dict(native_data_plane=False, timeout=30.0,
+                backoff_base=0.005, backoff_cap=0.05,
+                metadata_poll_interval=0.05)
+    base.update(kw)
+    return PeerTaskOptions(**base)
+
+
+def make_chaos_daemon(scheduler, tmp_path, name, recovery,
+                      **opt_kw) -> Daemon:
+    daemon = Daemon(scheduler, DaemonConfig(
+        storage_root=str(tmp_path / name), hostname=name,
+        keep_storage=False, task_options=chaos_options(**opt_kw),
+        recovery_stats=recovery,
+    ))
+    daemon.start()
+    return daemon
+
+
+# ----------------------------------------------------------------------
+# Corrupt pieces: different-parent steering + blacklist
+# ----------------------------------------------------------------------
+
+
+def _req(parent: str, num: int) -> DownloadPieceRequest:
+    return DownloadPieceRequest(
+        task_id="t" * 32, src_peer_id="me", dst_peer_id=parent,
+        dst_addr=f"{parent}:80",
+        piece=PieceMetadata(num=num, md5="", offset=num * PIECE,
+                            start=num * PIECE, length=PIECE))
+
+
+class TestDispatcherCorruptSteering:
+    def test_refetch_prefers_a_different_parent(self):
+        """After report_corrupt(P, n), a queued request for piece n from
+        another parent wins even when P is better-scored."""
+        d = PieceDispatcher(random_ratio=0.0, seed=7)
+        d.put(_req("parent-p", 1))
+        d.put(_req("parent-q", 1))
+        d.report_corrupt("parent-p", 1)
+        got = d.get(timeout=0.1)
+        assert got.dst_peer_id == "parent-q"
+
+    def test_single_parent_fallback_still_serves(self):
+        """An avoided (parent, piece) pair is a LAST resort, not a dead
+        end: with no other parent offering the piece it is still
+        handed out (transient corruption must not wedge the task)."""
+        d = PieceDispatcher(random_ratio=0.0, seed=7)
+        d.put(_req("parent-p", 1))
+        d.report_corrupt("parent-p", 1)
+        got = d.get(timeout=0.1)
+        assert got is not None and got.dst_peer_id == "parent-p"
+
+    def test_ban_drops_queue_and_refuses_future_puts(self):
+        d = PieceDispatcher(random_ratio=0.0, seed=7)
+        d.put(_req("parent-p", 1))
+        d.put(_req("parent-p", 2))
+        dropped = d.ban("parent-p")
+        assert sorted(r.piece.num for r in dropped) == [1, 2]
+        assert d.is_banned("parent-p")
+        d.put(_req("parent-p", 3))
+        assert d.get(timeout=0.05) is None
+
+
+class TestCorruptParentBlacklist:
+    def test_repeat_corrupting_parent_blacklisted_then_task_recovers(
+            self, tmp_path, origin, small_pieces):
+        """Parent A serves every piece corrupt (seeded plan, matched to
+        A's addr). The child detects the mismatches, blacklists A after
+        the threshold, exhausts the mesh budget, degrades to
+        back-to-source, and STILL finishes md5-exact — the pre-ISSUE-5
+        behavior looped on A until the 120 s task deadline."""
+        content = os.urandom(6 * PIECE + 123)
+        (origin.root_dir / "c.bin").write_bytes(content)
+        scheduler = make_scheduler(tmp_path)
+        recovery = RecoveryStats()
+        peer_a = make_chaos_daemon(scheduler, tmp_path, "peer-a", None)
+        url = origin.url("c.bin")
+        ra = peer_a.download_file(url)
+        assert ra.success, ra.error
+        peer_b = make_chaos_daemon(
+            scheduler, tmp_path, "peer-b", recovery,
+            piece_retry_limit=4, corrupt_blacklist_threshold=2)
+        try:
+            a_addr = f"127.0.0.1:{peer_a.upload.port}"
+            faultplan.install(FaultPlan(seed=5).add(
+                "piece.body", FaultKind.CORRUPT, every_nth=1,
+                match=a_addr))
+            begin = time.monotonic()
+            rb = peer_b.download_file(url)
+            wall = time.monotonic() - begin
+            assert rb.success, rb.error
+            assert hashlib.md5(rb.read_all()).hexdigest() == \
+                hashlib.md5(content).hexdigest()
+            assert recovery.get("md5_mismatch_pieces") >= 2
+            assert recovery.get("parents_blacklisted") == 1
+            assert wall < 20.0  # nowhere near the task deadline
+        finally:
+            faultplan.uninstall()
+            peer_b.stop()
+            peer_a.stop()
+
+
+# ----------------------------------------------------------------------
+# Scheduler flap → bounded-grace back-to-source
+# ----------------------------------------------------------------------
+
+
+class _SilentScheduler:
+    """Accepts registration and lifecycle events, then never schedules —
+    the 'scheduler process wedged mid-task' mode."""
+
+    def __init__(self):
+        self.events = []
+
+    def register_peer(self, req, channel=None):
+        self.events.append("register")
+        return RegisterPeerResponse(size_scope=SizeScope.NORMAL)
+
+    def __getattr__(self, name):
+        def method(*a, **k):
+            self.events.append(name)
+            return None
+        return method
+
+
+class TestSchedulerGrace:
+    def test_silent_scheduler_degrades_within_grace(
+            self, tmp_path, origin, small_pieces):
+        content = os.urandom(4 * PIECE + 7)
+        (origin.root_dir / "g.bin").write_bytes(content)
+        recovery = RecoveryStats()
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "silent"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            _SilentScheduler(), storage,
+            host_id="h", task_id="g" * 32, peer_id="peer-silent",
+            url=origin.url("g.bin"),
+            options=chaos_options(scheduler_grace=0.3),
+            recovery_stats=recovery,
+        )
+        begin = time.monotonic()
+        result = conductor.run()
+        wall = time.monotonic() - begin
+        assert result.success, result.error
+        assert result.read_all() == content
+        assert recovery.get("scheduler_degraded_to_source") == 1
+        # Bounded grace, not the 30 s task deadline (let alone 120 s).
+        assert wall < 10.0
+
+    def test_failing_rpcs_open_the_grace_window(self, tmp_path):
+        import threading
+
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "w"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            _SilentScheduler(), storage,
+            host_id="h", task_id="w" * 32, peer_id="p",
+            url="http://unused/",
+            options=chaos_options(scheduler_grace=0.05),
+        )
+        conductor._started_at = time.monotonic()
+        # A live syncer disables the silent-scheduler rule so this test
+        # isolates the failing-RPC window.
+        conductor._syncers["parent"] = threading.current_thread()
+        conductor._note_scheduler(False)
+        time.sleep(0.12)
+        assert conductor._scheduler_stalled()
+        # Recovery of the scheduler OR fresh progress closes the window.
+        conductor._note_scheduler(True)
+        assert not conductor._scheduler_stalled()
+        conductor._note_scheduler(False)
+        conductor._touch_progress()
+        assert not conductor._scheduler_stalled()
+
+
+# ----------------------------------------------------------------------
+# Report batcher: retry ladder, bounded pending queue, counted drops
+# ----------------------------------------------------------------------
+
+
+class _FlakyScheduler:
+    def __init__(self, fail_first: int):
+        self.fail_first = fail_first
+        self.batches = []
+
+    def download_pieces_finished(self, reports):
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            raise ConnectionError("scheduler flap")
+        self.batches.append(list(reports))
+
+
+def _reports(lo, hi):
+    return [PieceFinished(peer_id="p", piece_number=i)
+            for i in range(lo, hi)]
+
+
+class TestBatcherRetryQueue:
+    def kwargs(self, recovery):
+        from dragonfly2_tpu.client.dataplane import DataPlaneStats
+
+        return dict(flush_deadline=0, stats=DataPlaneStats(),
+                    retry_base=0.001, retry_cap=0.002, recovery=recovery)
+
+    def test_failed_flush_parks_then_redelivers_exactly_once(self):
+        recovery = RecoveryStats()
+        sched = _FlakyScheduler(fail_first=2)  # first flush: both attempts
+        b = PieceReportBatcher(sched, flush_count=4, retry_limit=1,
+                               **self.kwargs(recovery))
+        for r in _reports(0, 4):
+            b.report(r)          # flush fails twice → parks
+        assert sched.batches == []
+        assert recovery.get("report_flush_retries") == 2
+        for r in _reports(4, 8):
+            b.report(r)          # next flush: pending + new, delivered
+        delivered = [p.piece_number for batch in sched.batches
+                     for p in batch]
+        assert sorted(delivered) == list(range(8))
+        assert len(delivered) == len(set(delivered))
+        # Only the 4 PARKED reports count as redelivered — the 4 new
+        # ones landed on their first attempt.
+        assert recovery.get("report_flush_redelivered") == 4
+        assert recovery.get("report_flush_dropped") == 0
+        b.close()
+
+    def test_pending_overflow_drops_oldest_and_counts(self):
+        recovery = RecoveryStats()
+        sched = _FlakyScheduler(fail_first=10 ** 6)
+        b = PieceReportBatcher(sched, flush_count=4, retry_limit=0,
+                               pending_cap=6, **self.kwargs(recovery))
+        for r in _reports(0, 12):   # three failed flushes of 4
+            b.report(r)
+        # 12 buffered into a 6-cap queue → 6 dropped, 6 still pending.
+        assert recovery.get("report_flush_dropped") == 6
+
+    def test_close_gives_up_and_counts_drops(self):
+        recovery = RecoveryStats()
+        sched = _FlakyScheduler(fail_first=10 ** 6)
+        b = PieceReportBatcher(sched, flush_count=100, retry_limit=1,
+                               **self.kwargs(recovery))
+        for r in _reports(0, 5):
+            b.report(r)
+        b.close()
+        assert recovery.get("report_flush_dropped") == 5
+
+
+# ----------------------------------------------------------------------
+# ENOSPC fails fast
+# ----------------------------------------------------------------------
+
+
+class TestEnospcFailFast:
+    def test_back_to_source_disk_full_fails_task_fast(
+            self, tmp_path, origin, small_pieces):
+        content = os.urandom(8 * PIECE)
+        (origin.root_dir / "e.bin").write_bytes(content)
+        recovery = RecoveryStats()
+        faultplan.install(FaultPlan(seed=1).add(
+            "storage.write", FaultKind.ENOSPC, every_nth=1))
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "full"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            _SilentScheduler(), storage,
+            host_id="h", task_id="e" * 32, peer_id="peer-full",
+            url=origin.url("e.bin"),
+            options=chaos_options(source_retry_limit=5),
+            recovery_stats=recovery,
+        )
+        begin = time.monotonic()
+        result = conductor._run_back_to_source(report=False)
+        wall = time.monotonic() - begin
+        assert not result.success
+        assert "ENOSPC" in result.error
+        assert recovery.get("enospc_fail_fast") >= 1
+        # Fail-fast: no source_retry budget burned on a full disk.
+        assert recovery.get("source_run_retries") == 0
+        assert wall < 5.0
+
+    def test_downloader_marks_enospc_fatal(self):
+        import errno
+
+        from dragonfly2_tpu.client.downloader import DownloadPieceError
+
+        err = DownloadPieceError("x", fatal=True)
+        assert err.fatal
+        assert not DownloadPieceError("x").fatal
+        assert errno.ENOSPC  # the classification key exists
+
+
+# ----------------------------------------------------------------------
+# Metadata-sync budget with jittered backoff
+# ----------------------------------------------------------------------
+
+
+class TestMetadataSyncBudget:
+    def test_dead_parent_gives_up_after_budget(self, tmp_path):
+        from dragonfly2_tpu.client.peer_task import ParentInfo
+
+        recovery = RecoveryStats()
+        sched = _SilentScheduler()
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "m"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            sched, storage,
+            host_id="h", task_id="m" * 32, peer_id="p",
+            url="http://unused/",
+            options=chaos_options(metadata_retry_limit=2,
+                                  metadata_timeout=0.2,
+                                  metadata_poll_interval=0.01),
+            recovery_stats=recovery,
+        )
+        begin = time.monotonic()
+        # Nothing listens on port 9: every poll fails fast.
+        conductor._sync_parent(ParentInfo("dead-parent", "127.0.0.1:9"))
+        wall = time.monotonic() - begin
+        assert recovery.get("metadata_retries") == 2
+        assert recovery.get("metadata_sync_giveups") == 1
+        # The give-up told the scheduler the parent is bad (retried form).
+        assert "download_piece_failed" in sched.events
+        assert wall < 5.0
+
+    def test_banned_parent_sync_exits_immediately(self, tmp_path):
+        from dragonfly2_tpu.client.peer_task import ParentInfo
+
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "b"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            _SilentScheduler(), storage,
+            host_id="h", task_id="b" * 32, peer_id="p",
+            url="http://unused/", options=chaos_options(),
+        )
+        conductor._banned_parents.add("bad-parent")
+        begin = time.monotonic()
+        conductor._sync_parent(ParentInfo("bad-parent", "127.0.0.1:9"))
+        assert time.monotonic() - begin < 0.5
+
+
+# ----------------------------------------------------------------------
+# The ladder itself (slow tier)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosLadderE2E:
+    def test_one_percent_rung_ends_md5_correct(self):
+        from dragonfly2_tpu.client.chaosbench import run_chaos_ladder
+
+        out = run_chaos_ladder(rates=(0.0, 0.01), tasks=2,
+                               size_bytes=1 << 20, seed=3)
+        for rate, rung in out["ladder"].items():
+            assert rung["success_rate"] == 1.0, (rate, rung["failures"])
+        assert out["all_rungs_full_success"]
+        assert "goodput_retention_at_max" in out
+        assert "recovery_p99_ms" in out["ladder"]["0.01"]

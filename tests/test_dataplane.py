@@ -68,7 +68,8 @@ def scoped_http_stats():
 
 
 def back_to_source(tmp_path, url, *, stats, coalesce_run, workers=2,
-                   shaper=None, metrics=None, name="run"):
+                   shaper=None, metrics=None, name="run",
+                   source_retries=0):
     storage = StorageManager(StorageOptions(
         root=str(tmp_path / f"storage-{name}"), keep_storage=False))
     conductor = PeerTaskConductor(
@@ -76,8 +77,12 @@ def back_to_source(tmp_path, url, *, stats, coalesce_run, workers=2,
         host_id="h", task_id=f"dataplane-{name}-{'0' * 24}",
         peer_id=f"peer-{name}", url=url,
         shaper=shaper, metrics=metrics,
+        # source_retry_limit=0 by default: these tests assert exact
+        # request/connection counters, which budgeted run retries
+        # (ISSUE 5) would legitimately inflate.
         options=PeerTaskOptions(back_source_concurrency=workers,
-                                coalesce_run=coalesce_run),
+                                coalesce_run=coalesce_run,
+                                source_retry_limit=source_retries),
         dataplane_stats=stats,
     )
     result = conductor._run_back_to_source(report=False)
@@ -395,20 +400,26 @@ class TestPieceReportBatcher:
         assert stats.snapshot()["report_rpcs_saved"] == 0
 
     def test_scheduler_error_never_duplicates(self):
+        from dragonfly2_tpu.client.recovery import RecoveryStats
+
         sched = _RecordingScheduler(fail_batches=1)
         stats = DataPlaneStats()
+        recovery = RecoveryStats()
         b = PieceReportBatcher(sched, flush_count=4, flush_deadline=0,
-                               stats=stats)
+                               stats=stats, retry_base=0.001,
+                               retry_cap=0.002, recovery=recovery)
         for r in _reports(12):
             b.report(r)
         b.close()
-        # First batch lost to the scheduler error (best-effort semantics,
-        # same as the old per-piece try/except) — but NOTHING delivered
-        # twice, and the later batches all landed. Only the SUCCESSFUL
-        # flushes count as saved RPCs.
-        assert sorted(sched.delivered) == list(range(4, 12))
+        # The first flush fails once and is REDELIVERED on its retry
+        # (ISSUE 5: flush failures retry with backoff instead of being
+        # silently dropped) — every report lands exactly once.
+        assert sorted(sched.delivered) == list(range(12))
         assert len(sched.delivered) == len(set(sched.delivered))
-        assert stats.snapshot()["report_batches"] == 2
+        assert stats.snapshot()["report_batches"] == 3
+        assert recovery.get("report_flush_retries") == 1
+        assert recovery.get("report_flush_redelivered") == 4
+        assert recovery.get("report_flush_dropped") == 0
 
     def test_scheduler_service_batched_form(self, tmp_path):
         """SchedulerService.download_pieces_finished stores every piece

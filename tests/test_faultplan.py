@@ -1,0 +1,191 @@
+"""Deterministic fault-injection plane (utils/faultplan.py).
+
+The tier-1 contract: a seeded FaultPlan produces a BIT-IDENTICAL fault
+sequence for a fixed visit order, every rule form (every-Nth,
+probability, time-window, match, max_fires) behaves, and the
+application helpers produce the real failure shapes the recovery code
+keys on.
+"""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils.faultplan import (
+    BodyFilter,
+    FaultKind,
+    FaultPlan,
+    FaultRule,
+    RpcFaultProxy,
+)
+
+
+@pytest.fixture(autouse=True)
+def no_active_plan():
+    yield
+    faultplan.uninstall()
+
+
+def drive(plan: FaultPlan, visits):
+    """Run a fixed (site, context) visit sequence; return the history."""
+    for site, context in visits:
+        plan.check(site, context)
+    return list(plan.history)
+
+
+class TestDeterminism:
+    VISITS = ([("piece.body", "10.0.0.1:80")] * 40
+              + [("pool.connect", "10.0.0.2:81")] * 25
+              + [("piece.body", "10.0.0.1:80"),
+                 ("scheduler.rpc", "register_peer")] * 30)
+
+    def build(self):
+        return (FaultPlan(seed=1234)
+                .add("piece.body", FaultKind.CORRUPT, probability=0.2)
+                .add("piece.body", FaultKind.RESET, probability=0.1)
+                .add("pool.connect", FaultKind.CONNECT_REFUSED,
+                     probability=0.3)
+                .add("scheduler.rpc", FaultKind.UNAVAILABLE,
+                     probability=0.25))
+
+    def test_bit_identical_sequence_across_runs(self):
+        h1 = drive(self.build(), self.VISITS)
+        h2 = drive(self.build(), self.VISITS)
+        assert h1, "plan with these rates must fire at least once"
+        assert h1 == h2
+
+    def test_different_seed_different_sequence(self):
+        h1 = drive(self.build(), self.VISITS)
+        plan2 = FaultPlan(seed=99)
+        for site, kind, p in (("piece.body", FaultKind.CORRUPT, 0.2),
+                              ("piece.body", FaultKind.RESET, 0.1),
+                              ("pool.connect", FaultKind.CONNECT_REFUSED,
+                               0.3),
+                              ("scheduler.rpc", FaultKind.UNAVAILABLE,
+                               0.25)):
+            plan2.add(site, kind, probability=p)
+        assert h1 != drive(plan2, self.VISITS)
+
+    def test_sites_do_not_perturb_each_other(self):
+        """A site's fault positions stay identical whether or not OTHER
+        sites are visited in between — each site owns its RNG."""
+        solo = [(s, v) for s, v in self.VISITS if s == "piece.body"]
+        h_interleaved = [h for h in drive(self.build(), self.VISITS)
+                         if h[0] == "piece.body"]
+        h_solo = [h for h in drive(self.build(), solo)
+                  if h[0] == "piece.body"]
+        assert h_interleaved == h_solo
+
+
+class TestRules:
+    def test_every_nth(self):
+        plan = FaultPlan().add("s", FaultKind.RESET, every_nth=3)
+        fired = [plan.check("s") is not None for _ in range(9)]
+        assert fired == [False, False, True] * 3
+
+    def test_time_window(self):
+        clock = [0.0]
+        plan = FaultPlan(clock=lambda: clock[0])
+        plan.add("s", FaultKind.RESET, every_nth=1, after=5.0, until=10.0)
+        assert plan.check("s") is None          # t=0, before window
+        clock[0] = 7.0
+        assert plan.check("s") is not None      # inside window
+        clock[0] = 11.0
+        assert plan.check("s") is None          # past window
+
+    def test_max_fires(self):
+        plan = FaultPlan().add("s", FaultKind.RESET, every_nth=1,
+                               max_fires=2)
+        fires = sum(plan.check("s") is not None for _ in range(10))
+        assert fires == 2
+
+    def test_match_filters_by_context(self):
+        plan = FaultPlan().add("s", FaultKind.CORRUPT, every_nth=1,
+                               match="10.0.0.9")
+        assert plan.check("s", context="10.0.0.1:80") is None
+        assert plan.check("s", context="10.0.0.9:80") is not None
+
+    def test_snapshot_counts(self):
+        plan = FaultPlan().add("s", FaultKind.RESET, every_nth=2)
+        for _ in range(4):
+            plan.check("s")
+        snap = plan.snapshot()
+        assert snap["s"]["visits"] == 4
+        assert snap["s"]["fires"] == {"reset": 2}
+        assert snap["s"]["total_fires"] == 2
+
+
+class TestHelpers:
+    def test_no_plan_installed_is_inert(self):
+        assert faultplan.ACTIVE is None
+
+    def test_install_uninstall(self):
+        plan = faultplan.install(FaultPlan())
+        assert faultplan.ACTIVE is plan
+        faultplan.uninstall()
+        assert faultplan.ACTIVE is None
+
+    def test_raise_connect(self):
+        rule = FaultRule(FaultKind.CONNECT_REFUSED)
+        with pytest.raises(ConnectionRefusedError):
+            faultplan.raise_connect(rule, "pool.connect", "h:1")
+
+    def test_body_filter_corrupt_flips_one_byte(self):
+        flt = BodyFilter(FaultRule(FaultKind.CORRUPT))
+        out = flt(b"\x00" * 8)
+        assert out != b"\x00" * 8 and len(out) == 8
+        assert flt(b"\x00" * 8) == b"\x00" * 8  # applied once
+
+    def test_body_filter_reset_raises(self):
+        flt = BodyFilter(FaultRule(FaultKind.RESET))
+        with pytest.raises(ConnectionResetError):
+            flt(b"data")
+
+    def test_body_filter_truncate_ends_stream(self):
+        flt = BodyFilter(FaultRule(FaultKind.TRUNCATE))
+        first = flt(b"x" * 100)
+        assert 0 < len(first) < 100
+        assert flt(b"more") == b""  # stream over
+
+    def test_faulting_body_wraps_reads(self):
+        body = faultplan.FaultingBody(io.BytesIO(b"y" * 64),
+                                      FaultRule(FaultKind.TRUNCATE))
+        data = body.read(64)
+        assert 0 < len(data) < 64
+        assert body.read(64) == b""
+        body.close()
+
+    def test_rpc_proxy_raises_service_error(self):
+        from dragonfly2_tpu.scheduler.service import ServiceError
+
+        class Target:
+            def ping(self):
+                return "pong"
+
+        proxy = RpcFaultProxy(Target())
+        assert proxy.ping() == "pong"  # no plan → passthrough
+        faultplan.install(
+            FaultPlan().add("scheduler.rpc", FaultKind.UNAVAILABLE,
+                            every_nth=1))
+        with pytest.raises(ServiceError) as err:
+            proxy.ping()
+        assert err.value.code == "Unavailable"
+
+    def test_rpc_proxy_deadline(self):
+        from dragonfly2_tpu.scheduler.service import ServiceError
+
+        class Target:
+            def ping(self):
+                return "pong"
+
+        faultplan.install(
+            FaultPlan().add("scheduler.rpc", FaultKind.DEADLINE,
+                            every_nth=2))
+        proxy = RpcFaultProxy(Target())
+        assert proxy.ping() == "pong"
+        with pytest.raises(ServiceError) as err:
+            proxy.ping()
+        assert err.value.code == "DeadlineExceeded"

@@ -1,0 +1,184 @@
+"""Health-service wiring (ISSUE 5 satellite).
+
+The DF2 HealthService (rpc/health.py) is no longer an orphan: every
+``serve()`` shell exposes its instance and drains through NOT_SERVING on
+stop, the inference sidecar flips NOT_SERVING for the hot-reload grace
+window, and ``BalancedSchedulerClient`` deprioritizes targets that
+report NOT_SERVING.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.rpc.health import (
+    NOT_SERVING,
+    SERVING,
+    HealthCheckRequest,
+    HealthService,
+)
+
+
+class TestServerHealth:
+    def test_serve_exposes_health_and_stop_drains(self):
+        from dragonfly2_tpu.rpc.client import ServiceClient
+        from dragonfly2_tpu.rpc.codec import message  # noqa: F401
+        from dragonfly2_tpu.rpc.health import HEALTH_SPEC
+        from dragonfly2_tpu.rpc.service import serve
+
+        server = serve([])
+        assert server.health is not None
+        cli = ServiceClient(server.target, HEALTH_SPEC, retries=0)
+        try:
+            reply = cli.Check(HealthCheckRequest(service=""), timeout=5)
+            assert reply.status == SERVING
+        finally:
+            cli.close()
+        server.stop()
+        # stop() flipped the shared instance before the listener died.
+        assert server.health.Check(
+            HealthCheckRequest(service=""), None).status == NOT_SERVING
+
+    def test_hosted_service_marked_serving(self):
+        from dragonfly2_tpu.inference.sidecar import (
+            INFERENCE_SPEC,
+            InferenceService,
+        )
+        from dragonfly2_tpu.rpc.service import serve
+
+        server = serve([(INFERENCE_SPEC, InferenceService(
+            micro_batch=False))])
+        try:
+            assert server.health.Check(
+                HealthCheckRequest(service=INFERENCE_SPEC.name),
+                None).status == SERVING
+        finally:
+            server.stop()
+
+
+class _SumScorer:
+    max_batch = 64
+
+    def score(self, features):
+        return np.asarray(features).sum(axis=1)
+
+
+class TestSidecarGraceWindow:
+    def test_hot_reload_flips_not_serving_for_the_grace(self):
+        from dragonfly2_tpu.inference.sidecar import InferenceService
+
+        service = InferenceService(micro_batch=True, reload_grace_s=0.15)
+        health = HealthService()
+        service.set_health(health)
+
+        def status():
+            return health.Check(HealthCheckRequest(service=""),
+                                None).status
+
+        service.install_scorer("mlp", _SumScorer())
+        assert status() == SERVING  # first install: nothing to drain
+        service.install_scorer("mlp", _SumScorer(), version="v2")
+        assert status() == NOT_SERVING  # grace window open
+        deadline = time.monotonic() + 5
+        while status() != SERVING and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert status() == SERVING  # window closed, back in rotation
+        service.stop()
+        assert status() == NOT_SERVING
+
+    def test_stop_during_grace_stays_not_serving(self):
+        from dragonfly2_tpu.inference.sidecar import InferenceService
+
+        service = InferenceService(micro_batch=True, reload_grace_s=30.0)
+        health = HealthService()
+        service.set_health(health)
+        service.install_scorer("mlp", _SumScorer())
+        service.install_scorer("mlp", _SumScorer(), version="v2")
+        service.stop()
+        assert health.Check(HealthCheckRequest(service=""),
+                            None).status == NOT_SERVING
+
+
+class _StubSchedulerClient:
+    """Capture which target served register_peer."""
+
+    registered = []
+
+    def __init__(self, target):
+        self.target = target
+
+    def register_peer(self, req, channel=None):
+        from dragonfly2_tpu.scheduler.resource.task import SizeScope
+        from dragonfly2_tpu.scheduler.service import RegisterPeerResponse
+
+        _StubSchedulerClient.registered.append(self.target)
+        return RegisterPeerResponse(size_scope=SizeScope.NORMAL)
+
+    def close(self):
+        pass
+
+
+class TestBalancedClientHealthSkip:
+    @pytest.fixture(autouse=True)
+    def clear(self):
+        _StubSchedulerClient.registered = []
+        yield
+
+    def make(self, statuses):
+        from dragonfly2_tpu.scheduler.rpcserver import (
+            BalancedSchedulerClient,
+        )
+
+        return BalancedSchedulerClient(
+            list(statuses), client_factory=_StubSchedulerClient,
+            health_probe=lambda target: statuses[target])
+
+    def test_not_serving_target_deprioritized(self):
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        statuses = {"sched-1:1": SERVING, "sched-2:2": SERVING,
+                    "sched-3:3": SERVING}
+        cli = self.make(statuses)
+        req = RegisterPeerRequest(host_id="h", task_id="t" * 32,
+                                  peer_id="p1", url="http://x/")
+        owner = next(iter(cli.ring.walk("t" * 32)))
+        cli.register_peer(req)
+        assert _StubSchedulerClient.registered == [owner]
+        # The ring owner goes NOT_SERVING: the next registration (fresh
+        # cache) must land on a SERVING replica instead.
+        statuses[owner] = NOT_SERVING
+        cli._health_cache.clear()
+        cli.register_peer(RegisterPeerRequest(
+            host_id="h", task_id="t" * 32, peer_id="p2", url="http://x/"))
+        assert _StubSchedulerClient.registered[-1] != owner
+        assert statuses[_StubSchedulerClient.registered[-1]] == SERVING
+
+    def test_all_not_serving_still_best_effort(self):
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        statuses = {"sched-1:1": NOT_SERVING, "sched-2:2": NOT_SERVING}
+        cli = self.make(statuses)
+        cli.register_peer(RegisterPeerRequest(
+            host_id="h", task_id="t" * 32, peer_id="p", url="http://x/"))
+        # Every target drained → the walk still tried one (no instant
+        # "no schedulers" outage).
+        assert len(_StubSchedulerClient.registered) == 1
+
+    def test_probe_error_means_usable(self):
+        from dragonfly2_tpu.scheduler.rpcserver import (
+            BalancedSchedulerClient,
+        )
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        def broken_probe(target):
+            raise ConnectionError("no health service there")
+
+        cli = BalancedSchedulerClient(
+            ["sched-1:1"], client_factory=_StubSchedulerClient,
+            health_probe=broken_probe)
+        cli.register_peer(RegisterPeerRequest(
+            host_id="h", task_id="t" * 32, peer_id="p", url="http://x/"))
+        assert _StubSchedulerClient.registered == ["sched-1:1"]
