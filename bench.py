@@ -157,14 +157,46 @@ class BenchState:
 
 # --------------------------------------------------------------------------
 # Worker: runs the actual staged benchmark on one platform.
+#
+# Stages live in ONE registry (STAGES, populated by @stage below), not a
+# hand-maintained if/elif chain: the runner iterates the registry in
+# declaration order, applies each stage's budget guard, and wraps
+# optional stages' failures into <name>_error extras — so a new stage
+# cannot be silently dropped from the ladder, and `bench.py <stage>`
+# can run any single stage by name.
 # --------------------------------------------------------------------------
 
-def run_stages(state: BenchState, platform: str, budget: float) -> None:
-    t_start = time.perf_counter()
+STAGES: list = []
 
-    def left() -> float:
-        return budget - (time.perf_counter() - t_start)
 
+class _Stage:
+    __slots__ = ("name", "min_left", "required", "needs_device", "fn")
+
+    def __init__(self, name, min_left, required, needs_device, fn):
+        self.name = name
+        self.min_left = min_left
+        self.required = required
+        self.needs_device = needs_device
+        self.fn = fn
+
+
+def stage(name: str, *, min_left: float = 0.0, required: bool = False,
+          needs_device: bool = False):
+    """Register a bench stage. ``min_left`` skips the stage when less
+    wall budget remains; ``required`` propagates its failures (headline
+    stages) instead of recording <name>_error; ``needs_device`` makes
+    single-stage runs execute the init stage first."""
+
+    def deco(fn):
+        STAGES.append(_Stage(name, min_left, required, needs_device, fn))
+        return fn
+
+    return deco
+
+
+@stage("init", required=True)
+def stage_init(state: BenchState, ctx: dict) -> None:
+    platform = ctx["platform"]
     if platform != "tpu":
         # Must happen before ANY backend use; the env var alone is
         # overridden by this machine's sitecustomize.
@@ -178,15 +210,18 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
 
     import jax
 
-    from dragonfly2_tpu.data import SyntheticCluster
     from dragonfly2_tpu.parallel import data_parallel_mesh
-    from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
 
     mesh = data_parallel_mesh()
+    ctx["mesh"] = mesh
     state.record(platform=jax.devices()[0].platform, n_devices=mesh.n_data)
     state.stage_done("init")
 
-    # Stage 1: parent-selection latency FIRST — it is weight-independent
+
+@stage("scorer", required=True, needs_device=True)
+def stage_scorer(state: BenchState, ctx: dict) -> None:
+    left = ctx["left"]
+    # Parent-selection latency FIRST — it is weight-independent
     # (a synthetically initialized MLP exercises the same compiled
     # dispatch path a trained one would), so the <1 ms target gets
     # validated before the GNN stage can starve it. Two measurements:
@@ -198,6 +233,7 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
     # jit round trip: the tunneled axon TPU pays a network RTT per call
     # — observed ~68 ms — so raw and floor-corrected are published side
     # by side, clearly labeled).
+    import jax
     import jax.numpy as jnp
 
     from dragonfly2_tpu.inference import ParentScorer
@@ -311,11 +347,21 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
         for k, v in load_ladder.items()})
     state.stage_done("scorer")
 
-    # Stage 2 (headline): GraphSAGE on a probe graph. The step loop gets
-    # the remaining budget minus reserves for eval + emit, and publishes
-    # throughput incrementally so a watchdog fire always has the latest
-    # steady-state rate. CPU insurance shrinks the problem so every
-    # stage COMPLETES — a small honest number beats a kill mid-compile.
+
+@stage("gnn", required=True, needs_device=True)
+def stage_gnn(state: BenchState, ctx: dict) -> None:
+    """Headline: GraphSAGE on a probe graph. The step loop gets the
+    remaining budget minus reserves for eval + emit, and publishes
+    throughput incrementally so a watchdog fire always has the latest
+    steady-state rate. CPU insurance shrinks the problem so every stage
+    COMPLETES — a small honest number beats a kill mid-compile."""
+    left = ctx["left"]
+    platform = ctx["platform"]
+    mesh = ctx["mesh"]
+
+    from dragonfly2_tpu.data import SyntheticCluster
+    from dragonfly2_tpu.train import GNNTrainConfig, train_gnn
+
     if platform == "tpu":
         # (8192, 16) won the round-4 on-chip grid (artifacts/
         # tune_gnn_r4.json: 351k vs 275k at k=8 in matched windows) —
@@ -323,7 +369,7 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
         n_edges, batch, steps_per_call = 2_000_000, 8192, 16
     else:
         n_edges, batch, steps_per_call = 200_000, 2048, 1
-    cluster = SyntheticCluster(n_hosts=2000, seed=0)
+    cluster = ctx["cluster"] = SyntheticCluster(n_hosts=2000, seed=0)
     graph = cluster.probe_graph(n_edges)
     state.stamp("graph_built")
 
@@ -365,159 +411,242 @@ def run_stages(state: BenchState, platform: str, budget: float) -> None:
     )
     state.stage_done("gnn")
 
-    # Stage 3 (only if budget allows): MLP training throughput + honest
-    # registry mae from a really-trained model.
-    if left() > 45.0:
-        from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
 
-        X, y = cluster.pair_example_columns(300_000)
-        mlp = train_mlp(
-            X, y,
-            MLPTrainConfig(epochs=100, batch_size=16384,
-                           max_seconds=max(min(left() - 25.0, 25.0), 2.0),
-                           progress_callback=lambda s, r: state.record(
-                               mlp_train_samples_per_sec_per_chip=int(
-                                   r / mesh.n_data)),
-                           compile_callback=lambda c: state.record(
-                               mlp_compile_seconds=round(c, 1))),
-            mesh,
-        )
+@stage("mlp", min_left=45.0, required=True, needs_device=True)
+def stage_mlp(state: BenchState, ctx: dict) -> None:
+    """MLP training throughput + honest registry mae from a
+    really-trained model (budget-gated)."""
+    left = ctx["left"]
+    mesh = ctx["mesh"]
+
+    from dragonfly2_tpu.train import MLPTrainConfig, train_mlp
+
+    cluster = ctx.get("cluster")
+    if cluster is None:
+        from dragonfly2_tpu.data import SyntheticCluster
+
+        cluster = ctx["cluster"] = SyntheticCluster(n_hosts=2000, seed=0)
+    X, y = cluster.pair_example_columns(300_000)
+    mlp = train_mlp(
+        X, y,
+        MLPTrainConfig(epochs=100, batch_size=16384,
+                       max_seconds=max(min(left() - 25.0, 25.0), 2.0),
+                       progress_callback=lambda s, r: state.record(
+                           mlp_train_samples_per_sec_per_chip=int(
+                               r / mesh.n_data)),
+                       compile_callback=lambda c: state.record(
+                           mlp_compile_seconds=round(c, 1))),
+        mesh,
+    )
+    state.record(
+        mlp_train_samples_per_sec_per_chip=int(
+            mlp.samples_per_sec / mesh.n_data),
+        mlp_eval_mae_mbps=round(mlp.mae, 3),
+    )
+    state.stage_done("mlp")
+
+
+@stage("dataplane", min_left=12.0)
+def stage_dataplane(state: BenchState, ctx: dict) -> None:
+    """Data plane — loopback back-to-source throughput with the PR-3
+    amortization counters (range coalescing, keep-alive pools, batched
+    reports). Pure CPU + loopback, a few seconds; the run=1 rung is the
+    one-GET-per-piece baseline the coalesced rung is measured against.
+    MB/s is informational — the counters are the asserted contract
+    (tests/test_dataplane.py)."""
+    from dragonfly2_tpu.client.dataplane import run_loopback_bench
+
+    ladder = {}
+    for run in (1, 8):
+        ladder[run] = run_loopback_bench(
+            64 << 20, coalesce_run=run, workers=4)
+    best = ladder[8]
+    state.record(
+        dataplane_loopback_mb_per_s=best["mb_per_s"],
+        dataplane_pieces=best["pieces"],
+        dataplane_requests_saved=best["requests_saved"],
+        dataplane_connections_opened=best["connections_opened"],
+        dataplane_connections_reused=best["connections_reused"],
+        dataplane_coalesce_run_p50=best["coalesce_run_p50"],
+        dataplane_report_rpcs_saved=best["report_rpcs_saved"],
+        dataplane_ladder={
+            str(run): {k: v[k] for k in (
+                "mb_per_s", "seconds", "source_requests",
+                "source_pieces", "requests_saved",
+                "connections_opened", "connections_reused",
+                "server_connections", "server_requests",
+                "coalesce_run_p50")}
+            for run, v in ladder.items()},
+    )
+    state.stage_done("dataplane")
+
+
+@stage("scheduler", min_left=15.0)
+def stage_scheduler(state: BenchState, ctx: dict) -> None:
+    """Scheduler control plane — in-process swarm load ladder against
+    the real SchedulerService (sharded managers + incremental GC + O(1)
+    peer statistics). Pure CPU, no device. Reports announce→first-
+    decision p50/p99, decisions/sec, piece-reports/sec and GC pause p99
+    per swarm size; the documented bound (docs/SCHEDULER.md) is
+    largest-rung decision p99 within LADDER_P99_BOUND× of the smallest
+    rung."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.scheduler.loadbench import run_swarm_ladder
+
+    sizes = (100, 1000, 5000) if left() > 30.0 else (100, 500, 1500)
+    sched = run_swarm_ladder(sizes, workers=8)
+    ladder = sched["ladder"]
+    largest = ladder[str(sizes[-1])]
+    state.record(
+        scheduler_swarm_sizes=list(sizes),
+        scheduler_announce_p50_ms=largest["announce_p50_ms"],
+        scheduler_announce_p99_ms=largest["announce_p99_ms"],
+        scheduler_decisions_per_sec=largest["decisions_per_sec"],
+        scheduler_piece_reports_per_sec=largest[
+            "piece_reports_per_sec"],
+        scheduler_gc_pause_p99_ms=largest["gc_pause_p99_ms"],
+        scheduler_gc_budget_overruns=largest["gc_budget_overruns"],
+        scheduler_bad_node_fast=largest["bad_node_fast"],
+        scheduler_bad_node_slow=largest["bad_node_slow"],
+        scheduler_decision_p99_ratio=sched["decision_p99_ratio"],
+        scheduler_ladder_p99_bound=sched["ladder_p99_bound"],
+        scheduler_p99_within_bound=sched["p99_within_bound"],
+        scheduler_ladder={
+            size: {k: v[k] for k in (
+                "seconds", "announce_p50_ms", "announce_p99_ms",
+                "decisions", "decisions_per_sec", "piece_reports",
+                "piece_reports_per_sec", "back_to_source",
+                "filter_ms_p99", "evaluate_ms_p99", "gc_ticks",
+                "gc_pause_p50_ms", "gc_pause_p99_ms",
+                "gc_budget_overruns", "gc_reclaimed", "tasks",
+                "workers", "errors")}
+            for size, v in ladder.items()},
+    )
+    state.stage_done("scheduler")
+
+
+@stage("chaos", min_left=15.0)
+def stage_chaos(state: BenchState, ctx: dict) -> None:
+    """Chaos — deterministic fault-injection ladder over the loopback
+    swarm (scheduler + two peers + origin, client/chaosbench.py), plus
+    the ISSUE-6 scheduler-kill rung: three scheduler replica PROCESSES,
+    one hard-killed mid-swarm by the seeded ``scheduler.process`` site.
+    Ladder bound (docs/CHAOS.md): 100% task success at every rung and
+    ≥70% goodput retention at the 5% rung. Kill-rung bound: 100% task
+    success, p99 re-route ≤ scheduler_grace, 0 tasks degraded to
+    back-to-source while ≥1 replica survives. The combined verdict
+    lands in the bench JSON, and a passing run persists into
+    artifacts/bench_state/ like the TPU runs do."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.client.chaosbench import (
+        run_chaos_ladder,
+        run_scheduler_kill_rung,
+    )
+
+    chaos = run_chaos_ladder(seed=0)
+    top = chaos["ladder"][str(max(chaos["rates"]))]
+    state.record(
+        chaos_rates=chaos["rates"],
+        chaos_success_rate_at_max=top["success_rate"],
+        chaos_goodput_retention_at_max=chaos[
+            "goodput_retention_at_max"],
+        chaos_goodput_retention_bound=chaos[
+            "goodput_retention_bound"],
+        chaos_recovery_p50_ms=top["recovery_p50_ms"],
+        chaos_recovery_p99_ms=top["recovery_p99_ms"],
+        chaos_recovery_events=top["recovery_events"],
+        chaos_all_rungs_full_success=chaos[
+            "all_rungs_full_success"],
+        chaos_ladder={
+            rate: {k: v[k] for k in (
+                "success_rate", "downloads", "mb_per_s",
+                "seconds", "recovery_events", "recovery_p50_ms",
+                "recovery_p99_ms", "download_p99_s")}
+            for rate, v in chaos["ladder"].items()},
+    )
+    kill = None
+    if left() <= 8.0:
+        # A skipped kill rung must never read as a verified pass: the
+        # combined verdict below then covers the LADDER ONLY, and both
+        # the bench JSON and the persisted artifact say so explicitly
+        # (chaos_scheduler_kill_verdict_pass stays absent — a driver
+        # gating on it sees a miss, not a green).
+        state.record(chaos_scheduler_kill_skipped=True)
+    else:
+        kill = run_scheduler_kill_rung(seed=0)
         state.record(
-            mlp_train_samples_per_sec_per_chip=int(
-                mlp.samples_per_sec / mesh.n_data),
-            mlp_eval_mae_mbps=round(mlp.mae, 3),
+            chaos_scheduler_kill_success_rate=kill["success_rate"],
+            chaos_scheduler_kill_reroutes=kill["reroutes"],
+            chaos_scheduler_kill_reroute_p50_ms=kill["reroute_p50_ms"],
+            chaos_scheduler_kill_reroute_p99_ms=kill["reroute_p99_ms"],
+            chaos_scheduler_kill_reroute_bound_s=kill["reroute_bound_s"],
+            chaos_scheduler_kill_failovers=kill["failovers"],
+            chaos_scheduler_kill_pieces_replayed=kill["pieces_replayed"],
+            chaos_scheduler_kill_degraded=kill["degraded_to_source"],
+            chaos_scheduler_kill_verdict_pass=kill["verdict_pass"],
         )
-        state.stage_done("mlp")
-
-    # Stage 4: data plane — loopback back-to-source throughput with the
-    # PR-3 amortization counters (range coalescing, keep-alive pools,
-    # batched reports). Pure CPU + loopback, a few seconds; the run=1
-    # rung is the one-GET-per-piece baseline the coalesced rung is
-    # measured against. MB/s is informational — the counters are the
-    # asserted contract (tests/test_dataplane.py).
-    if left() > 12.0:
+    verdict = bool(chaos["verdict_pass"]
+                   and (kill is None or kill["verdict_pass"]))
+    state.record(chaos_verdict_pass=verdict)
+    state.stage_done("chaos")
+    if verdict:
+        dest = os.path.join(
+            STATE_DIR,
+            f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json")
+        tmp_path_ = dest + ".tmp"
         try:
-            from dragonfly2_tpu.client.dataplane import run_loopback_bench
+            os.makedirs(STATE_DIR, exist_ok=True)
+            with open(tmp_path_, "w") as f:
+                json.dump({"ladder": chaos,
+                           "scheduler_kill": (kill if kill is not None
+                                              else {"skipped": True})}, f)
+            os.replace(tmp_path_, dest)
+        except OSError:
+            pass
 
-            ladder = {}
-            for run in (1, 8):
-                ladder[run] = run_loopback_bench(
-                    64 << 20, coalesce_run=run, workers=4)
-            best = ladder[8]
-            state.record(
-                dataplane_loopback_mb_per_s=best["mb_per_s"],
-                dataplane_pieces=best["pieces"],
-                dataplane_requests_saved=best["requests_saved"],
-                dataplane_connections_opened=best["connections_opened"],
-                dataplane_connections_reused=best["connections_reused"],
-                dataplane_coalesce_run_p50=best["coalesce_run_p50"],
-                dataplane_report_rpcs_saved=best["report_rpcs_saved"],
-                dataplane_ladder={
-                    str(run): {k: v[k] for k in (
-                        "mb_per_s", "seconds", "source_requests",
-                        "source_pieces", "requests_saved",
-                        "connections_opened", "connections_reused",
-                        "server_connections", "server_requests",
-                        "coalesce_run_p50")}
-                    for run, v in ladder.items()},
-            )
-            state.stage_done("dataplane")
-        except Exception as exc:  # noqa: BLE001 — informational stage
-            state.record(dataplane_error=f"{type(exc).__name__}: {exc}")
 
-    # Stage 5: scheduler control plane — in-process swarm load ladder
-    # against the real SchedulerService (sharded managers + incremental
-    # GC + O(1) peer statistics). Pure CPU, no device. Reports
-    # announce→first-decision p50/p99, decisions/sec, piece-reports/sec
-    # and GC pause p99 per swarm size; the documented bound
-    # (docs/SCHEDULER.md) is largest-rung decision p99 within
-    # LADDER_P99_BOUND× of the smallest rung.
-    if left() > 15.0:
+def run_stages(state: BenchState, platform: str, budget: float,
+               only: str | None = None) -> None:
+    """Drive the registry. ``only`` runs a single named stage (plus the
+    init stage when it needs a device) — the `bench.py <stage>` path."""
+    t_start = time.perf_counter()
+
+    def left() -> float:
+        return budget - (time.perf_counter() - t_start)
+
+    ctx: dict = {"platform": platform, "left": left}
+    wanted = None
+    if only is not None:
+        by_name = {s.name: s for s in STAGES}
+        if only not in by_name:
+            raise SystemExit(
+                f"unknown stage {only!r}; stages: {', '.join(by_name)}")
+        wanted = by_name[only]
+    for st in STAGES:
+        if wanted is not None and st is not wanted:
+            if not (st.name == "init" and wanted.needs_device):
+                continue
+        # An explicitly requested stage bypasses its budget gate — a
+        # driver asking for `bench.py chaos` must get the stage (or its
+        # error), never a silent skip that reads as pass.
+        if st.min_left and left() < st.min_left and st is not wanted:
+            continue
+        if st.required and wanted is None:
+            st.fn(state, ctx)  # a required stage failing fails the run
+            continue
+        # Everything else owes the driver the JSON line: record the
+        # failure instead of dying before emit(). A failed required
+        # stage here is single-stage init — skip the device stage it
+        # was feeding.
         try:
-            from dragonfly2_tpu.scheduler.loadbench import run_swarm_ladder
-
-            sizes = (100, 1000, 5000) if left() > 30.0 else (100, 500, 1500)
-            sched = run_swarm_ladder(sizes, workers=8)
-            ladder = sched["ladder"]
-            largest = ladder[str(sizes[-1])]
-            state.record(
-                scheduler_swarm_sizes=list(sizes),
-                scheduler_announce_p50_ms=largest["announce_p50_ms"],
-                scheduler_announce_p99_ms=largest["announce_p99_ms"],
-                scheduler_decisions_per_sec=largest["decisions_per_sec"],
-                scheduler_piece_reports_per_sec=largest[
-                    "piece_reports_per_sec"],
-                scheduler_gc_pause_p99_ms=largest["gc_pause_p99_ms"],
-                scheduler_gc_budget_overruns=largest["gc_budget_overruns"],
-                scheduler_bad_node_fast=largest["bad_node_fast"],
-                scheduler_bad_node_slow=largest["bad_node_slow"],
-                scheduler_decision_p99_ratio=sched["decision_p99_ratio"],
-                scheduler_ladder_p99_bound=sched["ladder_p99_bound"],
-                scheduler_p99_within_bound=sched["p99_within_bound"],
-                scheduler_ladder={
-                    size: {k: v[k] for k in (
-                        "seconds", "announce_p50_ms", "announce_p99_ms",
-                        "decisions", "decisions_per_sec", "piece_reports",
-                        "piece_reports_per_sec", "back_to_source",
-                        "filter_ms_p99", "evaluate_ms_p99", "gc_ticks",
-                        "gc_pause_p50_ms", "gc_pause_p99_ms",
-                        "gc_budget_overruns", "gc_reclaimed", "tasks",
-                        "workers", "errors")}
-                    for size, v in ladder.items()},
-            )
-            state.stage_done("scheduler")
-        except Exception as exc:  # noqa: BLE001 — informational stage
-            state.record(scheduler_error=f"{type(exc).__name__}: {exc}")
-
-    # Stage 6: chaos — deterministic fault-injection ladder over the
-    # loopback swarm (scheduler + two peers + origin, client/
-    # chaosbench.py). Seeded FaultPlan rungs at 0%/1%/5% inject
-    # corruption / resets / refused dials / truncated bodies /
-    # scheduler UNAVAILABLE; the documented bound (docs/CHAOS.md) is
-    # 100% task success at every rung and ≥70% goodput retention at
-    # the 5% rung — the verdict lands in the bench JSON, and a passing
-    # run persists into artifacts/bench_state/ like the TPU runs do.
-    if left() > 15.0:
-        try:
-            from dragonfly2_tpu.client.chaosbench import run_chaos_ladder
-
-            chaos = run_chaos_ladder(seed=0)
-            top = chaos["ladder"][str(max(chaos["rates"]))]
-            state.record(
-                chaos_rates=chaos["rates"],
-                chaos_success_rate_at_max=top["success_rate"],
-                chaos_goodput_retention_at_max=chaos[
-                    "goodput_retention_at_max"],
-                chaos_goodput_retention_bound=chaos[
-                    "goodput_retention_bound"],
-                chaos_recovery_p50_ms=top["recovery_p50_ms"],
-                chaos_recovery_p99_ms=top["recovery_p99_ms"],
-                chaos_recovery_events=top["recovery_events"],
-                chaos_all_rungs_full_success=chaos[
-                    "all_rungs_full_success"],
-                chaos_verdict_pass=chaos["verdict_pass"],
-                chaos_ladder={
-                    rate: {k: v[k] for k in (
-                        "success_rate", "downloads", "mb_per_s",
-                        "seconds", "recovery_events", "recovery_p50_ms",
-                        "recovery_p99_ms", "download_p99_s")}
-                    for rate, v in chaos["ladder"].items()},
-            )
-            state.stage_done("chaos")
-            if chaos["verdict_pass"]:
-                dest = os.path.join(
-                    STATE_DIR,
-                    f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json")
-                tmp_path_ = dest + ".tmp"
-                try:
-                    os.makedirs(STATE_DIR, exist_ok=True)
-                    with open(tmp_path_, "w") as f:
-                        json.dump(chaos, f)
-                    os.replace(tmp_path_, dest)
-                except OSError:
-                    pass
-        except Exception as exc:  # noqa: BLE001 — informational stage
-            state.record(chaos_error=f"{type(exc).__name__}: {exc}")
+            st.fn(state, ctx)
+        except Exception as exc:  # noqa: BLE001
+            state.record(**{f"{st.name}_error":
+                            f"{type(exc).__name__}: {exc}"})
+            if st.required and st is not wanted:
+                break
 
 
 def worker_main(platform: str, out_path: str, budget: float) -> None:
@@ -799,8 +928,21 @@ def main() -> None:
         state.emit()
 
 
+def single_stage_main(name: str) -> None:
+    """`bench.py <stage>`: run ONE registry stage on the CPU platform
+    with the full budget and print its extras as the JSON line — the
+    entry the driver (and a human) uses to gate a single ladder, e.g.
+    `bench.py chaos`."""
+    state = BenchState(os.path.join(STATE_DIR, f"stage_{name}.json"))
+    os.makedirs(STATE_DIR, exist_ok=True)
+    run_stages(state, "cpu", BUDGET_S, only=name)
+    state.emit()
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
         worker_main(sys.argv[2], sys.argv[3], float(sys.argv[4]))
+    elif len(sys.argv) == 2 and not sys.argv[1].startswith("-"):
+        single_stage_main(sys.argv[1])
     else:
         main()

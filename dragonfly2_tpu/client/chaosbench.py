@@ -40,6 +40,14 @@ SUCCESS_BOUND = 1.0
 GOODPUT_RETENTION_BOUND = 0.70
 DEFAULT_RATES = (0.0, 0.01, 0.05)
 
+#: Scheduler-kill rung bound (ISSUE 6 acceptance): with ≥1 replica
+#: surviving a hard kill, every task succeeds, NONE degrade to
+#: back-to-source for scheduler loss, and the p99 re-route (first failed
+#: peer-keyed call → session re-established on a live replica) stays
+#: within the conductor's scheduler_grace — the window that would
+#: otherwise have been burned degrading.
+KILL_RUNG_REPLICAS = 3
+
 
 class MultiBlobServer(ThreadedHTTPService):
     """Range-capable loopback origin serving one blob per path — the
@@ -217,6 +225,302 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
     if plan is not None:
         out["faults"] = plan.snapshot()
     return out
+
+
+def spawn_scheduler_replica(data_dir: str, startup_timeout: float = 30.0):
+    """One scheduler replica as a REAL child process (``scheduler/
+    replica.py``); returns (Popen, target). Killing it is the one
+    failure an in-process server can't reproduce."""
+    import os
+    import queue as queue_mod
+    import subprocess
+    import sys
+    import threading
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # never probe a device
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.scheduler.replica",
+         "--data-dir", data_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    # A bare readline() hangs the whole bench if the child stalls
+    # before printing (slow import, bind wedged) — bound the wait.
+    line_q: "queue_mod.Queue" = queue_mod.Queue()
+    threading.Thread(target=lambda: line_q.put(proc.stdout.readline()),
+                     name="replica-startup-read", daemon=True).start()
+    try:
+        line = line_q.get(timeout=startup_timeout).strip()
+    except queue_mod.Empty:
+        proc.kill()
+        proc.wait()
+        raise RuntimeError(
+            f"replica did not start within {startup_timeout}s") from None
+    if not line.startswith("REPLICA "):
+        proc.kill()
+        proc.wait()
+        raise RuntimeError(f"replica failed to start: {line!r}")
+    return proc, line.split(" ", 1)[1]
+
+
+def run_scheduler_kill_rung(*, replicas: int = KILL_RUNG_REPLICAS,
+                            tasks: int = 8, size_bytes: int = 2 << 20,
+                            piece_size: int = 128 << 10, seed: int = 0,
+                            kill_after: float = 0.6, workers: int = 4,
+                            root: str | None = None) -> dict:
+    """The ISSUE-6 chaos rung: a loopback swarm against ``replicas``
+    scheduler processes, one hard-killed mid-swarm by a seeded
+    ``scheduler.process`` KILL rule. Reports re-route p50/p99 (from the
+    rung's injected RecoveryStats), failover/re-registration counters,
+    and tasks degraded to source; the verdict is 100 % task success,
+    p99 re-route ≤ ``scheduler_grace``, and 0 degrades while the other
+    replicas survive."""
+    import os
+    import queue as queue_mod
+    import threading
+
+    import numpy as np
+
+    from dragonfly2_tpu.client import peer_task as peer_task_mod
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.client.recovery import RecoveryStats
+    from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
+
+    tmp = root or tempfile.mkdtemp(prefix="df2-ha-")
+    blobs = {
+        f"/ha/blob-{i}": np.random.default_rng(seed * 7 + i).bytes(size_bytes)
+        for i in range(tasks)
+    }
+    procs = []
+    targets = []
+    try:
+        for i in range(replicas):
+            proc, target = spawn_scheduler_replica(
+                os.path.join(tmp, f"replica-{i}"))
+            procs.append(proc)
+            targets.append(target)
+    except BaseException:
+        # The finally below only guards the swarm; a partial spawn
+        # failure must not orphan the replicas already running.
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+        raise
+
+    balanced = None
+    daemons = []
+    try:
+        recovery = RecoveryStats()
+        options = _chaos_task_options()
+        balanced = BalancedSchedulerClient(targets, recovery=recovery)
+        for name in ("ha-a", "ha-b"):
+            daemons.append(Daemon(balanced, DaemonConfig(
+                storage_root=os.path.join(tmp, name), hostname=name,
+                keep_storage=False, task_options=options,
+                recovery_stats=recovery,
+                # Throttle so the swarm SPANS the kill window:
+                # unthrottled loopback can drain every task before
+                # kill_after and the rung would measure a no-op kill.
+                total_download_rate_bps=4 * (1 << 20),
+            )))
+    except BaseException:
+        # Same contract as the spawn guard: the big finally below only
+        # starts once the swarm is running — a client/daemon ctor
+        # failure here must not orphan three replica processes (or the
+        # tmp tree) for the life of the machine.
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+        if balanced is not None:
+            try:
+                balanced.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for proc in procs:
+            proc.kill()
+            proc.wait()
+        if root is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+    prev_piece_size = peer_task_mod.compute_piece_size
+    peer_task_mod.compute_piece_size = lambda content_length: piece_size
+
+    results: "queue_mod.Queue" = queue_mod.Queue()
+    failures = []
+    killed: dict = {}
+    supervisor_stop = threading.Event()
+    wall0 = time.perf_counter()
+    try:
+        for d in daemons:
+            d.start()
+        with MultiBlobServer(blobs) as origin:
+            plan = FaultPlan(seed=seed)
+            plan.add("scheduler.process", FaultKind.KILL, every_nth=1,
+                     after=kill_after, max_fires=1)
+            faultplan.install(plan)
+
+            def live_owner_counts():
+                counts = {t: 0 for t in targets}
+                for tgt in balanced.peer_session_targets():
+                    if tgt in counts:
+                        counts[tgt] += 1
+                return counts
+
+            def supervisor() -> None:
+                """Kill a session-owning replica when the (seeded,
+                time-windowed) KILL rule fires. Prefer a victim whose
+                session count just GREW: a session observed at the tail
+                of its download can deliver its final report between
+                the count and the SIGKILL landing (a no-op kill that
+                measures no re-routes and voids the verdict), while a
+                freshly registered session has its whole throttled
+                download ahead. Only after no growth for a beat does it
+                fall back to the busiest owner (a static count means
+                the swarm is mid-download — also safe)."""
+                fallback_wait_s = 0.5
+
+                def alive(t):
+                    return procs[targets.index(t)].poll() is None
+
+                prev = {t: 0 for t in targets}
+                last_grown = time.perf_counter()
+                while not supervisor_stop.is_set() and not killed:
+                    counts = live_owner_counts()
+                    grown = [t for t in targets
+                             if counts[t] > prev[t] and alive(t)]
+                    prev = counts
+                    victim = None
+                    if grown:
+                        last_grown = time.perf_counter()
+                        victim = max(grown, key=lambda t: counts[t])
+                    elif (time.perf_counter() - last_grown
+                          > fallback_wait_s):
+                        busiest = max(targets, key=lambda t: counts[t])
+                        if counts[busiest] > 0 and alive(busiest):
+                            victim = busiest
+                    # The site is visited only while an eligible victim
+                    # exists, so the one seeded fire always lands on it.
+                    if victim is not None and faultplan.should_kill(
+                            plan, "scheduler.process", context=victim):
+                        proc = procs[targets.index(victim)]
+                        proc.kill()
+                        proc.wait()
+                        killed["target"] = victim
+                        killed["at_s"] = round(
+                            time.perf_counter() - wall0, 3)
+                        killed["owned_sessions"] = counts[victim]
+                        return
+                    supervisor_stop.wait(0.02)
+
+            sup = threading.Thread(target=supervisor, daemon=True,
+                                   name="replica-killer")
+            sup.start()
+
+            work: "queue_mod.Queue" = queue_mod.Queue()
+            for path, blob in blobs.items():
+                for daemon in daemons:
+                    work.put((daemon, path, blob))
+
+            def downloader() -> None:
+                while True:
+                    try:
+                        daemon, path, blob = work.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    want = hashlib.md5(blob).hexdigest()
+                    begin = time.perf_counter()
+                    try:
+                        result = daemon.download_file(origin.url(path))
+                    except Exception as exc:  # noqa: BLE001 — counted
+                        results.put((path, time.perf_counter() - begin,
+                                     f"raised: {exc}"))
+                        continue
+                    err = ""
+                    if not result.success:
+                        err = f"failed: {result.error}"
+                    elif (hashlib.md5(result.read_all()).hexdigest()
+                          != want):
+                        err = "md5 mismatch"
+                    results.put((path, time.perf_counter() - begin, err))
+
+            pool = [threading.Thread(target=downloader, daemon=True,
+                                     name=f"ha-dl-{i}")
+                    for i in range(workers)]
+            for t in pool:
+                t.start()
+            for t in pool:
+                t.join()
+            supervisor_stop.set()  # a no-kill run must not stall the join
+            sup.join(timeout=1.0)
+    finally:
+        supervisor_stop.set()
+        faultplan.uninstall()
+        peer_task_mod.compute_piece_size = prev_piece_size
+        for d in daemons:
+            try:
+                d.stop()
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
+        try:
+            balanced.close()
+        except Exception:  # noqa: BLE001
+            pass
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if root is None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    wall = time.perf_counter() - wall0
+    downloads = 0
+    durations = []
+    while True:
+        try:
+            path, dur, err = results.get_nowait()
+        except queue_mod.Empty:
+            break
+        downloads += 1
+        durations.append(dur)
+        if err:
+            failures.append(f"{path}: {err}")
+    reroutes = sorted(recovery.reroute_samples())
+    grace = options.scheduler_grace
+    degraded = recovery.get("scheduler_degraded_to_source")
+    success_rate = round((downloads - len(failures)) / max(downloads, 1), 4)
+    reroute_p99_s = percentile(reroutes, 0.99)
+    verdict = bool(
+        killed
+        and success_rate >= SUCCESS_BOUND
+        and degraded == 0
+        and (not reroutes or reroute_p99_s <= grace)
+        and recovery.get("scheduler_failovers") > 0
+    )
+    return {
+        "replicas": replicas,
+        "targets": targets,
+        "tasks": tasks,
+        "downloads": downloads,
+        "pieces_per_task": size_bytes // piece_size,
+        "failures": failures[:5],
+        "success_rate": success_rate,
+        "seconds": round(wall, 3),
+        "killed": killed or None,
+        "reroutes": len(reroutes),
+        "reroute_p50_ms": round(percentile(reroutes, 0.50) * 1e3, 1),
+        "reroute_p99_ms": round(reroute_p99_s * 1e3, 1),
+        "reroute_bound_s": grace,
+        "failovers": recovery.get("scheduler_failovers"),
+        "reregisters": recovery.get("scheduler_reregisters"),
+        "pieces_replayed": recovery.get("scheduler_failover_pieces_replayed"),
+        "degraded_to_source": degraded,
+        "download_p99_s": round(percentile(sorted(durations), 0.99), 3),
+        "recovery_counters": recovery.snapshot(),
+        "verdict_pass": verdict,
+    }
 
 
 def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,

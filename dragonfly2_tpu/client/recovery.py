@@ -30,10 +30,25 @@ just infer it from the absence of errors:
   scheduler reports retried once, and those dropped after the retry.
 - ``enospc_fail_fast`` — tasks failed immediately on a disk-full write
   instead of hanging workers on a doomed requeue loop.
+- ``scheduler_failovers`` / ``scheduler_reregisters`` /
+  ``scheduler_failover_pieces_replayed`` — peer-keyed scheduler calls
+  that hit a dead/unreachable replica and walked the ring, announce
+  sessions transparently re-established on a new replica, and stored
+  pieces replayed into the new replica's resource view so its parent
+  decisions resume from truth instead of zero.
+- ``scheduler_handoff_rehomed`` / ``scheduler_handoff_stranded`` —
+  in-flight peers cooperatively re-homed off a replica removed by
+  ``update_targets`` (planned membership change / rolling restart), and
+  peers that could not be re-homed (no reachable replacement) and
+  stayed pinned to the retired client.
 
 ``recovery_p50_ms`` / ``recovery_p99_ms`` summarize piece-recovery
 latency: the time from a piece's FIRST failed fetch to its eventual
-successful store (ring of the last 4096).
+successful store (ring of the last 4096). ``reroute_p50_ms`` /
+``reroute_p99_ms`` summarize scheduler re-route latency: first failed
+peer-keyed call → session re-established and the call retried OK on a live replica (the number
+the ``bench.py chaos`` scheduler-kill rung bounds by
+``scheduler_grace``).
 """
 
 from __future__ import annotations
@@ -61,6 +76,11 @@ COUNTER_KEYS = (
     "piece_failed_report_retries",
     "reports_dropped",
     "enospc_fail_fast",
+    "scheduler_failovers",
+    "scheduler_reregisters",
+    "scheduler_failover_pieces_replayed",
+    "scheduler_handoff_rehomed",
+    "scheduler_handoff_stranded",
 )
 
 
@@ -73,6 +93,7 @@ class RecoveryStats:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {k: 0 for k in COUNTER_KEYS}
         self._recoveries: collections.deque = collections.deque(maxlen=4096)
+        self._reroutes: collections.deque = collections.deque(maxlen=4096)
 
     def tick(self, key: str, n: int = 1) -> None:
         with self._lock:
@@ -83,6 +104,13 @@ class RecoveryStats:
         with self._lock:
             self._recoveries.append(seconds)
 
+    def observe_reroute(self, seconds: float) -> None:
+        """One scheduler failover: first failed peer-keyed call →
+        session re-established (and the call retried) on a live
+        replica."""
+        with self._lock:
+            self._reroutes.append(seconds)
+
     def get(self, key: str) -> int:
         with self._lock:
             return self._counts.get(key, 0)
@@ -91,13 +119,21 @@ class RecoveryStats:
         with self._lock:
             return list(self._recoveries)
 
+    def reroute_samples(self) -> List[float]:
+        with self._lock:
+            return list(self._reroutes)
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             out: Dict[str, float] = dict(self._counts)
             samples = sorted(self._recoveries)
+            reroutes = sorted(self._reroutes)
         out["recovery_samples"] = len(samples)
         out["recovery_p50_ms"] = round(percentile(samples, 0.50) * 1e3, 3)
         out["recovery_p99_ms"] = round(percentile(samples, 0.99) * 1e3, 3)
+        out["reroute_samples"] = len(reroutes)
+        out["reroute_p50_ms"] = round(percentile(reroutes, 0.50) * 1e3, 3)
+        out["reroute_p99_ms"] = round(percentile(reroutes, 0.99) * 1e3, 3)
         return out
 
 

@@ -148,11 +148,23 @@ class RpcServer:
     def target(self) -> str:
         return f"127.0.0.1:{self.port}"
 
-    def stop(self, grace: Optional[float] = 0.5) -> None:
+    def stop(self, grace: Optional[float] = 0.5,
+             drain_s: float = 0.0) -> None:
+        """Flip NOT_SERVING, optionally hold the listener open for
+        ``drain_s`` (cooperative handoff window: health-aware clients
+        stop routing NEW work here and re-home in-flight peers through
+        their re-registration path while this server still answers),
+        then stop with the gRPC ``grace``."""
         if self.health is not None:
             from dragonfly2_tpu.rpc.health import NOT_SERVING
 
             self.health.set_status("", NOT_SERVING)
+        if drain_s > 0:
+            # Honored even without a health service: the open listener
+            # is the drain window; health just advertises it.
+            import time
+
+            time.sleep(drain_s)
         self.server.stop(grace).wait()
 
 

@@ -19,6 +19,10 @@ Counter semantics (see docs/SCHEDULER.md):
 - ``piece_reports`` / ``report_batches`` — piece-finished reports
   processed vs batched RPCs that carried them (PR 3's
   ``download_pieces_finished`` form).
+- ``peer_reregistrations`` — ``register_peer`` calls that found the
+  peer already registered and served the idempotent upsert path (a
+  failover or handoff re-home re-establishing its session here) instead
+  of rejecting the duplicate.
 - ``bad_node_fast`` / ``bad_node_slow`` — ``is_bad_node`` verdicts
   served from the O(1) windowed Welford aggregates vs the legacy
   numpy-over-history path (duck-typed peers without stats). On the real
@@ -72,6 +76,7 @@ class ControlPlaneStats:
         self.back_to_source = 0
         self.piece_reports = 0
         self.report_batches = 0
+        self.peer_reregistrations = 0
         self.bad_node_fast = 0
         self.bad_node_slow = 0
         self.gc_ticks = 0
@@ -108,6 +113,10 @@ class ControlPlaneStats:
             self.piece_reports += n
             if batched:
                 self.report_batches += 1
+
+    def observe_reregistration(self) -> None:
+        with self._lock:
+            self.peer_reregistrations += 1
 
     def observe_bad_node(self, *, fast: bool) -> None:
         # Lock-free: this fires once per CANDIDATE inside the filter hot
@@ -148,6 +157,7 @@ class ControlPlaneStats:
                 "evaluate_ms_p99": round(ev_p99, 4),
                 "piece_reports": self.piece_reports,
                 "report_batches": self.report_batches,
+                "peer_reregistrations": self.peer_reregistrations,
                 "bad_node_fast": self.bad_node_fast,
                 "bad_node_slow": self.bad_node_slow,
                 "gc_ticks": self.gc_ticks,

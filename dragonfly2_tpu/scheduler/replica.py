@@ -1,0 +1,80 @@
+"""Minimal scheduler-replica process entry for the HA chaos plane.
+
+``python -m dragonfly2_tpu.scheduler.replica --port 0 --data-dir D``
+builds a bare SchedulerService (resource model + rule scheduling + CSV
+sink, no manager/trainer/topology extras) behind the gRPC surface,
+prints one ``REPLICA <host:port>`` line on stdout, and serves until the
+process dies. The chaos bench's scheduler-kill rung (and the rolling-
+restart e2e) spawn several of these and SIGKILL/cycle them mid-swarm —
+a REAL process death, which is the one failure mode an in-process
+server can't produce (its Python state survives a ``stop()``).
+
+Deliberately lighter than ``cmd/scheduler.py``: no argparse config
+files, no metrics server, no jax anywhere on the import path — the
+supervisor needs replicas that are up within ~1–2 s so the kill rung
+fits inside the bench budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+
+def build_replica(data_dir: str, *, host: str = "127.0.0.1", port: int = 0,
+                  retry_interval: float = 0.01,
+                  retry_back_to_source_limit: int = 2):
+    """(service, server) — the same assembly the e2e tests use."""
+    from dragonfly2_tpu.rpc import serve
+    from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+    from dragonfly2_tpu.scheduler.resource.resource import Resource
+    from dragonfly2_tpu.scheduler.rpcserver import (
+        SCHEDULER_SPEC,
+        SchedulerRpcService,
+    )
+    from dragonfly2_tpu.scheduler.scheduling.core import (
+        Scheduling,
+        SchedulingConfig,
+    )
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+    service = SchedulerService(
+        resource=Resource(),
+        scheduling=Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(
+                retry_interval=retry_interval,
+                retry_back_to_source_limit=retry_back_to_source_limit),
+        ),
+        storage=Storage(data_dir),
+    )
+    server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))],
+                   host=host, port=port)
+    return service, server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-scheduler-replica")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--data-dir", required=True)
+    parser.add_argument("--retry-interval", type=float, default=0.01)
+    parser.add_argument("--retry-back-to-source-limit", type=int, default=2)
+    args = parser.parse_args(argv)
+
+    _, server = build_replica(
+        args.data_dir, host=args.host, port=args.port,
+        retry_interval=args.retry_interval,
+        retry_back_to_source_limit=args.retry_back_to_source_limit)
+    # The supervisor parses this single line for the bound target.
+    print(f"REPLICA {server.target}", flush=True)
+    # Serve until killed (the rung's whole point is that we never get a
+    # clean shutdown path).
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,9 +141,15 @@ class Peer:
 
     def store_piece(self, piece: Piece) -> None:
         with self._lock:
+            # Upsert: a redelivered/replayed report (failover replay,
+            # report-batcher redelivery) refreshes the piece record but
+            # must not double-count its cost in the bad-node window —
+            # exactly-once statistics over at-least-once delivery.
+            fresh = piece.number not in self.finished_pieces
             self.pieces[piece.number] = piece
             self.finished_pieces.add(piece.number)
-            self.append_piece_cost(piece.cost)
+            if fresh:
+                self.append_piece_cost(piece.cost)
             self.piece_updated_at = time.time()
 
     def load_piece(self, number: int) -> Optional[Piece]:

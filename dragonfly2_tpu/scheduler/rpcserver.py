@@ -27,7 +27,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from dragonfly2_tpu.utils import faultplan
 
@@ -559,17 +559,33 @@ class SchedulerRpcService:
 
 
 class _AnnounceSession:
-    """One open AnnouncePeer stream for one peer."""
+    """One open AnnouncePeer stream for one peer.
 
-    def __init__(self, responses, send_queue: "queue.Queue"):
+    A stream whose read loop ended WITHOUT a deliberate ``close()`` is
+    marked ``dead``: the server vanished (replica kill/restart) or the
+    channel broke. Sends on a dead session raise ``ServiceError
+    ("Unavailable")`` instead of silently enqueueing into a stream
+    nobody consumes — the raise is what lets the balanced client's
+    failover path notice replica loss from the very next peer-keyed
+    call instead of waiting out the conductor's whole grace window."""
+
+    def __init__(self, responses, send_queue: "queue.Queue",
+                 peer_id: str = ""):
         self.responses = responses
         self.send_queue = send_queue
+        self.peer_id = peer_id
         self.register_reply: "queue.Queue" = queue.Queue()
+        self.dead = False
+        self.closing = False
 
     def send(self, msg) -> None:
+        if self.dead:
+            raise ServiceError(
+                "Unavailable", "announce stream lost (scheduler gone)")
         self.send_queue.put(msg)
 
     def close(self) -> None:
+        self.closing = True
         self.send_queue.put(None)
 
 
@@ -585,6 +601,14 @@ class GrpcSchedulerClient:
         self._client = ServiceClient(target, SCHEDULER_SPEC, tls=tls)
         self._sessions: Dict[str, _AnnounceSession] = {}
         self._lock = threading.Lock()
+        # Set by BalancedSchedulerClient: called (self, peer_id,
+        # dead_session) from the read loop when a REGISTERED peer's
+        # announce stream dies without close() — the proactive failover
+        # trigger that covers peers with no RPC in flight (e.g.
+        # idle-waiting for a parent decision when the replica is
+        # killed). The session identity lets the hook ignore a stream
+        # that was already replaced on this same client.
+        self.on_session_lost = None
 
     @staticmethod
     def _inject(method: str) -> None:
@@ -643,9 +667,18 @@ class GrpcSchedulerClient:
                 yield item
 
         responses = self._client.AnnouncePeer(requests())
-        session = _AnnounceSession(responses, send_queue)
+        session = _AnnounceSession(responses, send_queue, req.peer_id)
         with self._lock:
+            displaced = self._sessions.get(req.peer_id)
             self._sessions[req.peer_id] = session
+        if displaced is not None:
+            # Re-register over an existing session (failover healing
+            # back onto this same client): the displaced stream must be
+            # poisoned, or its request-pump generator blocks on
+            # send_queue.get() forever and the server keeps the old
+            # AnnouncePeer stream open, pushing decisions into the
+            # shared conductor channel.
+            displaced.close()
         session.send(WireRegisterPeer(
             host_id=req.host_id, task_id=req.task_id, peer_id=req.peer_id,
             url=req.url, tag=req.tag, application=req.application,
@@ -721,6 +754,36 @@ class GrpcSchedulerClient:
                 session.register_reply.put(exc)
             else:
                 logger.debug("announce read loop ended: %s", exc)
+        finally:
+            # Stream over without close(): the scheduler is gone (or the
+            # channel died). Poison the session so the next send fails
+            # fast into the failover path rather than black-holing, and
+            # fire the proactive hook — a peer with NO call in flight
+            # (waiting on a decision) must not sit out the grace window.
+            if not session.closing:
+                session.dead = True
+                hook = self.on_session_lost
+                if hook is not None and registered:
+                    try:
+                        hook(self, session.peer_id, session)
+                    except Exception:  # noqa: BLE001 — observer only
+                        logger.debug("session-lost hook failed",
+                                     exc_info=True)
+                # After failover the peer finalizes on its NEW owner, so
+                # no later call on THIS client will ever pop the entry —
+                # dropping here keeps _sessions from accumulating one
+                # dead stream per failed-over peer under replica churn.
+                # Sends racing the pop still fail fast on session.dead;
+                # after it, _require_session raises NotFound, which the
+                # failover path treats the same. The only= guard matters
+                # because the hook may already have re-homed the peer
+                # onto THIS client (replica restarted on the same
+                # address) — that fresh session must survive. The dead
+                # session itself is closed unconditionally (close only
+                # poisons its OWN queue): when the guard no-ops, nothing
+                # else ever unblocks its request-pump thread.
+                session.close()
+                self._drop_session(session.peer_id, only=session)
 
     def _session(self, peer_id: str) -> Optional[_AnnounceSession]:
         with self._lock:
@@ -732,11 +795,18 @@ class GrpcSchedulerClient:
             raise ServiceError("NotFound", f"no announce session for {peer_id}")
         return session
 
-    def _drop_session(self, peer_id: str) -> None:
+    def _drop_session(self, peer_id: str, *,
+                      only: Optional[_AnnounceSession] = None) -> None:
+        """Pop and close the peer's session. With ``only``, drop it only
+        if the mapped session IS that one — a dead stream's cleanup must
+        not tear down a fresh session re-established on this same client
+        (replica restarted on the same address) in the meantime."""
         with self._lock:
-            session = self._sessions.pop(peer_id, None)
-        if session is not None:
-            session.close()
+            session = self._sessions.get(peer_id)
+            if session is None or (only is not None and session is not only):
+                return
+            del self._sessions[peer_id]
+        session.close()
 
     def _send_event(self, peer_id: str, event: str, *, cost: float = 0.0,
                     content_length: int = -1, total: int = 0,
@@ -818,6 +888,32 @@ class GrpcSchedulerClient:
         self._client.close()
 
 
+class _PeerFinalizedError(Exception):
+    """The peer finalized while a re-home was in flight — the rehome
+    must not resurrect its owner mapping."""
+
+
+class _PeerSessionState:
+    """Everything needed to re-establish one peer's announce session on
+    a different replica: the original registration request, the
+    conductor's decision channel, and the replayable download state
+    (started markers + every piece reported so far). ``lock``
+    serializes failovers for the peer — concurrent failing calls from
+    the reporter and the conductor must re-home ONCE."""
+
+    __slots__ = ("request", "channel", "target", "started",
+                 "back_to_source_started", "pieces", "lock")
+
+    def __init__(self, request: RegisterPeerRequest, channel, target: str):
+        self.request = request
+        self.channel = channel
+        self.target = target
+        self.started = False
+        self.back_to_source_started = False
+        self.pieces: Dict[int, PieceFinished] = {}
+        self.lock = threading.Lock()
+
+
 class BalancedSchedulerClient:
     """Multi-scheduler SchedulerAPI: task-affine routing over a hash ring.
 
@@ -826,11 +922,25 @@ class BalancedSchedulerClient:
     picks the task's owner via the ring (every peer of a task lands on the
     same scheduler replica, pkg/balancer/consistent_hashing.go:51-124 /
     scheduler client_v1.go:171 hash key = TaskId) and walks the ring on
-    UNAVAILABLE, so losing a replica only moves its tasks. Peer-keyed calls
-    follow the session created at registration; host announce/leave fan out
-    to every replica (each replica keeps its own resource view).
+    UNAVAILABLE, so losing a replica only moves its tasks. Host
+    announce/leave fan out to every replica (each replica keeps its own
+    resource view).
 
-    ``update_targets`` is the dynconfig observer hook.
+    Peer-keyed calls follow the session created at registration — and
+    when that session's replica dies mid-download, the call FAILS OVER
+    instead of degrading: the ring walk picks a live replica, the peer
+    is re-registered there (an idempotent upsert server-side), the
+    replayable state (started marker, every reported piece) is pushed so
+    the new replica's parent decisions resume from truth, and the failed
+    call is retried. Replica loss becomes a re-route measured in the
+    ``recovery`` debug block (``reroute_p50/p99_ms``), not a
+    degrade-to-source.
+
+    ``update_targets`` is the dynconfig observer hook; removing a target
+    with in-flight peers triggers the cooperative half of the same
+    machinery — peers are re-homed onto their new ring owners while the
+    draining replica still answers, which is what makes a rolling
+    restart zero-drop.
 
     Target selection is health-aware: before walking the ring, each
     candidate's DF2 health service (rpc/health.py, auto-mounted on every
@@ -838,14 +948,32 @@ class BalancedSchedulerClient:
     report NOT_SERVING (draining for shutdown, hot-reload grace) are
     DEPRIORITIZED — tried only after every SERVING target failed, so a
     fleet that is entirely draining still gets a best-effort attempt
-    instead of an instant "no schedulers".
+    instead of an instant "no schedulers". Targets that fail a walk with
+    a connection error are negative-cached for a SHORT TTL so the next
+    call does not re-pay the dead target's dial timeout, while a
+    recovered replica rejoins within ``NEGATIVE_HEALTH_TTL``.
     """
 
     #: How long a per-target health verdict is trusted before re-probing.
     HEALTH_TTL = 5.0
+    #: How long a walk-observed connection failure keeps a target
+    #: deprioritized. Deliberately < HEALTH_TTL: a dead target must not
+    #: stall every caller for a dial timeout, but a restarted replica
+    #: should rejoin the walk quickly.
+    NEGATIVE_HEALTH_TTL = 1.0
+    #: How long update_targets waits for the removed replica's handoff
+    #: threads before detaching them. Each re-home can block up to a
+    #: register timeout per candidate replica; an unbounded join would
+    #: stall the dynconfig observer (and every later membership update,
+    #: including the one adding the recovered replica) behind the
+    #: slowest peer. Stragglers finish in the background — a peer that
+    #: could not move stays pinned to the retired client, which still
+    #: closes on its last finalize.
+    HANDOFF_DRAIN_JOIN_S = 10.0
 
     def __init__(self, targets, client_factory=None, tls=None,
-                 health_probe=None):
+                 health_probe=None, recovery=None):
+        from dragonfly2_tpu.client.recovery import RECOVERY
         from dragonfly2_tpu.rpc.client import HashRing
 
         self._factory = client_factory or (
@@ -854,14 +982,25 @@ class BalancedSchedulerClient:
         self.ring = HashRing(targets)
         self._clients: Dict[str, GrpcSchedulerClient] = {}
         self._peer_owner: Dict[str, GrpcSchedulerClient] = {}
+        # peer_id → replayable session state (failover + handoff input).
+        self._peer_states: Dict[str, _PeerSessionState] = {}
+        # host_id → last announced Host: a replica that joined after the
+        # daemon announced (rolling restart) learns the host during
+        # session re-establishment.
+        self._known_hosts: Dict[str, Host] = {}
         # Clients removed from the ring but still owning in-flight peers;
         # closed when their last peer finalizes.
         self._retired: set = set()
         self._lock = threading.Lock()
         self._tls = tls
+        # Failover/handoff counters + the re-route latency ring
+        # (/debug/vars "recovery" block unless a bench injects its own).
+        self.recovery = recovery if recovery is not None else RECOVERY
         # target → health status string; tests inject a fake probe.
         self._health_probe = health_probe or self._grpc_health_probe
         self._health_clients: Dict[str, object] = {}
+        # target → (serving, trusted_until). Always touched under
+        # self._lock — update_targets mutates it from other threads.
         self._health_cache: Dict[str, tuple[bool, float]] = {}
 
     # -- health-aware target ordering -----------------------------------
@@ -879,12 +1018,14 @@ class BalancedSchedulerClient:
         return cli.Check(HealthCheckRequest(service=""), timeout=1.0).status
 
     def _serving(self, target: str) -> bool:
-        """False only when the target AFFIRMATIVELY reports NOT_SERVING;
-        probe errors (no health service, network blip) leave the target
-        in the normal walk — the walk's own error handling decides."""
+        """False only when the target AFFIRMATIVELY reports NOT_SERVING
+        (or recently failed a walk — the negative cache); probe errors
+        (no health service, network blip) leave the target in the
+        normal walk — the walk's own error handling decides."""
         now = time.monotonic()
-        cached = self._health_cache.get(target)
-        if cached is not None and now - cached[1] < self.HEALTH_TTL:
+        with self._lock:
+            cached = self._health_cache.get(target)
+        if cached is not None and now < cached[1]:
             return cached[0]
         from dragonfly2_tpu.rpc.health import NOT_SERVING
 
@@ -892,8 +1033,25 @@ class BalancedSchedulerClient:
             serving = self._health_probe(target) != NOT_SERVING
         except Exception:  # noqa: BLE001 — absence of proof isn't proof
             serving = True
-        self._health_cache[target] = (serving, now)
+        with self._lock:
+            cur = self._health_cache.get(target)
+            if (cur is not None and not cur[0]
+                    and time.monotonic() < cur[1]):
+                # A walk failed this target while our probe was in
+                # flight — that negative verdict is fresher evidence
+                # than a probe begun before the failure (and probe
+                # errors default to serving=True). Don't clobber it.
+                return False
+            self._health_cache[target] = (serving, now + self.HEALTH_TTL)
         return serving
+
+    def _note_unreachable(self, target: str) -> None:
+        """A walk just paid this target's connection failure — feed the
+        health cache a short negative verdict so the NEXT walks skip to
+        live replicas instead of re-paying the dial timeout each call."""
+        with self._lock:
+            self._health_cache[target] = (
+                False, time.monotonic() + self.NEGATIVE_HEALTH_TTL)
 
     def _walk_healthy(self, key: str):
         """Ring order with NOT_SERVING targets moved to the back. Lazy:
@@ -913,74 +1071,244 @@ class BalancedSchedulerClient:
     def update_targets(self, targets) -> None:
         desired = set(targets)
         for t in desired - self.ring.targets:
+            # A joiner starts with an empty resource view; it learns
+            # our hosts lazily — _register_at re-announces the cached
+            # Host when a register bounces on "not announced". No eager
+            # preload here: serial announce_host calls against a
+            # not-yet-listening replacement would burn a dial timeout
+            # per host on the dynconfig observer thread and delay the
+            # removal/handoff half of this very update.
             self.ring.add(t)
         for t in self.ring.targets - desired:
             self.ring.remove(t)
+            self._remove_target_client(t)
+        # A concurrent failover walking a pre-removal ring snapshot can
+        # re-create a client for a just-removed target AFTER the pop
+        # above — sweep strays through the same retire-or-close path so
+        # they don't leak a dead channel until process-level close().
+        with self._lock:
+            stray = [t for t in self._clients if t not in desired]
+        for t in stray:
+            self._remove_target_client(t)
+
+    def _remove_target_client(self, t: str) -> None:
+        with self._lock:
+            self._health_cache.pop(t, None)
+            health = self._health_clients.pop(t, None)
+            old = self._clients.pop(t, None)
+        if health is not None:
+            try:
+                health.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if old is None:
+            return
+        retired = False
+        with self._lock:
+            if old in self._peer_owner.values():
+                # In-flight peers still report through this client;
+                # cooperative handoff tries to re-home them onto live
+                # replicas while the removed one is still draining.
+                # Whatever cannot move keeps reporting here; close when
+                # the last peer finalizes.
+                self._retired.add(old)
+                retired = True
+        if retired:
+            self._drain_retired(old, t)
+        else:
+            old.close()
+
+    def _drain_retired(self, old: "GrpcSchedulerClient",
+                       removed_target: str) -> None:
+        """Planned membership change: re-home the removed replica's
+        in-flight peers through the ordinary re-registration path. The
+        draining replica may well still be serving (a rolling restart
+        announces NOT_SERVING before it dies), so a failed re-home is
+        not fatal — the peer stays pinned to the retired client, which
+        then closes on its final report as before."""
+        with self._lock:
+            to_move = [(pid, self._peer_states.get(pid))
+                       for pid, owner in self._peer_owner.items()
+                       if owner is old]
+        workers = []
+        for peer_id, state in to_move:
+            if state is None:
+                self.recovery.tick("scheduler_handoff_stranded")
+                continue
+            # Concurrent per-peer re-homes: each can block up to a full
+            # register timeout per candidate replica, so a serial drain
+            # would stall the dynconfig observer thread for N peers ×
+            # timeout while later peers overshoot the drain window.
+            t = threading.Thread(
+                target=self._handoff_one,
+                args=(peer_id, state, old, removed_target),
+                name=f"handoff-{peer_id[-8:]}", daemon=True)
+            t.start()
+            workers.append(t)
+        deadline = time.monotonic() + self.HANDOFF_DRAIN_JOIN_S
+        for t in workers:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                logger.warning(
+                    "handoff drain for %s detached straggler %s",
+                    removed_target, t.name)
+        self._maybe_close_retired(old)
+
+    def _handoff_one(self, peer_id: str, state: "_PeerSessionState",
+                     old: "GrpcSchedulerClient",
+                     removed_target: str) -> None:
+        with state.lock:
             with self._lock:
-                self._health_cache.pop(t, None)
-                health = self._health_clients.pop(t, None)
-                old = self._clients.pop(t, None)
-            if health is not None:
-                try:
-                    health.close()
-                except Exception:  # noqa: BLE001
-                    pass
-            with self._lock:
-                if old is None:
-                    continue
-                if old in self._peer_owner.values():
-                    # In-flight peers still report through this client;
-                    # close when the last one finalizes, not mid-download.
-                    self._retired.add(old)
-                    old = None
-            if old is not None:
-                old.close()
+                if peer_id not in self._peer_states:
+                    return  # finalized while the drain was queued
+                if self._peer_owner.get(peer_id) is not old:
+                    return  # a concurrent failover already moved it
+            try:
+                self._rehome_locked(peer_id, state, avoid=removed_target)
+            except _PeerFinalizedError:
+                return  # finished mid-drain: neither rehomed nor stranded
+            except Exception as exc:  # noqa: BLE001 — best effort
+                logger.warning("handoff of peer %s off %s failed: %s",
+                               peer_id, removed_target, exc)
+                self.recovery.tick("scheduler_handoff_stranded")
+                return
+        self.recovery.tick("scheduler_handoff_rehomed")
+
+    def _maybe_close_retired(self, cli: "GrpcSchedulerClient") -> None:
+        close_me = None
+        with self._lock:
+            if (cli in self._retired
+                    and cli not in self._peer_owner.values()):
+                self._retired.discard(cli)
+                close_me = cli
+        if close_me is not None:
+            close_me.close()
 
     def _client_at(self, target: str) -> GrpcSchedulerClient:
         with self._lock:
             cli = self._clients.get(target)
             if cli is None:
                 cli = self._factory(target)
+                try:
+                    cli.on_session_lost = self._on_session_lost
+                except Exception:  # noqa: BLE001 — stub clients may not care
+                    pass
                 self._clients[target] = cli
         return cli
 
+    def _on_session_lost(self, cli: GrpcSchedulerClient,
+                         peer_id: str, session=None) -> None:
+        """Proactive failover: a registered peer's announce stream died
+        without close(). Re-home it NOW — the reactive path only fires
+        on the next peer-keyed call, and a peer idle-waiting for a
+        parent decision makes none until the grace window has already
+        degraded it to back-to-source."""
+        with self._lock:
+            owner = self._peer_owner.get(peer_id)
+            state = self._peer_states.get(peer_id)
+        if owner is not cli or state is None:
+            return  # finalized or already re-homed
+        t0 = time.monotonic()
+        with state.lock:
+            with self._lock:
+                if self._peer_owner.get(peer_id) is not cli:
+                    return  # raced a reactive failover that won
+            if session is not None:
+                # The owner-is-cli guard can't see a re-home back onto
+                # the SAME client (replica restarted on its old port):
+                # only the session identity can. A concurrent call that
+                # beat us to state.lock installed a FRESH session there
+                # — re-homing again would negative-cache the healthy
+                # target and replay everything a second time.
+                probe = getattr(cli, "_session", None)
+                if probe is not None and probe(peer_id) is not session:
+                    return
+            if state.target:
+                self._note_unreachable(state.target)
+            try:
+                self._rehome_locked(peer_id, state, avoid=state.target)
+            except _PeerFinalizedError:
+                return  # finalized mid-rehome — nothing left to re-route
+            except Exception as exc:  # noqa: BLE001 — reactive path remains
+                logger.warning("proactive failover for peer %s failed: %s",
+                               peer_id, exc)
+                return
+            # Success-only, matching _peer_call: a failed proactive
+            # attempt must not pre-count the failover the reactive
+            # path will count when it succeeds.
+            self.recovery.tick("scheduler_failovers")
+        self.recovery.observe_reroute(time.monotonic() - t0)
+        logger.info("peer %s proactively re-routed to %s after stream loss",
+                    peer_id, state.target)
+
     # -- host lifecycle: fan out to every replica ----------------------
+
+    def _fan_out(self, op, op_name: str) -> Tuple[List[tuple], int]:
+        """Run ``op(client)`` against every replica CONCURRENTLY and
+        return ([(target, exc)] failures, attempted count). Serial fan-out let one dead
+        replica's dial timeout stall host announcement for the whole
+        fleet; concurrent fan-out bounds the announce path to the
+        slowest single replica. Failed targets feed the negative health
+        cache so the ring walks route around them too."""
+        targets = sorted(self.ring.targets)
+        errors: List[tuple] = []
+        errors_lock = threading.Lock()
+
+        def call(target: str) -> None:
+            try:
+                op(self._client_at(target))
+            except Exception as exc:  # noqa: BLE001 — per-replica
+                if self._walk_retryable(exc):
+                    # Transport failure or dead-replica code — real gRPC
+                    # surfaces these as grpc.RpcError UNAVAILABLE /
+                    # ServiceError, not ConnectionError.
+                    self._note_unreachable(target)
+                with errors_lock:
+                    errors.append((target, exc))
+
+        if len(targets) == 1:
+            call(targets[0])
+        else:
+            threads = [threading.Thread(target=call, args=(t,),
+                                        name=f"{op_name}-{t}", daemon=True)
+                       for t in targets]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        return errors, len(targets)
 
     def announce_host(self, host: Host) -> None:
         """Best-effort fan-out; succeeds if at least one replica took it."""
-        errors = []
-        for target in sorted(self.ring.targets):
-            try:
-                self._client_at(target).announce_host(host)
-            except Exception as exc:  # noqa: BLE001 — per-replica
-                errors.append((target, exc))
-        if errors and len(errors) == len(self.ring.targets):
+        with self._lock:
+            self._known_hosts[host.id] = host
+        errors, attempted = self._fan_out(
+            lambda cli: cli.announce_host(host), "announce-host")
+        # Compare against the fan-out's own snapshot — the ring can gain
+        # or lose targets mid-flight, and a total failure must raise.
+        if errors and len(errors) == attempted:
             raise ConnectionError(f"announce_host failed everywhere: {errors}")
         for target, exc in errors:
             logger.warning("announce_host to %s failed: %s", target, exc)
 
     def leave_host(self, host_id: str) -> None:
-        for target in sorted(self.ring.targets):
-            try:
-                self._client_at(target).leave_host(host_id)
-            except Exception:  # noqa: BLE001
-                logger.warning("leave_host to %s failed", target)
+        with self._lock:
+            self._known_hosts.pop(host_id, None)
+        errors, _ = self._fan_out(
+            lambda cli: cli.leave_host(host_id), "leave-host")
+        for target, _exc in errors:
+            logger.warning("leave_host to %s failed", target)
 
     def stat_task(self, task_id: str):
         last: Optional[Exception] = None
         for target in self._walk_healthy(task_id):
             try:
                 return self._client_at(target).stat_task(task_id)
-            except (ConnectionError, OSError) as exc:
+            except Exception as exc:  # noqa: BLE001 — walk on dead replicas
+                if not self._walk_retryable(exc):
+                    raise
+                self._note_unreachable(target)
                 last = exc
-            except Exception as exc:  # noqa: BLE001 — grpc UNAVAILABLE etc.
-                import grpc
-
-                if (isinstance(exc, grpc.RpcError)
-                        and exc.code() == grpc.StatusCode.UNAVAILABLE):
-                    last = exc
-                    continue
-                raise
         raise last if last is not None else ConnectionError("no schedulers")
 
     def probe_sync(self, host_id: str = ""):
@@ -991,7 +1319,50 @@ class BalancedSchedulerClient:
             return self._client_at(target).probe_sync(host_id)
         raise ConnectionError("no schedulers")
 
+    # -- failover plumbing ----------------------------------------------
+
+    @staticmethod
+    def _walk_retryable(exc: Exception) -> bool:
+        """May the ring walk continue past this failure? Transport-level
+        errors and the dead-replica ServiceError codes walk on;
+        scheduler REJECTIONS (invalid URL, forbidden priority) re-raise."""
+        if isinstance(exc, ServiceError):
+            return exc.code in ("DeadlineExceeded", "Unavailable")
+        if isinstance(exc, (ConnectionError, OSError)):
+            return True
+        import grpc
+
+        return (isinstance(exc, grpc.RpcError)
+                and exc.code() == grpc.StatusCode.UNAVAILABLE)
+
+    @classmethod
+    def _failover_retryable(cls, exc: Exception) -> bool:
+        """May a PEER-KEYED call fail over? Everything the walk retries,
+        plus NotFound: a replica that restarted (lost its resource view)
+        or a client session dropped after an error both surface NotFound,
+        and both are healed by re-registration."""
+        if isinstance(exc, ServiceError) and exc.code == "NotFound":
+            return True
+        return cls._walk_retryable(exc)
+
     # -- SchedulerAPI ---------------------------------------------------
+
+    def _register_at(self, cli: GrpcSchedulerClient,
+                     req: RegisterPeerRequest,
+                     channel) -> RegisterPeerResponse:
+        """register_peer against one replica, teaching it the host
+        first when it answers "not announced" — a replica that joined
+        after the daemon's announce (rolling restart) must be usable
+        for FRESH registrations and failover replays alike."""
+        try:
+            return cli.register_peer(req, channel=channel)
+        except ServiceError as exc:
+            host = self._known_hosts.get(req.host_id)
+            if (exc.code != "NotFound" or "not announced" not in str(exc)
+                    or host is None):
+                raise
+            cli.announce_host(host)
+            return cli.register_peer(req, channel=channel)
 
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:
@@ -999,28 +1370,211 @@ class BalancedSchedulerClient:
         for target in self._walk_healthy(req.task_id):
             cli = self._client_at(target)
             try:
-                resp = cli.register_peer(req, channel=channel)
-            except (ConnectionError, OSError, ServiceError) as exc:
-                # ServiceError from a dead stream (DeadlineExceeded) walks
-                # on; scheduler-rejected registrations (e.g. invalid URL)
-                # re-raise below via non-retryable codes.
-                if (isinstance(exc, ServiceError)
-                        and exc.code not in ("DeadlineExceeded", "Unavailable")):
+                resp = self._register_at(cli, req, channel)
+            except Exception as exc:  # noqa: BLE001
+                if not self._walk_retryable(exc):
                     raise
+                # Anything walk-retryable means the TARGET is gone/sick
+                # (real gRPC surfaces dead replicas as grpc.RpcError
+                # UNAVAILABLE / ServiceError, not ConnectionError).
+                self._note_unreachable(target)
                 last = exc
                 continue
-            except Exception as exc:  # noqa: BLE001
-                import grpc
-
-                if (isinstance(exc, grpc.RpcError)
-                        and exc.code() == grpc.StatusCode.UNAVAILABLE):
-                    last = exc
-                    continue
-                raise
+            if (resp.size_scope == SizeScope.EMPTY
+                    or (resp.size_scope == SizeScope.TINY
+                        and resp.direct_piece)):
+                # The conductor returns straight from register for these
+                # responses (TINY only short-circuits when the piece
+                # rides inline; a bare TINY scope still downloads) —
+                # no started/pieces/finished calls ever come,
+                # so a session entry would leak forever and the handoff
+                # machinery would keep re-homing a long-finished ghost.
+                # The underlying announce stream (+ its read-loop thread)
+                # must go too, or every EMPTY/TINY download pins one
+                # gRPC stream until process exit. getattr: duck-typed
+                # clients without announce sessions have nothing to drop.
+                drop = getattr(cli, "_drop_session", None)
+                if drop is not None:
+                    drop(req.peer_id)
+                return resp
             with self._lock:
                 self._peer_owner[req.peer_id] = cli
+                self._peer_states[req.peer_id] = _PeerSessionState(
+                    req, channel, target)
             return resp
         raise last if last is not None else ConnectionError("no schedulers")
+
+    def _reestablish(self, cli: GrpcSchedulerClient,
+                     state: _PeerSessionState) -> None:
+        """Re-create the peer's announce session on ``cli`` and replay
+        its download state: register (idempotent upsert server-side,
+        re-announcing the host first if this replica never saw it),
+        started markers (the new replica resumes issuing parent
+        decisions into the SAME conductor channel), then every piece
+        reported so far (so finished counts / task metadata are truthful
+        and duplicate redeliveries stay upserts)."""
+        req = state.request
+        self._register_at(cli, req, state.channel)
+        if state.started:
+            cli.download_peer_started(req.peer_id)
+        if state.back_to_source_started:
+            cli.download_peer_back_to_source_started(req.peer_id)
+        pieces = list(state.pieces.values())
+        if pieces:
+            cli.download_pieces_finished(pieces)
+            self.recovery.tick("scheduler_failover_pieces_replayed",
+                               len(pieces))
+        self.recovery.tick("scheduler_reregisters")
+
+    def _rehome_locked(self, peer_id: str, state: _PeerSessionState,
+                       avoid: str = "") -> GrpcSchedulerClient:
+        """Walk the ring (excluding ``avoid`` until last) and move the
+        peer's session to the first replica that takes it. Caller holds
+        ``state.lock``. Raises the last walk error when nothing does."""
+        last: Optional[Exception] = None
+
+        def candidates():
+            # LAZY: _walk_healthy probes health per target as the walk
+            # advances (cold probes cost up to 1 s each) — a first-
+            # candidate success must not pay for probing the fleet
+            # while every call for this peer queues on state.lock.
+            for t in self._walk_healthy(state.request.task_id):
+                if t != avoid:
+                    yield t
+            if avoid and avoid in self.ring.targets:
+                # The failed target last: a transient blip (or a replica
+                # restarted on the same port) heals by re-registering
+                # there.
+                yield avoid
+
+        for target in candidates():
+            if target not in self.ring.targets:
+                # update_targets removed it while this walk was on a
+                # pre-removal ring snapshot: registering here would pin
+                # the peer to a replica about to die and resurrect the
+                # client entry the removal just popped.
+                continue
+            cli = self._client_at(target)
+            try:
+                self._reestablish(cli, state)
+            except Exception as exc:  # noqa: BLE001
+                if not self._failover_retryable(exc):
+                    raise
+                if self._walk_retryable(exc):
+                    # Dead/sick target (transport error or Unavailable/
+                    # DeadlineExceeded) — NOT NotFound, which comes from
+                    # a live replica that merely lost its resource view.
+                    self._note_unreachable(target)
+                last = exc
+                continue
+            with self._lock:
+                if peer_id not in self._peer_states:
+                    # Finalized while the re-establish was in flight
+                    # (the terminal call can land directly on a
+                    # still-serving owner without taking state.lock):
+                    # writing the owner back would leak the entry
+                    # forever and resurrect a finished peer. The ghost
+                    # register on the new replica is left to server GC.
+                    raise _PeerFinalizedError(peer_id)
+                old = self._peer_owner.get(peer_id)
+                self._peer_owner[peer_id] = cli
+            state.target = target
+            if old is not None and old is not cli:
+                # The peer may still hold an OPEN announce session on
+                # the old client (cooperative handoff, or failover off
+                # a slow-but-alive replica): close it, or the starved
+                # old replica keeps pushing decisions — including
+                # NeedBackToSource at retry exhaustion — into the same
+                # conductor channel the new session feeds, degrading a
+                # healthy re-homed task. Dead streams drop idempotently.
+                # getattr: duck-typed clients may have no sessions.
+                drop = getattr(old, "_drop_session", None)
+                if drop is not None:
+                    drop(peer_id)
+                self._maybe_close_retired(old)
+            return cli
+        raise last if last is not None else ConnectionError("no schedulers")
+
+    def _peer_call(self, peer_id: str, op):
+        """Run ``op(client)`` against the peer's owner; on a
+        dead-replica failure, transparently fail over — re-register the
+        peer on a live replica, replay its state, and retry the call
+        once there. The re-route latency (first failure → retried OK)
+        lands in the recovery ring the chaos bench bounds."""
+        with self._lock:
+            owner = self._peer_owner.get(peer_id)
+            state = self._peer_states.get(peer_id)
+        if owner is None and state is None:
+            raise ServiceError("NotFound", f"no scheduler owns peer {peer_id}")
+        cause: Optional[Exception] = None
+        if owner is not None:
+            try:
+                return op(owner)
+            except Exception as exc:  # noqa: BLE001
+                if state is None or not self._failover_retryable(exc):
+                    raise
+                cause = exc
+        t0 = time.monotonic()
+        with state.lock:
+            with self._lock:
+                current = self._peer_owner.get(peer_id)
+                finalized = peer_id not in self._peer_states
+            if finalized:
+                # The peer's terminal report finalized it while we
+                # waited on the lock — re-homing now would resurrect a
+                # finished peer (ghost RUNNING until GC) and leak the
+                # owner entry forever. Surface the original failure.
+                raise cause if cause is not None else ServiceError(
+                    "NotFound", f"peer {peer_id} already finalized")
+            if current is not None and current is not owner:
+                # Another thread already re-homed this peer while we
+                # waited on the lock — just retry on the new owner.
+                try:
+                    return op(current)
+                except Exception as exc:  # noqa: BLE001
+                    if not self._failover_retryable(exc):
+                        raise
+                    cause = exc
+            failed_target = state.target
+            # Walk-retryable = dead/sick target. NotFound is excluded:
+            # it comes from a HEALTHY replica that merely lost its
+            # resource view (restart) — re-registration heals it, so it
+            # must be neither negative-cached (deprioritizing a live
+            # target for every other walk) nor avoided in the re-home
+            # (re-homing a task's peer AWAY from its healthy ring owner
+            # would split the swarm across replicas: fresh registers of
+            # the same task still walk to the owner).
+            target_sick = cause is not None and self._walk_retryable(cause)
+            if failed_target and target_sick:
+                self._note_unreachable(failed_target)
+            try:
+                cli = self._rehome_locked(
+                    peer_id, state,
+                    avoid=failed_target if target_sick else "")
+            except _PeerFinalizedError:
+                # The terminal call landed directly on the old owner
+                # while we were re-establishing — the peer is done;
+                # surface the original failure, don't retry a finished
+                # peer on the new replica.
+                raise cause if cause is not None else ServiceError(
+                    "NotFound", f"peer {peer_id} already finalized")
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("failover for peer %s failed: %s",
+                               peer_id, exc)
+                raise (cause if cause is not None else exc) from exc
+            result = op(cli)
+            # Counted only once the retried call SUCCEEDS — after the
+            # raced-rehome/finalize checks — so one replica loss
+            # observed by N concurrent calls (or a failed proactive
+            # attempt followed by this reactive one) is one failover,
+            # exactly matching the reroute sample it produces, and a
+            # rehome whose retry then fails (new replica also dying)
+            # never reports a successful re-route it didn't deliver.
+            self.recovery.tick("scheduler_failovers")
+            self.recovery.observe_reroute(time.monotonic() - t0)
+            logger.info("peer %s re-routed %s -> %s", peer_id,
+                        failed_target, state.target)
+            return result
 
     def leave_peer(self, peer_id: str) -> None:
         """Peers may leave after their terminal report finalized the owner
@@ -1036,49 +1590,88 @@ class BalancedSchedulerClient:
             except Exception:  # noqa: BLE001 — replica may not know the peer
                 continue
 
-    def _owner(self, peer_id: str) -> GrpcSchedulerClient:
+    def peer_session_targets(self) -> List[str]:
+        """Snapshot of each live peer session's current target, taken
+        under the lock — daemon threads register and finalize sessions
+        concurrently (benches poll this to find the busiest replica)."""
         with self._lock:
-            owner = self._peer_owner.get(peer_id)
-        if owner is None:
-            raise ServiceError("NotFound", f"no scheduler owns peer {peer_id}")
-        return owner
+            return [s.target for s in self._peer_states.values()]
 
     def _finalize(self, peer_id: str) -> None:
-        close_me = None
         with self._lock:
+            self._peer_states.pop(peer_id, None)
             owner = self._peer_owner.pop(peer_id, None)
-            if (owner is not None and owner in self._retired
-                    and owner not in self._peer_owner.values()):
-                self._retired.discard(owner)
-                close_me = owner
-        if close_me is not None:
-            close_me.close()
+        if owner is not None:
+            self._maybe_close_retired(owner)
+
+    # Replay state is recorded BEFORE the wire call, under state.lock:
+    # recording after leaves a window where the owner dies between the
+    # RPC returning and the marker landing, and the proactive re-home
+    # (fired from the read-loop thread the instant the stream breaks)
+    # replays WITHOUT it — a peer re-registered minus its "started"
+    # marker never gets parent decisions and degrades to back-to-source.
+    # Over-recording is safe: started/piece replays are idempotent
+    # upserts server-side, and the failed call is retried after replay.
+
+    def _mark_started(self, peer_id: str,
+                      back_to_source: bool = False) -> None:
+        with self._lock:
+            state = self._peer_states.get(peer_id)
+        if state is None:
+            return
+        with state.lock:
+            if back_to_source:
+                state.back_to_source_started = True
+            else:
+                state.started = True
+
+    def _record_pieces(self, peer_id: str, reports) -> None:
+        with self._lock:
+            state = self._peer_states.get(peer_id)
+        if state is None:
+            return
+        with state.lock:
+            for report in reports:
+                state.pieces[report.piece_number] = report
 
     def download_peer_started(self, peer_id: str) -> None:
-        self._owner(peer_id).download_peer_started(peer_id)
+        self._mark_started(peer_id)
+        self._peer_call(peer_id,
+                        lambda cli: cli.download_peer_started(peer_id))
 
     def download_peer_back_to_source_started(self, peer_id: str) -> None:
-        self._owner(peer_id).download_peer_back_to_source_started(peer_id)
+        self._mark_started(peer_id, back_to_source=True)
+        self._peer_call(
+            peer_id,
+            lambda cli: cli.download_peer_back_to_source_started(peer_id))
 
     def download_piece_finished(self, report: PieceFinished) -> None:
-        self._owner(report.peer_id).download_piece_finished(report)
+        self._record_pieces(report.peer_id, [report])
+        self._peer_call(report.peer_id,
+                        lambda cli: cli.download_piece_finished(report))
 
     def download_pieces_finished(self, reports) -> None:
         reports = list(reports)
         if not reports:
             return
         # One flush = one conductor = one peer = one owning scheduler.
-        self._owner(reports[0].peer_id).download_pieces_finished(reports)
+        self._record_pieces(reports[0].peer_id, reports)
+        self._peer_call(reports[0].peer_id,
+                        lambda cli: cli.download_pieces_finished(reports))
 
     def download_piece_failed(self, peer_id: str, parent_id: str,
                               piece_number: int) -> None:
-        self._owner(peer_id).download_piece_failed(
-            peer_id, parent_id, piece_number)
+        self._peer_call(
+            peer_id,
+            lambda cli: cli.download_piece_failed(
+                peer_id, parent_id, piece_number))
 
     def download_peer_finished(self, peer_id: str,
                                cost_seconds: float = 0.0) -> None:
         try:
-            self._owner(peer_id).download_peer_finished(peer_id, cost_seconds)
+            self._peer_call(
+                peer_id,
+                lambda cli: cli.download_peer_finished(peer_id, cost_seconds))
         finally:
             self._finalize(peer_id)
 
@@ -1087,28 +1680,36 @@ class BalancedSchedulerClient:
         cost_seconds: float = 0.0,
     ) -> None:
         try:
-            self._owner(peer_id).download_peer_back_to_source_finished(
-                peer_id, content_length, total_piece_count, cost_seconds)
+            self._peer_call(
+                peer_id,
+                lambda cli: cli.download_peer_back_to_source_finished(
+                    peer_id, content_length, total_piece_count, cost_seconds))
         finally:
             self._finalize(peer_id)
 
     def download_peer_failed(self, peer_id: str) -> None:
         try:
-            self._owner(peer_id).download_peer_failed(peer_id)
+            self._peer_call(peer_id,
+                            lambda cli: cli.download_peer_failed(peer_id))
         finally:
             self._finalize(peer_id)
 
     def download_peer_back_to_source_failed(self, peer_id: str) -> None:
         try:
-            self._owner(peer_id).download_peer_back_to_source_failed(peer_id)
+            self._peer_call(
+                peer_id,
+                lambda cli: cli.download_peer_back_to_source_failed(peer_id))
         finally:
             self._finalize(peer_id)
 
     def close(self) -> None:
         with self._lock:
-            clients = list(self._clients.values())
+            clients = list(self._clients.values()) + list(self._retired)
             self._clients.clear()
+            self._retired.clear()
             self._peer_owner.clear()
+            self._peer_states.clear()
+            self._known_hosts.clear()
             health_clients = list(self._health_clients.values())
             self._health_clients.clear()
             self._health_cache.clear()

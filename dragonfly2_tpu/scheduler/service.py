@@ -219,6 +219,18 @@ class SchedulerService:
         if channel is not None:
             peer.announce_channel = channel
 
+        # Idempotent re-registration (a peer past PENDING registered
+        # before): the failover/handoff path re-establishing a session
+        # lost with a dead replica — or replayed onto THIS replica after
+        # a restart. A cheap upsert, never an error: the channel above is
+        # refreshed so new decisions reach the peer, the FSM is left
+        # alone (the peer is mid-download), and the caller replays
+        # started/pieces right after. Counted so rolling restarts are
+        # visible on /debug/vars.
+        if not peer.fsm.is_state(PeerState.PENDING):
+            self.stats.observe_reregistration()
+            return self._scope_response(task, task.size_scope())
+
         # Priority ladder (service_v2.go:1308-1375 downloadTaskBySeedPeer;
         # the LEVEL1/LEVEL2 rejections fired above, pre-storage): LEVEL3
         # makes THIS peer back-source first instead of warming a seed;
@@ -231,23 +243,34 @@ class SchedulerService:
             self._maybe_trigger_seed_peer(task)
 
         scope = task.size_scope()
-        if task.fsm.is_state(TaskState.SUCCEEDED) and scope == SizeScope.EMPTY:
+        succeeded = task.fsm.is_state(TaskState.SUCCEEDED)
+        if succeeded and scope == SizeScope.EMPTY:
             peer.fsm.fire(PeerEvent.REGISTER_EMPTY)
-            return RegisterPeerResponse(SizeScope.EMPTY, content_length=0)
-        if (task.fsm.is_state(TaskState.SUCCEEDED) and scope == SizeScope.TINY
-                and task.direct_piece):
+        elif succeeded and scope == SizeScope.TINY and task.direct_piece:
             peer.fsm.fire(PeerEvent.REGISTER_TINY)
+        elif scope == SizeScope.SMALL and task.has_available_peer():
+            peer.fsm.fire(PeerEvent.REGISTER_SMALL)
+        else:
+            peer.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        return self._scope_response(task, scope)
+
+    @staticmethod
+    def _scope_response(task: Task, scope: SizeScope) -> RegisterPeerResponse:
+        """Scope → register-response mapping, shared by fresh
+        registration (which fires the matching FSM event first) and the
+        idempotent re-registration upsert (which answers from task
+        state without touching the mid-download peer's FSM)."""
+        succeeded = task.fsm.is_state(TaskState.SUCCEEDED)
+        if succeeded and scope == SizeScope.EMPTY:
+            return RegisterPeerResponse(SizeScope.EMPTY, content_length=0)
+        if succeeded and scope == SizeScope.TINY and task.direct_piece:
             return RegisterPeerResponse(
                 SizeScope.TINY, direct_piece=task.direct_piece,
                 content_length=task.content_length,
                 total_piece_count=task.total_piece_count,
             )
-        if scope == SizeScope.SMALL and task.has_available_peer():
-            peer.fsm.fire(PeerEvent.REGISTER_SMALL)
-        else:
-            peer.fsm.fire(PeerEvent.REGISTER_NORMAL)
         return RegisterPeerResponse(
-            SizeScope.NORMAL if scope in (SizeScope.UNKNOW,) else scope,
+            SizeScope.NORMAL if scope == SizeScope.UNKNOW else scope,
             content_length=task.content_length,
             total_piece_count=task.total_piece_count,
         )
@@ -305,18 +328,38 @@ class SchedulerService:
     # ------------------------------------------------------------------
 
     def download_peer_started(self, peer_id: str) -> None:
-        """(service_v2.go DownloadPeerStartedRequest) → schedule."""
+        """(service_v2.go DownloadPeerStartedRequest) → schedule.
+
+        Idempotent for a peer already RUNNING: the failover path replays
+        ``started`` when it re-homes a session, and the replay's job is
+        exactly the reschedule below (the new replica must start issuing
+        parent decisions). Any other out-of-order state still raises."""
         peer = self._peer(peer_id)
         if peer.task.fsm.can(TaskEvent.DOWNLOAD):
             peer.task.fsm.fire(TaskEvent.DOWNLOAD)
-        peer.fsm.fire(PeerEvent.DOWNLOAD)
+        if peer.fsm.is_state(PeerState.BACK_TO_SOURCE):
+            # Failover replays 'started' before 'back_to_source_started'
+            # in session order; a peer that already degraded needs no
+            # parent decisions — the replay is a no-op, not an FSM
+            # violation.
+            return
+        if peer.fsm.can(PeerEvent.DOWNLOAD):
+            peer.fsm.fire(PeerEvent.DOWNLOAD)
+        elif not peer.fsm.is_state(PeerState.RUNNING):
+            peer.fsm.fire(PeerEvent.DOWNLOAD)  # raises InvalidTransition
         self._schedule_timed(peer)
 
     def download_peer_back_to_source_started(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
         if peer.task.fsm.can(TaskEvent.DOWNLOAD):
             peer.task.fsm.fire(TaskEvent.DOWNLOAD)
-        peer.fsm.fire(PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+        # Same idempotency contract as download_peer_started: a replayed
+        # back-to-source start on a peer already in BACK_TO_SOURCE is an
+        # upsert of task membership, not an FSM violation.
+        if peer.fsm.can(PeerEvent.DOWNLOAD_BACK_TO_SOURCE):
+            peer.fsm.fire(PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+        elif not peer.fsm.is_state(PeerState.BACK_TO_SOURCE):
+            peer.fsm.fire(PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
         peer.task.back_to_source_peers.add(peer.id)
 
     def download_piece_finished(self, report: PieceFinished) -> None:

@@ -47,6 +47,12 @@ site                    where it fires
                         :class:`RpcFaultProxy` (context = method name)
 ``storage.write``       ``TaskStorage.write_piece`` (context = task id)
 ``infer.model_infer``   sidecar ``ModelInfer`` (context = model name)
+``scheduler.process``   process-level replica kills: the chaos bench's
+                        replica supervisor polls :func:`should_kill` per
+                        live scheduler replica (context = replica target)
+                        and SIGKILLs the one whose visit fires a ``KILL``
+                        rule — hard replica death, complementing the
+                        RPC-level ``scheduler.rpc`` faults
 ======================  =====================================================
 """
 
@@ -70,6 +76,7 @@ class FaultKind(enum.Enum):
     UNAVAILABLE = "unavailable"           # gRPC UNAVAILABLE
     DEADLINE = "deadline_exceeded"        # gRPC DEADLINE_EXCEEDED
     ENOSPC = "enospc"                     # disk full on write
+    KILL = "kill"                         # SIGKILL a whole process (bench)
 
 
 @dataclass
@@ -294,6 +301,17 @@ def maybe_raise_rpc(plan: FaultPlan, site: str, context: str = "") -> None:
         raise ServiceError(
             "DeadlineExceeded",
             f"injected DEADLINE_EXCEEDED at {site} ({context})")
+
+
+def should_kill(plan: FaultPlan, site: str, context: str = "") -> bool:
+    """Process-level site (``scheduler.process``): the supervisor that
+    OWNS the child processes polls this per live process; True means the
+    visit fired a ``KILL`` rule and the caller must hard-kill the
+    process named by ``context``. The decision (which visit fires) is
+    seeded like every other site; the kill itself stays with the caller
+    because only it holds the Popen handles."""
+    rule = plan.check(site, context)
+    return rule is not None and rule.kind is FaultKind.KILL
 
 
 class RpcFaultProxy:

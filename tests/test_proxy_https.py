@@ -15,6 +15,8 @@ import ssl
 
 import pytest
 
+pytest.importorskip("cryptography", reason="HTTPS interception tests need the optional cryptography package")
+
 from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
 from dragonfly2_tpu.client.proxy import (
     HEADER_TASK_ID,

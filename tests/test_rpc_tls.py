@@ -6,6 +6,8 @@ from __future__ import annotations
 import grpc
 import pytest
 
+pytest.importorskip("cryptography", reason="TLS tests need the optional cryptography package")
+
 from dragonfly2_tpu.rpc import ServiceClient, serve
 from dragonfly2_tpu.rpc.client import ClientTLS
 from dragonfly2_tpu.rpc.service import MethodKind, ServerTLS, ServiceSpec

@@ -1,0 +1,1007 @@
+"""Multi-scheduler HA: peer-session failover with state handoff (ISSUE 6).
+
+A peer's announce session used to be pinned to the replica that
+registered it — replica death mid-download meant every peer-keyed call
+failed until ``scheduler_grace`` degraded the task to back-to-source.
+These tests pin the new contract:
+
+- server-side re-registration is an idempotent upsert (counted, never an
+  error), and replayed started/piece reports are upserts too;
+- ``BalancedSchedulerClient`` fails peer-keyed calls over to a live
+  replica, re-establishing the session and replaying state, reactively
+  (on a failing call) AND proactively (on announce-stream loss);
+- ``update_targets`` removal cooperatively re-homes in-flight peers
+  (the rolling-restart path), with the retired client closed exactly
+  once when it drains;
+- negative health caching keeps dead targets out of the walk without
+  locking out a recovered replica;
+- the failover/re-registration/handoff counters are visible in the
+  ``recovery`` and ``scheduler`` ``/debug/vars`` blocks.
+
+The multi-process scheduler-kill rung and the rolling-restart e2e carry
+``slow`` + ``ha`` (registered markers; run with ``-m ha``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.recovery import RecoveryStats
+from dragonfly2_tpu.scheduler.controlstats import ControlPlaneStats
+from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+from dragonfly2_tpu.scheduler.resource.host import Host
+from dragonfly2_tpu.scheduler.resource.resource import Resource
+from dragonfly2_tpu.scheduler.resource.task import SizeScope
+from dragonfly2_tpu.scheduler.rpcserver import (
+    SCHEDULER_SPEC,
+    BalancedSchedulerClient,
+    GrpcSchedulerClient,
+    SchedulerRpcService,
+)
+from dragonfly2_tpu.scheduler.scheduling.core import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    RegisterPeerRequest,
+    RegisterPeerResponse,
+    SchedulerService,
+    ServiceError,
+)
+from dragonfly2_tpu.scheduler.storage.storage import Storage
+
+
+def make_service(tmp_path, name: str, stats=None) -> SchedulerService:
+    return SchedulerService(
+        resource=Resource(),
+        scheduling=Scheduling(
+            BaseEvaluator(),
+            SchedulingConfig(retry_interval=0.01,
+                             retry_back_to_source_limit=2),
+        ),
+        storage=Storage(str(tmp_path / f"datasets-{name}")),
+        stats=stats,
+    )
+
+
+def make_grpc_scheduler(tmp_path, name: str, stats=None):
+    from dragonfly2_tpu.rpc import serve
+
+    service = make_service(tmp_path, name, stats=stats)
+    server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))])
+    return service, server
+
+
+def make_host(host_id: str = "h1") -> Host:
+    return Host(id=host_id, hostname=host_id, ip="127.0.0.1",
+                port=1, download_port=1)
+
+
+def register_request(peer_id: str = "p1", task_id: str = "t1",
+                     host_id: str = "h1") -> RegisterPeerRequest:
+    return RegisterPeerRequest(
+        host_id=host_id, task_id=task_id, peer_id=peer_id,
+        url="http://origin/blob")
+
+
+def make_channel():
+    from dragonfly2_tpu.client.peer_task import QueueChannel
+
+    return QueueChannel()
+
+
+def wait_for(predicate, timeout: float = 5.0, interval: float = 0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ----------------------------------------------------------------------
+# Server side: idempotent re-registration
+# ----------------------------------------------------------------------
+
+
+class TestIdempotentReregistration:
+    def test_double_register_is_counted_upsert(self, tmp_path):
+        stats = ControlPlaneStats()
+        svc = make_service(tmp_path, "s1", stats=stats)
+        svc.announce_host(make_host())
+        first = svc.register_peer(register_request(), channel=make_channel())
+        svc.download_peer_started("p1")
+        peer = svc.resource.peer_manager.load("p1")
+        assert peer.fsm.current == "Running"
+
+        again = svc.register_peer(register_request(), channel=make_channel())
+        assert isinstance(again, RegisterPeerResponse)
+        assert again.size_scope == first.size_scope == SizeScope.NORMAL
+        # The peer was NOT reset: still the same object, still Running.
+        assert svc.resource.peer_manager.load("p1") is peer
+        assert peer.fsm.current == "Running"
+        assert stats.peer_reregistrations == 1
+        assert stats.snapshot()["peer_reregistrations"] == 1
+
+    def test_replayed_started_reschedules_instead_of_raising(self, tmp_path):
+        svc = make_service(tmp_path, "s1")
+        svc.announce_host(make_host())
+        svc.register_peer(register_request(), channel=make_channel())
+        svc.download_peer_started("p1")
+        # The failover replay: started on an already-Running peer.
+        svc.download_peer_started("p1")
+        assert svc.resource.peer_manager.load("p1").fsm.current == "Running"
+
+    def test_replayed_started_on_back_to_source_peer_is_noop(self, tmp_path):
+        """_reestablish replays 'started' before 'back_to_source_started'
+        (session order); when the target replica already holds the peer
+        in BACK_TO_SOURCE — same-replica stream blip, restart on the
+        same address — the replay must be a no-op, not InvalidTransition
+        (which would abort the whole re-home)."""
+        svc = make_service(tmp_path, "s1")
+        svc.announce_host(make_host())
+        svc.register_peer(register_request(), channel=make_channel())
+        svc.download_peer_started("p1")
+        svc.download_peer_back_to_source_started("p1")
+        svc.download_peer_started("p1")  # the replay
+        peer = svc.resource.peer_manager.load("p1")
+        assert peer.fsm.current == "BackToSource"
+        assert "p1" in peer.task.back_to_source_peers
+
+    def test_replayed_back_to_source_started_is_idempotent(self, tmp_path):
+        svc = make_service(tmp_path, "s1")
+        svc.announce_host(make_host())
+        svc.register_peer(register_request())
+        svc.download_peer_back_to_source_started("p1")
+        svc.download_peer_back_to_source_started("p1")
+        peer = svc.resource.peer_manager.load("p1")
+        assert peer.fsm.current == "BackToSource"
+        assert "p1" in peer.task.back_to_source_peers
+
+    def test_duplicate_piece_reports_are_upserts(self, tmp_path):
+        """Exactly-once statistics over at-least-once delivery: a
+        replayed/redelivered report must not inflate finished counts or
+        the piece-cost window."""
+        svc = make_service(tmp_path, "s1")
+        svc.announce_host(make_host())
+        svc.register_peer(register_request())
+        svc.download_peer_back_to_source_started("p1")
+        report = PieceFinished(peer_id="p1", piece_number=0, parent_id="",
+                               offset=0, length=64, digest="md5:x",
+                               cost_ns=1000)
+        svc.download_piece_finished(report)
+        svc.download_pieces_finished([report, report])  # replay + dup
+        peer = svc.resource.peer_manager.load("p1")
+        assert peer.finished_piece_count() == 1
+        assert peer.piece_cost_stats().snapshot()[0] == 1  # one cost sample
+
+    def test_fresh_register_still_rejects_bad_priority(self, tmp_path):
+        svc = make_service(tmp_path, "s1")
+        svc.announce_host(make_host())
+        req = register_request()
+        req.priority = 1
+        with pytest.raises(ServiceError):
+            svc.register_peer(req)
+
+
+# ----------------------------------------------------------------------
+# Client side: failover with stub clients
+# ----------------------------------------------------------------------
+
+
+class StubSchedulerClient:
+    """In-memory GrpcSchedulerClient shape with a kill switch."""
+
+    def __init__(self, target: str):
+        self.target = target
+        self.dead = False
+        self.know_hosts = True
+        self.registered = []      # RegisterPeerRequest list
+        self.announced = []       # Host list
+        self.started = []
+        self.b2s_started = []
+        self.piece_batches = []   # list of report lists
+        self.finished = []
+        self.close_calls = 0
+        self.scope = SizeScope.NORMAL
+        self.dropped = []         # peer_ids whose session was dropped
+
+    def _check(self):
+        if self.dead:
+            raise ServiceError("Unavailable", f"{self.target} is dead")
+
+    def announce_host(self, host):
+        self._check()
+        self.announced.append(host)
+        self.know_hosts = True
+
+    def leave_host(self, host_id):
+        self._check()
+
+    def register_peer(self, req, channel=None):
+        self._check()
+        if not self.know_hosts:
+            raise ServiceError("NotFound",
+                               f"host {req.host_id} not announced")
+        self.registered.append(req)
+        return RegisterPeerResponse(size_scope=self.scope)
+
+    def download_peer_started(self, peer_id):
+        self._check()
+        self.started.append(peer_id)
+
+    def download_peer_back_to_source_started(self, peer_id):
+        self._check()
+        self.b2s_started.append(peer_id)
+
+    def download_piece_finished(self, report):
+        self._check()
+        self.piece_batches.append([report])
+
+    def download_pieces_finished(self, reports):
+        self._check()
+        self.piece_batches.append(list(reports))
+
+    def download_piece_failed(self, peer_id, parent_id, piece_number):
+        self._check()
+
+    def download_peer_finished(self, peer_id, cost_seconds=0.0):
+        self._check()
+        self.finished.append(peer_id)
+
+    def download_peer_back_to_source_finished(self, peer_id, content_length,
+                                              total_piece_count,
+                                              cost_seconds=0.0):
+        self._check()
+
+    def download_peer_failed(self, peer_id):
+        self._check()
+
+    def download_peer_back_to_source_failed(self, peer_id):
+        self._check()
+
+    def leave_peer(self, peer_id):
+        self._check()
+
+    def _drop_session(self, peer_id):
+        self.dropped.append(peer_id)
+
+    def close(self):
+        self.close_calls += 1
+
+
+def make_balanced(targets, recovery=None):
+    stubs = {}
+
+    def factory(target):
+        stubs[target] = StubSchedulerClient(target)
+        return stubs[target]
+
+    balanced = BalancedSchedulerClient(
+        targets, client_factory=factory,
+        health_probe=lambda target: "SERVING",
+        recovery=recovery or RecoveryStats())
+    return balanced, stubs
+
+
+def piece(num: int) -> PieceFinished:
+    return PieceFinished(peer_id="p1", piece_number=num, parent_id="par",
+                         offset=num * 64, length=64, digest="md5:x")
+
+
+class TestBalancedFailover:
+    def test_peer_call_fails_over_with_state_replay(self):
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.register_peer(register_request(task_id="t-x"))
+        balanced.download_peer_started("p1")
+        balanced.download_pieces_finished([piece(0), piece(1)])
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+        assert stubs[owner].registered and stubs[owner].started
+
+        stubs[owner].dead = True
+        balanced.download_pieces_finished([piece(2)])  # triggers failover
+
+        neu = stubs[other]
+        assert [r.peer_id for r in neu.registered] == ["p1"]
+        assert neu.started == ["p1"]  # replayed
+        # Replayed pieces 0,1 + the retried batch [2].
+        replayed = {p.piece_number for batch in neu.piece_batches
+                    for p in batch}
+        assert replayed == {0, 1, 2}
+        assert recovery.get("scheduler_failovers") == 1
+        assert recovery.get("scheduler_reregisters") == 1
+        # Pieces 0,1 plus the in-flight batch [2], which is recorded
+        # BEFORE delivery so a mid-call replica death can't lose it.
+        assert recovery.get("scheduler_failover_pieces_replayed") == 3
+        # The old owner's announce session is dropped on re-home: a
+        # still-alive-but-failed replica must not keep a second stream
+        # pushing decisions into the conductor channel.
+        assert stubs[owner].dropped == ["p1"]
+        snap = recovery.snapshot()
+        assert snap["reroute_samples"] == 1
+        assert "reroute_p99_ms" in snap
+
+    def test_empty_scope_register_drops_session_and_state(self):
+        """EMPTY/TINY downloads return straight from register — no
+        session state may linger (handoff would re-home a ghost) and
+        the underlying announce session must be dropped (one pinned
+        gRPC stream per tiny download otherwise)."""
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        owner = balanced.ring.pick("t-empty")
+        balanced._client_at(owner).scope = SizeScope.EMPTY
+        resp = balanced.register_peer(register_request(task_id="t-empty"))
+        assert resp.size_scope == SizeScope.EMPTY
+        assert "p1" not in balanced._peer_states
+        assert "p1" not in balanced._peer_owner
+        assert stubs[owner].dropped == ["p1"]
+
+    def test_bare_tiny_scope_keeps_session_for_normal_download(self):
+        """TINY without an inline direct_piece does NOT short-circuit
+        the conductor (peer_task checks ``resp.direct_piece``) — the
+        download proceeds normally, so the session state must survive
+        or the very next download_peer_started degrades to source."""
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        owner = balanced.ring.pick("t-tiny")
+        balanced._client_at(owner).scope = SizeScope.TINY
+        resp = balanced.register_peer(register_request(task_id="t-tiny"))
+        assert resp.size_scope == SizeScope.TINY
+        assert "p1" in balanced._peer_states
+        assert "p1" in balanced._peer_owner
+        assert stubs[owner].dropped == []
+        balanced.download_peer_started("p1")
+        assert stubs[owner].started == ["p1"]
+
+    def test_tiny_with_direct_piece_drops_session(self):
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        owner = balanced.ring.pick("t-tiny")
+        stub = stubs.setdefault(owner, balanced._client_at(owner))
+
+        def register_with_payload(req, channel=None):
+            stub.registered.append(req)
+            return RegisterPeerResponse(size_scope=SizeScope.TINY,
+                                        direct_piece=b"payload")
+
+        stub.register_peer = register_with_payload
+        resp = balanced.register_peer(register_request(task_id="t-tiny"))
+        assert resp.direct_piece == b"payload"
+        assert "p1" not in balanced._peer_states
+        assert "p1" not in balanced._peer_owner
+        assert stub.dropped == ["p1"]
+
+    def test_notfound_from_restarted_replica_heals_by_reregistration(self):
+        """A replica that restarted (lost its resource view) answers
+        NotFound — the failover path re-registers rather than erroring
+        the conductor."""
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        stub = stubs[owner]
+
+        original = stub.download_piece_finished
+        calls = {"n": 0}
+
+        def flaky(report):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ServiceError("NotFound", "peer p1 not found")
+            return original(report)
+
+        stub.download_piece_finished = flaky
+        balanced.download_piece_finished(piece(0))
+        # Healed on SOME replica (ring order decides which); the peer was
+        # re-registered exactly once more.
+        assert recovery.get("scheduler_reregisters") == 1
+
+    def test_failover_reannounces_host_to_new_replica(self):
+        """A replica that joined after the daemon's announce learns the
+        host during session re-establishment."""
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.announce_host(make_host())
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+        stubs[other].know_hosts = False
+        stubs[other].announced.clear()
+        stubs[owner].dead = True
+
+        balanced.download_peer_started("p1")
+        assert [h.id for h in stubs[other].announced] == ["h1"]
+        assert [r.peer_id for r in stubs[other].registered] == ["p1"]
+
+    def test_no_replica_left_raises_original_error(self):
+        balanced, stubs = make_balanced(["a:1"])
+        balanced.register_peer(register_request(task_id="t-x"))
+        stubs["a:1"].dead = True
+        with pytest.raises(ServiceError):
+            balanced.download_peer_started("p1")
+
+    def test_replay_state_is_recorded_before_delivery(self):
+        """The started marker and piece records must land in the
+        session state BEFORE the wire call: recording after leaves a
+        window where the owner dies post-RPC and the proactive re-home
+        replays without them (a peer re-registered minus 'started'
+        never gets decisions and degrades to back-to-source)."""
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        seen = {}
+
+        def capture_started(peer_id):
+            with balanced._lock:
+                seen["started"] = balanced._peer_states["p1"].started
+
+        def capture_pieces(reports):
+            with balanced._lock:
+                seen["pieces"] = list(balanced._peer_states["p1"].pieces)
+
+        stubs[owner].download_peer_started = capture_started
+        stubs[owner].download_pieces_finished = capture_pieces
+        balanced.download_peer_started("p1")
+        balanced.download_pieces_finished([piece(0)])
+        assert seen["started"] is True
+        assert seen["pieces"] == [0]
+
+    def test_finalize_during_rehome_does_not_resurrect_owner(self):
+        """The terminal report can land directly on a still-serving old
+        owner (it never takes state.lock) while a re-home is mid-
+        register on the new replica. The rehome must abort instead of
+        writing the owner mapping back — that entry would leak forever
+        and resurrect a finished peer."""
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+        stubs[owner].dead = True
+
+        balanced._client_at(other)  # stubs are created lazily
+        original = stubs[other].register_peer
+
+        def register_then_finalized(req, channel=None):
+            resp = original(req, channel)
+            # Simulate the concurrent terminal call finalizing the
+            # peer while our re-establish was in flight.
+            balanced._finalize("p1")
+            return resp
+
+        stubs[other].register_peer = register_then_finalized
+        with pytest.raises(ServiceError):
+            balanced.download_peer_started("p1")
+        with balanced._lock:
+            assert "p1" not in balanced._peer_owner
+            assert "p1" not in balanced._peer_states
+
+
+class TestNegativeHealthCache:
+    def test_walk_failure_feeds_negative_cache_with_short_ttl(self):
+        probes = []
+
+        def factory(target):
+            stub = StubSchedulerClient(target)
+            if target == "a:1":
+                stub.dead = True
+                stub.register_peer = _raise_conn  # dial timeout shape
+            return stub
+
+        def _raise_conn(req, channel=None):
+            raise ConnectionError("dial a:1 timed out")
+
+        balanced = BalancedSchedulerClient(
+            ["a:1", "b:1"], client_factory=factory,
+            health_probe=lambda t: probes.append(t) or "SERVING",
+            recovery=RecoveryStats())
+        balanced.NEGATIVE_HEALTH_TTL = 0.15
+
+        # Force the walk to start at the dead target regardless of ring
+        # order by registering a task owned by a:1 — find one.
+        task_id = next(f"t-{i}" for i in range(64)
+                       if balanced.ring.pick(f"t-{i}") == "a:1")
+        balanced.register_peer(register_request(task_id=task_id))
+        assert not balanced._serving("a:1")      # negative-cached
+        serving, until = balanced._health_cache["a:1"]
+        assert serving is False
+        assert until - time.monotonic() <= balanced.NEGATIVE_HEALTH_TTL + 0.01
+
+        # The negative verdict expires quickly: the next check probes
+        # again instead of trusting a stale death certificate.
+        probes.clear()
+        time.sleep(0.2)
+        assert balanced._serving("a:1")
+        assert probes == ["a:1"]
+
+    def test_probe_does_not_clobber_fresh_negative_verdict(self):
+        """A probe in flight when a walk failed the target must not
+        overwrite the fresher negative verdict with its serving=True
+        default — that would put the dead target back at the front of
+        every walk for a full HEALTH_TTL."""
+        balanced, _ = make_balanced(["a:1", "b:1"])
+
+        def probe(target):
+            # A concurrent walk pays the dial failure mid-probe...
+            balanced._note_unreachable(target)
+            # ...then the probe completes with an error (dead process),
+            # which _serving treats as serving=True by default.
+            raise ConnectionError("probe raced the death")
+
+        balanced._health_probe = probe
+        assert balanced._serving("a:1") is False
+        serving, until = balanced._health_cache["a:1"]
+        assert serving is False
+        assert until - time.monotonic() <= balanced.NEGATIVE_HEALTH_TTL + 0.01
+
+    def test_serving_cache_is_guarded_under_churn(self):
+        """_serving writes raced update_targets' cache eviction
+        unguarded before ISSUE 6; hammer both paths for a while."""
+        balanced, _ = make_balanced(["a:1", "b:1", "c:1"])
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            flip = True
+            while not stop.is_set():
+                targets = (["a:1", "b:1", "c:1"] if flip
+                           else ["a:1", "b:1"])
+                flip = not flip
+                try:
+                    balanced.update_targets(targets)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        def probe():
+            while not stop.is_set():
+                try:
+                    balanced._serving("c:1")
+                    balanced._note_unreachable("c:1")
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        threads = [threading.Thread(target=churn, daemon=True),
+                   threading.Thread(target=probe, daemon=True),
+                   threading.Thread(target=probe, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        assert not errors
+
+
+class TestConcurrentFanOut:
+    def test_announce_succeeds_when_one_replica_stalls(self):
+        """One dead replica's dial latency must not serialize the whole
+        fan-out: with a 0.3 s stall on one of three replicas, the
+        announce completes in ~one stall, not three."""
+        def factory(target):
+            stub = StubSchedulerClient(target)
+            if target == "slow:1":
+                real = stub.announce_host
+
+                def slow_announce(host):
+                    time.sleep(0.3)
+                    return real(host)
+
+                stub.announce_host = slow_announce
+            return stub
+
+        balanced = BalancedSchedulerClient(
+            ["slow:1", "b:1", "c:1"], client_factory=factory,
+            health_probe=lambda t: "SERVING", recovery=RecoveryStats())
+        begin = time.monotonic()
+        balanced.announce_host(make_host())
+        assert time.monotonic() - begin < 0.6
+
+    def test_announce_raises_only_when_all_fail(self):
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        balanced.announce_host(make_host())  # creates clients
+        stubs["a:1"].dead = True
+        balanced.announce_host(make_host())  # one alive → fine
+        stubs["b:1"].dead = True
+        with pytest.raises(ConnectionError):
+            balanced.announce_host(make_host())
+
+
+class TestRetiredClientLifecycle:
+    def test_removal_rehomes_peers_and_closes_retired_once(self):
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.announce_host(make_host())
+        balanced.register_peer(register_request(task_id="t-x"))
+        balanced.download_peer_started("p1")
+        balanced.download_pieces_finished([piece(0)])
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+
+        balanced.update_targets([other])
+        # Cooperative handoff: the peer moved to the survivor with its
+        # state replayed, and the retired client closed immediately
+        # (drained), exactly once.
+        assert [r.peer_id for r in stubs[other].registered] == ["p1"]
+        assert stubs[other].started == ["p1"]
+        assert {p.piece_number for batch in stubs[other].piece_batches
+                for p in batch} == {0}
+        assert stubs[owner].close_calls == 1
+        assert recovery.get("scheduler_handoff_rehomed") == 1
+        # Later traffic flows to the survivor without further failover.
+        balanced.download_peer_finished("p1")
+        assert stubs[other].finished == ["p1"]
+        assert recovery.get("scheduler_failovers") == 0
+        assert stubs[owner].close_calls == 1
+
+    def test_unmovable_peer_keeps_retired_client_until_finalize(self):
+        recovery = RecoveryStats()
+        balanced, stubs = make_balanced(["a:1", "b:1"], recovery)
+        balanced.announce_host(make_host())  # instantiates both stubs
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+        # The replacement is unreachable: the handoff must strand the
+        # peer on the (still-draining) retired client, not lose it.
+        stubs[other].dead = True
+
+        balanced.update_targets([other])
+        assert recovery.get("scheduler_handoff_stranded") == 1
+        assert stubs[owner].close_calls == 0  # still owns an in-flight peer
+
+        # The retired replica finishes serving its peer; the final
+        # report closes it exactly once.
+        stubs[other].dead = False  # irrelevant for the pinned session
+        balanced.download_peer_finished("p1")
+        assert stubs[owner].finished == ["p1"]
+        assert stubs[owner].close_calls == 1
+
+    def test_close_closes_retired_clients_once(self):
+        balanced, stubs = make_balanced(["a:1", "b:1"])
+        balanced.announce_host(make_host())  # instantiates both stubs
+        balanced.register_peer(register_request(task_id="t-x"))
+        owner = balanced.ring.pick("t-x")
+        other = "b:1" if owner == "a:1" else "a:1"
+        stubs[other].dead = True
+        balanced.update_targets([other])  # owner retired, peer stranded
+        balanced.close()
+        assert stubs[owner].close_calls == 1
+
+
+class TestDebugVarsVisibility:
+    def test_failover_counters_published_on_debug_vars(self):
+        """The acceptance contract: failover/re-registration/handoff
+        counters are visible in the /debug/vars recovery and scheduler
+        blocks (the process-wide instances debugmon publishes)."""
+        from dragonfly2_tpu.utils.debugmon import debug_vars
+
+        blocks = debug_vars()
+        recovery = blocks["recovery"]
+        for key in ("scheduler_failovers", "scheduler_reregisters",
+                    "scheduler_failover_pieces_replayed",
+                    "scheduler_handoff_rehomed",
+                    "scheduler_handoff_stranded",
+                    "reroute_p50_ms", "reroute_p99_ms", "reroute_samples"):
+            assert key in recovery
+        assert "peer_reregistrations" in blocks["scheduler"]
+
+
+# ----------------------------------------------------------------------
+# Real gRPC: dead-stream detection + failover e2e
+# ----------------------------------------------------------------------
+
+
+class TestDeadStreamDetection:
+    def test_send_on_lost_stream_raises_unavailable(self, tmp_path):
+        service, server = make_grpc_scheduler(tmp_path, "s1")
+        cli = GrpcSchedulerClient(server.target)
+        try:
+            service.announce_host(make_host())
+            cli.register_peer(register_request())
+            # Grab the session BEFORE stopping: the read loop's finally
+            # drops it from _sessions, and on a fast cleanup _session()
+            # already answers None right after stop().
+            session = cli._session("p1")
+            server.stop(grace=0)
+            assert wait_for(lambda: session.dead)
+            with pytest.raises(ServiceError) as err:
+                cli.download_peer_started("p1")
+            # Unavailable while the poisoned session lingers, NotFound
+            # once the read loop's finally dropped it — both fail fast
+            # into the failover path.
+            assert err.value.code in ("Unavailable", "NotFound")
+            # The dead session must not leak: after failover the peer
+            # finalizes on its NEW owner, so nothing else ever pops it.
+            assert wait_for(lambda: cli._session("p1") is None)
+        finally:
+            cli.close()
+
+    def test_dead_stream_drop_spares_a_reestablished_session(self, tmp_path):
+        """When the replica restarts on the same address, the session-
+        lost hook can re-home the peer onto the SAME client before the
+        dead stream's finally runs — the conditional drop must leave
+        that fresh session alone."""
+        _, server = make_grpc_scheduler(tmp_path, "s1")
+        cli = GrpcSchedulerClient(server.target)
+        try:
+            import queue as queue_mod
+
+            from dragonfly2_tpu.scheduler.rpcserver import _AnnounceSession
+
+            stale = _AnnounceSession(iter(()), queue_mod.Queue(), "p1")
+            fresh = _AnnounceSession(iter(()), queue_mod.Queue(), "p1")
+            cli._sessions["p1"] = fresh
+            cli._drop_session("p1", only=stale)  # stale's cleanup
+            assert cli._session("p1") is fresh
+            assert not fresh.closing
+            cli._drop_session("p1", only=fresh)
+            assert cli._session("p1") is None
+            assert fresh.closing
+        finally:
+            cli.close()
+            server.stop(grace=0)
+
+    def test_read_loop_closes_dead_session_even_when_rehomed(self, tmp_path):
+        """The dead stream's request-pump thread blocks on
+        send_queue.get() until close() poisons it — when the session-
+        lost hook re-homed the peer onto this SAME client (replica
+        restarted on the same address), the guarded map drop no-ops, so
+        the read-loop finally must close the dead session itself or the
+        thread leaks for the process lifetime."""
+        _, server = make_grpc_scheduler(tmp_path, "s1")
+        cli = GrpcSchedulerClient(server.target)
+        try:
+            import queue as queue_mod
+
+            from dragonfly2_tpu.scheduler.rpcserver import (
+                WireRegisterResponse,
+                _AnnounceSession,
+            )
+
+            responses = iter([WireRegisterResponse()])  # register, then EOF
+            stale = _AnnounceSession(responses, queue_mod.Queue(), "p1")
+            fresh = _AnnounceSession(iter(()), queue_mod.Queue(), "p1")
+
+            def rehome(client, peer_id, lost_session):
+                assert lost_session is stale
+                client._sessions[peer_id] = fresh
+
+            cli.on_session_lost = rehome
+            cli._sessions["p1"] = stale
+            cli._read_loop(stale, None)
+            assert stale.dead
+            assert stale.closing  # queue poisoned despite the re-home
+            assert stale.send_queue.get(timeout=1) is None
+            assert cli._session("p1") is fresh  # re-home survived
+            assert not fresh.closing
+        finally:
+            cli.close()
+            server.stop(grace=0)
+
+    def test_clean_close_is_not_marked_dead(self, tmp_path):
+        service, server = make_grpc_scheduler(tmp_path, "s1")
+        cli = GrpcSchedulerClient(server.target)
+        try:
+            service.announce_host(make_host())
+            cli.register_peer(register_request())
+            session = cli._session("p1")
+            cli.download_peer_started("p1")
+            cli.download_peer_failed("p1")  # final=True → clean close
+            time.sleep(0.2)
+            assert session.closing and not session.dead
+        finally:
+            cli.close()
+            server.stop(grace=0)
+
+
+class TestGrpcFailover:
+    def test_replica_kill_rehomes_peer_with_state(self, tmp_path):
+        recovery = RecoveryStats()
+        s1, srv1 = make_grpc_scheduler(tmp_path, "s1")
+        s2, srv2 = make_grpc_scheduler(tmp_path, "s2")
+        balanced = BalancedSchedulerClient([srv1.target, srv2.target],
+                                           recovery=recovery)
+        try:
+            balanced.announce_host(make_host())
+            task_id = next(
+                f"t-{i}" for i in range(64)
+                if balanced.ring.pick(f"t-{i}") == srv1.target)
+            balanced.register_peer(register_request(task_id=task_id))
+            balanced.download_peer_started("p1")
+            balanced.download_pieces_finished([
+                PieceFinished(peer_id="p1", piece_number=0, parent_id="",
+                              offset=0, length=64, digest="md5:x")])
+            assert s1.resource.peer_manager.load("p1") is not None
+
+            srv1.stop(grace=0)
+            # A send can race the kill into the not-yet-detected dead
+            # stream; the client records it in the session state either
+            # way, so the proactive (stream-loss hook) or reactive
+            # failover replays it — the peer must land on replica 2
+            # with ALL pieces, not just the post-kill one.
+            balanced.download_pieces_finished([
+                PieceFinished(peer_id="p1", piece_number=1, parent_id="",
+                              offset=64, length=64, digest="md5:y")])
+            assert wait_for(
+                lambda: s2.resource.peer_manager.load("p1") is not None)
+            peer = s2.resource.peer_manager.load("p1")
+            assert wait_for(lambda: peer.finished_piece_count() == 2)
+            assert peer.fsm.current == "Running"
+            assert recovery.get("scheduler_reregisters") >= 1
+            assert wait_for(
+                lambda: recovery.snapshot()["reroute_samples"] >= 1)
+        finally:
+            balanced.close()
+            srv2.stop(grace=0)
+
+
+# ----------------------------------------------------------------------
+# Slow tier: rolling restart + the multi-process kill rung
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def small_pieces(monkeypatch):
+    from dragonfly2_tpu.client import peer_task as peer_task_mod
+
+    monkeypatch.setattr(peer_task_mod, "compute_piece_size",
+                        lambda content_length: 64 << 10)
+
+
+@pytest.mark.slow
+@pytest.mark.ha
+class TestRollingRestart:
+    def test_cycling_every_replica_drops_nothing(self, tmp_path,
+                                                 small_pieces):
+        """The zero-drop rolling-restart story: cycle all three replicas
+        one at a time (NOT_SERVING drain → stop → replacement →
+        update_targets) under an active swarm; every task must finish
+        byte-exact with 0 scheduler degrades."""
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.client.peer_task import PeerTaskOptions
+        from tests.fileserver import FileServer
+
+        recovery = RecoveryStats()
+        replicas = {}
+        for name in ("r0", "r1", "r2"):
+            replicas[name] = make_grpc_scheduler(tmp_path, name)
+        targets = {name: srv.target for name, (_, srv) in replicas.items()}
+        balanced = BalancedSchedulerClient(list(targets.values()),
+                                           recovery=recovery)
+        options = PeerTaskOptions(
+            native_data_plane=False, timeout=60.0, scheduler_grace=2.0,
+            metadata_timeout=2.0, backoff_base=0.01, backoff_cap=0.2)
+        daemons = [
+            Daemon(balanced, DaemonConfig(
+                storage_root=str(tmp_path / f"daemon-{i}"),
+                hostname=f"peer-{i}", keep_storage=False,
+                task_options=options, recovery_stats=recovery,
+                # Throttle so downloads SPAN the replica cycles below —
+                # unthrottled loopback finishes each task in ~100 ms and
+                # the roll (whose NOT_SERVING drain window alone is
+                # 0.2 s) would never catch a session in flight.
+                total_download_rate_bps=1 << 20))
+            for i in range(2)
+        ]
+        origin_root = tmp_path / "origin"
+        origin_root.mkdir()
+        blobs = {f"roll-{i}.bin": os.urandom((2 << 20) + i)
+                 for i in range(4)}
+        for name, blob in blobs.items():
+            (origin_root / name).write_bytes(blob)
+
+        results = []
+        results_lock = threading.Lock()
+        try:
+            for d in daemons:
+                d.start()
+            with FileServer(str(origin_root)) as origin:
+                work = [(daemon, name) for name in blobs
+                        for daemon in daemons]
+
+                def downloader(jobs):
+                    for daemon, name in jobs:
+                        try:
+                            res = daemon.download_file(origin.url(name))
+                            ok = (res.success and hashlib.md5(
+                                res.read_all()).hexdigest()
+                                == hashlib.md5(blobs[name]).hexdigest())
+                            err = "" if ok else (res.error or "md5")
+                        except Exception as exc:  # noqa: BLE001
+                            ok, err = False, repr(exc)
+                        with results_lock:
+                            results.append((name, ok, err))
+                        time.sleep(0.05)
+
+                threads = [
+                    threading.Thread(target=downloader,
+                                     args=(work[i::3],), daemon=True)
+                    for i in range(3)
+                ]
+                for t in threads:
+                    t.start()
+
+                # Roll every replica while the swarm is live, busiest
+                # un-rolled replica first: a fixed order can burn its
+                # wait on a replica the ring gave no tasks while the
+                # swarm drains, proving nothing. Waiting for ANY
+                # un-rolled replica to own a live session (every active
+                # session lives on some replica) guarantees the first
+                # roll kills at least one in-flight session — the
+                # handoff/failover path the test exists to exercise.
+                rolled: list = []
+
+                def busiest_unrolled():
+                    counts = {n: 0 for n in replicas if n not in rolled}
+                    for s in list(balanced._peer_states.values()):
+                        for n in counts:
+                            if s.target == targets[n]:
+                                counts[n] += 1
+                    live = [n for n, c in counts.items() if c > 0]
+                    if not live:
+                        return None
+                    return max(live, key=lambda n: counts[n])
+
+                for _ in range(len(replicas)):
+                    wait_for(lambda: busiest_unrolled() is not None,
+                             timeout=3.0)
+                    name = busiest_unrolled() or next(
+                        n for n in replicas if n not in rolled)
+                    rolled.append(name)
+                    _, old_srv = replicas[name]
+                    replicas[name] = make_grpc_scheduler(
+                        tmp_path, f"{name}-v2")
+                    targets[name] = replicas[name][1].target
+                    # Rolling-restart order: membership flips FIRST,
+                    # while the outgoing replica still answers, so
+                    # update_targets' cooperative handoff re-homes its
+                    # in-flight peers through a LIVE drain window; only
+                    # then does the old listener stop. (Stopping first
+                    # would leave only the reactive-failover path under
+                    # test.)
+                    balanced.update_targets(list(targets.values()))
+                    old_srv.stop(grace=0.1, drain_s=0.1)
+
+                for t in threads:
+                    t.join(timeout=90)
+                assert not any(t.is_alive() for t in threads)
+        finally:
+            for d in daemons:
+                try:
+                    d.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            balanced.close()
+            for _, srv in replicas.values():
+                try:
+                    srv.stop(grace=0)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        failed = [(n, e) for n, ok, e in results if not ok]
+        assert len(results) == len(blobs) * len(daemons)
+        assert not failed, failed
+        assert recovery.get("scheduler_degraded_to_source") == 0
+        # The roll was actually exercised: sessions moved (handoff or
+        # failover) at least once across three replica cycles.
+        moved = (recovery.get("scheduler_handoff_rehomed")
+                 + recovery.get("scheduler_failovers"))
+        assert moved >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.ha
+@pytest.mark.chaos
+class TestSchedulerKillRung:
+    def test_kill_rung_verdict_green(self):
+        from dragonfly2_tpu.client.chaosbench import run_scheduler_kill_rung
+
+        out = run_scheduler_kill_rung(tasks=6, size_bytes=1 << 20,
+                                      piece_size=64 << 10, seed=3)
+        assert out["killed"], out
+        assert out["success_rate"] == 1.0, out["failures"]
+        assert out["degraded_to_source"] == 0
+        assert out["failovers"] >= 1
+        assert out["reroute_p99_ms"] <= out["reroute_bound_s"] * 1e3
+        assert out["verdict_pass"], out
