@@ -148,7 +148,10 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
     from dragonfly2_tpu.scheduler.service import SchedulerService
     from dragonfly2_tpu.scheduler.storage.storage import Storage
 
+    from dragonfly2_tpu.client.dataplane import DataPlaneStats
+
     recovery = RecoveryStats()
+    dataplane = DataPlaneStats()
     service = SchedulerService(
         resource=Resource(),
         scheduling=Scheduling(
@@ -167,6 +170,10 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
             storage_root=os.path.join(tmp, name), hostname=name,
             keep_storage=False, task_options=options,
             recovery_stats=recovery,
+            # Per-rung serving-engine counters: the p2p legs of the swarm
+            # ride the event-loop upload server, and the rung report
+            # carries its serve-path split as evidence.
+            dataplane_stats=dataplane,
         ))
         for name in ("chaos-a", "chaos-b")
     ]
@@ -221,6 +228,11 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
         "recovery_p50_ms": round(percentile(recoveries, 0.50) * 1e3, 1),
         "recovery_p99_ms": round(percentile(recoveries, 0.99) * 1e3, 1),
         "recovery_counters": recovery.snapshot(),
+        "upload_engine": {
+            k: v for k, v in dataplane.snapshot().items()
+            if k.startswith(("upload_", "sendfile", "mmap_bytes",
+                             "buffered_bytes", "connections_open"))
+        },
     }
     if plan is not None:
         out["faults"] = plan.snapshot()

@@ -66,6 +66,18 @@ class DaemonConfig:
     # process-wide /debug/vars "recovery" block); the chaos bench
     # injects a per-rung instance.
     recovery_stats: object = None
+    # Upload serving engine (client/upload_async): listen(2) backlog,
+    # admission cap on concurrently open peer connections (0 =
+    # unlimited; beyond it, arrivals get a best-effort 503), and the
+    # fixed event-loop worker count (0 = engine default). Thread cost is
+    # upload_workers + 1 regardless of connection count.
+    upload_serve_backlog: int = 128
+    upload_max_connections: int = 0
+    upload_workers: int = 0
+    # DataPlaneStats scope for the serving engine (None = the
+    # process-wide /debug/vars "data_plane" block); benches inject a
+    # per-run instance.
+    dataplane_stats: object = None
 
 
 class Daemon:
@@ -86,6 +98,10 @@ class Daemon:
         self.upload = UploadServer(
             self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
             metrics=self.metrics,
+            backlog=config.upload_serve_backlog,
+            max_connections=config.upload_max_connections,
+            workers=config.upload_workers,
+            stats=config.dataplane_stats,
         )
         self.shaper: TrafficShaper = new_traffic_shaper(
             config.traffic_shaper_type, config.total_download_rate_bps

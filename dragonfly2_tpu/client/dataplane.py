@@ -68,6 +68,18 @@ class DataPlaneStats:
         self.report_batches = 0
         self.reports_batched = 0
         self._runs: collections.deque = collections.deque(maxlen=1024)
+        # Serve side (the event-loop upload engine, client/upload_async).
+        self.upload_connections_open = 0
+        self.upload_connections_accepted = 0
+        self.upload_connections_rejected = 0
+        self.upload_requests = 0
+        self.upload_pieces_served = 0
+        self.upload_aborted = 0
+        self.sendfile_bytes = 0        # native + os.sendfile zero-copy
+        self.sendfile_native_pieces = 0
+        self.mmap_bytes = 0            # mmap-windowed chunked writes
+        self.buffered_bytes = 0        # whole-bytes fallback (visible!)
+        self.upload_aborted_bytes = 0
 
     # -- ticks -------------------------------------------------------------
 
@@ -100,6 +112,47 @@ class DataPlaneStats:
             self.report_batches += 1
             self.reports_batched += pieces
 
+    # -- serve-side ticks (upload engine) ----------------------------------
+
+    def upload_conn(self, opened: bool) -> None:
+        with self._lock:
+            if opened:
+                self.upload_connections_open += 1
+                self.upload_connections_accepted += 1
+            else:
+                self.upload_connections_open -= 1
+
+    def upload_rejected(self) -> None:
+        with self._lock:
+            self.upload_connections_rejected += 1
+
+    def upload_request(self) -> None:
+        with self._lock:
+            self.upload_requests += 1
+
+    def upload_served(self, kind: str, nbytes: int) -> None:
+        """One COMPLETED piece body, split by serve path. ``native`` and
+        ``sendfile`` share the zero-copy byte counter (same syscall; the
+        native split is kept as a piece count)."""
+        with self._lock:
+            self.upload_pieces_served += 1
+            if kind == "native":
+                self.sendfile_bytes += nbytes
+                self.sendfile_native_pieces += 1
+            elif kind == "sendfile":
+                self.sendfile_bytes += nbytes
+            elif kind == "mmap":
+                self.mmap_bytes += nbytes
+            else:
+                self.buffered_bytes += nbytes
+
+    def upload_abort(self, nbytes: int) -> None:
+        """A body write that died mid-stream: bytes that left the socket
+        before the failure — never counted as a served piece."""
+        with self._lock:
+            self.upload_aborted += 1
+            self.upload_aborted_bytes += nbytes
+
     # -- read side ---------------------------------------------------------
 
     def coalesce_run_p50(self) -> float:
@@ -124,6 +177,19 @@ class DataPlaneStats:
                 "requests_saved": self.source_pieces - self.source_requests,
                 "report_rpcs_saved": (self.reports_batched
                                       - self.report_batches),
+                "connections_open": self.upload_connections_open,
+                "upload_connections_accepted":
+                    self.upload_connections_accepted,
+                "upload_connections_rejected":
+                    self.upload_connections_rejected,
+                "upload_requests": self.upload_requests,
+                "upload_pieces_served": self.upload_pieces_served,
+                "upload_aborted": self.upload_aborted,
+                "upload_aborted_bytes": self.upload_aborted_bytes,
+                "sendfile_bytes": self.sendfile_bytes,
+                "sendfile_native_pieces": self.sendfile_native_pieces,
+                "mmap_bytes": self.mmap_bytes,
+                "buffered_bytes": self.buffered_bytes,
             }
         out["coalesce_run_p50"] = self.coalesce_run_p50()
         return out

@@ -96,6 +96,10 @@ class TaskStorage:
         if not os.path.exists(self.data_path):
             open(self.data_path, "wb").close()
         self._invalid = False
+        # Set by the owning StorageManager: called once when mark_done
+        # completes, so the manager's task_id → done-replica index stays
+        # current without the manager lock wrapping every piece write.
+        self.on_done = None
 
     # -- write path --------------------------------------------------------
 
@@ -279,6 +283,9 @@ class TaskStorage:
                 self.meta.piece_md5_sign = digestutil.sha256_from_strings(*md5s)
             self.meta.done = True
         self.persist()
+        cb = self.on_done
+        if cb is not None:  # outside self._lock: the callback takes the
+            cb(self)        # manager lock (lock order: manager > store)
 
     def persist(self) -> None:
         tmp = os.path.join(self.directory, METADATA_FILE + ".tmp")
@@ -413,6 +420,13 @@ class StorageManager:
         os.makedirs(opts.root, exist_ok=True)
         self._lock = threading.Lock()
         self._tasks: Dict[Tuple[str, str], TaskStorage] = {}
+        # task_id → one done+valid replica: the upload/metadata hot path
+        # (every request whose exact-peer lookup misses) resolves in
+        # O(1) instead of scanning every registered task under the
+        # manager lock. Maintained on mark_done (store callback) and
+        # delete_task; lookups self-heal on staleness (GC'd replica →
+        # one rescan refreshes or drops the entry).
+        self._done_index: Dict[str, TaskStorage] = {}
         if opts.keep_storage:
             self._reload()
 
@@ -434,17 +448,29 @@ class StorageManager:
                     logger.warning("skip corrupt metadata %s: %s", meta_path, exc)
                     continue
                 store = TaskStorage(os.path.join(task_dir, peer_id), meta)
+                store.on_done = self._note_done
                 self._tasks[(task_id, peer_id)] = store
+                if store.done:
+                    self._done_index[task_id] = store
 
     def register_task(self, task_id: str, peer_id: str) -> TaskStorage:
         with self._lock:
             key = (task_id, peer_id)
             if key not in self._tasks:
                 directory = os.path.join(self.opts.root, task_id, peer_id)
-                self._tasks[key] = TaskStorage(
+                store = TaskStorage(
                     directory, TaskMetadata(task_id=task_id, peer_id=peer_id)
                 )
+                store.on_done = self._note_done
+                self._tasks[key] = store
             return self._tasks[key]
+
+    def _note_done(self, store: TaskStorage) -> None:
+        """mark_done hook: index the fresh done replica (unless it was
+        deleted between finishing and the callback firing)."""
+        with self._lock:
+            if store.valid and store.done:
+                self._done_index[store.meta.task_id] = store
 
     def get(self, task_id: str, peer_id: str) -> Optional[TaskStorage]:
         with self._lock:
@@ -452,11 +478,20 @@ class StorageManager:
 
     def find_completed_task(self, task_id: str) -> Optional[TaskStorage]:
         """Any valid, done storage for this task — the reuse fast path
-        (storage_manager.go:101-106)."""
+        (storage_manager.go:101-106). O(1) through the done-replica
+        index on the hot path (every upload/metadata request whose
+        exact-peer lookup misses lands here); a stale entry (replica
+        GC'd/invalidated since) falls back to one scan that refreshes or
+        drops it."""
         with self._lock:
-            for (tid, _), store in self._tasks.items():
-                if tid == task_id and store.done and store.valid:
-                    return store
+            store = self._done_index.get(task_id)
+            if store is not None and store.done and store.valid:
+                return store
+            for (tid, _), candidate in self._tasks.items():
+                if tid == task_id and candidate.done and candidate.valid:
+                    self._done_index[task_id] = candidate
+                    return candidate
+            self._done_index.pop(task_id, None)
         return None
 
     def read_piece_any(self, task_id: str, peer_id: str,
@@ -517,6 +552,8 @@ class StorageManager:
             for k in keys:
                 store = self._tasks.pop(k)
                 store.invalidate()
+                if self._done_index.get(task_id) is store:
+                    self._done_index.pop(task_id)
                 tombstones.append(self._tombstone(store.directory))
                 removed += 1
             # Task-dir decision under the SAME lock as the registration

@@ -11,8 +11,9 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Callable, Dict
+from typing import Callable, Dict, List, Optional, Tuple
 
 from dragonfly2_tpu.client.piece import DEFAULT_PIECE_SIZE
 from dragonfly2_tpu.utils.ratelimit import INF, Limiter
@@ -78,19 +79,64 @@ class _TaskEntry:
     created_at: float = field(default_factory=time.time)
 
 
+class _ShaperShard:
+    """One shard of the task map: its own lock + dict, so the per-piece
+    ``wait_n``/``record`` hot path of one task never serializes against
+    another task's (they hash to different shards 1-1/N of the time)."""
+
+    __slots__ = ("lock", "tasks")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.tasks: Dict[str, _TaskEntry] = {}
+
+
 class SamplingTrafficShaper(TrafficShaper):
     """Per-second demand sampling with surplus redistribution
-    (traffic_shaper.go:139-271)."""
+    (traffic_shaper.go:139-271).
+
+    The task map is sharded (crc32(task_id) % ``shards``, same scheme as
+    the scheduler's resource managers): ``wait_n``/``record`` are taken
+    once per piece by EVERY worker of EVERY task, and with the
+    event-loop upload engine raising connection density per daemon, one
+    global lock on that path was the next serialization point. Only the
+    once-per-interval ``update_limits`` sweep touches all shards (one at
+    a time — never holding two shard locks at once)."""
 
     def __init__(self, total_rate_bps: float, interval: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 shards: int = 8):
         self.total_rate = float(total_rate_bps)
         self.interval = interval
         self._clock = clock
-        self._tasks: Dict[str, _TaskEntry] = {}
-        self._lock = threading.Lock()
+        self._shards: Tuple[_ShaperShard, ...] = tuple(
+            _ShaperShard() for _ in range(max(shards, 1)))
+        # Serializes task ADMISSION only (rare — once per task): two
+        # concurrent add_tasks reading the same count would both grant
+        # total/n for the same n, oversubscribing the link until the
+        # next sweep. The per-piece wait_n/record path never takes it.
+        self._admission_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def _shard(self, task_id: str) -> _ShaperShard:
+        return self._shards[
+            zlib.crc32(task_id.encode()) % len(self._shards)]
+
+    def _entry(self, task_id: str) -> Optional[_TaskEntry]:
+        shard = self._shard(task_id)
+        with shard.lock:
+            return shard.tasks.get(task_id)
+
+    def _all_entries(self) -> List[_TaskEntry]:
+        out: List[_TaskEntry] = []
+        for shard in self._shards:
+            with shard.lock:
+                out.extend(shard.tasks.values())
+        return out
+
+    def task_count(self) -> int:
+        return sum(len(s.tasks) for s in self._shards)
 
     def start(self) -> None:
         if self._thread is not None:
@@ -111,29 +157,35 @@ class SamplingTrafficShaper(TrafficShaper):
             self.update_limits()
 
     def add_task(self, task_id: str, content_length: int = -1) -> None:
-        with self._lock:
-            # A new task starts with an equal share of the total rate
-            # (traffic_shaper.go AddTask: totalRateLimit / (nTasks+1)).
-            n = len(self._tasks) + 1
+        # A new task starts with an equal share of the total rate
+        # (traffic_shaper.go AddTask: totalRateLimit / (nTasks+1)).
+        # Lock order: admission → shard (shard locks stay leaves).
+        with self._admission_lock:
+            n = self.task_count() + 1
             share = self.total_rate / n
-            self._tasks[task_id] = _TaskEntry(
-                limiter=Limiter(share, burst=int(share)),
-                content_length=content_length,
-            )
+            shard = self._shard(task_id)
+            with shard.lock:
+                shard.tasks[task_id] = _TaskEntry(
+                    limiter=Limiter(share, burst=int(share)),
+                    content_length=content_length,
+                )
 
     def remove_task(self, task_id: str) -> None:
-        with self._lock:
-            self._tasks.pop(task_id, None)
+        shard = self._shard(task_id)
+        with shard.lock:
+            shard.tasks.pop(task_id, None)
 
     def record(self, task_id: str, n: int) -> None:
-        with self._lock:
-            entry = self._tasks.get(task_id)
+        shard = self._shard(task_id)
+        with shard.lock:
+            entry = shard.tasks.get(task_id)
             if entry is not None:
                 entry.used += n
 
     def wait_n(self, task_id: str, n: int) -> None:
-        with self._lock:
-            entry = self._tasks.get(task_id)
+        shard = self._shard(task_id)
+        with shard.lock:
+            entry = shard.tasks.get(task_id)
             if entry is not None:
                 entry.needed += n
                 limiter = entry.limiter
@@ -145,22 +197,30 @@ class SamplingTrafficShaper(TrafficShaper):
     def update_limits(self) -> None:
         """Recompute per-task rates from last-interval demand: tasks that
         used less than their allocation donate the surplus to those that
-        wanted more, floored at one piece size/sec each."""
-        with self._lock:
-            if not self._tasks:
-                return
-            entries = list(self._tasks.values())
-            demands = [max(e.used, e.needed) for e in entries]
-            total_demand = sum(demands)
-            for entry, demand in zip(entries, demands):
-                if total_demand > 0:
-                    share = self.total_rate * (demand / total_demand)
-                else:
-                    share = self.total_rate / len(entries)
-                share = min(max(share, DEFAULT_PIECE_SIZE), self.total_rate)
-                entry.limiter.set_rate(share, burst=int(share))
-                entry.used = 0
-                entry.needed = 0
+        wanted more, floored at one piece size/sec each.
+
+        Stages every entry's demand shard by shard (resetting the
+        counters under each shard lock), then sets rates lock-free: the
+        limiters have their own locks, and an entry removed mid-sweep
+        just gets one harmless final ``set_rate``. The share math over
+        the staged snapshot is identical to the old single-lock sweep."""
+        staged: List[Tuple[_TaskEntry, int]] = []
+        for shard in self._shards:
+            with shard.lock:
+                for entry in shard.tasks.values():
+                    staged.append((entry, max(entry.used, entry.needed)))
+                    entry.used = 0
+                    entry.needed = 0
+        if not staged:
+            return
+        total_demand = sum(d for _, d in staged)
+        for entry, demand in staged:
+            if total_demand > 0:
+                share = self.total_rate * (demand / total_demand)
+            else:
+                share = self.total_rate / len(staged)
+            share = min(max(share, DEFAULT_PIECE_SIZE), self.total_rate)
+            entry.limiter.set_rate(share, burst=int(share))
 
 
 def new_traffic_shaper(kind: str, total_rate_bps: float = INF) -> TrafficShaper:

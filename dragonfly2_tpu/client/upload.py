@@ -2,204 +2,30 @@
 
 Reference counterpart: client/daemon/upload/upload_manager.go:92-188. Route
 shape is identical: ``GET /download/{task_prefix}/{task_id}?peerId=...`` with
-a single HTTP ``Range`` header selecting the piece bytes, plus ``/healthy``.
+a single HTTP ``Range`` header selecting the piece bytes, plus
+``/metadata/{task_id}`` (the piece-inventory poll) and ``/healthy``.
 Rate-limited by a token bucket (the reference uses x/time/rate at :110).
-Implementation is stdlib ThreadingHTTPServer — the daemon's data plane needs
-no framework.
+
+The implementation is the event-loop engine in
+:mod:`dragonfly2_tpu.client.upload_async`: a fixed worker-thread count
+multiplexing every keep-alive peer connection (the old
+``ThreadingHTTPServer`` shell held one OS thread per connection), with
+zero-copy bodies — native sendfile → pure-Python ``os.sendfile`` → mmap
+chunks → buffered, in that order (docs/DATAPLANE.md has the decision
+table). This module keeps the historical import surface:
+``UploadServer`` and the route constants.
 """
 
 from __future__ import annotations
 
-import logging
-import os
-import urllib.parse
-from http.server import BaseHTTPRequestHandler
+from dragonfly2_tpu.client.upload_async import (  # noqa: F401
+    ROUTE_DOWNLOAD,
+    ROUTE_HEALTHY,
+    ROUTE_METADATA,
+    SERVE_PATHS,
+    AsyncUploadServer,
+)
 
-from dragonfly2_tpu.client.piece import parse_http_range
-from dragonfly2_tpu.client.storage import StorageError, StorageManager
-from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
-from dragonfly2_tpu.utils.ratelimit import INF, Limiter
-
-logger = logging.getLogger(__name__)
-
-ROUTE_DOWNLOAD = "/download"
-ROUTE_METADATA = "/metadata"
-ROUTE_HEALTHY = "/healthy"
-
-
-class UploadServer(ThreadedHTTPService):
-    """Serves stored piece bytes to child peers."""
-
-    def __init__(self, storage: StorageManager, host: str = "127.0.0.1",
-                 port: int = 0, rate_limit_bps: float = INF, metrics=None,
-                 sendfile: bool = True):
-        self.storage = storage
-        self.metrics = metrics  # DaemonMetrics or None
-        self.sendfile = sendfile  # False pins the read-bytes serve path
-        self.limiter = Limiter(rate_limit_bps, burst=int(rate_limit_bps)
-                               if rate_limit_bps != INF else None)
-        manager = self
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, fmt, *args):  # route to our logger
-                logger.debug("upload: " + fmt, *args)
-
-            def do_GET(self):  # noqa: N802 (stdlib API)
-                manager._handle(self)
-
-        super().__init__(Handler, host=host, port=port, name="upload-server")
-
-    # -- request handling --------------------------------------------------
-
-    def _handle(self, req: BaseHTTPRequestHandler) -> None:
-        parsed = urllib.parse.urlparse(req.path)
-        if parsed.path == ROUTE_HEALTHY:
-            body = b'"OK"'
-            req.send_response(200)
-            req.send_header("Content-Length", str(len(body)))
-            req.end_headers()
-            req.wfile.write(body)
-            return
-        if parsed.path.startswith(ROUTE_METADATA + "/"):
-            self._handle_metadata(req, parsed)
-            return
-        if not parsed.path.startswith(ROUTE_DOWNLOAD + "/"):
-            req.send_error(404)
-            return
-        parts = parsed.path[len(ROUTE_DOWNLOAD) + 1:].split("/")
-        if len(parts) != 2:  # task_prefix/task_id (upload_manager.go:184)
-            req.send_error(422, "expected /download/{prefix}/{task_id}")
-            return
-        task_id = parts[1]
-        query = urllib.parse.parse_qs(parsed.query)
-        peer_id = (query.get("peerId") or [""])[0]
-        range_header = req.headers.get("Range")
-        if not range_header:
-            req.send_error(400, "Range header required")
-            return
-        if range_header.startswith("bytes=-"):
-            # Suffix ranges need the total length, which piece requests
-            # never use; reject rather than resolve against a sentinel.
-            req.send_error(400, "suffix ranges not supported")
-            return
-        try:
-            rng = parse_http_range(range_header, 1 << 62)
-        except ValueError as exc:
-            req.send_error(400, str(exc))
-            return
-        if self._try_sendfile(req, task_id, peer_id, rng):
-            return
-        try:
-            data = self.storage.read_piece_any(task_id, peer_id, rng=rng)
-        except StorageError as exc:
-            req.send_error(500, str(exc))
-            return
-        if not data:
-            req.send_error(416, "range past end of stored content")
-            return
-        self.limiter.wait_n(min(len(data), self.limiter.burst))
-        if self.metrics:
-            self.metrics.upload_piece_count.inc()
-            self.metrics.upload_traffic.inc(len(data))
-        req.send_response(206)
-        req.send_header("Content-Length", str(len(data)))
-        req.send_header(
-            "Content-Range", f"bytes {rng.start}-{rng.start + len(data) - 1}/*"
-        )
-        req.end_headers()
-        req.wfile.write(data)
-
-    def _try_sendfile(self, req: BaseHTTPRequestHandler, task_id: str,
-                      peer_id: str, rng) -> bool:
-        """Native fast path: piece bytes go page-cache → socket via
-        sendfile(2) (native/pieceio.cpp), skipping the Python bytes
-        object and one userspace copy per piece. False = caller takes
-        the read-bytes path (native unavailable, range not fully
-        stored, or a TLS-wrapped connection where writing the raw fd
-        would bypass the record layer)."""
-        from dragonfly2_tpu import native
-
-        if (not self.sendfile or not native.available()
-                or hasattr(req.connection, "cipher")):
-            return False
-        try:
-            span = self.storage.piece_span_any(task_id, peer_id, rng)
-        except StorageError:
-            return False
-        if span is None:
-            return False
-        path, offset, length = span
-        self.limiter.wait_n(min(length, self.limiter.burst))
-        req.send_response(206)
-        req.send_header("Content-Length", str(length))
-        req.send_header(
-            "Content-Range", f"bytes {rng.start}-{rng.start + length - 1}/*"
-        )
-        req.end_headers()
-        req.wfile.flush()  # headers out before bytes hit the raw fd
-        try:
-            in_fd = os.open(path, os.O_RDONLY)
-        except OSError:
-            req.close_connection = True  # headers already sent
-            return True
-        try:
-            sent = native.send_file_range(
-                req.connection.fileno(), in_fd, offset, length)
-        except native.NativeIOError as exc:
-            logger.debug("sendfile failed mid-stream: %s", exc)
-            sent = 0
-        finally:
-            os.close(in_fd)
-        if self.metrics and sent > 0:
-            # Count AFTER the transfer with the actual byte count — a
-            # failed attempt is retried and would otherwise be counted
-            # twice (phantom traffic on the failure, real on the retry).
-            self.metrics.upload_piece_count.inc()
-            self.metrics.upload_traffic.inc(sent)
-        if sent != length:
-            # Can't resend headers; poison the connection so the peer
-            # sees a short body and retries.
-            req.close_connection = True
-        return True
-
-    def _handle_metadata(self, req: BaseHTTPRequestHandler, parsed) -> None:
-        """``GET /metadata/{task_id}?peerId=`` — the parent's piece
-        inventory. Plays the role of the reference's peer-to-peer piece
-        metadata sync (dfdaemon GetPieceTasks / SyncPieceTasks,
-        client/daemon/rpcserver/rpcserver.go:934,1079) over the same HTTP
-        server that serves the piece bytes."""
-        import json
-
-        task_id = parsed.path[len(ROUTE_METADATA) + 1:]
-        query = urllib.parse.parse_qs(parsed.query)
-        peer_id = (query.get("peerId") or [""])[0]
-        store = self.storage.get(task_id, peer_id) if peer_id else None
-        if store is None or not store.meta.pieces:
-            # Prefer a completed replica, but a registered-and-still-empty
-            # store (a seed mid-back-source) must answer 200 with an empty
-            # piece list — 404 would trip the child's sync watchdog and
-            # permanently block a healthy parent.
-            store = self.storage.find_completed_task(task_id) or store
-        if store is None:
-            req.send_error(404, f"task {task_id} unknown")
-            return
-        meta = store.meta
-        body = json.dumps({
-            "taskId": task_id,
-            "peerId": meta.peer_id,
-            "contentLength": meta.content_length,
-            "totalPieces": meta.total_pieces,
-            "done": meta.done,
-            "pieces": [
-                {"num": p.num, "md5": p.md5, "offset": p.offset,
-                 "start": p.start, "length": p.length}
-                for p in (meta.pieces[n] for n in store.existing_piece_nums())
-            ],
-        }).encode()
-        req.send_response(200)
-        req.send_header("Content-Type", "application/json")
-        req.send_header("Content-Length", str(len(body)))
-        req.end_headers()
-        req.wfile.write(body)
+#: The daemon's upload server IS the async engine; the name survives for
+#: every existing constructor site (daemon assembly, tests, benches).
+UploadServer = AsyncUploadServer

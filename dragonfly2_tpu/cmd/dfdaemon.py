@@ -57,6 +57,9 @@ def build_daemon(args):
         traffic_shaper_type=args.traffic_shaper,
         probe_interval=args.probe_interval,
         announce_interval=args.announce_interval,
+        upload_serve_backlog=args.serve_backlog,
+        upload_max_connections=args.max_connections,
+        upload_workers=args.upload_workers,
     ))
     daemon.start()
     return daemon
@@ -113,6 +116,17 @@ def main(argv=None) -> int:
                              "(peerhost.go Reload.Interval)")
     parser.add_argument("--traffic-shaper", default="plain",
                         choices=["plain", "sampling"])
+    parser.add_argument("--serve-backlog", type=int, default=128,
+                        help="upload listener listen(2) backlog")
+    parser.add_argument("--max-connections", type=int, default=0,
+                        help="admission cap on concurrently open upload "
+                             "connections (0 = unlimited; beyond the cap "
+                             "arrivals get a best-effort 503)")
+    parser.add_argument("--upload-workers", type=int, default=0,
+                        help="event-loop worker threads for the upload "
+                             "engine (0 = default; total serving threads "
+                             "= workers + 1 acceptor, independent of "
+                             "connection count)")
     parser.add_argument("--probe-interval", type=float, default=0.0,
                         help="network-topology probe ticker seconds "
                              "(0 = disabled)")

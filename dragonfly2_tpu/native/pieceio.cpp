@@ -207,7 +207,11 @@ extern "C" {
 // (a connected socket). Prefers sendfile(2) — file pages go straight
 // from the page cache to the socket, no userspace copy — and falls back
 // to a pread/send loop when sendfile refuses the fd pair. Returns bytes
-// sent, or -errno.
+// sent, or -errno. On a NON-BLOCKING socket a full buffer returns the
+// partial byte count (possibly 0) instead of -EAGAIN: the event-loop
+// server resumes from offset+sent when the socket turns writable, so
+// progress is never lost mid-piece (a -EAGAIN that discarded `sent`
+// would make the caller resend bytes and corrupt the stream).
 int64_t df2_send_file_range(int out_fd, int in_fd, int64_t offset,
                             int64_t count) {
   int64_t sent = 0;
@@ -216,6 +220,7 @@ int64_t df2_send_file_range(int out_fd, int in_fd, int64_t offset,
     ssize_t n = sendfile(out_fd, in_fd, &off, (size_t)(count - sent));
     if (n < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return sent;
       if (errno == EINVAL || errno == ENOSYS) break; // fall back below
       return -errno;
     }
@@ -246,6 +251,10 @@ int64_t df2_send_file_range(int out_fd, int in_fd, int64_t offset,
       }
       if (w < 0) {
         if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          delete[] buf;
+          return sent + done; // partial — caller resumes here
+        }
         delete[] buf;
         return -errno;
       }

@@ -78,6 +78,16 @@ class Limiter:
                 return 0.0
             return -self._tokens / self._rate
 
+    def return_n(self, n: float) -> None:
+        """Refund tokens a caller reserved but provably never spent
+        (e.g. a reserved body whose peer vanished before any byte went
+        out). Capped at burst like every other credit."""
+        if self._rate == INF or n <= 0:
+            return
+        with self._lock:
+            self._advance()
+            self._tokens = min(self._burst, self._tokens + n)
+
     def wait_n(self, n: float, timeout: float | None = None) -> bool:
         """Block until ``n`` tokens are granted. False on timeout."""
         if n > self._burst and self._rate != INF:

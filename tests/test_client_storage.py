@@ -414,3 +414,86 @@ class TestLimiter:
         assert not lim.wait_n(10, timeout=0.01)
         # tokens restored: a later generous wait succeeds
         assert lim.wait_n(1, timeout=2.0)
+
+
+class _BoobyTrappedTasks(dict):
+    """A _tasks map whose iteration explodes — proves a lookup resolved
+    through the done-index without scanning."""
+
+    def items(self):
+        raise AssertionError("find_completed_task fell back to the scan")
+
+
+class TestDoneReplicaIndex:
+    """ISSUE-7 satellite: find_completed_task is hit on every upload /
+    metadata request whose exact-peer lookup misses; it must be O(1)
+    through the task_id → done-replica index, and stay CORRECT across
+    mark_done, delete_task and GC invalidation."""
+
+    def test_mark_done_indexes_and_lookup_skips_scan(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        task_id = "idx" + "a" * 29
+        store, _ = write_task(manager, task_id, "peer-1", os.urandom(2048),
+                              1024)
+        assert manager._done_index[task_id] is store
+        # Booby-trap the scan: the indexed lookup must never touch it.
+        real_tasks = manager._tasks
+        manager._tasks = _BoobyTrappedTasks(real_tasks)
+        try:
+            assert manager.find_completed_task(task_id) is store
+        finally:
+            manager._tasks = real_tasks
+
+    def test_delete_task_drops_index_entry(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        task_id = "idx" + "b" * 29
+        write_task(manager, task_id, "peer-1", os.urandom(2048), 1024)
+        assert manager.delete_task(task_id) == 1
+        assert task_id not in manager._done_index
+        assert manager.find_completed_task(task_id) is None
+
+    def test_stale_index_heals_to_surviving_replica(self, tmp_path):
+        """Index points at a replica that gets invalidated out-of-band
+        (the GC race shape): the next lookup must fall back, return the
+        OTHER done replica, and refresh the index to it."""
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        task_id = "idx" + "c" * 29
+        content = os.urandom(2048)
+        first, _ = write_task(manager, task_id, "peer-1", content, 1024)
+        second, _ = write_task(manager, task_id, "peer-2", content, 1024)
+        indexed = manager._done_index[task_id]
+        indexed.invalidate()  # GC'd underneath the index
+        survivor = second if indexed is first else first
+        assert manager.find_completed_task(task_id) is survivor
+        assert manager._done_index[task_id] is survivor
+
+    def test_per_peer_delete_keeps_other_replica_findable(self, tmp_path):
+        manager = StorageManager(StorageOptions(root=str(tmp_path)))
+        task_id = "idx" + "d" * 29
+        content = os.urandom(2048)
+        write_task(manager, task_id, "peer-1", content, 1024)
+        write_task(manager, task_id, "peer-2", content, 1024)
+        manager.delete_task(task_id, "peer-1")
+        found = manager.find_completed_task(task_id)
+        assert found is not None and found.meta.peer_id == "peer-2"
+
+    def test_reload_rebuilds_index(self, tmp_path):
+        task_id = "idx" + "e" * 29
+        first = StorageManager(StorageOptions(root=str(tmp_path)))
+        store, _ = write_task(first, task_id, "peer-1", os.urandom(2048),
+                              1024)
+        store.persist()
+        reloaded = StorageManager(StorageOptions(root=str(tmp_path),
+                                                 keep_storage=True))
+        assert task_id in reloaded._done_index
+        found = reloaded.find_completed_task(task_id)
+        assert found is not None and found.done
+
+    def test_gc_expiry_unindexes(self, tmp_path):
+        manager = StorageManager(StorageOptions(
+            root=str(tmp_path), task_expire_seconds=0.0))
+        task_id = "idx" + "f" * 29
+        write_task(manager, task_id, "peer-1", os.urandom(2048), 1024)
+        assert manager.try_gc() >= 1
+        assert task_id not in manager._done_index
+        assert manager.find_completed_task(task_id) is None

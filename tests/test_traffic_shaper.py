@@ -25,10 +25,9 @@ class TestSamplingShaper:
         shaper.add_task("b")
         for task in ("a", "b"):
             shaper.record(task, 1 * 1024 * 1024)
-            with shaper._lock:
-                shaper._tasks[task].needed = 100 * 1024 * 1024
+            shaper._entry(task).needed = 100 * 1024 * 1024
         shaper.update_limits()
-        total = sum(e.limiter.rate for e in shaper._tasks.values())
+        total = sum(e.limiter.rate for e in shaper._all_entries())
         assert total <= shaper.total_rate * 1.001
 
     def test_surplus_flows_to_needy_task(self):
@@ -36,12 +35,12 @@ class TestSamplingShaper:
         shaper.add_task("idle")
         shaper.add_task("busy")
         shaper.record("busy", 8_000_000)
-        with shaper._lock:
-            shaper._tasks["busy"].needed = 9_000_000
-            shaper._tasks["idle"].needed = 0
+        shaper._entry("busy").needed = 9_000_000
+        shaper._entry("idle").needed = 0
         shaper.update_limits()
-        rates = {k: e.limiter.rate for k, e in shaper._tasks.items()}
-        assert rates["busy"] > rates["idle"]
+        busy = shaper._entry("busy").limiter.rate
+        idle = shaper._entry("idle").limiter.rate
+        assert busy > idle
 
     def test_factory(self):
         assert isinstance(new_traffic_shaper("plain"), PlainTrafficShaper)
@@ -49,6 +48,66 @@ class TestSamplingShaper:
         assert isinstance(
             new_traffic_shaper("sampling", 1e6), SamplingTrafficShaper
         )
+
+    def test_tasks_spread_across_shards(self):
+        """crc32 routing actually spreads tasks — the contention win is
+        zero if everything lands in one shard."""
+        shaper = SamplingTrafficShaper(total_rate_bps=1e9, shards=8)
+        for i in range(256):
+            shaper.add_task(f"task-{i:04d}")
+        occupied = sum(1 for s in shaper._shards if s.tasks)
+        assert occupied >= 6  # 256 crc32-hashed ids miss ≤2 of 8 shards
+        assert shaper.task_count() == 256
+
+    def test_update_limits_correct_across_shards(self):
+        """The sharded demand sweep computes the same proportional
+        shares as the old single-lock sweep: demand-weighted, floored at
+        one piece size, summing to ≤ total."""
+        from dragonfly2_tpu.client.piece import DEFAULT_PIECE_SIZE
+
+        total = 400 * 1024 * 1024
+        shaper = SamplingTrafficShaper(total_rate_bps=total, shards=4)
+        demands = {"t-a": 3, "t-b": 1, "t-c": 0, "t-d": 4}
+        for task in demands:
+            shaper.add_task(task)
+        for task, units in demands.items():
+            shaper.record(task, units * 10 * 1024 * 1024)
+        shaper.update_limits()
+        rates = {t: shaper._entry(t).limiter.rate for t in demands}
+        # Proportional: a=3/8, b=1/8, d=4/8 of total; c floored.
+        assert abs(rates["t-a"] - total * 3 / 8) < 1024
+        assert abs(rates["t-d"] - total * 4 / 8) < 1024
+        assert rates["t-c"] == DEFAULT_PIECE_SIZE
+        assert sum(rates.values()) <= total + DEFAULT_PIECE_SIZE
+        # Counters were reset by the sweep.
+        assert all(shaper._entry(t).used == 0 for t in demands)
+
+    def test_concurrent_wait_record_under_sharding(self):
+        """wait_n/record from many threads across many tasks: no lost
+        accounting, no deadlock (shard locks are leaves — never nested)."""
+        import threading
+
+        shaper = SamplingTrafficShaper(total_rate_bps=1e12, shards=8)
+        tasks = [f"hammer-{i}" for i in range(16)]
+        for t in tasks:
+            shaper.add_task(t)
+        per_thread = 200
+
+        def worker(task_id):
+            for _ in range(per_thread):
+                shaper.wait_n(task_id, 100)
+                shaper.record(task_id, 100)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in tasks for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for task in tasks:
+            entry = shaper._entry(task)
+            assert entry.used == 2 * per_thread * 100
+            assert entry.needed == 2 * per_thread * 100
 
 
 class TestMetadataRoute:
