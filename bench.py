@@ -605,18 +605,23 @@ def stage_scheduler(state: BenchState, ctx: dict) -> None:
 def stage_chaos(state: BenchState, ctx: dict) -> None:
     """Chaos — deterministic fault-injection ladder over the loopback
     swarm (scheduler + two peers + origin, client/chaosbench.py), plus
-    the ISSUE-6 scheduler-kill rung: three scheduler replica PROCESSES,
-    one hard-killed mid-swarm by the seeded ``scheduler.process`` site.
+    the ISSUE-6 scheduler-kill rung (three scheduler replica PROCESSES,
+    one hard-killed mid-swarm by the seeded ``scheduler.process`` site)
+    and the ISSUE-8 daemon-kill rung (a daemon process SIGKILLed at
+    ~50% of a download, restarted on the same storage root).
     Ladder bound (docs/CHAOS.md): 100% task success at every rung and
-    ≥70% goodput retention at the 5% rung. Kill-rung bound: 100% task
-    success, p99 re-route ≤ scheduler_grace, 0 tasks degraded to
-    back-to-source while ≥1 replica survives. The combined verdict
-    lands in the bench JSON, and a passing run persists into
-    artifacts/bench_state/ like the TPU runs do."""
+    ≥70% goodput retention at the 5% rung. Scheduler-kill bound: 100%
+    task success, p99 re-route ≤ scheduler_grace, 0 tasks degraded to
+    back-to-source while ≥1 replica survives. Daemon-kill bound: 100%
+    task success, md5-exact final bytes, re-downloaded bytes ≤ missing
+    + one piece per worker, restarted seed re-announces and serves.
+    The combined verdict lands in the bench JSON, and a passing run
+    persists into artifacts/bench_state/ like the TPU runs do."""
     left = ctx["left"]
 
     from dragonfly2_tpu.client.chaosbench import (
         run_chaos_ladder,
+        run_daemon_kill_rung,
         run_scheduler_kill_rung,
     )
 
@@ -662,8 +667,31 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
             chaos_scheduler_kill_degraded=kill["degraded_to_source"],
             chaos_scheduler_kill_verdict_pass=kill["verdict_pass"],
         )
+    daemon_kill = None
+    if left() <= 8.0:
+        # Same contract as a budget-skipped scheduler-kill rung: the
+        # skip is recorded explicitly (never a silent pass) and the
+        # persisted artifact says {"skipped": true}.
+        state.record(chaos_daemon_kill_skipped=True)
+    else:
+        daemon_kill = run_daemon_kill_rung(seed=0)
+        state.record(
+            chaos_daemon_kill_success_rate=daemon_kill["success_rate"],
+            chaos_daemon_kill_killed=daemon_kill["killed"],
+            chaos_daemon_kill_resumed_pieces=daemon_kill.get(
+                "resume", {}).get("resumed_pieces"),
+            chaos_daemon_kill_bytes_fresh=daemon_kill.get(
+                "resume", {}).get("bytes_fresh"),
+            chaos_daemon_kill_refetch_bound=daemon_kill.get(
+                "refetch_bound_bytes"),
+            chaos_daemon_kill_reseed=daemon_kill.get("reseed"),
+            chaos_daemon_kill_failures=daemon_kill["failures"][:5],
+            chaos_daemon_kill_verdict_pass=daemon_kill["verdict_pass"],
+        )
     verdict = bool(chaos["verdict_pass"]
-                   and (kill is None or kill["verdict_pass"]))
+                   and (kill is None or kill["verdict_pass"])
+                   and (daemon_kill is None
+                        or daemon_kill["verdict_pass"]))
     state.record(chaos_verdict_pass=verdict)
     state.stage_done("chaos")
     if verdict:
@@ -676,7 +704,10 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
             with open(tmp_path_, "w") as f:
                 json.dump({"ladder": chaos,
                            "scheduler_kill": (kill if kill is not None
-                                              else {"skipped": True})}, f)
+                                              else {"skipped": True}),
+                           "daemon_kill": (daemon_kill
+                                           if daemon_kill is not None
+                                           else {"skipped": True})}, f)
             os.replace(tmp_path_, dest)
         except OSError:
             pass
@@ -1014,14 +1045,28 @@ def single_stage_main(name: str) -> None:
     state.emit()
 
 
-def check_regression_main() -> None:
-    """`bench.py dataplane --check-regression` — the one-command
-    data-plane perf gate: fresh upload-loopback rung vs the best
-    persisted artifacts/bench_state record; exits non-zero below the
-    documented fraction (docs/DATAPLANE.md)."""
-    from dragonfly2_tpu.client.uploadbench import check_regression
+def check_regression_main(stage_name: str) -> None:
+    """`bench.py <stage> --check-regression` — the one-command perf/
+    robustness gates: a fresh run vs the best persisted
+    artifacts/bench_state record, exiting non-zero on regression.
 
-    result = check_regression(STATE_DIR)
+    - ``dataplane``: fresh upload-loopback rung vs the best recorded
+      MB/s (docs/DATAPLANE.md fraction).
+    - ``chaos``: fresh fault ladder + daemon-kill rung vs the best
+      recorded chaos run (docs/CHAOS.md) — any lost verdict or a
+      goodput-retention collapse fails the gate."""
+    if stage_name == "dataplane":
+        from dragonfly2_tpu.client.uploadbench import check_regression
+
+        result = check_regression(STATE_DIR)
+    elif stage_name == "chaos":
+        from dragonfly2_tpu.client.chaosbench import check_chaos_regression
+
+        result = check_chaos_regression(STATE_DIR)
+    else:
+        raise SystemExit(
+            f"no regression gate for stage {stage_name!r} "
+            "(have: dataplane, chaos)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 
@@ -1029,9 +1074,9 @@ def check_regression_main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 5 and sys.argv[1] == "--worker":
         worker_main(sys.argv[2], sys.argv[3], float(sys.argv[4]))
-    elif (len(sys.argv) == 3 and sys.argv[1] == "dataplane"
+    elif (len(sys.argv) == 3
           and sys.argv[2] == "--check-regression"):
-        check_regression_main()
+        check_regression_main(sys.argv[1])
     elif len(sys.argv) == 2 and not sys.argv[1].startswith("-"):
         single_stage_main(sys.argv[1])
     else:

@@ -55,6 +55,16 @@ class DaemonConfig:
     traffic_shaper_type: str = "plain"
     task_options: PeerTaskOptions = field(default_factory=PeerTaskOptions)
     keep_storage: bool = True
+    # Crash-safe download state (ISSUE 8): incremental-journal cadence
+    # on the piece write path (see StorageOptions — amortized fsync, a
+    # SIGKILL loses at most one window of progress), md5-verification of
+    # journaled pieces at reload, and whether start() re-announces
+    # completed replicas to the scheduler so a restarted daemon resumes
+    # serving as a parent instead of going dark.
+    persist_every_pieces: int = 16
+    persist_interval_s: float = 2.0
+    reload_verify: bool = True
+    reseed_on_start: bool = True
     # Probe ticker (client/daemon/networktopology): 0 disables. Each tick
     # asks the scheduler for candidates, TCP-pings them, reports RTTs.
     probe_interval: float = 0.0
@@ -94,7 +104,10 @@ class Daemon:
         self.metrics = DaemonMetrics(version=__version__)
         self.storage = StorageManager(StorageOptions(
             root=config.storage_root, keep_storage=config.keep_storage,
-        ))
+            persist_every_pieces=config.persist_every_pieces,
+            persist_interval_s=config.persist_interval_s,
+            reload_verify=config.reload_verify,
+        ), recovery=config.recovery_stats)
         self.upload = UploadServer(
             self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
             metrics=self.metrics,
@@ -127,6 +140,14 @@ class Daemon:
         # recompute now that the listener exists.
         self.host_id = idgen.host_id_v1(self.config.hostname, self.upload.port)
         self.announce()
+        if self.config.reseed_on_start:
+            # Snapshot the reloaded done inventory ONCE: drained here,
+            # and re-drained by the announce ticker if schedulers were
+            # unreachable mid-drain (runtime-completed tasks never
+            # enter — their conductors already reported finished).
+            self._reseed_backlog = {
+                s.meta.task_id: s for s in self.storage.done_tasks()}
+            self._reannounce_done_tasks()
         if self.config.probe_interval > 0:
             self.prober = self._build_prober()
             self.prober.serve()
@@ -141,6 +162,10 @@ class Daemon:
         while not self._announce_stop.wait(self.config.announce_interval):
             try:
                 self.announce()
+                # Task re-announces deferred by an unreachable fleet at
+                # start() retry on the same ticker — completed replicas
+                # must not stay dark for the daemon's lifetime.
+                self._reannounce_done_tasks()
             except Exception:  # noqa: BLE001 — announcing must not die
                 logger.exception("host re-announce failed")
 
@@ -173,12 +198,73 @@ class Daemon:
         self.shaper.stop()
         self.upload.stop()
         self.storage.persist_all()
+        # Clean-shutdown sentinel: the next start on this root skips
+        # the crash-path resident-byte verify (storage._reload).
+        self.storage.mark_clean_shutdown()
         self._started = False
 
     def announce(self) -> None:
         """AnnounceHost (client/daemon/announcer/announcer.go:45-158)."""
         host = self.build_host()
         self.scheduler.announce_host(host)
+
+    def _reannounce_done_tasks(self) -> None:
+        """Drain the restart re-announce backlog (AnnounceTask
+        semantics): a SIGKILLed-and-restarted seed must resume serving
+        as a parent, not go dark until someone re-downloads through
+        it. Per-task best effort — a scheduler that predates
+        announce_task (or is briefly unreachable) costs a warning,
+        never a failed start; tasks deferred by an unreachable fleet
+        stay in the backlog and the announce ticker retries them."""
+        backlog = getattr(self, "_reseed_backlog", None)
+        if not backlog:
+            return
+        announce = getattr(self.scheduler, "announce_task", None)
+        if announce is None:
+            return
+        from dragonfly2_tpu.client.recovery import RECOVERY
+        from dragonfly2_tpu.scheduler.service import AnnounceTaskRequest
+
+        recovery = self.config.recovery_stats or RECOVERY
+        for task_id, store in list(backlog.items()):
+            meta = store.meta
+            if (meta.content_length < 0 or meta.total_pieces <= 0
+                    or not store.valid):
+                backlog.pop(task_id, None)  # nothing to offer
+                continue
+            try:
+                announce(AnnounceTaskRequest(
+                    host_id=self.host_id, task_id=meta.task_id,
+                    peer_id=meta.peer_id, url=meta.url,
+                    content_length=meta.content_length,
+                    total_piece_count=meta.total_pieces,
+                    piece_md5_sign=meta.piece_md5_sign,
+                ))
+            except Exception as exc:  # noqa: BLE001 — best effort per task
+                logger.warning("re-announce of task %s failed: %s",
+                               meta.task_id[:16], exc)
+                if self._scheduler_unreachable(exc):
+                    # The walk exhausted every target: later tasks
+                    # would pay the same full ring of dial timeouts.
+                    # One bounded stall; the ticker retries the rest.
+                    logger.warning("schedulers unreachable; deferring "
+                                   "%d remaining re-announce(s)",
+                                   len(backlog))
+                    return
+                backlog.pop(task_id, None)  # rejected — retry won't help
+                continue
+            backlog.pop(task_id, None)
+            recovery.tick("seed_tasks_reannounced")
+
+    @staticmethod
+    def _scheduler_unreachable(exc: Exception) -> bool:
+        """Transport-shaped announce failure (every target down) vs a
+        per-task rejection (which must not stop the other replicas)."""
+        from dragonfly2_tpu.scheduler.service import ServiceError
+
+        if isinstance(exc, ServiceError):
+            return exc.code in ("Unavailable", "DeadlineExceeded")
+        return isinstance(exc, (ConnectionError, OSError))
 
     def build_host(self) -> Host:
         """Identity + live psutil telemetry (announcer.go:45-158), so the
@@ -438,7 +524,10 @@ class SeedPeerDaemonClient:
                 ),
                 channel=conductor.channel,
             )
-            conductor.store = daemon.storage.register_task(task.id, peer_id)
+            # Adopt a crash-recovered partial store when one exists —
+            # a restarted seed resumes its warm-up from the journal
+            # instead of re-pulling the whole origin.
+            conductor._attach_store()
             conductor._started_at = time.monotonic()
             # Register with the shaper like download_file does — otherwise
             # SamplingTrafficShaper.wait_n is a no-op for the unknown task

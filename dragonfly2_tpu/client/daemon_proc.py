@@ -1,0 +1,146 @@
+"""Minimal daemon process entry for the crash-resume chaos plane.
+
+``python -m dragonfly2_tpu.client.daemon_proc --storage-root R
+--scheduler host:port`` runs one REAL dfdaemon process (storage +
+upload server + peer engine over ``BalancedSchedulerClient``), prints
+one ``DAEMON <host_id> <upload_addr>`` line on stdout, then serves a
+tiny line protocol on stdin:
+
+- ``DOWNLOAD <url>`` — start the download on a worker thread; every
+  verified piece landing prints ``PROGRESS <url> <cumulative_bytes>``
+  (the kill supervisor's mid-download trigger), and completion prints
+  ``RESULT <json>`` carrying success/md5/fresh-vs-resumed accounting.
+- ``STATS`` — prints ``STATS <json>`` of the process-wide recovery
+  counters (reload verify/drop, orphan sweep, resume, re-announce).
+- ``EXIT`` — graceful ``daemon.stop()`` (persists every journal), then
+  the process exits 0.
+
+The daemon-kill chaos rung (``client/chaosbench.py
+run_daemon_kill_rung``) spawns one of these, SIGKILLs it mid-download
+— a REAL process death, the failure mode ISSUE 8's durable journal
+exists for — and restarts it on the same ``--storage-root`` to prove
+the restart is a resume: journaled pieces verified and skipped, only
+the missing tail re-downloaded, completed replicas re-announced.
+
+Deliberately lighter than ``cmd/dfdaemon.py`` (same stance as
+``scheduler/replica.py``): no config files, no metrics server, no jax
+on the import path — the rung needs a daemon that is up in ~1 s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("df2-daemon-proc")
+    parser.add_argument("--storage-root", required=True)
+    parser.add_argument("--scheduler", required=True, action="append",
+                        help="host:port (repeatable)")
+    parser.add_argument("--hostname", default="daemon-proc")
+    parser.add_argument("--piece-size", type=int, default=0,
+                        help="pin the piece size (0 = production sizing) "
+                             "so the rung controls pieces-per-task")
+    parser.add_argument("--download-rate", type=float, default=0.0,
+                        help="bytes/sec throttle so a kill window exists "
+                             "on loopback (0 = unlimited)")
+    parser.add_argument("--persist-every", type=int, default=2,
+                        help="journal cadence in pieces (rung default is "
+                             "tight so the kill loses little progress)")
+    parser.add_argument("--type", default="normal")
+    args = parser.parse_args(argv)
+
+    if args.piece_size > 0:
+        from dragonfly2_tpu.client import peer_task as peer_task_mod
+
+        peer_task_mod.compute_piece_size = (
+            lambda content_length, _n=args.piece_size: _n)
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.client.peer_task import PeerTaskOptions
+    from dragonfly2_tpu.client.recovery import RECOVERY
+    from dragonfly2_tpu.scheduler.rpcserver import BalancedSchedulerClient
+    from dragonfly2_tpu.utils.hosttypes import HostType
+    from dragonfly2_tpu.utils.ratelimit import INF
+
+    scheduler = BalancedSchedulerClient(list(args.scheduler))
+    daemon = Daemon(scheduler, DaemonConfig(
+        storage_root=args.storage_root,
+        hostname=args.hostname,
+        host_type=HostType.from_name(args.type),
+        keep_storage=True,
+        total_download_rate_bps=args.download_rate or INF,
+        persist_every_pieces=args.persist_every,
+        task_options=PeerTaskOptions(
+            # The kill rung injects through the Python transports and
+            # wants deterministic piece accounting.
+            native_data_plane=False,
+            timeout=60.0,
+            scheduler_grace=5.0,
+        ),
+    ))
+    daemon.start()
+
+    out_lock = threading.Lock()
+
+    def emit(line: str) -> None:
+        with out_lock:
+            print(line, flush=True)
+
+    emit(f"DAEMON {daemon.host_id} {daemon.upload.address}")
+
+    def run_download(url: str) -> None:
+        fresh = {"bytes": 0, "pieces": 0}
+
+        def sink(store, piece) -> None:
+            fresh["bytes"] += piece.length
+            fresh["pieces"] += 1
+            emit(f"PROGRESS {url} {fresh['bytes']}")
+
+        payload = {"url": url, "ok": False, "error": "", "md5": "",
+                   "bytes_fresh": 0, "pieces_fresh": 0,
+                   "resumed_pieces": 0, "resumed_bytes": 0,
+                   "content_length": -1}
+        try:
+            result = daemon.download_file(url, piece_sink=sink)
+            digest = hashlib.md5()
+            if result.success:
+                for chunk in (result.storage.iter_content()
+                              if result.storage is not None
+                              else [result.direct_bytes or b""]):
+                    digest.update(chunk)
+            payload.update(
+                ok=result.success, error=result.error,
+                md5=digest.hexdigest() if result.success else "",
+                bytes_fresh=fresh["bytes"], pieces_fresh=fresh["pieces"],
+                resumed_pieces=result.resumed_pieces,
+                resumed_bytes=result.resumed_bytes,
+                content_length=result.content_length,
+                reused=result.reused,
+            )
+        except Exception as exc:  # noqa: BLE001 — reported, not fatal
+            payload["error"] = f"{type(exc).__name__}: {exc}"
+        emit(f"RESULT {json.dumps(payload)}")
+
+    for raw in sys.stdin:
+        line = raw.strip()
+        if not line:
+            continue
+        cmd, _, rest = line.partition(" ")
+        if cmd == "DOWNLOAD" and rest:
+            threading.Thread(target=run_download, args=(rest,),
+                             name="proc-download", daemon=True).start()
+        elif cmd == "STATS":
+            emit(f"STATS {json.dumps(RECOVERY.snapshot())}")
+        elif cmd == "EXIT":
+            break
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,10 @@ logger = logging.getLogger(__name__)
 
 TRAFFIC_REMOTE_PEER = "remote_peer"
 TRAFFIC_BACK_TO_SOURCE = "back_to_source"
+# Pieces replayed from a crash-recovered journal: no bytes moved, but
+# the scheduler's piece upserts (and task metadata, parent_id="") must
+# reflect them so decisions resume from truth.
+TRAFFIC_RESUMED = "resumed_local"
 
 
 class SchedulerAPI(Protocol):
@@ -232,6 +236,12 @@ class PeerTaskResult:
     # True when served from completed local storage without a new
     # conductor run (peertask_reuse.go fast path).
     reused: bool = False
+    # Crash-resume accounting: verified pieces adopted from a
+    # journal-recovered partial store (skipped, not re-downloaded) and
+    # their byte total — the daemon-kill chaos rung's re-download bound
+    # is built from these.
+    resumed_pieces: int = 0
+    resumed_bytes: int = 0
 
     def read_all(self) -> bytes:
         if self.direct_bytes is not None:
@@ -334,6 +344,10 @@ class PeerTaskConductor:
         self._enqueued: set[int] = set()
         self._written_lock = threading.Lock()
         self._written: set[int] = set()
+        # Crash-resume bookkeeping: pieces adopted from a recovered
+        # journal (already verified on disk — skipped, not fetched).
+        self._resumed_pieces = 0
+        self._resumed_bytes = 0
         self._sync_stop = threading.Event()
         self._syncers: Dict[str, threading.Thread] = {}
         self._workers: List[threading.Thread] = []
@@ -399,9 +413,7 @@ class PeerTaskConductor:
                     direct_bytes=resp.direct_piece,
                 )
 
-            self.store = self.storage_manager.register_task(
-                self.task_id, self.peer_id
-            )
+            resumed = self._attach_store()
             if resp.content_length >= 0:
                 self._learn_length(resp.content_length, resp.total_piece_count)
 
@@ -411,9 +423,61 @@ class PeerTaskConductor:
                 logger.warning("download started failed (%s); back-to-source", exc)
                 return self._run_back_to_source(report=False)
 
+            if resumed:
+                # Registration is in: replay the recovered pieces into
+                # the scheduler's view through the idempotent-upsert
+                # path (PR 6 — duplicate replays never double-count),
+                # so its parent decisions and finished counts resume
+                # from truth instead of zero.
+                self._replay_resumed(resumed)
             return self._pull_pieces()
         finally:
             self._shutdown_workers()
+
+    # -- crash resume (journal-recovered partial stores) -------------------
+
+    def _attach_store(self) -> "List[PieceMetadata]":
+        """Bind task storage, adopting a journal-recovered partial
+        store when one exists: its verified pieces seed the
+        downloaded-set, so syncer enqueues skip them and only the
+        missing tail is fetched. Returns the adopted pieces (empty on
+        a fresh registration)."""
+        resume = getattr(self.storage_manager, "register_or_resume", None)
+        if resume is None:  # duck-typed stand-in without resume support
+            self.store = self.storage_manager.register_task(
+                self.task_id, self.peer_id)
+            return []
+        self.store, resumed = resume(self.task_id, self.peer_id)
+        self.store.update(url=self.url)
+        if not resumed:
+            return []
+        with self._written_lock:
+            for piece in resumed:
+                self._written.add(piece.num)
+        self._resumed_pieces = len(resumed)
+        self._resumed_bytes = sum(p.length for p in resumed)
+        self.recovery.tick("tasks_resumed")
+        self.recovery.tick("resume_pieces_reused", len(resumed))
+        meta = self.store.meta
+        if meta.content_length >= 0:
+            # The journal knows the task shape even when the scheduler
+            # (also restarted) no longer does.
+            self._learn_length(meta.content_length, meta.total_pieces)
+        logger.info(
+            "task %s resumed from journal: %d verified piece(s), %d bytes",
+            self.task_id[:16], self._resumed_pieces, self._resumed_bytes)
+        return resumed
+
+    def _replay_resumed(self, resumed: "List[PieceMetadata]") -> None:
+        for piece in resumed:
+            self.reporter.report(PieceFinished(
+                peer_id=self.peer_id, piece_number=piece.num, parent_id="",
+                offset=piece.offset, length=piece.length,
+                digest=f"md5:{piece.md5}" if piece.md5 else "",
+                cost_ns=0, traffic_type=TRAFFIC_RESUMED,
+            ))
+        self._touch_progress()
+        self._check_finished()  # crash AFTER the last piece, BEFORE done
 
     # -- scheduling decision loop (receivePeerPacket / pullPiecesWithP2P) --
 
@@ -455,9 +519,13 @@ class PeerTaskConductor:
             return PeerTaskResult(
                 self.task_id, self.peer_id, True,
                 content_length=self.content_length, storage=self.store,
+                resumed_pieces=self._resumed_pieces,
+                resumed_bytes=self._resumed_bytes,
             )
         return PeerTaskResult(self.task_id, self.peer_id, False,
-                              storage=self.store, error=self._error)
+                              storage=self.store, error=self._error,
+                              resumed_pieces=self._resumed_pieces,
+                              resumed_bytes=self._resumed_bytes)
 
     # -- scheduler health (bounded-grace degradation) ----------------------
 
@@ -890,7 +958,9 @@ class PeerTaskConductor:
         except Exception:
             pass
         return PeerTaskResult(self.task_id, self.peer_id, False,
-                              storage=self.store, error=error)
+                              storage=self.store, error=error,
+                              resumed_pieces=self._resumed_pieces,
+                              resumed_bytes=self._resumed_bytes)
 
     def _shutdown_workers(self) -> None:
         self._done.set()
@@ -927,11 +997,15 @@ class PeerTaskConductor:
                            "could serve the task")
             self._done.set()
             return PeerTaskResult(self.task_id, self.peer_id, False,
-                                  storage=self.store, error=self._error)
+                                  storage=self.store, error=self._error,
+                                  resumed_pieces=self._resumed_pieces,
+                                  resumed_bytes=self._resumed_bytes)
         if self.store is None:
-            self.store = self.storage_manager.register_task(
-                self.task_id, self.peer_id
-            )
+            # Degrade paths (register failed / scheduler silent) still
+            # adopt a recovered journal — resume must not depend on a
+            # healthy scheduler. No replay reports here: the peer may
+            # never have registered.
+            self._attach_store()
         if report:
             try:
                 self.scheduler.download_peer_back_to_source_started(self.peer_id)
@@ -948,7 +1022,9 @@ class PeerTaskConductor:
                     pass
             self._error = f"back-to-source failed: {exc}"
             return PeerTaskResult(self.task_id, self.peer_id, False,
-                                  storage=self.store, error=self._error)
+                                  storage=self.store, error=self._error,
+                                  resumed_pieces=self._resumed_pieces,
+                                  resumed_bytes=self._resumed_bytes)
         cost = time.monotonic() - self._started_at
         # Deliver every piece before the task-level success report: the
         # scheduler promotes back-source pieces into task metadata other
@@ -963,7 +1039,9 @@ class PeerTaskConductor:
                              exc_info=True)
         self._success = True
         return PeerTaskResult(self.task_id, self.peer_id, True,
-                              content_length=content_length, storage=self.store)
+                              content_length=content_length, storage=self.store,
+                              resumed_pieces=self._resumed_pieces,
+                              resumed_bytes=self._resumed_bytes)
 
     def _download_source(self) -> tuple[int, int]:
         """(piece_manager.go:301 DownloadSource; known-length concurrent

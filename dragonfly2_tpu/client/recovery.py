@@ -41,6 +41,20 @@ just infer it from the absence of errors:
   ``update_targets`` (planned membership change / rolling restart), and
   peers that could not be re-homed (no reachable replacement) and
   stayed pinned to the retired client.
+- ``reload_pieces_verified`` / ``reload_pieces_dropped`` — journaled
+  pieces re-hashed OK at storage reload after a restart, and pieces
+  dropped there (md5 mismatch, short data file, or journaled before
+  the wire digest arrived) so a resume never trusts bad bytes.
+- ``reload_orphans_swept`` — task/peer directories whose metadata
+  journal was missing or corrupt, quarantined+deleted at reload
+  instead of leaking their data files forever.
+- ``tasks_resumed`` / ``resume_pieces_reused`` — downloads that
+  adopted a crash-recovered partial store, and the verified pieces
+  they skipped re-downloading (reported to the scheduler through the
+  idempotent piece-upsert path instead of re-fetched).
+- ``seed_tasks_reannounced`` — completed replicas a restarted daemon
+  re-announced to the scheduler so it resumes serving as a parent
+  instead of going dark.
 
 ``recovery_p50_ms`` / ``recovery_p99_ms`` summarize piece-recovery
 latency: the time from a piece's FIRST failed fetch to its eventual
@@ -81,6 +95,12 @@ COUNTER_KEYS = (
     "scheduler_failover_pieces_replayed",
     "scheduler_handoff_rehomed",
     "scheduler_handoff_stranded",
+    "reload_pieces_verified",
+    "reload_pieces_dropped",
+    "reload_orphans_swept",
+    "tasks_resumed",
+    "resume_pieces_reused",
+    "seed_tasks_reannounced",
 )
 
 

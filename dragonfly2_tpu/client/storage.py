@@ -7,11 +7,34 @@ one data file per peer task + metadata JSON), completed-task reuse lookup
 (storage_manager.go TryGC). Layout here: ``<root>/<taskID>/<peerID>/data``
 plus ``metadata.json``; md5-per-piece verification happens at write time via
 :class:`~dragonfly2_tpu.utils.digest.DigestReader` semantics.
+
+Crash-safety contract (ISSUE 8 — KeepStorage semantics that survive
+SIGKILL, client/config/peerhost.go:63):
+
+- ``metadata.json`` is a **piece-granular durable journal**, updated
+  incrementally on the write path (amortized: every
+  ``persist_every_pieces`` landings or ``persist_interval_s`` seconds,
+  whichever first) — not only at ``mark_done``. A journaled piece was
+  md5-verified BEFORE it was journaled, so the journal never claims
+  bytes that were not fully written.
+- ``persist()`` is crash-atomic and race-free: the snapshot is written
+  to a **unique-per-call** tmp name, fsynced, published with
+  ``os.replace``, and the parent directory is fsynced — a crash at any
+  point leaves either the old or the new journal, never a torn or
+  empty one, and two concurrent persists never interleave writes into
+  a shared tmp path.
+- ``_reload`` recovers **partial** stores too, re-verifying every
+  resident piece against its journaled md5 (mismatched/short/unhashed
+  pieces are dropped, a ``done`` store with drops is demoted), and
+  sweeps orphan directories whose journal is missing or corrupt. A
+  restarted daemon resumes from the verified journal instead of
+  re-downloading from zero (``StorageManager.register_or_resume``).
 """
 
 from __future__ import annotations
 
 import errno
+import hashlib
 import json
 import logging
 import os
@@ -30,6 +53,10 @@ logger = logging.getLogger(__name__)
 
 METADATA_FILE = "metadata.json"
 DATA_FILE = "data"
+# Root-level sentinel a graceful shutdown leaves behind (and the next
+# reload consumes): present ⇒ every journal was persisted by a live
+# stop() ⇒ the full resident-byte verify pass can be skipped.
+CLEAN_SHUTDOWN_FILE = ".clean_shutdown"
 
 
 class StorageError(Exception):
@@ -67,6 +94,10 @@ class TaskMetadata:
     piece_md5_sign: str = ""
     header: Dict[str, str] = field(default_factory=dict)
     done: bool = False
+    # Source URL the task was derived from: lets a restarted daemon
+    # re-announce a completed replica to the scheduler (the scheduler's
+    # Task needs a url for other peers' back-to-source budget).
+    url: str = ""
     pieces: Dict[int, PieceMetadata] = field(default_factory=dict)
 
     def to_json(self) -> str:
@@ -86,7 +117,9 @@ class TaskMetadata:
 class TaskStorage:
     """One peer task's on-disk state: sparse data file + metadata."""
 
-    def __init__(self, directory: str, meta: TaskMetadata):
+    def __init__(self, directory: str, meta: TaskMetadata,
+                 persist_every_pieces: int = 0,
+                 persist_interval_s: float = 0.0):
         self.directory = directory
         self.meta = meta
         self._lock = threading.Lock()
@@ -96,10 +129,22 @@ class TaskStorage:
         if not os.path.exists(self.data_path):
             open(self.data_path, "wb").close()
         self._invalid = False
+        # Incremental-journal cadence (0/0 = persist only at mark_done
+        # and persist_all — the pre-ISSUE-8 behavior). Landings since
+        # the last persist and its timestamp live under _lock.
+        self._persist_every = max(int(persist_every_pieces), 0)
+        self._persist_interval = max(float(persist_interval_s), 0.0)
+        self._dirty_pieces = 0
+        self._last_persist = time.monotonic()
         # Set by the owning StorageManager: called once when mark_done
         # completes, so the manager's task_id → done-replica index stays
         # current without the manager lock wrapping every piece write.
         self.on_done = None
+        # True for stores rebuilt by StorageManager._reload and not yet
+        # adopted by a conductor — the register_or_resume handshake only
+        # ever adopts recovered stores, so a concurrent in-process
+        # download of the same task can never steal a live writer's.
+        self.recovered = False
 
     # -- write path --------------------------------------------------------
 
@@ -173,7 +218,40 @@ class TaskStorage:
         )
         with self._lock:
             self.meta.pieces[piece.num] = final
+        self._piece_landed()
         return written
+
+    def _piece_landed(self) -> None:
+        """Amortized journal tick on the write path: the landing that
+        crosses the count or age threshold persists the metadata
+        inline (the data write it journals already closed/flushed, so
+        the journal never leads the data). Writer-thread cost is one
+        fsynced ~KB JSON per ``persist_every_pieces`` landings; the
+        serve path never comes through here."""
+        if self._persist_every <= 0 and self._persist_interval <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            self._dirty_pieces += 1
+            due = (
+                (0 < self._persist_every <= self._dirty_pieces)
+                or (self._persist_interval > 0
+                    and now - self._last_persist >= self._persist_interval)
+            )
+        if due:
+            try:
+                self.persist()
+            except OSError as exc:
+                if exc.errno == errno.ENOSPC:
+                    # Same fail-fast contract as the data write: a full
+                    # disk is terminal for the task, and retrying the
+                    # piece (the generic transient path) just grinds.
+                    raise DiskFullError(f"journal persist: {exc}") from exc
+                # Any other journal-write failure must NOT fail a piece
+                # whose data landed: a stale journal is exactly the
+                # state the crash-recovery verify pass tolerates.
+                logger.warning("journal persist failed (piece kept): %s",
+                               exc)
 
     # -- native data-plane hooks ------------------------------------------
     # The C++ hot loops (dragonfly2_tpu/native) stream bytes directly
@@ -212,6 +290,7 @@ class TaskStorage:
         )
         with self._lock:
             self.meta.pieces[piece.num] = final
+        self._piece_landed()
         return written
 
     def piece_span(self, rng: Range) -> Optional[Tuple[str, int, int]]:
@@ -250,11 +329,16 @@ class TaskStorage:
                 num=num, md5=md5, offset=existing.offset,
                 start=existing.start, length=existing.length, cost_ns=cost_ns,
             )
+        # The digest is what makes the journaled piece verifiable at
+        # reload (write_piece stored it with md5="" on this path) — its
+        # arrival is journal-worthy like the landing itself.
+        self._piece_landed()
 
     def update(self, content_length: int | None = None,
                total_pieces: int | None = None,
                piece_md5_sign: str | None = None,
-               header: Dict[str, str] | None = None) -> None:
+               header: Dict[str, str] | None = None,
+               url: str | None = None) -> None:
         with self._lock:
             if content_length is not None:
                 self.meta.content_length = content_length
@@ -264,6 +348,8 @@ class TaskStorage:
                 self.meta.piece_md5_sign = piece_md5_sign
             if header is not None:
                 self.meta.header = dict(header)
+            if url is not None:
+                self.meta.url = url
 
     def mark_done(self) -> None:
         """Validate completeness, compute the piece-md5 signature, persist.
@@ -288,19 +374,102 @@ class TaskStorage:
             cb(self)        # manager lock (lock order: manager > store)
 
     def persist(self) -> None:
-        tmp = os.path.join(self.directory, METADATA_FILE + ".tmp")
+        """Crash-atomic journal publish: unique-per-call tmp (two
+        concurrent persists never interleave into one path), fsync the
+        tmp BEFORE ``os.replace`` (a crash can publish old or new,
+        never torn or empty), fsync the directory after (the rename
+        itself survives the crash)."""
+        tmp = os.path.join(
+            self.directory, f".{METADATA_FILE}.{uuid.uuid4().hex}.tmp")
         with self._lock:
             if self._invalid:
                 return  # deleted underneath us; nothing to persist
             raw = self.meta.to_json()
+            # Claimed optimistically (concurrent landings keep counting
+            # toward the NEXT window) but restored on failure — a
+            # failed publish must not silently double the documented
+            # at-most-one-cadence-window loss bound.
+            claimed_dirty = self._dirty_pieces
+            self._dirty_pieces = 0
+            self._last_persist = time.monotonic()
         try:
             with open(tmp, "w") as f:
                 f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, os.path.join(self.directory, METADATA_FILE))
+            dir_fd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
         except FileNotFoundError:
             # Directory raced away (concurrent delete_task/GC): a store
             # that lost its directory is dead weight, not a crash.
             self.invalidate()
+            self._unlink_quietly(tmp)
+        except Exception:
+            # Never leak a partial tmp next to a journal a crashy disk
+            # already failed to update; the old journal stays current.
+            # (Debris from a REAL mid-persist process death is swept by
+            # _reload's stale-tmp pass instead.) The claimed dirty
+            # count flows back so the NEXT landing re-arms the cadence
+            # instead of waiting out a whole fresh window.
+            with self._lock:
+                self._dirty_pieces += claimed_dirty
+            self._unlink_quietly(tmp)
+            raise
+
+    @staticmethod
+    def _unlink_quietly(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def verify_resident_pieces(self) -> Tuple[int, int]:
+        """Re-hash every journaled piece against the data file —
+        ``(verified, dropped)``. Mismatched, short, or md5-less pieces
+        are dropped from the journal (their bytes are garbage the next
+        fetch overwrites); a ``done`` store that loses a piece is
+        demoted to partial (its piece_md5_sign no longer holds). The
+        restart path runs this so a crash between a data write and its
+        fsync — or real on-disk corruption — can never serve or skip a
+        bad piece."""
+        with self._lock:
+            pieces = list(self.meta.pieces.values())
+        dropped: List[int] = []
+        try:
+            f = open(self.data_path, "rb")
+        except OSError:
+            f = None
+        try:
+            for piece in pieces:
+                ok = False
+                if f is not None and piece.md5:
+                    f.seek(piece.offset)
+                    digest = hashlib.new(digestutil.ALGORITHM_MD5)
+                    remaining = piece.length
+                    while remaining > 0:
+                        chunk = f.read(min(1 << 20, remaining))
+                        if not chunk:
+                            break
+                        digest.update(chunk)
+                        remaining -= len(chunk)
+                    ok = remaining == 0 and digest.hexdigest() == piece.md5
+                if not ok:
+                    dropped.append(piece.num)
+        finally:
+            if f is not None:
+                f.close()
+        if dropped:
+            with self._lock:
+                for num in dropped:
+                    self.meta.pieces.pop(num, None)
+                if self.meta.done:
+                    self.meta.done = False
+                    self.meta.piece_md5_sign = ""
+        return len(pieces) - len(dropped), len(dropped)
 
     # -- read path ---------------------------------------------------------
 
@@ -406,6 +575,18 @@ class StorageOptions:
     task_expire_seconds: float = 6 * 60 * 60.0
     disk_gc_threshold_bytes: int = 0  # 0 = unlimited
     keep_storage: bool = True
+    # Incremental-journal cadence on the write path: persist task
+    # metadata after this many piece landings since the last persist
+    # (0 disables) and/or when the journal is dirty and this many
+    # seconds old. Both amortize the fsync so the loopback MB/s ladder
+    # does not regress; a SIGKILL loses at most one cadence window of
+    # progress, never the whole download.
+    persist_every_pieces: int = 16
+    persist_interval_s: float = 2.0
+    # Re-hash every journaled piece at _reload (drop mismatches). The
+    # cost is one sequential read of resident bytes at startup; turn
+    # off only for stores whose medium is trusted end-to-end.
+    reload_verify: bool = True
 
 
 class StorageManager:
@@ -413,10 +594,15 @@ class StorageManager:
     completed-task reuse and TTL/usage GC
     (reference: client/daemon/storage/storage_manager.go:91-154)."""
 
-    def __init__(self, opts: StorageOptions):
+    def __init__(self, opts: StorageOptions, recovery=None):
         if not opts.root:
             raise ValueError("storage root required")
+        from dragonfly2_tpu.client.recovery import RECOVERY
+
         self.opts = opts
+        # Reload/resume observability ("recovery" debug block unless a
+        # bench/test injects its own scope).
+        self.recovery = recovery if recovery is not None else RECOVERY
         os.makedirs(opts.root, exist_ok=True)
         self._lock = threading.Lock()
         self._tasks: Dict[Tuple[str, str], TaskStorage] = {}
@@ -427,43 +613,215 @@ class StorageManager:
         # delete_task; lookups self-heal on staleness (GC'd replica →
         # one rescan refreshes or drops the entry).
         self._done_index: Dict[str, TaskStorage] = {}
+        # task_id → reload-recovered stores not yet adopted: the
+        # register_or_resume fast path (EVERY registration comes
+        # through it) must not scan the whole task map under the
+        # manager lock on a long-lived seed. Entries are pruned at
+        # adoption; the set is small and fixed after _reload.
+        self._recovered_by_task: Dict[str, List[TaskStorage]] = {}
         if opts.keep_storage:
             self._reload()
 
+    def _new_store(self, directory: str, meta: TaskMetadata) -> TaskStorage:
+        store = TaskStorage(
+            directory, meta,
+            persist_every_pieces=self.opts.persist_every_pieces,
+            persist_interval_s=self.opts.persist_interval_s,
+        )
+        store.on_done = self._note_done
+        return store
+
     def _reload(self) -> None:
         """Recover persisted tasks after restart (KeepStorage semantics,
-        client/config/peerhost.go:63)."""
-        for task_id in os.listdir(self.opts.root):
+        client/config/peerhost.go:63). Partial stores are recovered too
+        — their journaled pieces are re-verified against the data file
+        (``reload_pieces_verified``/``reload_pieces_dropped``) so a
+        resumed download only ever skips bytes that are provably good.
+        Directories whose journal is missing or corrupt leak data files
+        forever with nothing to GC them (no registration → no TTL); the
+        sweep quarantines them through the tombstone path and counts
+        ``reload_orphans_swept``."""
+        # A clean shutdown leaves the sentinel (mark_clean_shutdown);
+        # its presence means every journal was persisted by a live
+        # stop() and nothing was written since — the full resident-byte
+        # re-hash is for CRASH recovery. Consumed either way, so only
+        # the next shutdown can re-earn the skip.
+        clean = False
+        sentinel = os.path.join(self.opts.root, CLEAN_SHUTDOWN_FILE)
+        if os.path.exists(sentinel):
+            clean = True
+            TaskStorage._unlink_quietly(sentinel)
+        orphans = 0
+        verified = dropped = 0
+
+        def sweep(path: str) -> None:
+            nonlocal orphans
+            orphans += 1
+            tomb = self._tombstone(path)
+            shutil.rmtree(tomb or path, ignore_errors=True)
+
+        for task_id in sorted(os.listdir(self.opts.root)):
             task_dir = os.path.join(self.opts.root, task_id)
             if not os.path.isdir(task_dir):
                 continue
-            for peer_id in os.listdir(task_dir):
-                meta_path = os.path.join(task_dir, peer_id, METADATA_FILE)
-                if not os.path.exists(meta_path):
+            if task_id == ".trash":
+                # Tombstones a previous process renamed but never got
+                # to rmtree (crash mid-delete): finish the job.
+                for leftover in os.listdir(task_dir):
+                    shutil.rmtree(os.path.join(task_dir, leftover),
+                                  ignore_errors=True)
+                continue
+            for peer_id in sorted(os.listdir(task_dir)):
+                peer_dir = os.path.join(task_dir, peer_id)
+                if not os.path.isdir(peer_dir):
                     continue
+                meta_path = os.path.join(peer_dir, METADATA_FILE)
                 try:
                     with open(meta_path) as f:
                         meta = TaskMetadata.from_json(f.read())
-                except (OSError, ValueError, TypeError, KeyError) as exc:
-                    logger.warning("skip corrupt metadata %s: %s", meta_path, exc)
+                except FileNotFoundError:
+                    logger.warning("orphan task dir %s (no journal)",
+                                   peer_dir)
+                    sweep(peer_dir)
                     continue
-                store = TaskStorage(os.path.join(task_dir, peer_id), meta)
-                store.on_done = self._note_done
-                self._tasks[(task_id, peer_id)] = store
+                except (ValueError, TypeError, KeyError) as exc:
+                    logger.warning(
+                        "orphan task dir %s (corrupt journal): %s",
+                        peer_dir, exc)
+                    sweep(peer_dir)
+                    continue
+                except OSError as exc:
+                    # Transient I/O (EIO/EACCES/EMFILE) is NOT proof of
+                    # orphanhood — deleting a valid replica over a read
+                    # blip would be the opposite of durability. Skip;
+                    # the next reload retries.
+                    logger.warning("skip unreadable journal %s: %s",
+                                   meta_path, exc)
+                    continue
+                self._sweep_stale_tmp(peer_dir)
+                store = self._new_store(peer_dir, meta)
+                if self.opts.reload_verify and not clean:
+                    ok, bad = store.verify_resident_pieces()
+                    verified += ok
+                    dropped += bad
+                    if bad:
+                        logger.warning(
+                            "task %s peer %s: dropped %d unverifiable "
+                            "piece(s) at reload", task_id[:16], peer_id, bad)
+                        store.persist()  # re-journal the verified truth
+                store.recovered = True
+                # Key by the JOURNALED peer id, not the directory name:
+                # a crash between a failed adoption rename and the
+                # re-keyed journal's persist leaves them diverged, and
+                # the journal is the truth every other lookup uses.
+                self._tasks[(task_id, meta.peer_id)] = store
+                self._recovered_by_task.setdefault(task_id, []).append(store)
                 if store.done:
                     self._done_index[task_id] = store
+            try:  # a task dir whose every peer was swept is itself junk
+                os.rmdir(task_dir)
+            except OSError:
+                pass
+        if orphans:
+            self.recovery.tick("reload_orphans_swept", orphans)
+        if verified:
+            self.recovery.tick("reload_pieces_verified", verified)
+        if dropped:
+            self.recovery.tick("reload_pieces_dropped", dropped)
+
+    def mark_clean_shutdown(self) -> None:
+        """Leave the clean-shutdown sentinel: every journal was just
+        persisted (persist_all) and this process is stopping. The next
+        reload then skips the full resident-byte re-hash — a graceful
+        rolling restart of a seed holding many GB stays O(metadata) —
+        while any crash (no sentinel) still pays the verify pass."""
+        try:
+            with open(os.path.join(self.opts.root, CLEAN_SHUTDOWN_FILE),
+                      "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass  # worst case: the next start verifies, as after a crash
+
+    @staticmethod
+    def _sweep_stale_tmp(peer_dir: str) -> None:
+        """Unique-per-call persist tmps survive a crash between write
+        and replace; they are garbage once a reload is looking."""
+        try:
+            names = os.listdir(peer_dir)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith(f".{METADATA_FILE}.") and name.endswith(".tmp"):
+                TaskStorage._unlink_quietly(os.path.join(peer_dir, name))
 
     def register_task(self, task_id: str, peer_id: str) -> TaskStorage:
         with self._lock:
             key = (task_id, peer_id)
             if key not in self._tasks:
                 directory = os.path.join(self.opts.root, task_id, peer_id)
-                store = TaskStorage(
+                self._tasks[key] = self._new_store(
                     directory, TaskMetadata(task_id=task_id, peer_id=peer_id)
                 )
-                store.on_done = self._note_done
-                self._tasks[key] = store
             return self._tasks[key]
+
+    def register_or_resume(
+        self, task_id: str, peer_id: str,
+    ) -> Tuple[TaskStorage, List[PieceMetadata]]:
+        """Registration that ADOPTS a crash-recovered partial store for
+        the task when one exists: the store is re-keyed to the new peer
+        id (a restarted daemon registers with a fresh one) and its
+        verified pieces are returned so the conductor can seed its
+        downloaded-set and fetch only the missing tail. Only stores
+        marked ``recovered`` by ``_reload`` are adoptable — a live
+        writer's store in this same process never is — and adoption
+        clears the mark, so exactly one conductor resumes each
+        recovered store. Falls back to plain registration."""
+        with self._lock:
+            key = (task_id, peer_id)
+            existing = self._tasks.get(key)
+            if existing is not None:
+                return existing, []
+            best: Optional[TaskStorage] = None
+            pool = self._recovered_by_task.get(task_id, ())
+            for candidate in pool:
+                if (candidate.recovered and candidate.valid
+                        and not candidate.done
+                        and (best is None
+                             or len(candidate.meta.pieces)
+                             > len(best.meta.pieces))):
+                    best = candidate
+            if best is None:
+                self._recovered_by_task.pop(task_id, None)  # all spent
+                directory = os.path.join(self.opts.root, task_id, peer_id)
+                store = self._new_store(
+                    directory, TaskMetadata(task_id=task_id, peer_id=peer_id))
+                self._tasks[key] = store
+                return store, []
+            best.recovered = False
+            self._recovered_by_task[task_id] = [
+                s for s in pool if s is not best]
+            self._tasks.pop((task_id, best.meta.peer_id), None)
+            old_dir = best.directory
+            new_dir = os.path.join(self.opts.root, task_id, peer_id)
+            try:
+                os.rename(old_dir, new_dir)
+                best.directory = new_dir
+                best.data_path = os.path.join(new_dir, DATA_FILE)
+            except OSError:
+                pass  # layout keeps the old dir name; ids live in the meta
+            best.meta.peer_id = peer_id
+            self._tasks[key] = best
+            resumed = [best.meta.pieces[n]
+                       for n in sorted(best.meta.pieces)]
+        best.persist()  # journal the adoption (new peer id) durably
+        return best, resumed
+
+    def done_tasks(self) -> List[TaskStorage]:
+        """Every valid completed replica — the restart re-announce
+        inventory (one per task: the done index is authoritative)."""
+        with self._lock:
+            return [s for s in self._done_index.values()
+                    if s.done and s.valid]
 
     def _note_done(self, store: TaskStorage) -> None:
         """mark_done hook: index the fresh done replica (unless it was

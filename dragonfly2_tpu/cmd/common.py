@@ -190,13 +190,36 @@ def start_metrics_server(args, registry):
     return server
 
 
-def wait_for_shutdown() -> None:
-    """Block until SIGINT/SIGTERM (service commands)."""
+def install_shutdown_handlers() -> threading.Event:
+    """Install SIGINT/SIGTERM handlers that request a GRACEFUL stop;
+    returns the event they set.
+
+    Call this EARLY in a service ``main`` — before the long build/serve
+    phase, not at the final ``wait_for_shutdown`` — so a signal
+    delivered during startup still routes through the command's
+    orderly teardown (daemon: ``stop()`` → ``storage.persist_all()``)
+    instead of killing the process with default disposition and
+    losing every unjournaled byte of state."""
     stop = threading.Event()
 
     def handler(signum, frame):
         stop.set()
 
-    signal.signal(signal.SIGINT, handler)
-    signal.signal(signal.SIGTERM, handler)
+    try:
+        signal.signal(signal.SIGINT, handler)
+        signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        # Not the main thread (embedded/test invocation): signals can't
+        # route here; the caller still gets a working event it can set.
+        pass
+    return stop
+
+
+def wait_for_shutdown(stop: threading.Event | None = None) -> None:
+    """Block until SIGINT/SIGTERM (service commands). Pass the event
+    from :func:`install_shutdown_handlers` when handlers were installed
+    early; with no argument the handlers are installed here (commands
+    whose startup holds no state worth a graceful path)."""
+    if stop is None:
+        stop = install_shutdown_handlers()
     stop.wait()

@@ -12,6 +12,7 @@ import sys
 
 from dragonfly2_tpu.cmd.common import (
     init_tracing,
+    install_shutdown_handlers,
     parse_with_config,
     add_common_flags,
     init_logging,
@@ -55,6 +56,9 @@ def build_daemon(args):
         total_download_rate_bps=args.download_rate or INF,
         upload_rate_bps=args.upload_rate or INF,
         traffic_shaper_type=args.traffic_shaper,
+        persist_every_pieces=args.persist_every_pieces,
+        persist_interval_s=args.persist_interval,
+        reload_verify=not args.no_reload_verify,
         probe_interval=args.probe_interval,
         announce_interval=args.announce_interval,
         upload_serve_backlog=args.serve_backlog,
@@ -127,6 +131,19 @@ def main(argv=None) -> int:
                              "engine (0 = default; total serving threads "
                              "= workers + 1 acceptor, independent of "
                              "connection count)")
+    parser.add_argument("--persist-every-pieces", type=int, default=16,
+                        help="journal task metadata after this many piece "
+                             "landings (0 disables the count trigger); "
+                             "with --persist-interval this bounds how much "
+                             "download progress a SIGKILL can lose")
+    parser.add_argument("--persist-interval", type=float, default=2.0,
+                        help="also journal a dirty task after this many "
+                             "seconds (0 disables the age trigger; set "
+                             "BOTH 0 to journal only at completion/"
+                             "shutdown, the pre-journal behavior)")
+    parser.add_argument("--no-reload-verify", action="store_true",
+                        help="skip md5 re-verification of journaled pieces "
+                             "at startup reload (trusted storage medium)")
     parser.add_argument("--probe-interval", type=float, default=0.0,
                         help="network-topology probe ticker seconds "
                              "(0 = disabled)")
@@ -171,6 +188,13 @@ def main(argv=None) -> int:
                              "by IP (SNI override)")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
+    # SIGTERM/SIGINT must run the graceful stop path from the moment
+    # the daemon starts building state (storage reload, announce) —
+    # installed only at wait_for_shutdown, a production SIGTERM during
+    # startup (or delivered to a handler-less daemon) would kill the
+    # process with default disposition and never reach
+    # daemon.stop() → storage.persist_all().
+    shutdown = install_shutdown_handlers()
     init_logging(args.verbose, args.log_dir, service="dfdaemon")
     init_tracing(args, "dfdaemon")
     if args.sni_port >= 0 and not args.proxy_hijack_https:
@@ -320,7 +344,7 @@ def main(argv=None) -> int:
         watcher = ConfigWatcher(args.config, _apply_reload,
                                 interval=args.reload_interval).start()
 
-    wait_for_shutdown()
+    wait_for_shutdown(shutdown)
     if watcher is not None:
         watcher.stop()
     if dynconfig is not None:

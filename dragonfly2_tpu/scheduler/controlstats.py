@@ -77,6 +77,7 @@ class ControlPlaneStats:
         self.piece_reports = 0
         self.report_batches = 0
         self.peer_reregistrations = 0
+        self.task_reannounces = 0
         self.bad_node_fast = 0
         self.bad_node_slow = 0
         self.gc_ticks = 0
@@ -118,6 +119,10 @@ class ControlPlaneStats:
         with self._lock:
             self.peer_reregistrations += 1
 
+    def observe_task_reannounce(self) -> None:
+        with self._lock:
+            self.task_reannounces += 1
+
     def observe_bad_node(self, *, fast: bool) -> None:
         # Lock-free: this fires once per CANDIDATE inside the filter hot
         # loop — taking the shared stats lock there would re-introduce
@@ -158,6 +163,7 @@ class ControlPlaneStats:
                 "piece_reports": self.piece_reports,
                 "report_batches": self.report_batches,
                 "peer_reregistrations": self.peer_reregistrations,
+                "task_reannounces": self.task_reannounces,
                 "bad_node_fast": self.bad_node_fast,
                 "bad_node_slow": self.bad_node_slow,
                 "gc_ticks": self.gc_ticks,

@@ -36,6 +36,7 @@ from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
 from dragonfly2_tpu.scheduler.resource.host import Host
 from dragonfly2_tpu.scheduler.resource.task import SizeScope
 from dragonfly2_tpu.scheduler.service import (
+    AnnounceTaskRequest,
     PieceFinished,
     ProbeResult,
     RegisterPeerRequest,
@@ -146,6 +147,23 @@ class PeerID:
 @dataclass
 class TaskID:
     task_id: str = ""
+
+
+@message("scheduler.WireAnnounceTask")
+@dataclass
+class WireAnnounceTask:
+    """Restart re-announce of a completed local replica (KeepStorage
+    reload → the daemon resumes serving as a parent)."""
+
+    host_id: str = ""
+    task_id: str = ""
+    peer_id: str = ""
+    url: str = ""
+    tag: str = ""
+    application: str = ""
+    content_length: int = -1
+    total_piece_count: int = 0
+    piece_md5_sign: str = ""
 
 
 @message("scheduler.StatTaskResponse")
@@ -320,6 +338,7 @@ SCHEDULER_SPEC = ServiceSpec(
     name="df2.scheduler.Scheduler",
     methods={
         "AnnounceHost": MethodKind.UNARY_UNARY,
+        "AnnounceTask": MethodKind.UNARY_UNARY,
         "LeaveHost": MethodKind.UNARY_UNARY,
         "LeavePeer": MethodKind.UNARY_UNARY,
         "StatTask": MethodKind.UNARY_UNARY,
@@ -369,6 +388,17 @@ class SchedulerRpcService:
 
     def AnnounceHost(self, request: AnnounceHostRequest, context) -> Empty:  # noqa: N802
         self.service.announce_host(request.to_host())
+        return Empty()
+
+    def AnnounceTask(self, request: WireAnnounceTask, context) -> Empty:  # noqa: N802
+        self._guard(context, self.service.announce_task, AnnounceTaskRequest(
+            host_id=request.host_id, task_id=request.task_id,
+            peer_id=request.peer_id, url=request.url, tag=request.tag,
+            application=request.application,
+            content_length=request.content_length,
+            total_piece_count=request.total_piece_count,
+            piece_md5_sign=request.piece_md5_sign,
+        ))
         return Empty()
 
     def LeaveHost(self, request: HostID, context) -> Empty:  # noqa: N802
@@ -636,6 +666,29 @@ class GrpcSchedulerClient:
         self._inject("announce_host")
         self._client.AnnounceHost(AnnounceHostRequest.from_host(host),
                                   timeout=10)
+
+    def announce_task(self, req: AnnounceTaskRequest) -> None:
+        """Restart re-announce of a completed replica (unary). A
+        NOT_FOUND abort ("host not announced" on a replica that joined
+        after our announce) is surfaced as the in-process ServiceError
+        so the balanced client's host-teaching heal path stays one
+        code path for both transports."""
+        import grpc
+
+        self._inject("announce_task")
+        try:
+            self._client.AnnounceTask(WireAnnounceTask(
+                host_id=req.host_id, task_id=req.task_id,
+                peer_id=req.peer_id, url=req.url, tag=req.tag,
+                application=req.application,
+                content_length=req.content_length,
+                total_piece_count=req.total_piece_count,
+                piece_md5_sign=req.piece_md5_sign,
+            ), timeout=10)
+        except grpc.RpcError as err:
+            if err.code() == grpc.StatusCode.NOT_FOUND:
+                raise ServiceError("NotFound", err.details()) from err
+            raise
 
     def leave_host(self, host_id: str) -> None:
         self._client.LeaveHost(HostID(host_id), timeout=10)
@@ -1311,6 +1364,26 @@ class BalancedSchedulerClient:
                 last = exc
         raise last if last is not None else ConnectionError("no schedulers")
 
+    def announce_task(self, req) -> None:
+        """Restart re-announce of a completed replica — task-affine
+        like register_peer (children of the task register at the same
+        ring owner, so the replica answering their registration is the
+        one that must know this parent), teaching the host on "not
+        announced" exactly like ``_register_at``."""
+        last: Optional[Exception] = None
+        for target in self._walk_healthy(req.task_id):
+            cli = self._client_at(target)
+            try:
+                self._teach_host_and_retry(
+                    cli, req.host_id, lambda: cli.announce_task(req))
+                return
+            except Exception as exc:  # noqa: BLE001 — walk on dead replicas
+                if not self._walk_retryable(exc):
+                    raise
+                self._note_unreachable(target)
+                last = exc
+        raise last if last is not None else ConnectionError("no schedulers")
+
     def probe_sync(self, host_id: str = ""):
         """Probe stream to this host's ring-stable replica — hashing the
         daemon's host_id spreads the fleet's probe load across replicas
@@ -1347,22 +1420,29 @@ class BalancedSchedulerClient:
 
     # -- SchedulerAPI ---------------------------------------------------
 
-    def _register_at(self, cli: GrpcSchedulerClient,
-                     req: RegisterPeerRequest,
-                     channel) -> RegisterPeerResponse:
-        """register_peer against one replica, teaching it the host
+    def _teach_host_and_retry(self, cli: GrpcSchedulerClient,
+                              host_id: str, call):
+        """Host-keyed call against one replica, teaching it the host
         first when it answers "not announced" — a replica that joined
         after the daemon's announce (rolling restart) must be usable
-        for FRESH registrations and failover replays alike."""
+        for fresh registrations, failover replays, and task
+        re-announces alike."""
         try:
-            return cli.register_peer(req, channel=channel)
+            return call()
         except ServiceError as exc:
-            host = self._known_hosts.get(req.host_id)
+            host = self._known_hosts.get(host_id)
             if (exc.code != "NotFound" or "not announced" not in str(exc)
                     or host is None):
                 raise
             cli.announce_host(host)
-            return cli.register_peer(req, channel=channel)
+            return call()
+
+    def _register_at(self, cli: GrpcSchedulerClient,
+                     req: RegisterPeerRequest,
+                     channel) -> RegisterPeerResponse:
+        return self._teach_host_and_retry(
+            cli, req.host_id,
+            lambda: cli.register_peer(req, channel=channel))
 
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:

@@ -82,6 +82,25 @@ class RegisterPeerResponse:
 
 
 @dataclass
+class AnnounceTaskRequest:
+    """A daemon re-announcing a COMPLETED local replica after restart
+    (KeepStorage reload) — the reference's AnnounceTask surface
+    (scheduler v1, used by dfcache import and persisted-cache reload).
+    The scheduler learns: this host holds the whole task and can serve
+    as a parent right now."""
+
+    host_id: str
+    task_id: str
+    peer_id: str
+    url: str = ""
+    tag: str = ""
+    application: str = ""
+    content_length: int = -1
+    total_piece_count: int = 0
+    piece_md5_sign: str = ""
+
+
+@dataclass
 class PieceFinished:
     peer_id: str
     piece_number: int
@@ -274,6 +293,50 @@ class SchedulerService:
             content_length=task.content_length,
             total_piece_count=task.total_piece_count,
         )
+
+    def announce_task(self, req: AnnounceTaskRequest) -> None:
+        """Install a completed replica into the resource view: task
+        upserted to SUCCEEDED with the announced shape, and a SUCCEEDED
+        peer bound to the announcing host so scheduling offers it as a
+        candidate parent immediately (children then sync the piece
+        inventory straight from the daemon's upload server).
+
+        Idempotent per (peer, host); a stale peer record under the same
+        id but a DIFFERENT host (the daemon restarted on a new port —
+        host identity hashes the port) is replaced, not refreshed:
+        children must never be pointed at the dead listener."""
+        host = self.resource.host_manager.load(req.host_id)
+        if host is None:
+            raise ServiceError(NOT_FOUND, f"host {req.host_id} not announced")
+        if req.content_length < 0 or req.total_piece_count <= 0:
+            raise ServiceError(INVALID_ARGUMENT,
+                               "announce_task needs the completed shape "
+                               "(content_length, total_piece_count)")
+        task = self.resource.task_manager.load_or_store(
+            Task(req.task_id, url=req.url, tag=req.tag,
+                 application=req.application)
+        )
+        if task.fsm.can(TaskEvent.DOWNLOAD):
+            task.fsm.fire(TaskEvent.DOWNLOAD)
+        task.report_success(req.content_length, req.total_piece_count)
+        existing = self.resource.peer_manager.load(req.peer_id)
+        if existing is not None:
+            if (existing.host.id == host.id
+                    and existing.fsm.is_state(PeerState.SUCCEEDED)):
+                self.stats.observe_task_reannounce()
+                return  # already known exactly as announced
+            self.leave_peer(req.peer_id)
+        peer = Peer(req.peer_id, task, host,
+                    tag=req.tag, application=req.application)
+        self.resource.peer_manager.store(peer)
+        peer.fsm.fire(PeerEvent.REGISTER_NORMAL)
+        peer.fsm.fire(PeerEvent.DOWNLOAD)
+        peer.finished_pieces.update(range(req.total_piece_count))
+        peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        self.stats.observe_task_reannounce()
+        logger.info("task %s re-announced by %s (%d pieces, host %s)",
+                    req.task_id[:16], req.peer_id[-16:],
+                    req.total_piece_count, req.host_id[:16])
 
     def _maybe_trigger_seed_peer(self, task: Task) -> None:
         """First download of a pending task fans a seed-peer back-source

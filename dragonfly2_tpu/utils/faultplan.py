@@ -53,6 +53,12 @@ site                    where it fires
                         and SIGKILLs the one whose visit fires a ``KILL``
                         rule — hard replica death, complementing the
                         RPC-level ``scheduler.rpc`` faults
+``daemon.process``      process-level DAEMON kills: the daemon-kill chaos
+                        rung polls :func:`should_kill` once a victim
+                        daemon's download progress crosses the rung's
+                        threshold (context = daemon hostname) and
+                        SIGKILLs it mid-write — the failure the durable
+                        piece journal + restart-resume path exist for
 ======================  =====================================================
 """
 

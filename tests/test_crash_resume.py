@@ -1,0 +1,707 @@
+"""Crash-safe download state (ISSUE 8): durable piece journal, restart
+verify + resume, seed re-announce, and the daemon-kill chaos rung.
+
+Tier-1 tests cover the storage-level contracts in-process (crash-atomic
+persist, torn-journal-never-published, reload verify/drop, orphan
+sweep, incremental cadence, resume adoption, re-announce serving a
+child); the ``slow``+``chaos`` test SIGKILLs a REAL subprocess daemon
+mid-write through ``client/chaosbench.run_daemon_kill_rung`` and
+asserts the full rung verdict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import threading
+
+import pytest
+
+from dragonfly2_tpu.client.piece import PieceMetadata
+from dragonfly2_tpu.client.recovery import RecoveryStats
+from dragonfly2_tpu.client.storage import (
+    METADATA_FILE,
+    StorageManager,
+    StorageOptions,
+    TaskMetadata,
+    TaskStorage,
+    WritePieceRequest,
+)
+
+PIECE = 64 * 1024
+
+
+def _blob(n_pieces: int, seed: int = 0) -> bytes:
+    import numpy as np
+
+    return np.random.default_rng(seed).bytes(n_pieces * PIECE)
+
+
+def _write_store(root: str, task_id: str, peer_id: str, blob: bytes,
+                 n_pieces: int, *, total: int | None = None,
+                 done: bool = False, url: str = "") -> str:
+    """Craft an on-disk store the way a crashed daemon leaves one:
+    data file with the first ``n_pieces`` pieces + a journal claiming
+    exactly those (verified) pieces."""
+    peer_dir = os.path.join(root, task_id, peer_id)
+    os.makedirs(peer_dir, exist_ok=True)
+    with open(os.path.join(peer_dir, "data"), "wb") as f:
+        f.write(blob[: n_pieces * PIECE])
+    meta = TaskMetadata(
+        task_id=task_id, peer_id=peer_id, content_length=len(blob),
+        total_pieces=(total if total is not None
+                      else (len(blob) + PIECE - 1) // PIECE),
+        done=done, url=url)
+    meta.pieces = {
+        i: PieceMetadata(
+            num=i, md5=hashlib.md5(blob[i * PIECE:(i + 1) * PIECE]).hexdigest(),
+            offset=i * PIECE, start=i * PIECE, length=PIECE)
+        for i in range(n_pieces)
+    }
+    with open(os.path.join(peer_dir, METADATA_FILE), "w") as f:
+        f.write(meta.to_json())
+    return peer_dir
+
+
+def _piece_req(task_id: str, peer_id: str, blob: bytes,
+               num: int) -> tuple[WritePieceRequest, bytes]:
+    data = blob[num * PIECE:(num + 1) * PIECE]
+    return WritePieceRequest(task_id, peer_id, PieceMetadata(
+        num=num, md5=hashlib.md5(data).hexdigest(),
+        offset=num * PIECE, start=num * PIECE, length=len(data))), data
+
+
+class TestCrashAtomicPersist:
+    def test_unique_tmp_names_and_no_leftovers(self, tmp_path):
+        """Concurrent persists must never interleave into one tmp path
+        and must leave no tmp debris behind."""
+        store = TaskStorage(str(tmp_path / "s"),
+                            TaskMetadata(task_id="t", peer_id="p"))
+        seen = set()
+        real_replace = os.replace
+
+        def spy_replace(src, dst):
+            seen.add(src)
+            real_replace(src, dst)
+
+        blob = _blob(8)
+        import io
+        from unittest import mock
+
+        with mock.patch("dragonfly2_tpu.client.storage.os.replace",
+                        side_effect=spy_replace):
+            threads = []
+            for i in range(8):
+                req, data = _piece_req("t", "p", blob, i)
+                store.write_piece(req, io.BytesIO(data))
+                threads.append(threading.Thread(target=store.persist))
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(seen) == 8  # one UNIQUE tmp per persist call
+        leftovers = [n for n in os.listdir(store.directory)
+                     if n.endswith(".tmp")]
+        assert leftovers == []
+        reloaded = TaskMetadata.from_json(
+            open(os.path.join(store.directory, METADATA_FILE)).read())
+        assert len(reloaded.pieces) == 8
+
+    def test_torn_metadata_never_published(self, tmp_path):
+        """Crash-loop unit: kill the persist at every step (tmp write,
+        fsync, replace) — the published journal must always parse and
+        always describe a consistent piece set (old or new, never
+        torn/empty)."""
+        import io
+        from unittest import mock
+
+        directory = str(tmp_path / "s")
+        store = TaskStorage(directory,
+                            TaskMetadata(task_id="t", peer_id="p"))
+        blob = _blob(6)
+        req, data = _piece_req("t", "p", blob, 0)
+        store.write_piece(req, io.BytesIO(data))
+        store.persist()  # baseline journal: {0}
+
+        published = os.path.join(directory, METADATA_FILE)
+
+        def journal_piece_count() -> int:
+            meta = TaskMetadata.from_json(open(published).read())
+            for piece in meta.pieces.values():  # every claim verifiable
+                span = blob[piece.offset:piece.offset + piece.length]
+                assert hashlib.md5(span).hexdigest() == piece.md5
+            return len(meta.pieces)
+
+        crash = RuntimeError("injected crash")
+        crash_points = [
+            mock.patch("dragonfly2_tpu.client.storage.os.fsync",
+                       side_effect=crash),
+            mock.patch("dragonfly2_tpu.client.storage.os.replace",
+                       side_effect=crash),
+        ]
+        for n, patcher in enumerate(crash_points, start=1):
+            req, data = _piece_req("t", "p", blob, n)
+            store.write_piece(req, io.BytesIO(data))
+            with patcher:
+                with pytest.raises(RuntimeError):
+                    store.persist()
+            # The old journal survives intact; no tmp debris.
+            assert journal_piece_count() == n  # pre-crash content
+            assert [x for x in os.listdir(directory)
+                    if x.endswith(".tmp")] == []
+            store.persist()  # the next healthy persist publishes all
+            assert journal_piece_count() == n + 1
+
+
+class TestIncrementalJournal:
+    def test_write_path_persists_at_cadence(self, tmp_path):
+        import io
+
+        store = TaskStorage(str(tmp_path / "s"),
+                            TaskMetadata(task_id="t", peer_id="p"),
+                            persist_every_pieces=4)
+        blob = _blob(8)
+        published = os.path.join(store.directory, METADATA_FILE)
+        for i in range(3):
+            req, data = _piece_req("t", "p", blob, i)
+            store.write_piece(req, io.BytesIO(data))
+        assert not os.path.exists(published)  # under cadence: no journal
+        req, data = _piece_req("t", "p", blob, 3)
+        store.write_piece(req, io.BytesIO(data))  # 4th landing: journal
+        meta = TaskMetadata.from_json(open(published).read())
+        assert sorted(meta.pieces) == [0, 1, 2, 3]
+        assert not meta.done
+
+    def test_zero_cadence_keeps_old_behavior(self, tmp_path):
+        import io
+
+        store = TaskStorage(str(tmp_path / "s"),
+                            TaskMetadata(task_id="t", peer_id="p"))
+        blob = _blob(4)
+        for i in range(4):
+            req, data = _piece_req("t", "p", blob, i)
+            store.write_piece(req, io.BytesIO(data))
+        assert not os.path.exists(
+            os.path.join(store.directory, METADATA_FILE))
+
+
+class TestReloadVerify:
+    def test_corrupt_piece_dropped_at_reload(self, tmp_path):
+        blob = _blob(6)
+        root = str(tmp_path)
+        peer_dir = _write_store(root, "task", "peer", blob, 6, done=True)
+        # Flip bytes inside piece 2 on disk.
+        with open(os.path.join(peer_dir, "data"), "r+b") as f:
+            f.seek(2 * PIECE + 100)
+            f.write(b"\x00\xff\x00")
+        rec = RecoveryStats()
+        mgr = StorageManager(StorageOptions(root=root), recovery=rec)
+        store = mgr.get("task", "peer")
+        assert store is not None
+        assert sorted(store.meta.pieces) == [0, 1, 3, 4, 5]
+        assert not store.done  # a done store with a drop is DEMOTED
+        assert store.meta.piece_md5_sign == ""
+        assert mgr.find_completed_task("task") is None
+        assert rec.get("reload_pieces_verified") == 5
+        assert rec.get("reload_pieces_dropped") == 1
+        # The corrected journal was re-published durably.
+        on_disk = TaskMetadata.from_json(
+            open(os.path.join(peer_dir, METADATA_FILE)).read())
+        assert sorted(on_disk.pieces) == [0, 1, 3, 4, 5]
+        assert not on_disk.done
+
+    def test_short_data_file_and_md5less_pieces_dropped(self, tmp_path):
+        blob = _blob(4)
+        root = str(tmp_path)
+        peer_dir = _write_store(root, "task", "peer", blob, 4)
+        # Truncate the data file mid-piece-3 and erase piece 1's md5
+        # (journaled before the wire digest arrived).
+        with open(os.path.join(peer_dir, "data"), "r+b") as f:
+            f.truncate(3 * PIECE + 10)
+        meta = TaskMetadata.from_json(
+            open(os.path.join(peer_dir, METADATA_FILE)).read())
+        p1 = meta.pieces[1]
+        meta.pieces[1] = PieceMetadata(num=1, md5="", offset=p1.offset,
+                                       start=p1.start, length=p1.length)
+        with open(os.path.join(peer_dir, METADATA_FILE), "w") as f:
+            f.write(meta.to_json())
+        rec = RecoveryStats()
+        mgr = StorageManager(StorageOptions(root=root), recovery=rec)
+        store = mgr.get("task", "peer")
+        assert sorted(store.meta.pieces) == [0, 2]
+        assert rec.get("reload_pieces_dropped") == 2
+
+    def test_clean_shutdown_sentinel_skips_verify_once(self, tmp_path):
+        """Graceful stop leaves the sentinel → the next reload skips
+        the resident-byte re-hash; the sentinel is CONSUMED, so a
+        subsequent crash-shaped start verifies again."""
+        from dragonfly2_tpu.client.storage import CLEAN_SHUTDOWN_FILE
+
+        blob = _blob(4)
+        root = str(tmp_path)
+        _write_store(root, "task", "peer", blob, 4, done=True)
+        mgr = StorageManager(StorageOptions(root=root))
+        mgr.persist_all()
+        mgr.mark_clean_shutdown()
+        sentinel = os.path.join(root, CLEAN_SHUTDOWN_FILE)
+        assert os.path.exists(sentinel)
+        rec = RecoveryStats()
+        mgr2 = StorageManager(StorageOptions(root=root), recovery=rec)
+        assert mgr2.find_completed_task("task") is not None
+        assert rec.get("reload_pieces_verified") == 0  # skipped
+        assert not os.path.exists(sentinel)  # consumed
+        rec3 = RecoveryStats()
+        StorageManager(StorageOptions(root=root), recovery=rec3)
+        assert rec3.get("reload_pieces_verified") == 4  # crash path
+
+    def test_transient_read_error_never_sweeps_a_replica(
+            self, tmp_path, monkeypatch):
+        """EIO/EACCES while READING a journal is not orphanhood — the
+        store is skipped this reload, never deleted."""
+        import builtins
+
+        blob = _blob(3)
+        root = str(tmp_path)
+        peer_dir = _write_store(root, "task", "peer", blob, 3, done=True)
+        meta_path = os.path.join(peer_dir, METADATA_FILE)
+        real_open = builtins.open
+
+        def flaky_open(path, *a, **kw):
+            if os.fspath(path) == meta_path:
+                raise OSError(5, "Input/output error")
+            return real_open(path, *a, **kw)
+
+        rec = RecoveryStats()
+        monkeypatch.setattr(builtins, "open", flaky_open)
+        mgr = StorageManager(StorageOptions(root=root), recovery=rec)
+        monkeypatch.undo()
+        assert rec.get("reload_orphans_swept") == 0
+        assert os.path.exists(meta_path)  # data survived the blip
+        assert mgr.get("task", "peer") is None  # just skipped this pass
+        mgr2 = StorageManager(StorageOptions(root=root))
+        assert mgr2.find_completed_task("task") is not None  # healed
+
+    def test_task_dir_reaped_in_the_sweeping_pass(self, tmp_path):
+        """A task dir whose ONLY peer is an orphan disappears in the
+        same reload, not the next one."""
+        root = str(tmp_path)
+        lone = os.path.join(root, "lonely-task", "no-journal")
+        os.makedirs(lone)
+        open(os.path.join(lone, "data"), "wb").close()
+        rec = RecoveryStats()
+        StorageManager(StorageOptions(root=root), recovery=rec)
+        assert rec.get("reload_orphans_swept") == 1
+        assert not os.path.exists(os.path.join(root, "lonely-task"))
+
+    def test_orphans_swept_and_stale_tmp_cleaned(self, tmp_path):
+        blob = _blob(2)
+        root = str(tmp_path)
+        peer_dir = _write_store(root, "task", "peer", blob, 2)
+        # Stale persist tmp beside a healthy journal.
+        stale = os.path.join(peer_dir, f".{METADATA_FILE}.deadbeef.tmp")
+        open(stale, "w").write("partial")
+        # Orphan 1: peer dir with no journal at all.
+        os.makedirs(os.path.join(root, "task", "no-journal"))
+        open(os.path.join(root, "task", "no-journal", "data"), "wb").close()
+        # Orphan 2: corrupt journal.
+        bad_dir = os.path.join(root, "othertask", "bad")
+        os.makedirs(bad_dir)
+        open(os.path.join(bad_dir, METADATA_FILE), "w").write("{not json")
+        rec = RecoveryStats()
+        mgr = StorageManager(StorageOptions(root=root), recovery=rec)
+        assert rec.get("reload_orphans_swept") == 2
+        assert not os.path.exists(stale)
+        assert not os.path.exists(os.path.join(root, "task", "no-journal"))
+        # othertask had ONLY the orphan: its task dir is reaped too.
+        assert not os.path.exists(bad_dir)
+        assert mgr.get("task", "peer") is not None
+
+
+class TestResumeAdoption:
+    def test_register_or_resume_adopts_best_partial(self, tmp_path):
+        blob = _blob(10)
+        root = str(tmp_path)
+        _write_store(root, "task", "small", blob, 2)
+        _write_store(root, "task", "big", blob, 7)
+        mgr = StorageManager(StorageOptions(root=root))
+        store, resumed = mgr.register_or_resume("task", "fresh-peer")
+        assert [p.num for p in resumed] == list(range(7))
+        assert store.meta.peer_id == "fresh-peer"
+        assert mgr.get("task", "fresh-peer") is store
+        # Adoption is exactly-once: the next registration gets a fresh
+        # store (the small partial is NOT handed to a second conductor
+        # once... it is still recovered and unclaimed, so it IS next).
+        store2, resumed2 = mgr.register_or_resume("task", "other-peer")
+        assert [p.num for p in resumed2] == [0, 1]
+        store3, resumed3 = mgr.register_or_resume("task", "third-peer")
+        assert resumed3 == [] and store3 not in (store, store2)
+
+    def test_failed_rename_then_crash_still_adoptable(
+            self, tmp_path, monkeypatch):
+        """Adoption rename fails (journal re-keyed under the OLD dir
+        name), daemon crashes, reload recovers: the second adoption
+        must work — the map is keyed by the JOURNALED peer id, and
+        removal uses the same key."""
+        blob = _blob(5)
+        root = str(tmp_path)
+        _write_store(root, "task", "original", blob, 5)
+        mgr = StorageManager(StorageOptions(root=root))
+        def failing_rename(*a, **k):
+            raise OSError("injected rename failure")
+
+        monkeypatch.setattr(
+            "dragonfly2_tpu.client.storage.os.rename", failing_rename)
+        store, resumed = mgr.register_or_resume("task", "adopter-1")
+        monkeypatch.undo()
+        assert len(resumed) == 5
+        assert store.meta.peer_id == "adopter-1"
+        assert os.path.basename(store.directory) == "original"  # kept
+        # "Crash": a fresh manager reloads the diverged layout.
+        mgr2 = StorageManager(StorageOptions(root=root))
+        assert mgr2.get("task", "adopter-1") is not None  # journal key
+        store2, resumed2 = mgr2.register_or_resume("task", "adopter-2")
+        assert len(resumed2) == 5
+        assert store2.meta.peer_id == "adopter-2"
+
+    def test_live_writer_store_never_adopted(self, tmp_path):
+        mgr = StorageManager(StorageOptions(root=str(tmp_path)))
+        import io
+
+        blob = _blob(3)
+        live = mgr.register_task("task", "writer")
+        req, data = _piece_req("task", "writer", blob, 0)
+        live.write_piece(req, io.BytesIO(data))
+        _, resumed = mgr.register_or_resume("task", "newcomer")
+        assert resumed == []  # in-process stores are never recovered
+
+
+class TestEndToEndResume:
+    @pytest.fixture()
+    def swarm(self, tmp_path, monkeypatch):
+        from dragonfly2_tpu.client import peer_task as peer_task_mod
+        from dragonfly2_tpu.client.chaosbench import MultiBlobServer
+        from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+        from dragonfly2_tpu.scheduler.resource.resource import Resource
+        from dragonfly2_tpu.scheduler.scheduling.core import (
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        monkeypatch.setattr(peer_task_mod, "compute_piece_size",
+                            lambda content_length: PIECE)
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(
+                BaseEvaluator(),
+                SchedulingConfig(retry_interval=0.01,
+                                 retry_back_to_source_limit=2)),
+        )
+        blob = _blob(10, seed=7)
+        server = MultiBlobServer({"/resume/blob": blob})
+        server.start()
+        yield service, server, blob
+        server.stop()
+
+    def test_restart_resumes_partial_and_reports_replay(
+            self, swarm, tmp_path):
+        """A journal left by a 'crashed' daemon (store crafted exactly
+        as the incremental persist leaves it) is verified, adopted,
+        and only the missing tail is fetched; replayed pieces reach
+        the scheduler through the idempotent upsert path."""
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.utils import idgen
+
+        service, server, blob = swarm
+        url = server.url("/resume/blob")
+        task_id = idgen.task_id_v1(url)
+        root = str(tmp_path / "daemon")
+        _write_store(root, task_id, "crashed-peer", blob, 6, url=url)
+        rec = RecoveryStats()
+        fresh = {"pieces": 0, "bytes": 0}
+        daemon = Daemon(service, DaemonConfig(
+            storage_root=root, hostname="resume-d", recovery_stats=rec))
+        daemon.start()
+        try:
+            result = daemon.download_file(
+                url, piece_sink=lambda s, p: (
+                    fresh.__setitem__("pieces", fresh["pieces"] + 1),
+                    fresh.__setitem__("bytes", fresh["bytes"] + p.length)))
+        finally:
+            daemon.stop()
+        assert result.success, result.error
+        assert hashlib.md5(result.read_all()).hexdigest() \
+            == hashlib.md5(blob).hexdigest()
+        assert result.resumed_pieces == 6
+        assert result.resumed_bytes == 6 * PIECE
+        assert fresh["pieces"] == 4  # ONLY the missing tail was fetched
+        assert rec.get("tasks_resumed") == 1
+        assert rec.get("resume_pieces_reused") == 6
+        # Replay landed scheduler-side: the peer's finished set covers
+        # the resumed pieces too, not just the 4 fresh ones.
+        peer = service.resource.peer_manager.load(result.peer_id)
+        assert peer is not None
+        assert len(peer.finished_pieces) == 10
+
+    def test_crash_after_last_piece_before_done_resumes_complete(
+            self, swarm, tmp_path):
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.utils import idgen
+
+        service, server, blob = swarm
+        url = server.url("/resume/blob")
+        task_id = idgen.task_id_v1(url)
+        root = str(tmp_path / "daemon")
+        # Every piece journaled, done never published.
+        _write_store(root, task_id, "crashed-peer", blob, 10, url=url)
+        daemon = Daemon(service, DaemonConfig(
+            storage_root=root, hostname="resume-e"))
+        daemon.start()
+        try:
+            fresh = {"pieces": 0}
+            result = daemon.download_file(
+                url, piece_sink=lambda s, p: fresh.__setitem__(
+                    "pieces", fresh["pieces"] + 1))
+        finally:
+            daemon.stop()
+        assert result.success, result.error
+        assert fresh["pieces"] == 0  # nothing re-downloaded
+        assert result.resumed_pieces == 10
+        assert hashlib.md5(result.read_all()).hexdigest() \
+            == hashlib.md5(blob).hexdigest()
+
+    def test_restarted_seed_reannounces_and_serves_child(
+            self, swarm, tmp_path):
+        """A daemon restarted over a DONE replica re-announces it and
+        a child with back-to-source disabled downloads entirely off
+        the restarted seed."""
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.utils import idgen
+
+        service, server, blob = swarm
+        url = server.url("/resume/blob")
+        task_id = idgen.task_id_v1(url)
+        seed_root = str(tmp_path / "seed")
+        _write_store(seed_root, task_id, "seed-peer", blob, 10,
+                     done=True, url=url)
+        rec = RecoveryStats()
+        seed = Daemon(service, DaemonConfig(
+            storage_root=seed_root, hostname="reseed-seed",
+            recovery_stats=rec))
+        child = Daemon(service, DaemonConfig(
+            storage_root=str(tmp_path / "child"), hostname="reseed-child",
+            keep_storage=False))
+        seed.start()
+        child.start()
+        try:
+            assert rec.get("seed_tasks_reannounced") == 1
+            served = {"pieces": 0}
+            result = child.download_file(
+                url, disable_back_source=True,
+                piece_sink=lambda s, p: served.__setitem__(
+                    "pieces", served["pieces"] + 1))
+        finally:
+            child.stop()
+            seed.stop()
+        assert result.success, result.error
+        assert hashlib.md5(result.read_all()).hexdigest() \
+            == hashlib.md5(blob).hexdigest()
+        assert served["pieces"] == 10  # every byte came off the seed
+
+    def test_deferred_reannounce_retried_by_announce_ticker(
+            self, swarm, tmp_path):
+        """Schedulers unreachable during the start() drain: the done
+        replica must NOT stay dark — the announce ticker retries the
+        backlog until it lands."""
+        import time
+
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.scheduler.service import ServiceError
+        from dragonfly2_tpu.utils import idgen
+
+        service, server, blob = swarm
+        url = server.url("/resume/blob")
+        task_id = idgen.task_id_v1(url)
+        root = str(tmp_path / "flaky-seed")
+        _write_store(root, task_id, "seed-peer", blob, 10,
+                     done=True, url=url)
+
+        class FlakyAnnounceTask:
+            """Scheduler facade: announce_task is down for the first
+            two calls, then heals; everything else passes through."""
+
+            def __init__(self, inner):
+                self._inner = inner
+                self.calls = 0
+
+            def announce_task(self, req):
+                self.calls += 1
+                if self.calls <= 2:
+                    raise ServiceError("Unavailable", "injected outage")
+                return self._inner.announce_task(req)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        flaky = FlakyAnnounceTask(service)
+        rec = RecoveryStats()
+        daemon = Daemon(flaky, DaemonConfig(
+            storage_root=root, hostname="flaky-seed",
+            recovery_stats=rec, announce_interval=0.1))
+        daemon.start()
+        try:
+            assert rec.get("seed_tasks_reannounced") == 0  # deferred
+            deadline = time.monotonic() + 10.0
+            while (rec.get("seed_tasks_reannounced") < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+        finally:
+            daemon.stop()
+        assert rec.get("seed_tasks_reannounced") == 1
+        assert flaky.calls >= 3  # failed twice, landed on a retry
+
+    def test_shapeless_or_partial_stores_not_reannounced(
+            self, swarm, tmp_path):
+        from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+        from dragonfly2_tpu.utils import idgen
+
+        service, server, blob = swarm
+        url = server.url("/resume/blob")
+        task_id = idgen.task_id_v1(url)
+        root = str(tmp_path / "partial-seed")
+        _write_store(root, task_id, "p", blob, 4, url=url)  # not done
+        rec = RecoveryStats()
+        daemon = Daemon(service, DaemonConfig(
+            storage_root=root, hostname="partial-seed",
+            recovery_stats=rec))
+        daemon.start()
+        daemon.stop()
+        assert rec.get("seed_tasks_reannounced") == 0
+
+
+class TestAnnounceTaskService:
+    def _service_with_host(self):
+        from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+        from dragonfly2_tpu.scheduler.resource.host import Host
+        from dragonfly2_tpu.scheduler.resource.resource import Resource
+        from dragonfly2_tpu.scheduler.scheduling.core import (
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(BaseEvaluator(), SchedulingConfig()))
+        host = Host(id="h1", hostname="h1", ip="127.0.0.1", port=1,
+                    download_port=1)
+        service.announce_host(host)
+        return service, host
+
+    def test_announce_task_installs_succeeded_peer(self):
+        from dragonfly2_tpu.scheduler.resource.peer import PeerState
+        from dragonfly2_tpu.scheduler.resource.task import TaskState
+        from dragonfly2_tpu.scheduler.service import AnnounceTaskRequest
+
+        service, _ = self._service_with_host()
+        req = AnnounceTaskRequest(
+            host_id="h1", task_id="t1", peer_id="p1",
+            url="http://o/x", content_length=10 * PIECE,
+            total_piece_count=10)
+        service.announce_task(req)
+        task = service.resource.task_manager.load("t1")
+        assert task.fsm.is_state(TaskState.SUCCEEDED)
+        assert task.total_piece_count == 10
+        peer = service.resource.peer_manager.load("p1")
+        assert peer.fsm.is_state(PeerState.SUCCEEDED)
+        assert peer.finished_piece_count() == 10
+        assert task.has_available_peer()
+        # Idempotent: same host, same peer — an upsert, not an error.
+        service.announce_task(req)
+        assert service.resource.peer_manager.load("p1") is peer
+
+    def test_announce_task_replaces_stale_host_binding(self):
+        """The daemon restarted on a new port → new host id: the stale
+        peer record (pointing children at the dead listener) must be
+        REPLACED, not refreshed."""
+        from dragonfly2_tpu.scheduler.resource.host import Host
+        from dragonfly2_tpu.scheduler.service import AnnounceTaskRequest
+
+        service, _ = self._service_with_host()
+        req = AnnounceTaskRequest(
+            host_id="h1", task_id="t1", peer_id="p1",
+            url="http://o/x", content_length=4 * PIECE,
+            total_piece_count=4)
+        service.announce_task(req)
+        old_peer = service.resource.peer_manager.load("p1")
+        service.announce_host(Host(id="h2", hostname="h2",
+                                   ip="127.0.0.1", port=2,
+                                   download_port=2))
+        service.announce_task(AnnounceTaskRequest(
+            host_id="h2", task_id="t1", peer_id="p1",
+            url="http://o/x", content_length=4 * PIECE,
+            total_piece_count=4))
+        new_peer = service.resource.peer_manager.load("p1")
+        assert new_peer is not old_peer
+        assert new_peer.host.id == "h2"
+
+    def test_announce_task_requires_host_and_shape(self):
+        import pytest as _pytest
+
+        from dragonfly2_tpu.scheduler.service import (
+            AnnounceTaskRequest,
+            ServiceError,
+        )
+
+        service, _ = self._service_with_host()
+        with _pytest.raises(ServiceError):
+            service.announce_task(AnnounceTaskRequest(
+                host_id="ghost", task_id="t", peer_id="p",
+                content_length=10, total_piece_count=1))
+        with _pytest.raises(ServiceError):
+            service.announce_task(AnnounceTaskRequest(
+                host_id="h1", task_id="t", peer_id="p",
+                content_length=-1, total_piece_count=0))
+
+
+class TestShutdownHandlers:
+    def test_sigterm_routes_to_graceful_event(self):
+        from dragonfly2_tpu.cmd.common import install_shutdown_handlers
+
+        previous_term = signal.getsignal(signal.SIGTERM)
+        previous_int = signal.getsignal(signal.SIGINT)
+        try:
+            stop = install_shutdown_handlers()
+            assert not stop.is_set()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert stop.wait(timeout=5.0)
+        finally:
+            signal.signal(signal.SIGTERM, previous_term)
+            signal.signal(signal.SIGINT, previous_int)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestDaemonKillRung:
+    def test_kill_minus_nine_mid_write_resumes_byte_exact(self, tmp_path):
+        """The ISSUE-8 rung end to end with REAL processes: a daemon
+        SIGKILLed at ~50% of a download and restarted on the same
+        storage root finishes byte-exact, re-downloads at most the
+        missing bytes + one piece per worker, and re-announces its
+        completed replica (a back-source-disabled child serves off
+        it)."""
+        from dragonfly2_tpu.client.chaosbench import run_daemon_kill_rung
+
+        out = run_daemon_kill_rung(seed=0, root=str(tmp_path))
+        assert out["verdict_pass"], json.dumps(out, indent=1)
+        assert out["killed"] is not None
+        assert 0.3 <= out["killed"]["fraction"] <= 0.9
+        resume = out["resume"]
+        assert resume["ok"] and resume["resumed_pieces"] > 0
+        assert resume["bytes_fresh"] <= out["refetch_bound_bytes"]
+        assert out["recovery_counters"]["seed_tasks_reannounced"] >= 1
+        assert out["reseed"]["child_ok"]
+        assert out["reseed"]["served_pieces"] >= 1
+        assert out["success_rate"] == 1.0
