@@ -169,6 +169,19 @@ class BenchState:
 STAGES: list = []
 
 
+def _persist_json(dest: str, payload: dict) -> None:
+    """Atomic best-effort stage-record write (tmp + rename) — the one
+    copy of the idiom the green-run persists share."""
+    tmp = dest + ".tmp"
+    try:
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, dest)
+    except OSError:
+        pass
+
+
 class _Stage:
     __slots__ = ("name", "min_left", "required", "needs_device", "fn")
 
@@ -539,21 +552,13 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
     state.record(dataplane_verdict_pass=verdict)
     state.stage_done("dataplane")
     if verdict:
-        dest = os.path.join(
-            STATE_DIR,
-            f"dataplane_run_{time.strftime('%Y%m%d_%H%M%S')}.json")
-        tmp_path_ = dest + ".tmp"
-        try:
-            os.makedirs(STATE_DIR, exist_ok=True)
-            with open(tmp_path_, "w") as f:
-                json.dump({
-                    "ladder": {str(k): v for k, v in ladder.items()},
-                    "upload_loopback": upload,
-                    "density": density,
-                }, f)
-            os.replace(tmp_path_, dest)
-        except OSError:
-            pass
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"dataplane_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"ladder": {str(k): v for k, v in ladder.items()},
+             "upload_loopback": upload,
+             "density": density})
 
 
 @stage("scheduler", min_left=15.0)
@@ -695,22 +700,72 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
     state.record(chaos_verdict_pass=verdict)
     state.stage_done("chaos")
     if verdict:
-        dest = os.path.join(
-            STATE_DIR,
-            f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json")
-        tmp_path_ = dest + ".tmp"
-        try:
-            os.makedirs(STATE_DIR, exist_ok=True)
-            with open(tmp_path_, "w") as f:
-                json.dump({"ladder": chaos,
-                           "scheduler_kill": (kill if kill is not None
-                                              else {"skipped": True}),
-                           "daemon_kill": (daemon_kill
-                                           if daemon_kill is not None
-                                           else {"skipped": True})}, f)
-            os.replace(tmp_path_, dest)
-        except OSError:
-            pass
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"ladder": chaos,
+             "scheduler_kill": (kill if kill is not None
+                                else {"skipped": True}),
+             "daemon_kill": (daemon_kill if daemon_kill is not None
+                             else {"skipped": True})})
+
+
+@stage("fanout", min_left=90.0)
+def stage_fanout(state: BenchState, ctx: dict) -> None:
+    """Fleet-scale checkpoint fan-out — the ISSUE-9 dissemination
+    ladder (client/fanoutbench.py): one throttled origin, a ≥256 MiB
+    sharded checkpoint, cold fleet rungs of 4/16/32 in-process daemons
+    plus a preheated variant at the largest rung. Reports
+    time-to-last-byte per rung, origin-egress amplification, P2P share
+    and per-daemon MB/s. Documented bounds (docs/FANOUT.md): cold
+    amplification ≤ 2.0 at the 32-rung AND TTLB(32) ≤ 3× TTLB(4);
+    preheated origin bytes ≈ 0. A green run persists to
+    artifacts/bench_state/fanout_run_*.json — the record
+    `bench.py fanout --check-regression` gates against. Budget-starved
+    rungs record an explicit skip and withhold the verdict (never a
+    silent pass)."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.client.fanoutbench import run_fanout_ladder
+
+    ladder = run_fanout_ladder(seed=0, time_left=left)
+    rungs = ladder["ladder"]
+    largest = str(max(ladder["rungs"]))
+    top = rungs.get(largest, {})
+    state.record(
+        fanout_rungs=ladder["rungs"],
+        fanout_checkpoint_mb=ladder["checkpoint_bytes"] >> 20,
+        fanout_origin_rate_mb_per_s=ladder["origin_rate_mb_per_s"],
+        fanout_skipped_rungs=ladder["skipped_rungs"],
+        fanout_ttlb_ratio=ladder.get("ttlb_ratio"),
+        fanout_ttlb_ratio_bound=ladder["ttlb_ratio_bound"],
+        fanout_cold_amplification=ladder.get("cold_amplification_at_max"),
+        fanout_amplification_bound=ladder["amplification_bound"],
+        fanout_cold_ttlb_s=top.get("ttlb_s"),
+        fanout_cold_p2p_share=top.get("p2p_share"),
+        fanout_per_daemon_mb_per_s_p50=top.get("per_daemon_mb_per_s_p50"),
+        fanout_preheat_origin_fraction=ladder.get(
+            "preheat_origin_fraction"),
+        fanout_preheat_ttlb_s=(ladder.get("preheated") or {}).get(
+            "ttlb_s"),
+        fanout_ladder={
+            n: {k: v.get(k) for k in (
+                "ttlb_s", "origin_amplification", "p2p_share",
+                "per_daemon_mb_per_s_p50", "per_daemon_mb_per_s_min",
+                "success_rate", "origin_requests", "downloads",
+                "failures")}
+            for n, v in rungs.items()},
+    )
+    if "verdict_pass" in ladder:
+        state.record(fanout_verdict_pass=ladder["verdict_pass"])
+    state.stage_done("fanout")
+    if ladder.get("verdict_pass"):
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"fanout_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            ladder)
 
 
 def run_stages(state: BenchState, platform: str, budget: float,
@@ -1054,7 +1109,10 @@ def check_regression_main(stage_name: str) -> None:
       MB/s (docs/DATAPLANE.md fraction).
     - ``chaos``: fresh fault ladder + daemon-kill rung vs the best
       recorded chaos run (docs/CHAOS.md) — any lost verdict or a
-      goodput-retention collapse fails the gate."""
+      goodput-retention collapse fails the gate.
+    - ``fanout``: fresh dissemination ladder vs the best recorded
+      fanout run (docs/FANOUT.md) — a lost verdict or a 2× TTLB /
+      amplification collapse fails the gate."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.uploadbench import check_regression
 
@@ -1063,10 +1121,14 @@ def check_regression_main(stage_name: str) -> None:
         from dragonfly2_tpu.client.chaosbench import check_chaos_regression
 
         result = check_chaos_regression(STATE_DIR)
+    elif stage_name == "fanout":
+        from dragonfly2_tpu.client.fanoutbench import check_fanout_regression
+
+        result = check_fanout_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
-            "(have: dataplane, chaos)")
+            "(have: dataplane, chaos, fanout)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

@@ -556,7 +556,11 @@ class DaemonProc:
     def __init__(self, storage_root: str, scheduler_targets, *,
                  hostname: str, piece_size: int = 0,
                  download_rate: float = 0.0, persist_every: int = 2,
-                 startup_timeout: float = 30.0):
+                 startup_timeout: float = 30.0, native: bool = False,
+                 timeout: float = 0.0, poll_interval: float = 0.0,
+                 piece_concurrency: int = 0, serve_rpc: bool = False,
+                 host_type: str = "", fallback_wait: float = 0.0,
+                 scheduler_grace: float = 0.0):
         import os
         import queue as queue_mod
         import subprocess
@@ -574,11 +578,31 @@ class DaemonProc:
             cmd += ["--piece-size", str(piece_size)]
         if download_rate > 0:
             cmd += ["--download-rate", str(download_rate)]
+        if native:
+            cmd += ["--native"]
+        if timeout > 0:
+            cmd += ["--timeout", str(timeout)]
+        if poll_interval > 0:
+            cmd += ["--poll-interval", str(poll_interval)]
+        if piece_concurrency > 0:
+            cmd += ["--piece-concurrency", str(piece_concurrency)]
+        if serve_rpc:
+            cmd += ["--serve-rpc"]
+        if host_type:
+            cmd += ["--type", host_type]
+        if fallback_wait > 0:
+            cmd += ["--fallback-wait", str(fallback_wait)]
+        if scheduler_grace > 0:
+            cmd += ["--scheduler-grace", str(scheduler_grace)]
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, env=env)
         self._progress_lock = threading.Lock()
         self.progress: Dict[str, int] = {}  # url → cumulative fresh bytes
+        # url → perf_counter stamp of the LAST progress event — the
+        # fan-out rungs read time-to-last-byte from these instead of
+        # RESULT arrival (which also pays the md5 verification pass).
+        self.progress_at: Dict[str, float] = {}
         self.results: "queue_mod.Queue" = queue_mod.Queue()
         self.stats_q: "queue_mod.Queue" = queue_mod.Queue()
         self._ready: "queue_mod.Queue" = queue_mod.Queue()
@@ -594,7 +618,7 @@ class DaemonProc:
         if not isinstance(first, tuple):
             self.kill()
             raise RuntimeError(f"daemon proc failed to start: {first!r}")
-        self.host_id, self.address = first
+        self.host_id, self.address, self.rpc_target = first
 
     def _read_loop(self) -> None:
         import json as json_mod
@@ -605,14 +629,16 @@ class DaemonProc:
             kind, _, rest = line.partition(" ")
             if kind == "DAEMON" and not announced:
                 announced = True
-                parts = rest.split(" ", 1)
-                self._ready.put((parts[0], parts[1] if len(parts) > 1
-                                 else ""))
+                parts = rest.split(" ")
+                self._ready.put((parts[0],
+                                 parts[1] if len(parts) > 1 else "",
+                                 parts[2] if len(parts) > 2 else ""))
             elif kind == "PROGRESS":
                 url, _, total = rest.rpartition(" ")
                 try:
                     with self._progress_lock:
                         self.progress[url] = int(total)
+                        self.progress_at[url] = time.perf_counter()
                 except ValueError:
                     pass
             elif kind == "RESULT":

@@ -349,6 +349,7 @@ class Daemon:
                 url_range=rng,
                 priority=priority,
                 recovery_stats=self.config.recovery_stats,
+                dataplane_stats=self.config.dataplane_stats,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
@@ -510,6 +511,7 @@ class SeedPeerDaemonClient:
                 url_range=(parse_url_range(seed_range)
                            if seed_range else None),
                 recovery_stats=daemon.config.recovery_stats,
+                dataplane_stats=daemon.config.dataplane_stats,
             )
             # Seeds go straight to source (StartSeedTask → back-source);
             # register first so the peer exists in the scheduler's DAG.
@@ -524,6 +526,7 @@ class SeedPeerDaemonClient:
                 ),
                 channel=conductor.channel,
             )
+            conductor._registered = True  # claims eligible (seed warm-up)
             # Adopt a crash-recovered partial store when one exists —
             # a restarted seed resumes its warm-up from the journal
             # instead of re-pulling the whole origin.
@@ -540,9 +543,39 @@ class SeedPeerDaemonClient:
             if not result.success:
                 logger.warning("seed trigger for %s failed: %s",
                                task.id, result.error)
+            elif result.storage is not None:
+                # Preheat pipeline last leg: the warmed replica is
+                # announced task-affinely (PR-8 announce_task path) so
+                # EVERY scheduler replica on the task's ring — not just
+                # the one that triggered us — offers this seed as a
+                # parent, and a preheated fleet never touches origin.
+                self._announce_completed(task.id, peer_id, result)
             run.outcome = result.success
             return result.success
         finally:
             with self._inflight_lock:
                 self._inflight.pop(task.id, None)
             run.event.set()
+
+    def _announce_completed(self, task_id: str, peer_id: str,
+                            result: PeerTaskResult) -> None:
+        announce = getattr(self.daemon.scheduler, "announce_task", None)
+        if announce is None:
+            return  # pre-announce_task scheduler — trigger-side view only
+        meta = result.storage.meta
+        if meta.content_length < 0 or meta.total_pieces <= 0:
+            return
+        from dragonfly2_tpu.scheduler.service import AnnounceTaskRequest
+
+        try:
+            announce(AnnounceTaskRequest(
+                host_id=self.daemon.host_id, task_id=task_id,
+                peer_id=peer_id, url=meta.url,
+                content_length=meta.content_length,
+                total_piece_count=meta.total_pieces,
+                piece_md5_sign=meta.piece_md5_sign,
+            ))
+        except Exception as exc:  # noqa: BLE001 — best effort: the
+            # triggering scheduler already has the live peer record.
+            logger.warning("post-trigger announce of %s failed: %s",
+                           task_id[:16], exc)

@@ -52,6 +52,33 @@ def main(argv=None) -> int:
                         help="journal cadence in pieces (rung default is "
                              "tight so the kill loses little progress)")
     parser.add_argument("--type", default="normal")
+    # Fan-out fleet knobs (client/fanoutbench.py): the dissemination
+    # rungs run MANY of these processes, so the chaos-rung defaults
+    # (pure-Python plane, fast journal cadence) are overridable.
+    parser.add_argument("--native", action="store_true",
+                        help="use the C++ piece data plane")
+    parser.add_argument("--timeout", type=float, default=60.0,
+                        help="per-task conductor deadline (seconds)")
+    parser.add_argument("--poll-interval", type=float, default=0.2,
+                        help="parent metadata sync interval (seconds)")
+    parser.add_argument("--piece-concurrency", type=int, default=0,
+                        help="piece/back-source fetcher threads per task "
+                             "(0 = PeerTaskOptions defaults)")
+    parser.add_argument("--fallback-wait", type=float, default=0.0,
+                        help="hybrid back-to-source mesh-stall window "
+                             "before claiming leased pieces locally "
+                             "(0 = PeerTaskOptions default; fan-out rungs "
+                             "raise it — a throttled origin makes slow "
+                             "mesh progress NORMAL, and premature "
+                             "fallbacks double origin egress)")
+    parser.add_argument("--scheduler-grace", type=float, default=5.0,
+                        help="scheduler-silence window before degrading "
+                             "to back-to-source")
+    parser.add_argument("--serve-rpc", action="store_true",
+                        help="also serve the daemon gRPC surface "
+                             "(ObtainSeeds for preheat triggers); the "
+                             "DAEMON line gains a third field with the "
+                             "rpc target")
     args = parser.parse_args(argv)
 
     if args.piece_size > 0:
@@ -67,6 +94,20 @@ def main(argv=None) -> int:
     from dragonfly2_tpu.utils.hosttypes import HostType
     from dragonfly2_tpu.utils.ratelimit import INF
 
+    options = PeerTaskOptions(
+        # The kill rung injects through the Python transports and
+        # wants deterministic piece accounting; the fan-out rungs flip
+        # --native for throughput.
+        native_data_plane=args.native,
+        timeout=args.timeout,
+        scheduler_grace=args.scheduler_grace,
+        metadata_poll_interval=args.poll_interval,
+    )
+    if args.piece_concurrency > 0:
+        options.piece_concurrency = args.piece_concurrency
+        options.back_source_concurrency = args.piece_concurrency
+    if args.fallback_wait > 0:
+        options.source_fallback_wait = args.fallback_wait
     scheduler = BalancedSchedulerClient(list(args.scheduler))
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=args.storage_root,
@@ -75,15 +116,14 @@ def main(argv=None) -> int:
         keep_storage=True,
         total_download_rate_bps=args.download_rate or INF,
         persist_every_pieces=args.persist_every,
-        task_options=PeerTaskOptions(
-            # The kill rung injects through the Python transports and
-            # wants deterministic piece accounting.
-            native_data_plane=False,
-            timeout=60.0,
-            scheduler_grace=5.0,
-        ),
+        task_options=options,
     ))
     daemon.start()
+    rpc = None
+    if args.serve_rpc:
+        from dragonfly2_tpu.client.rpcserver import serve_daemon_rpc
+
+        rpc = serve_daemon_rpc(daemon)
 
     out_lock = threading.Lock()
 
@@ -91,7 +131,8 @@ def main(argv=None) -> int:
         with out_lock:
             print(line, flush=True)
 
-    emit(f"DAEMON {daemon.host_id} {daemon.upload.address}")
+    suffix = f" {rpc.target}" if rpc is not None else ""
+    emit(f"DAEMON {daemon.host_id} {daemon.upload.address}{suffix}")
 
     def run_download(url: str) -> None:
         fresh = {"bytes": 0, "pieces": 0}
@@ -135,9 +176,18 @@ def main(argv=None) -> int:
             threading.Thread(target=run_download, args=(rest,),
                              name="proc-download", daemon=True).start()
         elif cmd == "STATS":
-            emit(f"STATS {json.dumps(RECOVERY.snapshot())}")
+            from dragonfly2_tpu.client.dataplane import STATS as DP_STATS
+
+            snap = dict(RECOVERY.snapshot())
+            # Nested so the flat recovery keys the kill rung reads stay
+            # exactly as they were; the fan-out rungs sum these across
+            # the fleet for the P2P-share metric.
+            snap["data_plane"] = DP_STATS.snapshot()
+            emit(f"STATS {json.dumps(snap)}")
         elif cmd == "EXIT":
             break
+    if rpc is not None:
+        rpc.stop()
     daemon.stop()
     return 0
 

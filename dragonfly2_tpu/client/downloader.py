@@ -36,11 +36,17 @@ MIN_SCORE_NS = 60 * 1_000_000_000    # failure penalty pole
 class DownloadPieceError(Exception):
     """A piece fetch failed. ``fatal`` marks failures no other parent
     can fix (disk full): the conductor fails the task instead of
-    burning the retry budget."""
+    burning the retry budget. ``not_ready`` marks a parent that does
+    not hold the piece YET (a partial peer still downloading, HTTP
+    404): the conductor parks the piece for the next metadata sync
+    instead of ticking the corruption/blacklist counters or burning
+    the per-piece retry budget."""
 
-    def __init__(self, message: str, fatal: bool = False):
+    def __init__(self, message: str, fatal: bool = False,
+                 not_ready: bool = False):
         super().__init__(message)
         self.fatal = fatal
+        self.not_ready = not_ready
 
 
 class DispatcherClosedError(Exception):
@@ -69,7 +75,17 @@ class DownloadPieceResult:
 class PieceDispatcher:
     """Parent-scored piece request queue (piece_dispatcher.go:47-172)."""
 
-    def __init__(self, random_ratio: float = 0.1, seed: int | None = None):
+    def __init__(self, random_ratio: float = 0.1, seed: int | None = None,
+                 rarity_fn: Callable[[int], int] | None = None):
+        # Rarest-first piece selection: when set, pieces within the
+        # chosen parent's queue are served in ascending availability
+        # order (how many known parents advertise the piece — the
+        # conductor feeds this from its metadata syncs) with a seeded
+        # random tie-break, so concurrent children of one partial seed
+        # pull DISJOINT pieces and immediately cross-serve instead of
+        # all racing for the head of the file. None keeps the original
+        # uniform-random order.
+        self.rarity_fn = rarity_fn
         self._requests: Dict[str, List[DownloadPieceRequest]] = {}
         self._score: Dict[str, int] = {}
         self._downloaded: Set[int] = set()
@@ -133,7 +149,12 @@ class PieceDispatcher:
             if not queue:
                 continue
             order = list(range(len(queue)))
-            self._rand.shuffle(order)
+            if self.rarity_fn is None:
+                self._rand.shuffle(order)
+            else:
+                rarity = self.rarity_fn
+                order.sort(key=lambda i: (rarity(queue[i].piece.num),
+                                          self._rand.random()))
             for i in order:
                 req = queue[i]
                 if peer in self._avoid.get(req.piece.num, ()):
@@ -296,7 +317,11 @@ class PieceDownloader:
             conn.close()  # unknown body framing — don't try to realign
             raise DownloadPieceError(
                 f"{req.dst_addr} piece {piece.num}: status {resp.status}, "
-                f"body {resp.length}/{piece.length}"
+                f"body {resp.length}/{piece.length}",
+                # 404 = the parent doesn't hold the piece (yet): a
+                # partial peer mid-download (X-Df2-Not-Ready) or a store
+                # that raced away — park and re-offer, don't blacklist.
+                not_ready=resp.status == 404,
             )
 
     # -- fetch -------------------------------------------------------------
@@ -518,7 +543,8 @@ class NativePieceFetcher:
                     sock.close()
                 raise DownloadPieceError(
                     f"{req.dst_addr} piece {piece.num}: status "
-                    f"{res.status}, body {res.body_len}/{piece.length}"
+                    f"{res.status}, body {res.body_len}/{piece.length}",
+                    not_ready=res.status == 404,
                 )
             if res.keep_alive:
                 self._checkin(req.dst_addr, sock)

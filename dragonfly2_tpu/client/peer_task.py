@@ -25,7 +25,6 @@ import queue
 import random
 import threading
 import time
-import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Protocol, Sequence
 
@@ -222,6 +221,47 @@ class PeerTaskOptions:
     # (client/piece_reporter.py).
     report_retry_limit: int = 2
     report_pending_cap: int = 1024
+    # -- fan-out dissemination (ISSUE 9) ----------------------------------
+    # Hybrid back-to-source: when the scheduler exposes
+    # claim_source_run, origin fetches claim DISJOINT runs through the
+    # swarm-wide lease ledger and the mesh (partial parents from the
+    # claim replies) fills everything this peer was NOT granted —
+    # origin egress for an N-daemon cold fan-out stays ≈1× the file.
+    # False pins the pre-ISSUE-9 behavior (every b2s peer pulls the
+    # whole file itself).
+    source_claims: bool = True
+    # Poll pacing while the claim verdict is "wait" (other claimants
+    # hold the remaining leases; the mesh is delivering).
+    claim_wait_interval: float = 0.25
+    # No piece landed for this long while waiting on the mesh → claim
+    # missing pieces LOCALLY from the origin regardless of leases
+    # (liveness when the mesh stalls; duplicate origin bytes are the
+    # bench's amplification metric, not a correctness issue).
+    source_fallback_wait: float = 8.0
+    # A parent answering 404 on its metadata endpoint within this grace
+    # of sync start is "not ready yet" (offered at registration, store
+    # not created) — polls don't count toward metadata_retry_limit.
+    metadata_not_ready_grace: float = 10.0
+    # Idle-adaptive sync polling: a poll that surfaces NO new pieces
+    # doubles the next wait up to this cap; any new piece snaps back to
+    # metadata_poll_interval. Keeps dissemination latency tight while a
+    # parent is producing without a fleet-wide poll storm against the
+    # parents that aren't. 0 pins the fixed interval.
+    metadata_idle_poll_cap: float = 0.3
+    # A (parent, piece) pair that answers 404 not-ready this many times
+    # falls through to the normal failure path (a parent that
+    # advertises a piece but never serves it must not park forever).
+    piece_not_ready_limit: int = 64
+    # Mid-download parent refresh: every interval without a decision,
+    # ask the scheduler to re-evaluate candidates (a cold fan-out burst
+    # wires children to whatever peers existed at registration — all
+    # empty; refreshing re-ranks onto the by-then piece-RICH peers and
+    # flattens the dissemination chains). 0 disables.
+    reschedule_interval: float = 1.0
+    # Live metadata syncers per task: each costs one keep-alive poll
+    # loop against a parent — the cap bounds the fleet-wide poll load
+    # while refreshes rotate onto better parents as syncers retire.
+    max_syncers: int = 5
 
 
 @dataclass
@@ -317,7 +357,16 @@ class PeerTaskConductor:
         # publishes the "recovery" debug block from startup.
         self.recovery = recovery_stats if recovery_stats is not None else RECOVERY
         self.channel = QueueChannel()
-        self.dispatcher = PieceDispatcher(random_ratio=self.opts.random_ratio)
+        # Swarm-visibility for rarest-first dispatch: per-parent piece
+        # inventories from metadata syncs and the derived availability
+        # count per piece (how many known parents hold it). Written
+        # under _written_lock; read lock-free by the dispatcher's
+        # rarity function (a stale count only reorders a pick).
+        self._parent_pieces: Dict[str, set] = {}
+        self._avail: Dict[int, int] = {}
+        self.dispatcher = PieceDispatcher(
+            random_ratio=self.opts.random_ratio,
+            rarity_fn=self._piece_availability)
         self.downloader = PieceDownloader(stats=self.stats)
         self.native_fetcher = (
             NativePieceFetcher(stats=self.stats)
@@ -333,6 +382,14 @@ class PeerTaskConductor:
             pending_cap=self.opts.report_pending_cap,
             on_delivery=self._note_scheduler,
             recovery=self.recovery)
+        # Keep-alive pool for parent metadata polls (one conn per
+        # parent): syncers poll at metadata_poll_interval, and a
+        # connection per poll would make the fleet's metadata plane a
+        # TCP-handshake storm.
+        from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
+
+        self._meta_pool = HTTPConnectionPool(
+            per_host=1, timeout=self.opts.metadata_timeout)
         self.store: Optional[TaskStorage] = None
         self.content_length = -1
         self.total_pieces = -1
@@ -362,12 +419,21 @@ class PeerTaskConductor:
         self._corrupt_pieces: set[int] = set()
         self._corrupt_counts: Dict[str, int] = {}
         self._banned_parents: set[str] = set()
+        # Not-ready parks per (parent, piece): a partial parent that
+        # 404s a piece it advertised gets the piece re-offered on the
+        # next sync instead of a failure tick, bounded by
+        # piece_not_ready_limit.
+        self._not_ready_counts: Dict[tuple, int] = {}
+        # Hybrid back-to-source state (fan-out dissemination).
+        self._b2s_mode = False
+        self._registered = False
         # Scheduler-health window for the bounded-grace degradation:
         # when RPCs started failing (None = healthy) and the last time
         # the task made progress (piece stored / decision received).
         self._sched_lock = threading.Lock()
         self._sched_fail_since: Optional[float] = None
         self._last_progress_at = time.monotonic()
+        self._last_refresh_at = time.monotonic()
 
     # -- public entry ------------------------------------------------------
 
@@ -394,6 +460,7 @@ class PeerTaskConductor:
             )
             try:
                 resp = self.scheduler.register_peer(register, channel=self.channel)
+                self._registered = True
             except Exception as exc:
                 # Scheduler unreachable → degrade to pure back-to-source,
                 # like the conductor's dummy-scheduler fallback
@@ -476,6 +543,11 @@ class PeerTaskConductor:
                 digest=f"md5:{piece.md5}" if piece.md5 else "",
                 cost_ns=0, traffic_type=TRAFFIC_RESUMED,
             ))
+        # Deliver the replay BEFORE any scheduling decision can race it:
+        # the source-claim ledger must see the resumed pieces as landed,
+        # or a back-to-source claim could be granted runs this daemon
+        # already holds (re-downloading them from origin).
+        self.reporter.flush()
         self._touch_progress()
         self._check_finished()  # crash AFTER the last piece, BEFORE done
 
@@ -492,6 +564,7 @@ class PeerTaskConductor:
                 decision = self.channel.decisions.get(timeout=min(remaining, 0.5))
             except queue.Empty:
                 self._check_finished()
+                self._maybe_refresh_parents()
                 if not self._done.is_set() and self._scheduler_stalled():
                     # Scheduler went UNAVAILABLE mid-task and nothing is
                     # progressing: degrade after the bounded grace
@@ -526,6 +599,39 @@ class PeerTaskConductor:
                               storage=self.store, error=self._error,
                               resumed_pieces=self._resumed_pieces,
                               resumed_bytes=self._resumed_bytes)
+
+    def _maybe_refresh_parents(self) -> None:
+        """Periodic LIGHT parent refresh while the download runs: a
+        probe claim (run_len=0) returns the evaluator-ranked partial
+        parents — the peers that actually accumulated pieces since this
+        child registered — and fresh syncers re-aim at them. No DAG
+        edges, no scheduling ladder, no schedule_count growth: a cold
+        fan-out burst wires children to whatever (empty) peers existed
+        at registration, and without this the dissemination tree stays
+        a deep chain for the whole download."""
+        interval = self.opts.reschedule_interval
+        if interval <= 0 or self._done.is_set() or not self._registered:
+            return
+        probe = getattr(self.scheduler, "claim_source_run", None)
+        if probe is None:
+            return
+        now = time.monotonic()
+        if now - self._last_refresh_at < interval:
+            return
+        self._last_refresh_at = now
+        from dragonfly2_tpu.scheduler.service import SourceClaimRequest
+
+        try:
+            reply = probe(SourceClaimRequest(
+                peer_id=self.peer_id, task_id=self.task_id, run_len=0))
+            self._note_scheduler(True)
+        except Exception:
+            self._note_scheduler(False)
+            logger.debug("parent refresh failed", exc_info=True)
+            return
+        self.recovery.tick("parent_refreshes")
+        for pid, addr in reply.parents:
+            self._start_syncer(ParentInfo(pid, addr))
 
     # -- scheduler health (bounded-grace degradation) ----------------------
 
@@ -582,6 +688,14 @@ class PeerTaskConductor:
         existing = self._syncers.get(parent.peer_id)
         if existing is not None and existing.is_alive():
             return
+        if (existing is None and self.opts.max_syncers > 0
+                and sum(1 for t in self._syncers.values() if t.is_alive())
+                >= self.opts.max_syncers):
+            # Poll-load cap: every live syncer keep-alive-polls its
+            # parent; an uncapped refresh stream would accrete one loop
+            # per parent ever offered and the fleet's poll traffic
+            # would swamp the mesh it feeds.
+            return
         t = threading.Thread(
             target=self._sync_parent, args=(parent,),
             name=f"piece-sync-{parent.peer_id[:8]}", daemon=True,
@@ -589,25 +703,68 @@ class PeerTaskConductor:
         self._syncers[parent.peer_id] = t
         t.start()
 
+    def _fetch_parent_metadata(self, parent: ParentInfo) -> tuple:
+        """One metadata poll over the conductor's keep-alive pool —
+        urllib's connection-per-poll made a fleet's metadata plane cost
+        one TCP handshake per parent per poll interval. Returns
+        (status, body bytes); transport failures raise."""
+        host, sep, port = parent.addr.rpartition(":")
+        if not sep or not port.isdigit():
+            raise OSError(f"malformed parent address {parent.addr!r}")
+        conn, resp = self._meta_pool.request(
+            ("http", host, int(port)), "GET",
+            f"/metadata/{self.task_id}?peerId={parent.peer_id}",
+            headers={"Connection": "keep-alive"})
+        try:
+            body = resp.read()
+            status = resp.status
+        except Exception:
+            conn.close()
+            raise
+        if resp.will_close or not resp.isclosed():
+            conn.close()
+        else:
+            self._meta_pool.checkin(("http", host, int(port)), conn)
+        return status, body
+
     def _sync_parent(self, parent: ParentInfo) -> None:
-        url = (
-            f"http://{parent.addr}/metadata/{self.task_id}"
-            f"?peerId={parent.peer_id}"
-        )
         failures = 0
+        # Partial-parent grace: a parent offered at registration may not
+        # have CREATED its store yet (it registers, then attaches
+        # storage) — its 404s within this window are "not ready", not
+        # failures, or every cold fan-out child would burn its sync
+        # budget on the very parents it is supposed to wait for.
+        not_ready_until = time.monotonic() + self.opts.metadata_not_ready_grace
+        # Idle-adaptive pacing: fast polls while the parent produces,
+        # doubling toward metadata_idle_poll_cap while it doesn't — a
+        # 32-daemon fleet polling every idle parent at the fast
+        # interval measurably starves the transfers the polls feed.
+        seen_pieces = -1
+        interval = self.opts.metadata_poll_interval
         while not self._sync_stop.is_set():
             if parent.peer_id in self._banned_parents:
                 return  # blacklisted mid-sync (repeat corruption)
             backoff = 0.0
             try:
-                with urllib.request.urlopen(
-                        url, timeout=self.opts.metadata_timeout) as resp:
-                    meta = json.loads(resp.read())
+                status, body = self._fetch_parent_metadata(parent)
+                if status == 404:
+                    if time.monotonic() < not_ready_until:
+                        self.recovery.tick("metadata_not_ready_polls")
+                        self._sync_stop.wait(self.opts.metadata_poll_interval)
+                        continue
+                    raise OSError(f"metadata 404 from {parent.addr}")
+                if status != 200:
+                    raise OSError(
+                        f"metadata status {status} from {parent.addr}")
+                meta = json.loads(body)
                 failures = 0
                 if meta.get("contentLength", -1) >= 0:
                     self._learn_length(meta["contentLength"],
                                        meta.get("totalPieces", -1))
-                for p in meta.get("pieces", []):
+                pieces = meta.get("pieces", [])
+                self._update_availability(
+                    parent.peer_id, {p["num"] for p in pieces})
+                for p in pieces:
                     self._enqueue_piece(parent, PieceMetadata(
                         num=p["num"], md5=p.get("md5", ""),
                         offset=p["offset"], start=p["start"],
@@ -618,6 +775,12 @@ class PeerTaskConductor:
                 # syncer poll re-enqueues them.
                 if meta.get("done") and self._all_written():
                     return
+                cap = self.opts.metadata_idle_poll_cap
+                if len(pieces) != seen_pieces or cap <= 0:
+                    seen_pieces = len(pieces)
+                    interval = self.opts.metadata_poll_interval
+                else:
+                    interval = min(max(interval * 2, 1e-3), cap)
             except Exception as exc:
                 failures += 1
                 logger.debug("metadata sync %s failed (%d): %s",
@@ -626,6 +789,7 @@ class PeerTaskConductor:
                     # Watchdog gives up on the parent
                     # (peertask_piecetask_synchronizer.go:70 watchdog).
                     self.recovery.tick("metadata_sync_giveups")
+                    self._drop_parent_availability(parent.peer_id)
                     self._report_piece_failed(parent.peer_id, -1)
                     return
                 # Budgeted retry with full jitter instead of hammering
@@ -633,7 +797,33 @@ class PeerTaskConductor:
                 self.recovery.tick("metadata_retries")
                 backoff = full_jitter(failures - 1, self.opts.backoff_base,
                                       self.opts.backoff_cap, self._rng)
-            self._sync_stop.wait(self.opts.metadata_poll_interval + backoff)
+                interval = self.opts.metadata_poll_interval
+            self._sync_stop.wait(interval + backoff)
+
+    # -- swarm availability (rarest-first input) ---------------------------
+
+    def _piece_availability(self, num: int) -> int:
+        """How many known live parents advertise the piece (0 = rarest).
+        Lock-free read — the dispatcher calls this per candidate pick."""
+        return self._avail.get(num, 0)
+
+    def _update_availability(self, parent_id: str, nums: set) -> None:
+        with self._written_lock:
+            prev = self._parent_pieces.get(parent_id, set())
+            for n in nums - prev:
+                self._avail[n] = self._avail.get(n, 0) + 1
+            self._parent_pieces[parent_id] = nums
+
+    def _drop_parent_availability(self, parent_id: str) -> None:
+        """The parent left the mesh (sync giveup / blacklist): its
+        inventory no longer counts toward piece availability."""
+        with self._written_lock:
+            for n in self._parent_pieces.pop(parent_id, set()):
+                count = self._avail.get(n, 0)
+                if count <= 1:
+                    self._avail.pop(n, None)
+                else:
+                    self._avail[n] = count - 1
 
     def _all_written(self) -> bool:
         if self.total_pieces < 0:
@@ -710,6 +900,12 @@ class PeerTaskConductor:
                     self.recovery.tick("enospc_fail_fast")
                     self._fail(f"disk full: {exc}")
                     return
+                if exc.not_ready and self._note_piece_not_ready(req):
+                    # Partial parent hasn't landed the piece yet: parked
+                    # (re-offered by the next metadata sync) — no
+                    # corruption/blacklist tick, no retry-budget burn,
+                    # no scheduler piece-failed report.
+                    continue
                 self.dispatcher.report(DownloadPieceResult(
                     req.dst_peer_id, req.piece.num, fail=True))
                 self._report_piece_failed(req.dst_peer_id, req.piece.num)
@@ -795,6 +991,26 @@ class PeerTaskConductor:
             return
         self._after_piece_stored(req, cost_ns)
 
+    def _note_piece_not_ready(self, req: DownloadPieceRequest) -> bool:
+        """A parent 404'd a piece it doesn't hold YET. Park the piece
+        (un-mark it enqueued so the next metadata sync — of this parent
+        once it lands the piece, or of any other — re-offers it) and
+        tell the dispatcher nothing: "not yet" is not a failure, so no
+        score penalty, no avoid-map entry, no retry-budget burn.
+        Returns False once the (parent, piece) pair exhausted
+        ``piece_not_ready_limit`` — the caller then takes the normal
+        failure path (a parent forever advertising what it won't serve
+        must not park pieces until the task deadline)."""
+        key = (req.dst_peer_id, req.piece.num)
+        with self._written_lock:
+            count = self._not_ready_counts.get(key, 0) + 1
+            self._not_ready_counts[key] = count
+            if count > self.opts.piece_not_ready_limit > 0:
+                return False
+            self._enqueued.discard(req.piece.num)
+        self.recovery.tick("piece_not_ready_parks")
+        return True
+
     def _note_piece_failure(self, piece_num: int) -> None:
         """Count one failed attempt at a piece, re-open it for (other)
         syncers, and enforce the per-piece retry budget: an exhausted
@@ -839,6 +1055,7 @@ class PeerTaskConductor:
         if (count >= self.opts.corrupt_blacklist_threshold > 0
                 and parent not in self._banned_parents):
             self._banned_parents.add(parent)
+            self._drop_parent_availability(parent)
             self.recovery.tick("parents_blacklisted")
             logger.warning("parent %s blacklisted for task %s after %d "
                            "corrupt pieces", parent, self.task_id[:16], count)
@@ -931,6 +1148,16 @@ class PeerTaskConductor:
             complete = len(self._written) >= self.total_pieces
         if not complete:
             return
+        if self._b2s_mode:
+            # Hybrid back-to-source: the mesh delivered the last piece
+            # while origin workers were claiming. The back-to-source
+            # flow owns the task-level finish (mark_done + the
+            # back_to_source_finished report carrying the task shape) —
+            # just stop the loops; _download_source sees _done and
+            # finalizes.
+            self._success = True
+            self._done.set()
+            return
         try:
             self.store.mark_done()
         except Exception as exc:
@@ -973,15 +1200,20 @@ class PeerTaskConductor:
             t.join(timeout=2)
         for t in self._syncers.values():
             t.join(timeout=2)
-        # After the workers are down: drop the keep-alive pool and make
+        # After the workers are down: drop the keep-alive pools and make
         # the exactly-once guarantee on buffered reports (close flushes;
         # stragglers from a timed-out join deliver synchronously).
         self.downloader.close()
+        self._meta_pool.close()
         self.reporter.close()
 
     # -- back-to-source (pullPiecesFromSource / DownloadSource) ------------
 
     def _run_back_to_source(self, report: bool = True) -> PeerTaskResult:
+        # Hybrid-mode flag read by _check_finished: mesh syncers/workers
+        # stay live during back-to-source, and the task-level finish
+        # belongs to THIS flow.
+        self._b2s_mode = True
         if self.opts.disable_back_source:
             # Report like every other terminal failure (_fail / the
             # back-to-source exception path) so the scheduler's peer FSM
@@ -1077,27 +1309,103 @@ class PeerTaskConductor:
         # runs): a dead source fails in seconds instead of grinding
         # through N doomed fetches before anyone looks at `errors`.
         abort = threading.Event()
+        # Swarm-coordinated origin claims (fan-out dissemination): when
+        # the scheduler exposes the claim ledger AND this peer is
+        # registered, origin workers fetch only DISJOINT leased runs and
+        # the mesh (partial parents from the claim replies) delivers the
+        # rest. Any claim failure or mesh stall degrades ONE WAY to the
+        # local sequential claims below — liveness never depends on the
+        # scheduler or the mesh.
+        remote_claims = bool(
+            self._registered and self.opts.source_claims
+            and getattr(self.scheduler, "claim_source_run", None) is not None)
+        mode = {"local": not remote_claims}
 
-        def claim() -> "tuple[int, int] | None":
+        # Pieces some worker is currently fetching (kept through its
+        # whole retry loop): the re-sweep below must never double-claim
+        # a run another worker is mid-fetch on.
+        inflight: set[int] = set()
+
+        def local_claim() -> "tuple[int, int] | None":
             """Next run of ≤run_len CONTIGUOUS missing pieces (pieces
             already stored — e.g. partial p2p progress before the
-            back-to-source decision — break runs rather than being
-            re-fetched)."""
+            back-to-source decision, or mesh deliveries during the
+            hybrid phase — break runs rather than being re-fetched)."""
+
+            def claimable(n: int) -> bool:
+                return n not in inflight and not self.store.has_piece(n)
+
             with lock:
                 if abort.is_set():
                     return None
-                while (cursor[0] < total
-                       and self.store.has_piece(cursor[0])):
+                while cursor[0] < total and not claimable(cursor[0]):
                     cursor[0] += 1
                 if cursor[0] >= total:
                     return None
                 start = cursor[0]
                 n = 0
                 while (n < run_len and start + n < total
-                       and not self.store.has_piece(start + n)):
+                       and claimable(start + n)):
                     n += 1
                 cursor[0] = start + n
                 return start, n
+
+        def remote_claim() -> "tuple | None":
+            """One scheduler claim poll → ('run', first, count),
+            ('wait',), or None (origin work exhausted AND the file is
+            locally complete). Claim replies double as mesh discovery:
+            every reply's partial parents get a syncer."""
+            from dragonfly2_tpu.scheduler.service import SourceClaimRequest
+
+            try:
+                reply = self.scheduler.claim_source_run(SourceClaimRequest(
+                    peer_id=self.peer_id, task_id=self.task_id,
+                    total_pieces=total, run_len=run_len))
+                # Duck-typed scheduler stand-ins may accept the call
+                # and return garbage — a malformed reply degrades like
+                # a failed one.
+                parents = list(reply.parents)
+                first, count = int(reply.first), int(reply.count)
+            except Exception as exc:
+                logger.debug("source claim failed (%s); degrading to "
+                             "local claims", exc)
+                self.recovery.tick("source_claim_fallbacks")
+                # Keyed by failure shape so a fleet report can tell a
+                # saturated scheduler (DeadlineExceeded) from a legacy
+                # one (AttributeError) at a glance.
+                self.recovery.tick(
+                    f"source_claim_fallback_{type(exc).__name__}")
+                with lock:
+                    mode["local"] = True
+                return ("retry",)
+            for pid, addr in parents:
+                self._start_syncer(ParentInfo(pid, addr))
+            if first >= 0:
+                return ("run", first, count)
+            if self._source_complete():
+                return None
+            return ("wait",)
+
+        def claim() -> "tuple | None":
+            if abort.is_set():
+                return None
+            with lock:
+                local = mode["local"]
+            if not local:
+                return remote_claim()
+            granted = local_claim()
+            if granted is not None:
+                return ("run", granted[0], granted[1])
+            # Cursor exhausted. In pure-local mode that used to mean
+            # done — but mesh deliveries may still be in flight (the
+            # hybrid phase), and a mesh fetch that later FAILS re-opens
+            # a hole behind the cursor: re-sweep (skipping runs other
+            # workers hold in flight) until the file is complete.
+            if self._source_complete():
+                return None
+            with lock:
+                cursor[0] = 0
+            return ("wait",)
 
         def fetch_run(first: int, count: int) -> "Exception | None":
             """ONE ranged GET covering pieces [first, first+count), split
@@ -1157,6 +1465,9 @@ class PeerTaskConductor:
                     # children can verify (back-source pieces define the
                     # task's truth).
                     self.store.set_piece_digest(num, reader.hexdigest(), cost)
+                    with self._written_lock:
+                        self._written.add(num)
+                    self._touch_progress()
                     self._observe_piece_recovered(num)
                     self._notify_piece_sink(num)
                     self.shaper.record(self.task_id, rng.length)
@@ -1181,50 +1492,119 @@ class PeerTaskConductor:
                 self.stats.source_run(completed, completed_bytes)
             return run_exc
 
+        def fetch_claimed(first: int, count: int) -> bool:
+            """Fetch one claimed run with the source_retry_limit budget
+            + full jitter (transient blips retry; disk-full is terminal
+            immediately; an exhausted budget aborts remaining claims so
+            a DEAD source still fails in ~retry_limit runs per worker).
+            Returns False when the worker must stop."""
+            attempts = 0
+            while not abort.is_set():
+                err = fetch_run(first, count)
+                if err is None:
+                    return True
+                attempts += 1
+                # Pieces still missing from the failed run opened
+                # their recovery window now (closed when the retry
+                # stores them — the recovery-latency ring).
+                now = time.monotonic()
+                with self._written_lock:
+                    for num in range(first, first + count):
+                        if not self.store.has_piece(num):
+                            self._first_failure_at.setdefault(num, now)
+                # Retry the SAME run (the claim cursor has moved on):
+                # pieces that landed before the failure are drained
+                # as duplicates by write_piece's span-bounded dedup.
+                if isinstance(err, DiskFullError):
+                    self.recovery.tick("enospc_fail_fast")
+                    attempts = None  # terminal — no retry can help
+                if attempts is None or attempts > self.opts.source_retry_limit:
+                    with lock:
+                        errors.append(
+                            f"pieces {first}-{first + count - 1}: {err}")
+                    abort.set()
+                    return False
+                self.recovery.tick("source_run_retries")
+                logger.debug("source run %d-%d failed (attempt %d): %s",
+                             first, first + count - 1, attempts, err)
+                self._done.wait(full_jitter(
+                    attempts - 1, self.opts.backoff_base,
+                    self.opts.backoff_cap, self._rng))
+            return True
+
+        deadline = self._started_at + self.opts.timeout
+
         def worker() -> None:
-            """Claims runs; transient run failures retry under the
-            source_retry_limit budget with full jitter (the pre-ISSUE-5
-            behavior — first error fails the task — made every blip on
-            the origin fatal). Disk-full is terminal immediately, and an
-            exhausted budget aborts the remaining claims so a DEAD
-            source still fails in ~retry_limit runs per worker."""
-            while True:
+            """Claims runs until the file is locally complete. A "wait"
+            verdict means other claimants hold the remaining leases and
+            the mesh is delivering them — poll again after a beat; a
+            mesh that stalls past source_fallback_wait degrades the
+            whole task ONE WAY to local sequential claims (origin
+            completes the file regardless of swarm health)."""
+            while not self._done.is_set():
                 claimed = claim()
                 if claimed is None:
                     return
-                first, count = claimed
-                attempts = 0
-                while not abort.is_set():
-                    err = fetch_run(first, count)
-                    if err is None:
-                        break
-                    attempts += 1
-                    # Pieces still missing from the failed run opened
-                    # their recovery window now (closed when the retry
-                    # stores them — the recovery-latency ring).
+                kind = claimed[0]
+                if kind == "retry":
+                    continue  # mode flipped; re-claim immediately
+                if kind == "wait":
+                    if self._source_complete() or abort.is_set():
+                        return
+                    with self._sched_lock:
+                        last_progress = self._last_progress_at
                     now = time.monotonic()
-                    with self._written_lock:
-                        for num in range(first, first + count):
-                            if not self.store.has_piece(num):
-                                self._first_failure_at.setdefault(num, now)
-                    # Retry the SAME run (the claim cursor has moved on):
-                    # pieces that landed before the failure are drained
-                    # as duplicates by write_piece's span-bounded dedup.
-                    if isinstance(err, DiskFullError):
-                        self.recovery.tick("enospc_fail_fast")
-                        attempts = None  # terminal — no retry can help
-                    if attempts is None or attempts > self.opts.source_retry_limit:
+                    stalled = (now - last_progress
+                               > self.opts.source_fallback_wait)
+                    with lock:
+                        if stalled and not mode["local"]:
+                            mode["local"] = True
+                            cursor[0] = 0
+                            self.recovery.tick("source_mesh_stall_fallbacks")
+                            logger.warning(
+                                "task %s: mesh stalled %.1fs; claiming "
+                                "remaining pieces from origin",
+                                self.task_id[:16],
+                                now - last_progress)
+                            continue
+                    if now > deadline:
                         with lock:
                             errors.append(
-                                f"pieces {first}-{first + count - 1}: {err}")
+                                "timed out waiting for leased pieces "
+                                "from the mesh")
                         abort.set()
                         return
-                    self.recovery.tick("source_run_retries")
-                    logger.debug("source run %d-%d failed (attempt %d): %s",
-                                 first, first + count - 1, attempts, err)
-                    self._done.wait(full_jitter(
-                        attempts - 1, self.opts.backoff_base,
-                        self.opts.backoff_cap, self._rng))
+                    self._done.wait(self.opts.claim_wait_interval)
+                    continue
+                first, count = claimed[1], claimed[2]
+                # Clip the granted run to locally-MISSING subruns: a
+                # remote grant can race pieces landing here (mesh
+                # delivery, journal-resume replay still propagating) —
+                # re-downloading them would both waste origin bytes and
+                # re-fire piece sinks for bytes already on disk.
+                subruns = []
+                sub_first, sub_n = -1, 0
+                for num in range(first, first + count):
+                    if self.store.has_piece(num):
+                        if sub_n:
+                            subruns.append((sub_first, sub_n))
+                        sub_first, sub_n = -1, 0
+                        continue
+                    if sub_n == 0:
+                        sub_first = num
+                    sub_n += 1
+                if sub_n:
+                    subruns.append((sub_first, sub_n))
+                with lock:
+                    inflight.update(range(first, first + count))
+                try:
+                    for sub_first, sub_n in subruns:
+                        if not fetch_claimed(sub_first, sub_n):
+                            return
+                finally:
+                    with lock:
+                        inflight.difference_update(
+                            range(first, first + count))
 
         threads = [
             threading.Thread(target=worker, daemon=True,
@@ -1235,10 +1615,18 @@ class PeerTaskConductor:
             t.start()
         for t in threads:
             t.join()
-        if errors:
+        if errors and not self._source_complete():
             raise RuntimeError("; ".join(errors[:3]))
         self.store.mark_done()
         return length, total
+
+    def _source_complete(self) -> bool:
+        """Every piece of the (known-shape) task is on disk — origin
+        claims AND mesh deliveries both count."""
+        store = self.store
+        total = self.total_pieces
+        return (store is not None and total > 0
+                and len(store.meta.pieces) >= total)
 
     def _download_source_stream(self, request: source_mod.Request) -> tuple[int, int]:
         """Unknown length / no range support: single sequential stream cut

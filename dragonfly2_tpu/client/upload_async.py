@@ -599,11 +599,12 @@ class AsyncUploadServer:
             try:
                 data = self.storage.read_piece_any(task_id, peer_id, rng=rng)
             except StorageError as exc:
-                self._respond_error(worker, conn, 500, str(exc))
+                self._respond_missing(worker, conn, task_id, peer_id,
+                                      str(exc))
                 return
             if not data:
-                self._respond_error(worker, conn, 416,
-                                    "range past end of stored content")
+                self._respond_missing(worker, conn, task_id, peer_id,
+                                      "range past end of stored content")
                 return
             length = len(data)
             conn.kind = KIND_BUFFERED
@@ -618,6 +619,28 @@ class AsyncUploadServer:
         conn.reserved = min(length, self.limiter.burst)
         delay = self.limiter.reserve_n(conn.reserved)
         self._start_write(worker, conn, delay)
+
+    def _respond_missing(self, worker: _Worker, conn: _Conn, task_id: str,
+                         peer_id: str, detail: str) -> None:
+        """A requested range is not serveable. Distinguish "not yet"
+        from "never": a task the storage KNOWS about in a still-filling
+        store answers 404 + ``X-Df2-Not-Ready`` — partial peers serve
+        while downloading, and a child that raced ahead of this
+        parent's landings must PARK the piece for its next metadata
+        sync, not tick corruption/blacklist counters. An unknown task
+        is a plain 404; a range beyond a COMPLETED replica is a real
+        416 (it will never materialize)."""
+        store = (self.storage.get(task_id, peer_id)
+                 or self.storage.find_completed_task(task_id))
+        if store is not None and not store.meta.done:
+            self._respond_bytes(worker, conn, 404,
+                                b"piece not yet available",
+                                ("X-Df2-Not-Ready: 1",))
+            return
+        if store is None:
+            self._respond_error(worker, conn, 404, detail)
+        else:
+            self._respond_error(worker, conn, 416, detail)
 
     def _pick_span_kind(self, conn: _Conn) -> str:
         if conn.tls:

@@ -78,6 +78,8 @@ class ControlPlaneStats:
         self.report_batches = 0
         self.peer_reregistrations = 0
         self.task_reannounces = 0
+        self.source_claims = 0
+        self.source_claims_granted = 0
         self.bad_node_fast = 0
         self.bad_node_slow = 0
         self.gc_ticks = 0
@@ -123,6 +125,14 @@ class ControlPlaneStats:
         with self._lock:
             self.task_reannounces += 1
 
+    def observe_source_claim(self, *, granted: bool) -> None:
+        """One claim_source_run call (fan-out dissemination); granted
+        means a run was leased (vs wait/done verdicts)."""
+        with self._lock:
+            self.source_claims += 1
+            if granted:
+                self.source_claims_granted += 1
+
     def observe_bad_node(self, *, fast: bool) -> None:
         # Lock-free: this fires once per CANDIDATE inside the filter hot
         # loop — taking the shared stats lock there would re-introduce
@@ -164,6 +174,8 @@ class ControlPlaneStats:
                 "report_batches": self.report_batches,
                 "peer_reregistrations": self.peer_reregistrations,
                 "task_reannounces": self.task_reannounces,
+                "source_claims": self.source_claims,
+                "source_claims_granted": self.source_claims_granted,
                 "bad_node_fast": self.bad_node_fast,
                 "bad_node_slow": self.bad_node_slow,
                 "gc_ticks": self.gc_ticks,

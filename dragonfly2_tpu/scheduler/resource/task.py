@@ -118,6 +118,10 @@ class Task:
         self.back_to_source_peers: set[str] = set()
         self.peer_failed_count = 0
         self.pieces: Dict[int, Piece] = {}
+        # Lazily-created source-claim coordinator (resource/claims.py):
+        # present only once a back-to-source peer asked for disjoint
+        # origin claims — the piece-report hot path guards on None.
+        self.source_claims = None
         self.dag: dag_mod.DAG = dag_mod.DAG()
         self.created_at = time.time()
         self.updated_at = time.time()
@@ -140,6 +144,27 @@ class Task:
     def delete_piece(self, number: int) -> None:
         with self._lock:
             self.pieces.pop(number, None)
+
+    # -- source claims (fan-out dissemination, resource/claims.py) ------------
+
+    def ensure_source_claims(self, total_pieces: int):
+        """Lazily create the claim coordinator sized to the task. First
+        claimant wins the shape; a mismatched later total (cannot happen
+        for one URL, but duck-typed callers exist) keeps the original."""
+        from dragonfly2_tpu.scheduler.resource.claims import SourceClaims
+
+        with self._lock:
+            if self.source_claims is None:
+                self.source_claims = SourceClaims(total_pieces, seed=self.id)
+            return self.source_claims
+
+    def mark_piece_landed(self, number: int) -> None:
+        """Feed the claim map from the piece-report path: ANY replica of
+        a piece in the swarm means the origin never needs to serve it
+        again. No-op (one attribute read) while no claimant exists."""
+        claims = self.source_claims
+        if claims is not None:
+            claims.mark_landed(number)
 
     # -- peer DAG -------------------------------------------------------------
 

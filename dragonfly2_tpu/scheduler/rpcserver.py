@@ -43,6 +43,8 @@ from dragonfly2_tpu.scheduler.service import (
     RegisterPeerResponse,
     SchedulerService,
     ServiceError,
+    SourceClaimReply,
+    SourceClaimRequest,
 )
 from dragonfly2_tpu.utils.hosttypes import HostType
 
@@ -265,6 +267,29 @@ class WireCandidateParents:
     parents: List[WireParent] = field(default_factory=list)
 
 
+@message("scheduler.WireSourceClaim")
+@dataclass
+class WireSourceClaim:
+    """Back-to-source origin-run claim (fan-out dissemination): the
+    scheduler leases disjoint piece runs so concurrent cold starters
+    never pull the same bytes from the origin."""
+
+    peer_id: str = ""
+    task_id: str = ""
+    total_pieces: int = 0
+    run_len: int = 8
+
+
+@message("scheduler.WireSourceClaimReply")
+@dataclass
+class WireSourceClaimReply:
+    first: int = -1
+    count: int = 0
+    wait: bool = False
+    done: bool = False
+    parents: List[WireParent] = field(default_factory=list)
+
+
 @message("scheduler.WireNeedBackToSource")
 @dataclass
 class WireNeedBackToSource:
@@ -343,6 +368,7 @@ SCHEDULER_SPEC = ServiceSpec(
         "LeavePeer": MethodKind.UNARY_UNARY,
         "StatTask": MethodKind.UNARY_UNARY,
         "ListHosts": MethodKind.UNARY_UNARY,
+        "ClaimSource": MethodKind.UNARY_UNARY,
         "AnnouncePeer": MethodKind.STREAM_STREAM,
         "SyncProbes": MethodKind.STREAM_STREAM,
         "SyncReplicaProbes": MethodKind.UNARY_UNARY,
@@ -400,6 +426,20 @@ class SchedulerRpcService:
             piece_md5_sign=request.piece_md5_sign,
         ))
         return Empty()
+
+    def ClaimSource(self, request: WireSourceClaim,  # noqa: N802
+                    context) -> WireSourceClaimReply:
+        reply = self._guard(
+            context, self.service.claim_source_run,
+            SourceClaimRequest(
+                peer_id=request.peer_id, task_id=request.task_id,
+                total_pieces=request.total_pieces, run_len=request.run_len,
+            ))
+        return WireSourceClaimReply(
+            first=reply.first, count=reply.count,
+            wait=reply.wait, done=reply.done,
+            parents=[WireParent(pid, addr) for pid, addr in reply.parents],
+        )
 
     def LeaveHost(self, request: HostID, context) -> Empty:  # noqa: N802
         self._guard(context, self.service.leave_host, request.host_id)
@@ -689,6 +729,33 @@ class GrpcSchedulerClient:
             if err.code() == grpc.StatusCode.NOT_FOUND:
                 raise ServiceError("NotFound", err.details()) from err
             raise
+
+    def claim_source_run(self, req: SourceClaimRequest) -> SourceClaimReply:
+        """Disjoint origin-run claim (unary). NOT_FOUND (peer unknown to
+        a restarted replica) surfaces as the in-process ServiceError so
+        the balanced client's failover re-registration heals it."""
+        import grpc
+
+        self._inject("claim_source_run")
+        try:
+            # 30 s: a fleet-wide cold burst (registration storm + spawn
+            # wave on a small box) can queue unary calls behind the
+            # announce streams; a timed-out claim degrades the claimant
+            # to a FULL local origin pull, which is far costlier than
+            # waiting out the burst.
+            reply = self._client.ClaimSource(WireSourceClaim(
+                peer_id=req.peer_id, task_id=req.task_id,
+                total_pieces=req.total_pieces, run_len=req.run_len,
+            ), timeout=30)
+        except grpc.RpcError as err:
+            if err.code() == grpc.StatusCode.NOT_FOUND:
+                raise ServiceError("NotFound", err.details()) from err
+            raise
+        return SourceClaimReply(
+            first=reply.first, count=reply.count,
+            wait=reply.wait, done=reply.done,
+            parents=[(p.peer_id, p.addr) for p in reply.parents],
+        )
 
     def leave_host(self, host_id: str) -> None:
         self._client.LeaveHost(HostID(host_id), timeout=10)
@@ -1745,6 +1812,16 @@ class BalancedSchedulerClient:
             peer_id,
             lambda cli: cli.download_piece_failed(
                 peer_id, parent_id, piece_number))
+
+    def claim_source_run(self, req: SourceClaimRequest) -> SourceClaimReply:
+        """Origin-run claim, peer-keyed: the claim ledger lives on the
+        peer's owning replica (the same one its task's other peers
+        register at, so the disjointness ledger is swarm-wide). After a
+        failover the new owner starts a fresh ledger — the duplicate
+        origin pulls that allows are bounded by whatever was in flight
+        and are visible in the fan-out bench's amplification metric."""
+        return self._peer_call(
+            req.peer_id, lambda cli: cli.claim_source_run(req))
 
     def download_peer_finished(self, peer_id: str,
                                cost_seconds: float = 0.0) -> None:

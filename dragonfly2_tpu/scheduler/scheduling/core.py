@@ -194,6 +194,27 @@ class Scheduling:
         self.stats.observe_evaluate((perf_counter() - t1) * 1e3)
         return list(ranked[: self.config.candidate_parent_limit])
 
+    def find_partial_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
+        """Best-effort mesh assist for a BACK_TO_SOURCE claimant (the
+        fan-out dissemination pipeline): the same six filters and
+        evaluator ranking as the normal path, but (a) the requesting
+        peer may be in any active state — claimants are BackToSource,
+        not Running — and (b) only candidates that actually HOLD pieces
+        (or are seeds) qualify: a claimant needs pieces NOW, not a peer
+        that may have some later. No DAG edges are added — claimants
+        serve each other symmetrically, which an acyclic parent graph
+        cannot express."""
+        candidates = [
+            c for c in self._filter_candidate_parents(peer, blocklist)
+            if c.finished_piece_count() > 0 or c.host.type.is_seed
+        ]
+        if not candidates:
+            return []
+        ranked = self.evaluator.evaluate_parents(
+            candidates, peer, peer.task.total_piece_count
+        )
+        return list(ranked[: self.config.candidate_parent_limit])
+
     def find_success_parent(self, peer: Peer, blocklist: set[str]) -> Optional[Peer]:
         """(scheduling.go:433-462) best fully-downloaded parent, for task
         reuse paths."""
@@ -237,8 +258,12 @@ class Scheduling:
             if is_bad_node(candidate):
                 continue
             # A normal-host parent must itself have a source of pieces:
-            # a parent, back-to-source, or completed download. Seeds are
-            # exempt (they fetch on demand).
+            # a parent, back-to-source, a completed download — or an
+            # actual piece inventory (partial peers serve while they
+            # download: a Running peer holding verified pieces is a
+            # valid parent even with no in-edges, e.g. one resumed from
+            # a crash journal or fed by a claim-granted origin run).
+            # Seeds are exempt (they fetch on demand).
             try:
                 in_degree = dag.vertex(candidate.id).in_degree
             except VertexNotFoundError:
@@ -248,6 +273,7 @@ class Scheduling:
             if (
                 candidate.host.type == HostType.NORMAL
                 and in_degree == 0
+                and candidate.finished_piece_count() == 0
                 and not candidate.fsm.is_state(PeerState.BACK_TO_SOURCE, PeerState.SUCCEEDED)
             ):
                 continue

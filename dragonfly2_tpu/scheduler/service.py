@@ -101,6 +101,38 @@ class AnnounceTaskRequest:
 
 
 @dataclass
+class SourceClaimRequest:
+    """A back-to-source peer asking for its next DISJOINT origin run
+    (fan-out dissemination, resource/claims.py). ``task_id`` rides along
+    for wire affinity (the balanced client walks the task ring);
+    in-process the peer's task resolves it."""
+
+    peer_id: str
+    task_id: str = ""
+    total_pieces: int = 0
+    # run_len <= 0 is a PROBE: no lease is taken — the reply only
+    # carries the ranked partial parents. Mesh children use this as a
+    # light mid-download parent refresh (no DAG edges, no scheduling
+    # ladder, no schedule_count growth) to re-aim their syncers at
+    # whoever actually accumulated pieces.
+    run_len: int = 8
+
+
+@dataclass
+class SourceClaimReply:
+    """Claim verdict + a mesh assist: candidate parents that HOLD pieces
+    right now (peer_id, "ip:download_port") so the claimant's syncers
+    can pull everything it was NOT granted from the swarm instead of
+    the origin."""
+
+    first: int = -1
+    count: int = 0
+    wait: bool = False
+    done: bool = False
+    parents: List[tuple] = field(default_factory=list)
+
+
+@dataclass
 class PieceFinished:
     peer_id: str
     piece_number: int
@@ -425,6 +457,36 @@ class SchedulerService:
             peer.fsm.fire(PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
         peer.task.back_to_source_peers.add(peer.id)
 
+    def claim_source_run(self, req: SourceClaimRequest) -> SourceClaimReply:
+        """Lease the next disjoint origin run to a back-to-source peer
+        (fan-out dissemination: concurrent cold starters pull DISJOINT
+        ranges so origin egress stays ≈1× the file, resource/claims.py)
+        and offer the claimant candidate partial parents for everything
+        it was not granted. See docs/FANOUT.md."""
+        from dragonfly2_tpu.scheduler.resource.claims import ClaimGrant
+
+        peer = self._peer(req.peer_id)
+        task = peer.task
+        parents = self.scheduling.find_partial_parents(
+            peer, set(peer.block_parents))
+        if req.run_len <= 0:
+            grant = ClaimGrant()  # probe: parents only, no lease
+        else:
+            total = req.total_pieces or task.total_piece_count
+            if total <= 0:
+                raise ServiceError(INVALID_ARGUMENT,
+                                   "claim_source_run needs total_pieces "
+                                   "(task shape unknown)")
+            claims = task.ensure_source_claims(total)
+            grant = claims.claim(req.peer_id, req.run_len)
+            self.stats.observe_source_claim(granted=grant.first >= 0)
+        return SourceClaimReply(
+            first=grant.first, count=grant.count,
+            wait=grant.wait, done=grant.done,
+            parents=[(p.id, f"{p.host.ip}:{p.host.download_port}")
+                     for p in parents if p.id != req.peer_id],
+        )
+
     def download_piece_finished(self, report: PieceFinished) -> None:
         """(service_v2.go:1095 handleDownloadPieceFinishedRequest)"""
         peer = self._peer(report.peer_id)
@@ -435,6 +497,7 @@ class SchedulerService:
             traffic_type=report.traffic_type,
         )
         peer.store_piece(piece)
+        peer.task.mark_piece_landed(report.piece_number)
         self.stats.observe_piece_reports(1)
         # Back-to-source pieces become task pieces (the metadata other
         # peers will sync).
@@ -480,6 +543,7 @@ class SchedulerService:
                 traffic_type=report.traffic_type,
             )
             peer.store_piece(piece)
+            peer.task.mark_piece_landed(report.piece_number)
             stored += 1
             if not report.parent_id:
                 peer.task.store_piece(piece)
@@ -502,6 +566,13 @@ class SchedulerService:
         peer = self._peer(peer_id)
         if parent_id:
             peer.block_parents.add(parent_id)
+        if peer.fsm.is_state(PeerState.BACK_TO_SOURCE):
+            # A hybrid claimant's mesh fetch failed: it gets fresh
+            # partial parents from its next claim reply; the Running-
+            # peer retry ladder would just burn its back-to-source
+            # resend budget (find_candidate_parents filters non-Running
+            # requesters) and sleep the announce thread.
+            return
         self._schedule_timed(peer)
 
     def _schedule_timed(self, peer: Peer) -> None:
@@ -519,6 +590,8 @@ class SchedulerService:
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
+        if peer.fsm.is_state(PeerState.SUCCEEDED):
+            return  # duplicate terminal report (failover replay / race)
         peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
         if self.metrics:
             self.metrics.download_peer_finished.inc()
@@ -533,7 +606,14 @@ class SchedulerService:
     ) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
-        peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        # Idempotent on an already-Succeeded peer: the hybrid fan-out
+        # path can complete via the MESH a beat before the
+        # NeedBackToSource decision is consumed (the conductor then
+        # reports the peer-level finish first), and failover replays
+        # redeliver terminal events — the task-shape upsert below must
+        # still land either way.
+        if not peer.fsm.is_state(PeerState.SUCCEEDED):
+            peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
         task = peer.task
         task.report_success(content_length, total_piece_count)
         if task.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
@@ -548,6 +628,8 @@ class SchedulerService:
     def download_peer_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
+        if peer.task.source_claims is not None:
+            peer.task.source_claims.release(peer_id)
         peer.task.peer_failed_count += 1
         if self.metrics:
             self.metrics.download_peer_failure.inc()
@@ -560,6 +642,11 @@ class SchedulerService:
             self.metrics.download_peer_failure.inc()
         task = peer.task
         task.back_to_source_peers.discard(peer.id)
+        if task.source_claims is not None:
+            # Free the failed claimant's leases NOW instead of waiting
+            # out the TTL — surviving claimants pick the pieces up on
+            # their next claim poll.
+            task.source_claims.release(peer_id)
         if task.fsm.can(TaskEvent.DOWNLOAD_FAILED):
             task.fsm.fire(TaskEvent.DOWNLOAD_FAILED)
         # Unverified metadata dies with the failed back-source attempt
@@ -571,6 +658,8 @@ class SchedulerService:
 
     def leave_peer(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
+        if peer.task.source_claims is not None:
+            peer.task.source_claims.release(peer_id)
         peer.leave()
         peer.task.delete_peer_in_edges(peer.id)
         peer.task.delete_peer_out_edges(peer)

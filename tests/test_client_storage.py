@@ -322,14 +322,16 @@ class TestUploadDownloadRoundtrip:
             with pytest.raises(urllib.error.HTTPError) as exc_info:
                 urllib.request.urlopen(f"{base}/download/abc/{'f'*32}?peerId=x")
             assert exc_info.value.code == 400
-            # unknown task
+            # unknown task → 404 (ISSUE 9: a known-but-filling store
+            # would be 404 + X-Df2-Not-Ready; unknown is a plain miss)
             req = urllib.request.Request(
                 f"{base}/download/abc/{'f'*32}?peerId=x",
                 headers={"Range": "bytes=0-9"},
             )
             with pytest.raises(urllib.error.HTTPError) as exc_info:
                 urllib.request.urlopen(req)
-            assert exc_info.value.code == 500
+            assert exc_info.value.code == 404
+            assert exc_info.value.headers.get("X-Df2-Not-Ready") is None
             # suffix ranges are rejected (total length unknown server-side)
             req = urllib.request.Request(
                 f"{base}/download/abc/{'f'*32}?peerId=x",

@@ -1,0 +1,582 @@
+"""Fleet-scale fan-out dissemination tests (ISSUE 9).
+
+Covers the swarm-coordination layers bottom-up: the SourceClaims lease
+ledger, the scheduler's claim/probe service surface and partial-parent
+filter, the rarest-first dispatcher, the "not yet" (404) piece/metadata
+handling that must NOT burn failure budgets, the hybrid back-to-source
+conductor end-to-end (origin egress ≈ 1× for concurrent cold starters),
+and the fanout bench harness + regression gate plumbing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pathlib
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.scheduler.resource.claims import ClaimGrant, SourceClaims
+from dragonfly2_tpu.scheduler.service import (
+    PieceFinished,
+    RegisterPeerRequest,
+    SourceClaimRequest,
+)
+from tests.fileserver import FileServer
+from tests.test_p2p_e2e import make_daemon, make_scheduler
+
+
+# ----------------------------------------------------------------------
+# SourceClaims ledger
+# ----------------------------------------------------------------------
+
+
+class TestSourceClaims:
+    def test_concurrent_claimants_get_disjoint_runs(self):
+        claims = SourceClaims(32, seed=7)
+        seen: set[int] = set()
+        peers = iter("abcdefgh")
+        while True:
+            grant = claims.claim(next(peers), 8)
+            if grant.first < 0:
+                break
+            pieces = set(range(grant.first, grant.first + grant.count))
+            assert not pieces & seen, "grants must be disjoint"
+            seen |= pieces
+        assert seen == set(range(32))  # every piece granted exactly once
+        # Everything leased: the next claimant waits on the mesh.
+        assert claims.claim("z", 8) == ClaimGrant(wait=True)
+
+    def test_landed_pieces_never_granted(self):
+        claims = SourceClaims(8, seed=0)
+        for n in range(4):
+            claims.mark_landed(n)
+        grant = claims.claim("a", 8)
+        got = set(range(grant.first, grant.first + grant.count))
+        assert not got & {0, 1, 2, 3}
+        for n in range(8):
+            claims.mark_landed(n)
+        assert claims.claim("a", 8).done
+
+    def test_lease_expiry_reclaims_dead_claimant(self):
+        claims = SourceClaims(8, lease_ttl=10.0, seed=0)
+        g1 = claims.claim("dead", 8, now=100.0)
+        assert g1.count == 8
+        # Within the TTL the pieces stay leased …
+        assert claims.claim("live", 8, now=105.0).wait
+        # … after it they are claimable again.
+        g2 = claims.claim("live", 8, now=111.0)
+        assert g2.count == 8
+
+    def test_claiming_renews_own_leases(self):
+        claims = SourceClaims(16, lease_ttl=10.0, seed=0)
+        claims.claim("a", 8, now=0.0)   # leases 0-7 to a
+        # a polls again at t=8 (alive): 0-7 renew to t=18 AND the tail
+        # run 8-15 is granted to it.
+        assert claims.claim("a", 8, now=8.0).count == 8
+        # b at t=12: original TTL of the first run would have lapsed at
+        # t=10, but the renewal moved it — everything still leased.
+        assert claims.claim("b", 8, now=12.0).wait
+        # Past the renewed expiry the leases fall to b.
+        assert claims.claim("b", 8, now=18.5).count == 8
+
+    def test_release_frees_claimants_pieces(self):
+        claims = SourceClaims(8, seed=0)
+        claims.claim("a", 8)
+        assert claims.release("a") == 8
+        assert claims.claim("b", 8).count == 8
+
+    def test_seeded_scan_offset(self):
+        a = SourceClaims(64, seed="task-a")
+        b = SourceClaims(64, seed="task-b")
+        assert a.scan_start != b.scan_start  # different tasks, regions
+
+    def test_runs_are_contiguous_and_never_wrap(self):
+        claims = SourceClaims(10, seed=8)  # scan starts mid-ring
+        g = claims.claim("a", 8)
+        assert g.first + g.count <= 10  # one ranged GET ⇒ no wrap
+
+
+# ----------------------------------------------------------------------
+# Scheduler claim/probe surface
+# ----------------------------------------------------------------------
+
+
+def register_peer(service, host_id, task_id, peer_id, url="http://o/x"):
+    from dragonfly2_tpu.scheduler.resource.host import Host
+
+    if service.resource.host_manager.load(host_id) is None:
+        service.announce_host(Host(id=host_id, ip="10.0.0.1",
+                                   download_port=8001))
+    return service.register_peer(RegisterPeerRequest(
+        host_id=host_id, task_id=task_id, peer_id=peer_id, url=url))
+
+
+class TestClaimServiceSurface:
+    def test_two_claimants_disjoint_and_parents_offered(self, tmp_path):
+        service = make_scheduler(tmp_path)
+        register_peer(service, "h1", "t1", "p1")
+        register_peer(service, "h2", "t1", "p2")
+        r1 = service.claim_source_run(SourceClaimRequest(
+            peer_id="p1", task_id="t1", total_pieces=16, run_len=8))
+        r2 = service.claim_source_run(SourceClaimRequest(
+            peer_id="p2", task_id="t1", total_pieces=16, run_len=8))
+        a = set(range(r1.first, r1.first + r1.count))
+        b = set(range(r2.first, r2.first + r2.count))
+        assert a and b and not a & b
+        # p1 lands pieces → p2's next claim reply offers p1 as a
+        # partial parent (it HOLDS pieces now).
+        peer1 = service.resource.peer_manager.load("p1")
+        peer1.fsm.fire("Download")
+        for n in sorted(a):
+            service.download_piece_finished(PieceFinished(
+                peer_id="p1", piece_number=n, parent_id="",
+                offset=n * 4, length=4, traffic_type="back_to_source"))
+        r3 = service.claim_source_run(SourceClaimRequest(
+            peer_id="p2", task_id="t1", total_pieces=16, run_len=8))
+        assert ("p1", "10.0.0.1:8001") in r3.parents
+
+    def test_landed_reports_mark_ledger(self, tmp_path):
+        service = make_scheduler(tmp_path)
+        register_peer(service, "h1", "t2", "p1")
+        register_peer(service, "h2", "t2", "p2")
+        service.claim_source_run(SourceClaimRequest(
+            peer_id="p1", task_id="t2", total_pieces=8, run_len=2))
+        peer2 = service.resource.peer_manager.load("p2")
+        peer2.fsm.fire("Download")
+        # p2 (mesh) reports every piece → the ledger drains to done.
+        service.download_pieces_finished([
+            PieceFinished(peer_id="p2", piece_number=n, parent_id="x",
+                          offset=n, length=1)
+            for n in range(8)
+        ])
+        reply = service.claim_source_run(SourceClaimRequest(
+            peer_id="p1", task_id="t2", total_pieces=8, run_len=2))
+        assert reply.done and reply.first < 0
+
+    def test_probe_claims_nothing(self, tmp_path):
+        service = make_scheduler(tmp_path)
+        register_peer(service, "h1", "t3", "p1")
+        reply = service.claim_source_run(SourceClaimRequest(
+            peer_id="p1", task_id="t3", run_len=0))
+        assert reply.first < 0 and not reply.wait and not reply.done
+        # No ledger was created by the probe.
+        task = service.resource.task_manager.load("t3")
+        assert task.source_claims is None
+
+    def test_b2s_failure_releases_leases(self, tmp_path):
+        service = make_scheduler(tmp_path)
+        register_peer(service, "h1", "t4", "p1")
+        register_peer(service, "h2", "t4", "p2")
+        g = service.claim_source_run(SourceClaimRequest(
+            peer_id="p1", task_id="t4", total_pieces=8, run_len=8))
+        assert g.count == 8
+        peer1 = service.resource.peer_manager.load("p1")
+        peer1.fsm.fire("Download")
+        service.download_peer_back_to_source_started("p1")
+        service.download_peer_back_to_source_failed("p1")
+        g2 = service.claim_source_run(SourceClaimRequest(
+            peer_id="p2", task_id="t4", total_pieces=8, run_len=8))
+        assert g2.count == 8  # p1's leases were freed immediately
+
+
+# ----------------------------------------------------------------------
+# Rarest-first dispatcher
+# ----------------------------------------------------------------------
+
+
+class TestRarestFirstDispatch:
+    @staticmethod
+    def _req(parent, num):
+        from dragonfly2_tpu.client.downloader import DownloadPieceRequest
+        from dragonfly2_tpu.client.piece import PieceMetadata
+
+        return DownloadPieceRequest(
+            task_id="task", src_peer_id="me", dst_peer_id=parent,
+            dst_addr="127.0.0.1:1", piece=PieceMetadata(
+                num=num, md5="", offset=num, start=num, length=1))
+
+    def test_rarest_piece_served_first(self):
+        from dragonfly2_tpu.client.downloader import PieceDispatcher
+
+        avail = {0: 3, 1: 1, 2: 2}
+        d = PieceDispatcher(random_ratio=0.0, seed=1,
+                            rarity_fn=lambda n: avail.get(n, 0))
+        for num in (0, 1, 2, 3):  # 3 has availability 0 — rarest
+            d.put(self._req("parent", num))
+        order = [d.get(timeout=0.1).piece.num for _ in range(4)]
+        assert order == [3, 1, 2, 0]
+
+    def test_no_rarity_fn_keeps_uniform_order(self):
+        from dragonfly2_tpu.client.downloader import PieceDispatcher
+
+        d = PieceDispatcher(random_ratio=0.0, seed=1)
+        for num in range(4):
+            d.put(self._req("parent", num))
+        got = {d.get(timeout=0.1).piece.num for _ in range(4)}
+        assert got == {0, 1, 2, 3}
+
+
+# ----------------------------------------------------------------------
+# "Not yet" handling — parked, never punished (ISSUE 9 satellite fix)
+# ----------------------------------------------------------------------
+
+
+class TestNotReadyHandling:
+    def test_upload_server_distinguishes_not_ready(self, tmp_path):
+        """A known-but-still-filling store answers 404 +
+        X-Df2-Not-Ready; an unknown task answers a plain 404."""
+        import http.client
+
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+        from dragonfly2_tpu.client.upload import UploadServer
+
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "s"), keep_storage=False))
+        store = storage.register_task("t" * 32, "peer-1")
+        store.update(content_length=1 << 20, total_pieces=4)
+        server = UploadServer(storage, host="127.0.0.1")
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", f"/download/{'t' * 3}/{'t' * 32}"
+                                "?peerId=peer-1",
+                         headers={"Range": "bytes=0-65535"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 404, body
+            assert resp.getheader("X-Df2-Not-Ready") == "1"
+            conn.request("GET", f"/download/{'u' * 3}/{'u' * 32}"
+                                "?peerId=nobody",
+                         headers={"Range": "bytes=0-65535"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 404
+            assert resp.getheader("X-Df2-Not-Ready") is None
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_downloader_raises_not_ready(self, tmp_path):
+        from dragonfly2_tpu.client.downloader import (
+            DownloadPieceError,
+            PieceDownloader,
+        )
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+        from dragonfly2_tpu.client.upload import UploadServer
+
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "s"), keep_storage=False))
+        store = storage.register_task("v" * 32, "peer-1")
+        store.update(content_length=1 << 20, total_pieces=4)
+        server = UploadServer(storage, host="127.0.0.1")
+        server.start()
+        dl = PieceDownloader()
+        try:
+            req = TestRarestFirstDispatch._req("peer-1", 0)
+            req = type(req)(task_id="v" * 32, src_peer_id="me",
+                            dst_peer_id="peer-1",
+                            dst_addr=f"127.0.0.1:{server.port}",
+                            piece=req.piece)
+            with pytest.raises(DownloadPieceError) as err:
+                dl.fetch(req, os.open(os.devnull, os.O_WRONLY))
+            assert err.value.not_ready
+        finally:
+            dl.close()
+            server.stop()
+
+    def test_conductor_parks_not_ready_without_penalty(self, tmp_path):
+        """A not-ready piece must neither tick the corruption/blacklist
+        counters nor burn the per-piece retry budget; the piece is
+        re-offered on the next sync."""
+        from dragonfly2_tpu.client.downloader import DownloadPieceError
+        from dragonfly2_tpu.client.peer_task import (
+            PeerTaskConductor,
+            PeerTaskOptions,
+        )
+        from dragonfly2_tpu.client.recovery import RecoveryStats
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+
+        recovery = RecoveryStats()
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "c"), keep_storage=False))
+        conductor = PeerTaskConductor(
+            scheduler=None, storage=storage, host_id="h",
+            task_id="w" * 32, peer_id="child", url="http://o/x",
+            options=PeerTaskOptions(), recovery_stats=recovery)
+        req = TestRarestFirstDispatch._req("parent-1", 3)
+        with conductor._written_lock:
+            conductor._enqueued.add(3)
+        assert conductor._note_piece_not_ready(req) is True
+        assert recovery.get("piece_not_ready_parks") == 1
+        assert recovery.get("md5_mismatch_pieces") == 0
+        assert recovery.get("piece_retries") == 0
+        with conductor._written_lock:
+            assert 3 not in conductor._enqueued  # re-offerable
+            assert conductor._piece_attempts.get(3, 0) == 0
+        assert "parent-1" not in conductor._banned_parents
+        # The bounded escape hatch: past the limit it is a real failure.
+        conductor.opts.piece_not_ready_limit = 2
+        assert conductor._note_piece_not_ready(req) is True
+        assert conductor._note_piece_not_ready(req) is False
+        err = DownloadPieceError("x", not_ready=True)
+        assert err.not_ready and not err.fatal
+
+    def test_metadata_404_within_grace_not_counted(self, tmp_path):
+        """A parent offered before it created its store 404s its
+        metadata endpoint: within the grace that is a benign poll, not
+        a failure toward the sync giveup budget."""
+        from dragonfly2_tpu.client.peer_task import (
+            ParentInfo,
+            PeerTaskConductor,
+            PeerTaskOptions,
+        )
+        from dragonfly2_tpu.client.recovery import RecoveryStats
+        from dragonfly2_tpu.client.storage import (
+            StorageManager,
+            StorageOptions,
+        )
+        from dragonfly2_tpu.client.upload import UploadServer
+
+        recovery = RecoveryStats()
+        storage = StorageManager(StorageOptions(
+            root=str(tmp_path / "m"), keep_storage=False))
+        server = UploadServer(storage, host="127.0.0.1")  # knows no task
+        server.start()
+        conductor = PeerTaskConductor(
+            scheduler=None, storage=storage, host_id="h",
+            task_id="x" * 32, peer_id="child", url="http://o/x",
+            options=PeerTaskOptions(
+                metadata_poll_interval=0.02, metadata_retry_limit=2,
+                metadata_not_ready_grace=0.5),
+            recovery_stats=recovery)
+        try:
+            t = threading.Thread(
+                target=conductor._sync_parent,
+                args=(ParentInfo("parent-x", f"127.0.0.1:{server.port}"),),
+                daemon=True)
+            t.start()
+            time.sleep(0.3)
+            # Still inside the grace: polling, not giving up.
+            assert t.is_alive()
+            assert recovery.get("metadata_not_ready_polls") >= 2
+            assert recovery.get("metadata_sync_giveups") == 0
+            t.join(timeout=3.0)
+            # Past the grace the normal budget applies and the syncer
+            # exits (scheduler=None would raise on the report — the
+            # giveup path tolerates that via _report_piece_failed).
+            assert not t.is_alive()
+        finally:
+            conductor._shutdown_workers()
+            server.stop()
+
+
+# ----------------------------------------------------------------------
+# Hybrid fan-out end-to-end (origin egress ≈ 1×)
+# ----------------------------------------------------------------------
+
+
+class BytesCountingFileServer(FileServer):
+    pass
+
+
+class TestHybridFanOutE2E:
+    def test_concurrent_cold_starters_share_origin(self, tmp_path):
+        """Four daemons cold-start the same task concurrently: every
+        copy md5-exact, and the origin's ranged GETs cover the file
+        ≈once (disjoint claims), not once per daemon."""
+        from dragonfly2_tpu.client import peer_task as peer_task_mod
+        from dragonfly2_tpu.client.fanoutbench import (
+            ThrottledCheckpointOrigin,
+        )
+
+        blob = os.urandom(3 * 1024 * 1024)
+        prev = peer_task_mod.compute_piece_size
+        peer_task_mod.compute_piece_size = lambda n: 256 * 1024
+        scheduler = make_scheduler(tmp_path)
+        daemons = [make_daemon(scheduler, tmp_path, f"fan-{i}")
+                   for i in range(4)]
+        try:
+            with ThrottledCheckpointOrigin(
+                    {"/f/blob": blob}, rate_bps=1 << 30) as origin:
+                results = []
+
+                def dl(d):
+                    results.append(d.download_file(origin.url("/f/blob")))
+
+                threads = [threading.Thread(target=dl, args=(d,))
+                           for d in daemons]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                want = hashlib.md5(blob).hexdigest()
+                for r in results:
+                    assert r.success, r.error
+                    assert hashlib.md5(r.read_all()).hexdigest() == want
+                counters = origin.counters()
+            # ≈1× egress: well under 2 full copies even with probe
+            # overlap (the stampede baseline would be 4×).
+            assert counters["bytes_served"] < 2 * len(blob), counters
+            snap = scheduler.stats.snapshot()
+            assert snap["source_claims_granted"] >= 1
+        finally:
+            peer_task_mod.compute_piece_size = prev
+            for d in daemons:
+                d.stop()
+
+    def test_degrade_path_without_scheduler_still_completes(self, tmp_path):
+        """Register failure (no claims possible) keeps the pre-ISSUE-9
+        local sequential behavior."""
+        blob = os.urandom(1 * 1024 * 1024 + 7)
+        root = tmp_path / "origin"
+        root.mkdir()
+        (root / "solo.bin").write_bytes(blob)
+
+        class DeadScheduler:
+            """Announce works (daemon.start needs it); every download-
+            path call fails — the conductor's register-failed degrade."""
+
+            def announce_host(self, host):
+                return None
+
+            def __getattr__(self, name):
+                def boom(*a, **k):
+                    raise ConnectionError("scheduler down")
+                return boom
+
+        with FileServer(str(root)) as fs:
+            daemon = make_daemon(DeadScheduler(), tmp_path, "solo")
+            try:
+                result = daemon.download_file(fs.url("solo.bin"))
+                assert result.success, result.error
+                assert result.read_all() == blob
+            finally:
+                daemon.stop()
+
+
+# ----------------------------------------------------------------------
+# Bench harness + regression gate
+# ----------------------------------------------------------------------
+
+
+class TestFanoutBench:
+    def test_tiny_rung_reports_all_metrics(self, tmp_path):
+        from dragonfly2_tpu.client import peer_task as peer_task_mod
+        from dragonfly2_tpu.client.fanoutbench import (
+            make_checkpoint,
+            run_fanout_rung,
+        )
+
+        prev = peer_task_mod.compute_piece_size
+        peer_task_mod.compute_piece_size = lambda n: 256 * 1024
+        try:
+            blobs = make_checkpoint(2, 1 << 20, seed=5)
+            out = run_fanout_rung(2, blobs, origin_rate_bps=1 << 30,
+                                  seed=5, root=str(tmp_path / "rung"))
+        finally:
+            peer_task_mod.compute_piece_size = prev
+        assert out["success_rate"] == 1.0, out["failures"]
+        assert out["ttlb_s"] > 0
+        assert out["origin_amplification"] <= 2.0
+        assert out["p2p_share"] > 0
+        for key in ("per_daemon_mb_per_s_p50", "origin_requests",
+                    "claims", "p2p_bytes", "source_bytes"):
+            assert key in out
+
+    def test_regression_gate_fails_on_synthetic_regression(
+            self, tmp_path, monkeypatch):
+        import json
+
+        from dragonfly2_tpu.client import fanoutbench
+
+        state_dir = tmp_path / "state"
+        state_dir.mkdir()
+        record = {
+            "verdict_pass": True,
+            "rungs": [4, 16, 32],
+            "ladder": {"32": {"ttlb_s": 50.0,
+                              "origin_amplification": 1.1}},
+        }
+        (state_dir / "fanout_run_best.json").write_text(json.dumps(record))
+
+        def fresh(result):
+            return {
+                "rungs": [4, 16, 32], "verdict_pass": True,
+                "ttlb_ratio": 2.0,
+                "ladder": {"32": result},
+            }
+
+        # Healthy fresh run: inside 1/fraction of the record → pass.
+        monkeypatch.setattr(
+            fanoutbench, "run_fanout_ladder",
+            lambda **kw: fresh({"ttlb_s": 60.0,
+                                "origin_amplification": 1.2}))
+        out = fanoutbench.check_fanout_regression(str(state_dir))
+        assert out["passed"], out
+        # TTLB collapsed past 2× the record → gate fails.
+        monkeypatch.setattr(
+            fanoutbench, "run_fanout_ladder",
+            lambda **kw: fresh({"ttlb_s": 150.0,
+                                "origin_amplification": 1.2}))
+        out = fanoutbench.check_fanout_regression(str(state_dir))
+        assert not out["passed"], out
+        # Amplification collapsed → gate fails.
+        monkeypatch.setattr(
+            fanoutbench, "run_fanout_ladder",
+            lambda **kw: fresh({"ttlb_s": 60.0,
+                                "origin_amplification": 2.5}))
+        assert not fanoutbench.check_fanout_regression(
+            str(state_dir))["passed"]
+        # Lost verdict → gate fails regardless of numbers.
+        bad = fresh({"ttlb_s": 60.0, "origin_amplification": 1.2})
+        bad["verdict_pass"] = False
+        monkeypatch.setattr(fanoutbench, "run_fanout_ladder",
+                            lambda **kw: bad)
+        assert not fanoutbench.check_fanout_regression(
+            str(state_dir))["passed"]
+
+    def test_skipped_rung_withholds_verdict(self, tmp_path, monkeypatch):
+        from dragonfly2_tpu.client import fanoutbench
+
+        calls = []
+
+        def fake_rung(n, blobs, **kw):
+            calls.append(n)
+            return {"success_rate": 1.0, "ttlb_s": 1.0,
+                    "origin_amplification": 1.0, "origin_bytes": 0,
+                    "p2p_share": 1.0, "failures": []}
+
+        monkeypatch.setattr(fanoutbench, "run_fanout_rung", fake_rung)
+        out = fanoutbench.run_fanout_ladder(
+            rungs=(2, 4), shards=1, shard_bytes=1 << 20,
+            time_left=lambda: 0.0)
+        assert calls == []
+        assert out["skipped_rungs"]
+        assert "verdict_pass" not in out
+
+
+class TestPartialParentScheduling:
+    def test_find_partial_parents_requires_pieces(self, tmp_path):
+        service = make_scheduler(tmp_path)
+        register_peer(service, "h1", "t9", "rich")
+        register_peer(service, "h2", "t9", "poor")
+        register_peer(service, "h3", "t9", "asker")
+        rich = service.resource.peer_manager.load("rich")
+        rich.fsm.fire("Download")
+        rich.finished_pieces.update(range(4))
+        asker = service.resource.peer_manager.load("asker")
+        got = service.scheduling.find_partial_parents(asker, set())
+        ids = {p.id for p in got}
+        assert "rich" in ids and "poor" not in ids

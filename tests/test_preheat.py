@@ -142,6 +142,80 @@ class TestPreheatE2E:
             peer.stop()
             seed.stop()
 
+    def test_rest_job_preheat_pipeline_zero_origin(self, tmp_path):
+        """The whole production pipeline, REST-first (ISSUE 9 satellite):
+        POST /api/v1/jobs type=preheat → manager job plane → scheduler
+        seed-peer trigger → seed daemon back-sources + re-announces →
+        a child daemon then completes the task with ZERO origin
+        requests (asserted via the fileserver's request counters)."""
+        import time
+
+        from dragonfly2_tpu.manager import (
+            Database,
+            FilesystemObjectStore,
+            ManagerService,
+        )
+        from dragonfly2_tpu.manager.auth import (
+            AuthService,
+            DEFAULT_ROOT_PASSWORD,
+            DEFAULT_ROOT_USER,
+        )
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        blob = os.urandom(2 * 1024 * 1024 + 99)
+        (tmp_path / "ckpt.bin").write_bytes(blob)
+        scheduler = make_scheduler(tmp_path)
+        seed = make_daemon(scheduler, tmp_path, "rest-seed",
+                           HostType.SUPER_SEED)
+        scheduler.seed_peer_client = seed.seed_client()
+        bus = JobBus()
+        SchedulerJobWorker(bus, scheduler, scheduler_id=11).serve()
+        manager = ManagerService(
+            Database(":memory:"),
+            FilesystemObjectStore(str(tmp_path / "objects")))
+        auth = AuthService(manager.db, secret="s")
+        api = RestApi(manager, auth=auth, preheat=PreheatService(bus))
+        code, payload = api.dispatch(
+            "POST", "/api/v1/users/signin", {},
+            {"name": DEFAULT_ROOT_USER, "password": DEFAULT_ROOT_PASSWORD})
+        assert code == 200, payload
+        token = "Bearer " + payload["token"]
+        child = make_daemon(scheduler, tmp_path, "rest-child")
+        try:
+            with FileServer(str(tmp_path)) as fs:
+                url = fs.url("ckpt.bin")
+                code, payload = api.dispatch(
+                    "POST", "/api/v1/jobs", {},
+                    {"type": "preheat", "args": {"url": url},
+                     "scheduler_ids": [11]},
+                    authorization=token)
+                assert code == 200, payload
+                job_id = payload["ids"][0]
+                deadline = time.monotonic() + 60
+                state = "PENDING"
+                while state == "PENDING" and time.monotonic() < deadline:
+                    code, status = api.dispatch(
+                        "GET", f"/api/v1/jobs/{job_id}", {}, {},
+                        authorization=token)
+                    assert code == 200, status
+                    state = status["state"]
+                    time.sleep(0.05)
+                assert state == "SUCCESS", status
+                # The seed warmed the task off the origin; from here the
+                # fleet must never touch it again.
+                fs.reset_counters()
+                result = child.download_file(url)
+                assert result.success, result.error
+                assert hashlib.md5(result.read_all()).hexdigest() == \
+                    hashlib.md5(blob).hexdigest()
+                assert fs.request_count == 0, (
+                    f"preheated fleet touched origin "
+                    f"({fs.request_count} requests)")
+        finally:
+            bus.stop()
+            child.stop()
+            seed.stop()
+
     def test_preheat_without_seed_fails_group(self, tmp_path):
         scheduler = make_scheduler(tmp_path)  # no seed client
         bus = JobBus()

@@ -169,6 +169,44 @@ class TestProxyE2E:
                 proxy.stop()
                 daemon.stop()
 
+    def test_registry_mirror_second_pull_is_cache_hit(self, tmp_path):
+        """ISSUE-9 satellite (ROADMAP item 4's second rung, smoke
+        scope): one blob pull through the P2P path against a fake
+        registry, then a SECOND pull of the same blob served entirely
+        from the daemon's completed task storage — the registry sees no
+        further blob requests."""
+        from tests.test_preheat import write_registry
+
+        content = os.urandom(2 * 1024 * 1024 + 5)
+        digest = "sha256:" + "d" * 64
+        name = write_registry(tmp_path, {digest: content})
+        scheduler = make_scheduler(tmp_path)
+        daemon = make_daemon(scheduler, tmp_path, "mirror-hit-peer")
+        with FileServer(str(tmp_path)) as fs:
+            proxy = ProxyServer(daemon, ProxyConfig(
+                registry_mirror=RegistryMirror(
+                    remote=f"http://127.0.0.1:{fs.port}")))
+            proxy.start()
+            try:
+                url = (f"http://127.0.0.1:{proxy.port}"
+                       f"/v2/{name}/blobs/{digest}")
+                want = hashlib.sha256(content).hexdigest()
+                with urllib.request.urlopen(url, timeout=60) as resp:
+                    first = resp.read()
+                    assert resp.headers.get(HEADER_TASK_ID)
+                assert hashlib.sha256(first).hexdigest() == want
+                fs.reset_counters()
+                with urllib.request.urlopen(url, timeout=60) as resp:
+                    second = resp.read()
+                    assert resp.headers.get(HEADER_TASK_ID)
+                assert hashlib.sha256(second).hexdigest() == want
+                assert fs.request_count == 0, (
+                    "second pull must be a cache hit, registry saw "
+                    f"{fs.request_count} requests")
+            finally:
+                proxy.stop()
+                daemon.stop()
+
     def test_basic_auth(self, tmp_path):
         scheduler = make_scheduler(tmp_path)
         daemon = make_daemon(scheduler, tmp_path, "auth-peer")

@@ -102,15 +102,27 @@ class TestFindCandidateParents:
         assert parents[0].id not in {p.id for p in got}
 
     def test_rootless_normal_parent_filtered(self):
-        # A normal-host running parent with no in-edges and no
-        # back-to-source can't source pieces.
+        # A normal-host running parent with no in-edges, no
+        # back-to-source AND no piece inventory can't source pieces.
         _, parents, child = make_cluster(1, succeeded=False)
+        parents[0].finished_pieces.clear()
         got = scheduling().find_candidate_parents(child, set())
         assert got == []
         # ... but the same peer on a seed host is fine.
         _, parents, child = make_cluster(1, seed=True, succeeded=False)
+        parents[0].finished_pieces.clear()
         got = scheduling().find_candidate_parents(child, set())
         assert len(got) == 1
+
+    def test_partial_parent_with_pieces_offered(self):
+        # ISSUE 9: partial peers serve while downloading — a Running
+        # rootless peer that HOLDS verified pieces (claim-granted origin
+        # run, crash-journal resume) is a valid parent; children sync
+        # its live inventory from the upload server's /metadata.
+        _, parents, child = make_cluster(1, succeeded=False)
+        assert parents[0].finished_piece_count() > 0
+        got = scheduling().find_candidate_parents(child, set())
+        assert [p.id for p in got] == [parents[0].id]
 
     def test_no_free_upload_filtered(self):
         _, parents, child = make_cluster(1)
