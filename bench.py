@@ -563,18 +563,36 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
 
 @stage("scheduler", min_left=15.0)
 def stage_scheduler(state: BenchState, ctx: dict) -> None:
-    """Scheduler control plane — in-process swarm load ladder against
-    the real SchedulerService (sharded managers + incremental GC + O(1)
-    peer statistics). Pure CPU, no device. Reports announce→first-
-    decision p50/p99, decisions/sec, piece-reports/sec and GC pause p99
-    per swarm size; the documented bound (docs/SCHEDULER.md) is
-    largest-rung decision p99 within LADDER_P99_BOUND× of the smallest
-    rung."""
+    """Scheduler control plane — two ladders:
+
+    1. the in-process swarm ladder against one real SchedulerService
+       (sharded managers + incremental GC + O(1) peer statistics), now
+       extended to a 25k single-replica rung when budget allows, each
+       rung reporting the peak-RSS + bytes/peer gauges next to the
+       pre-slimming baseline;
+    2. the ISSUE-11 CLUSTER ladder (scheduler/clusterbench.py): a
+       4-replica subprocess cluster driven over real gRPC through the
+       BalancedSchedulerClient, baseline rung + big rung with a
+       mid-swarm replica SIGKILL, bounding announce p99 across the
+       cluster by the same LADDER_P99_BOUND and the re-route p99 by
+       the chaos-plane grace.
+
+    Budget-starved rungs record explicit skips (never a silent pass);
+    a green run persists to artifacts/bench_state/scheduler_run_*.json
+    — the record `bench.py scheduler --check-regression` gates against.
+    `--rungs` / `--cluster-peers` override the shapes from the CLI."""
     left = ctx["left"]
 
     from dragonfly2_tpu.scheduler.loadbench import run_swarm_ladder
 
-    sizes = (100, 1000, 5000) if left() > 30.0 else (100, 500, 1500)
+    if ctx.get("rungs"):
+        sizes = tuple(ctx["rungs"])
+    elif left() > 240.0:
+        sizes = (100, 1000, 5000, 25000)
+    elif left() > 30.0:
+        sizes = (100, 1000, 5000)
+    else:
+        sizes = (100, 500, 1500)
     sched = run_swarm_ladder(sizes, workers=8)
     ladder = sched["ladder"]
     largest = ladder[str(sizes[-1])]
@@ -589,6 +607,10 @@ def stage_scheduler(state: BenchState, ctx: dict) -> None:
         scheduler_gc_budget_overruns=largest["gc_budget_overruns"],
         scheduler_bad_node_fast=largest["bad_node_fast"],
         scheduler_bad_node_slow=largest["bad_node_slow"],
+        scheduler_peak_rss_mb=largest["peak_rss_mb"],
+        scheduler_bytes_per_peer=largest["bytes_per_peer"],
+        scheduler_bytes_per_peer_pre_slim=largest[
+            "bytes_per_peer_pre_slim_baseline"],
         scheduler_decision_p99_ratio=sched["decision_p99_ratio"],
         scheduler_ladder_p99_bound=sched["ladder_p99_bound"],
         scheduler_p99_within_bound=sched["p99_within_bound"],
@@ -599,11 +621,111 @@ def stage_scheduler(state: BenchState, ctx: dict) -> None:
                 "piece_reports_per_sec", "back_to_source",
                 "filter_ms_p99", "evaluate_ms_p99", "gc_ticks",
                 "gc_pause_p50_ms", "gc_pause_p99_ms",
-                "gc_budget_overruns", "gc_reclaimed", "tasks",
-                "workers", "errors")}
+                "gc_budget_overruns", "gc_reclaimed", "peak_rss_mb",
+                "peak_rss_scope", "rss_delta_mb", "bytes_per_peer",
+                "bytes_per_peer_pre_slim_baseline", "tasks",
+                "peers_per_task", "workers", "errors")}
             for size, v in ladder.items()},
     )
+
+    # -- cluster ladder (multi-process, real gRPC) ----------------------
+    # The full 100k rung is a ~10-minute drive on a small box; scale the
+    # rung to the remaining budget and record the scale explicitly. The
+    # persisted 100k green run comes from `BENCH_BUDGET_S=1800 bench.py
+    # scheduler` (or --cluster-peers 100000).
+    cluster = None
+    # In a FULL bench run the chaos/fanout stages still need their
+    # budget after this one — the cluster ladder may claim only a
+    # share of what's left; a single-stage `bench.py scheduler` run
+    # owns the whole budget.
+    cluster_budget = (left() - 25.0 if ctx.get("single_stage")
+                      else min(left() * 0.3, 240.0))
+    if ctx.get("cluster_peers") is not None:
+        cluster_peers = int(ctx["cluster_peers"])
+    elif cluster_budget > 1000.0:
+        cluster_peers = 100_000
+    elif cluster_budget > 400.0:
+        cluster_peers = 20_000
+    elif cluster_budget > 150.0:
+        cluster_peers = 4_000
+    else:
+        cluster_peers = 0
+    if cluster_peers <= 0:
+        state.record(scheduler_cluster_skipped=True)
+    else:
+        from dragonfly2_tpu.scheduler.clusterbench import run_cluster_ladder
+
+        cluster = run_cluster_ladder(
+            cluster_peers=cluster_peers, replicas=4,
+            kill_replica=True,
+            deadline_s=max(min(cluster_budget, left() - 25.0), 30.0))
+        big = cluster.get("cluster")
+        state.record(
+            scheduler_cluster_peers=cluster_peers,
+            scheduler_cluster_baseline_p99_ms=cluster["baseline"][
+                "announce_p99_ms"],
+            scheduler_cluster_baseline_samples=cluster["baseline"][
+                "samples"],
+        )
+        if big is not None:
+            state.record(
+                scheduler_cluster_replicas=big["replicas"],
+                scheduler_cluster_seconds=big["seconds"],
+                scheduler_cluster_announce_p50_ms=big["announce_p50_ms"],
+                scheduler_cluster_announce_p99_ms=big["announce_p99_ms"],
+                scheduler_cluster_decisions_per_sec=big[
+                    "decisions_per_sec"],
+                scheduler_cluster_success_rate=big["success_rate"],
+                scheduler_cluster_bytes_per_peer=big[
+                    "bytes_per_peer_cluster"],
+                scheduler_cluster_p99_ratio=cluster.get(
+                    "cluster_p99_ratio"),
+                scheduler_cluster_p99_bound=cluster["ladder_p99_bound"],
+                scheduler_cluster_kill=big.get("killed"),
+                scheduler_cluster_reroutes=big.get("reroutes"),
+                scheduler_cluster_reroute_p99_ms=big.get("reroute_p99_ms"),
+                scheduler_cluster_reroute_bound_s=big.get(
+                    "reroute_bound_s"),
+                scheduler_cluster_sessions_rehomed=big.get(
+                    "sessions_rehomed"),
+                scheduler_cluster_kill_verdict_pass=big.get(
+                    "kill_verdict_pass"),
+                scheduler_cluster_recovery=big["recovery_counters"],
+                scheduler_cluster_failovers=big["recovery_counters"][
+                    "scheduler_failovers"],
+                scheduler_cluster_per_replica=big["per_replica"],
+            )
+        if cluster.get("verdict_skipped_budget"):
+            state.record(scheduler_cluster_verdict_skipped=True)
+        else:
+            state.record(
+                scheduler_cluster_p99_within_bound=cluster[
+                    "p99_within_bound"],
+                scheduler_cluster_verdict_pass=cluster["verdict_pass"])
+
+    ladder_green = bool(sched["p99_within_bound"]
+                        and not largest["errors"])
+    # A budget-skipped cluster ladder is an EXPLICIT skip (recorded
+    # above), not a failure: the overall verdict covers what ran — the
+    # same contract as cluster_peers=0. Only an actually-failed cluster
+    # verdict turns the run red.
+    cluster_skipped = (cluster is not None
+                      and bool(cluster.get("verdict_skipped_budget")))
+    cluster_green = (cluster is not None
+                     and cluster.get("verdict_pass") is True)
+    green = bool(ladder_green
+                 and (cluster is None or cluster_skipped or cluster_green))
+    state.record(scheduler_verdict_pass=green)
     state.stage_done("scheduler")
+    if green:
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"scheduler_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"ladder": sched,
+             "cluster": (cluster if cluster is not None
+                         and not cluster_skipped
+                         else {"skipped": True})})
 
 
 @stage("chaos", min_left=15.0)
@@ -769,15 +891,20 @@ def stage_fanout(state: BenchState, ctx: dict) -> None:
 
 
 def run_stages(state: BenchState, platform: str, budget: float,
-               only: str | None = None) -> None:
+               only: str | None = None,
+               stage_opts: dict | None = None) -> None:
     """Drive the registry. ``only`` runs a single named stage (plus the
-    init stage when it needs a device) — the `bench.py <stage>` path."""
+    init stage when it needs a device) — the `bench.py <stage>` path.
+    ``stage_opts`` carries CLI per-stage options (e.g. the scheduler
+    stage's ``rungs``/``cluster_peers``) into the stage ctx."""
     t_start = time.perf_counter()
 
     def left() -> float:
         return budget - (time.perf_counter() - t_start)
 
-    ctx: dict = {"platform": platform, "left": left}
+    ctx: dict = {"platform": platform, "left": left,
+                 "single_stage": only is not None}
+    ctx.update(stage_opts or {})
     wanted = None
     if only is not None:
         by_name = {s.name: s for s in STAGES}
@@ -1089,15 +1216,40 @@ def main() -> None:
         state.emit()
 
 
-def single_stage_main(name: str) -> None:
+def single_stage_main(name: str, stage_opts: dict | None = None) -> None:
     """`bench.py <stage>`: run ONE registry stage on the CPU platform
     with the full budget and print its extras as the JSON line — the
     entry the driver (and a human) uses to gate a single ladder, e.g.
-    `bench.py chaos`."""
+    `bench.py chaos` or `bench.py scheduler --rungs 100,1000`."""
     state = BenchState(os.path.join(STATE_DIR, f"stage_{name}.json"))
     os.makedirs(STATE_DIR, exist_ok=True)
-    run_stages(state, "cpu", BUDGET_S, only=name)
+    run_stages(state, "cpu", BUDGET_S, only=name, stage_opts=stage_opts)
     state.emit()
+
+
+def parse_stage_opts(argv: list) -> dict:
+    """Per-stage CLI options after the stage name. ``--rungs 100,1000``
+    trims the scheduler's in-process ladder without editing source (the
+    dev-box path); ``--cluster-peers N`` pins the cluster-rung swarm
+    size (0 skips the cluster ladder)."""
+    opts: dict = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--rungs" and i + 1 < len(argv):
+            # Sorted + deduped: the ladder verdict compares LAST rung
+            # against FIRST — a descending list would invert the ratio
+            # and trivially green-light a contention regression.
+            opts["rungs"] = sorted(
+                {int(s) for s in argv[i + 1].split(",") if s})
+            i += 2
+        elif arg == "--cluster-peers" and i + 1 < len(argv):
+            opts["cluster_peers"] = int(argv[i + 1])
+            i += 2
+        else:
+            raise SystemExit(f"unknown stage option {arg!r} "
+                             "(have: --rungs N,N,..., --cluster-peers N)")
+    return opts
 
 
 def check_regression_main(stage_name: str) -> None:
@@ -1112,7 +1264,10 @@ def check_regression_main(stage_name: str) -> None:
       goodput-retention collapse fails the gate.
     - ``fanout``: fresh dissemination ladder vs the best recorded
       fanout run (docs/FANOUT.md) — a lost verdict or a 2× TTLB /
-      amplification collapse fails the gate."""
+      amplification collapse fails the gate.
+    - ``scheduler``: fresh top-rung swarm run vs the best recorded
+      scheduler run (docs/SCHEDULER.md) — under 0.5× the recorded
+      decisions/sec or over 2× the recorded announce p99 fails."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.uploadbench import check_regression
 
@@ -1125,10 +1280,16 @@ def check_regression_main(stage_name: str) -> None:
         from dragonfly2_tpu.client.fanoutbench import check_fanout_regression
 
         result = check_fanout_regression(STATE_DIR)
+    elif stage_name == "scheduler":
+        from dragonfly2_tpu.scheduler.loadbench import (
+            check_scheduler_regression,
+        )
+
+        result = check_scheduler_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
-            "(have: dataplane, chaos, fanout)")
+            "(have: dataplane, chaos, fanout, scheduler)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 
@@ -1139,7 +1300,7 @@ if __name__ == "__main__":
     elif (len(sys.argv) == 3
           and sys.argv[2] == "--check-regression"):
         check_regression_main(sys.argv[1])
-    elif len(sys.argv) == 2 and not sys.argv[1].startswith("-"):
-        single_stage_main(sys.argv[1])
+    elif len(sys.argv) >= 2 and not sys.argv[1].startswith("-"):
+        single_stage_main(sys.argv[1], parse_stage_opts(sys.argv[2:]))
     else:
         main()

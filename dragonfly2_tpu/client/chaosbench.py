@@ -251,10 +251,13 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
     return out
 
 
-def spawn_scheduler_replica(data_dir: str, startup_timeout: float = 30.0):
+def spawn_scheduler_replica(data_dir: str, startup_timeout: float = 30.0,
+                            extra_args: Sequence[str] = ()):
     """One scheduler replica as a REAL child process (``scheduler/
     replica.py``); returns (Popen, target). Killing it is the one
-    failure an in-process server can't reproduce."""
+    failure an in-process server can't reproduce. ``extra_args`` pass
+    replica CLI knobs through (the cluster bench sizes the worker pool
+    and GC to its swarm)."""
     import os
     import queue as queue_mod
     import subprocess
@@ -265,7 +268,7 @@ def spawn_scheduler_replica(data_dir: str, startup_timeout: float = 30.0):
     env.setdefault("JAX_PLATFORMS", "cpu")  # never probe a device
     proc = subprocess.Popen(
         [sys.executable, "-m", "dragonfly2_tpu.scheduler.replica",
-         "--data-dir", data_dir],
+         "--data-dir", data_dir, *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
         env=env)
     # A bare readline() hangs the whole bench if the child stalls

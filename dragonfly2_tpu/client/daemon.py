@@ -108,6 +108,12 @@ class Daemon:
             persist_interval_s=config.persist_interval_s,
             reload_verify=config.reload_verify,
         ), recovery=config.recovery_stats)
+        # A task whose LAST local replica was deleted (explicit delete
+        # or storage GC) must stop being announced as a seed: drop the
+        # balanced client's re-routable record (a membership change
+        # would otherwise re-announce the dark seed at a new owner) and
+        # the restart re-announce backlog entry.
+        self.storage.on_task_deleted = self._on_local_replica_deleted
         self.upload = UploadServer(
             self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
             metrics=self.metrics,
@@ -128,6 +134,14 @@ class Daemon:
         self._started = False
         self._conductors_lock = threading.Lock()
         self._conductors: Dict[str, PeerTaskConductor] = {}
+
+    def _on_local_replica_deleted(self, task_id: str) -> None:
+        backlog = getattr(self, "_reseed_backlog", None)
+        if backlog:
+            backlog.pop(task_id, None)
+        forget = getattr(self.scheduler, "forget_announced_task", None)
+        if forget is not None:
+            forget(task_id)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -55,6 +55,11 @@ just infer it from the absence of errors:
 - ``seed_tasks_reannounced`` — completed replicas a restarted daemon
   re-announced to the scheduler so it resumes serving as a parent
   instead of going dark.
+- ``seed_tasks_rerouted`` — announced completed replicas re-routed to
+  a task's NEW ring owner after a scheduler-membership change (the
+  cross-replica seed-visibility half of cluster scale-out: a
+  downloader whose task now hashes to a different replica must still
+  be offered this seed).
 
 ``recovery_p50_ms`` / ``recovery_p99_ms`` summarize piece-recovery
 latency: the time from a piece's FIRST failed fetch to its eventual
@@ -101,6 +106,7 @@ COUNTER_KEYS = (
     "tasks_resumed",
     "resume_pieces_reused",
     "seed_tasks_reannounced",
+    "seed_tasks_rerouted",
 )
 
 

@@ -619,6 +619,13 @@ class StorageManager:
         # manager lock on a long-lived seed. Entries are pruned at
         # adoption; the set is small and fixed after _reload.
         self._recovered_by_task: Dict[str, List[TaskStorage]] = {}
+        # Set by the owning daemon: called (task_id) once the LAST
+        # local replica of a task is deleted (explicit delete or GC) so
+        # announce-side state — the balanced client's re-routable seed
+        # record, the restart re-announce backlog — is dropped with it;
+        # a membership change must never re-announce a seed whose bytes
+        # are gone.
+        self.on_task_deleted = None
         if opts.keep_storage:
             self._reload()
 
@@ -930,6 +937,12 @@ class StorageManager:
         for tomb in tombstones:
             if tomb:
                 shutil.rmtree(tomb, ignore_errors=True)
+        if removed and not live and self.on_task_deleted is not None:
+            try:
+                self.on_task_deleted(task_id)
+            except Exception:  # noqa: BLE001 — observer only
+                logger.debug("on_task_deleted hook failed for %s",
+                             task_id, exc_info=True)
         return removed
 
     def _tombstone(self, directory: str) -> str:

@@ -24,11 +24,25 @@ import threading
 
 def build_replica(data_dir: str, *, host: str = "127.0.0.1", port: int = 0,
                   retry_interval: float = 0.01,
-                  retry_back_to_source_limit: int = 2):
-    """(service, server) — the same assembly the e2e tests use."""
+                  retry_back_to_source_limit: int = 2,
+                  resource_shards: int = 0, gc_budget_s: float = 0.0,
+                  gc_interval: float = 0.0, max_workers: int = 16,
+                  serve_gc: bool = False):
+    """(service, server) — the same assembly the e2e tests use.
+
+    The cluster-bench knobs mirror ``cmd/scheduler.py``:
+    ``resource_shards`` / ``gc_budget_s`` shape the sharded managers
+    (0 = manager defaults), ``max_workers`` sizes the gRPC pool (each
+    open AnnouncePeer stream holds a worker — a dense-swarm replica
+    needs more than the default 16, the fan-out bench lesson), and
+    ``serve_gc`` starts the interval GC so a long 100k rung reclaims
+    left peers instead of growing monotonically."""
     from dragonfly2_tpu.rpc import serve
     from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
-    from dragonfly2_tpu.scheduler.resource.resource import Resource
+    from dragonfly2_tpu.scheduler.resource.resource import (
+        Resource,
+        ResourceConfig,
+    )
     from dragonfly2_tpu.scheduler.rpcserver import (
         SCHEDULER_SPEC,
         SchedulerRpcService,
@@ -40,8 +54,16 @@ def build_replica(data_dir: str, *, host: str = "127.0.0.1", port: int = 0,
     from dragonfly2_tpu.scheduler.service import SchedulerService
     from dragonfly2_tpu.scheduler.storage.storage import Storage
 
+    rcfg = ResourceConfig()
+    if resource_shards > 0:
+        rcfg.shard_count = resource_shards
+    if gc_budget_s > 0:
+        rcfg.gc_budget_s = gc_budget_s
+    if gc_interval > 0:
+        rcfg.gc_interval = gc_interval
+    resource = Resource(rcfg)
     service = SchedulerService(
-        resource=Resource(),
+        resource=resource,
         scheduling=Scheduling(
             BaseEvaluator(),
             SchedulingConfig(
@@ -50,8 +72,10 @@ def build_replica(data_dir: str, *, host: str = "127.0.0.1", port: int = 0,
         ),
         storage=Storage(data_dir),
     )
+    if serve_gc:
+        resource.serve()
     server = serve([(SCHEDULER_SPEC, SchedulerRpcService(service))],
-                   host=host, port=port)
+                   host=host, port=port, max_workers=max_workers)
     return service, server
 
 
@@ -62,12 +86,27 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dir", required=True)
     parser.add_argument("--retry-interval", type=float, default=0.01)
     parser.add_argument("--retry-back-to-source-limit", type=int, default=2)
+    parser.add_argument("--resource-shards", type=int, default=0,
+                        help="manager map shards (0 = default 8)")
+    parser.add_argument("--gc-budget-ms", type=float, default=0.0,
+                        help="incremental-GC per-slice budget (0 = default)")
+    parser.add_argument("--gc-interval", type=float, default=0.0,
+                        help="GC firing interval seconds (0 = default 60)")
+    parser.add_argument("--max-workers", type=int, default=16,
+                        help="gRPC worker pool (1 open announce stream "
+                             "holds 1 worker)")
+    parser.add_argument("--serve-gc", action="store_true",
+                        help="run the interval GC (cluster rungs)")
     args = parser.parse_args(argv)
 
     _, server = build_replica(
         args.data_dir, host=args.host, port=args.port,
         retry_interval=args.retry_interval,
-        retry_back_to_source_limit=args.retry_back_to_source_limit)
+        retry_back_to_source_limit=args.retry_back_to_source_limit,
+        resource_shards=args.resource_shards,
+        gc_budget_s=args.gc_budget_ms / 1e3,
+        gc_interval=args.gc_interval,
+        max_workers=args.max_workers, serve_gc=args.serve_gc)
     # The supervisor parses this single line for the bound target.
     print(f"REPLICA {server.target}", flush=True)
     # Serve until killed (the rung's whole point is that we never get a

@@ -23,7 +23,7 @@ DEFAULT_PEER_CONCURRENT_UPLOAD_LIMIT = 50
 DEFAULT_SEED_PEER_CONCURRENT_UPLOAD_LIMIT = 300
 
 
-@dataclass
+@dataclass(slots=True)
 class Host:
     id: str
     hostname: str = ""
@@ -50,10 +50,15 @@ class Host:
     build: records.Build = field(default_factory=records.Build)
     created_at: float = field(default_factory=time.time)
     updated_at: float = field(default_factory=time.time)
+    # Internal state as init=False fields so the slotted dataclass can
+    # carry them (slots=True forbids __post_init__ inventing attributes).
+    _lock: threading.Lock = field(
+        init=False, repr=False, compare=False,
+        default_factory=threading.Lock)
+    _peers: Dict[str, object] = field(
+        init=False, repr=False, compare=False, default_factory=dict)
 
     def __post_init__(self):
-        self._lock = threading.Lock()
-        self._peers: Dict[str, object] = {}
         if self.concurrent_upload_limit == 0:
             self.concurrent_upload_limit = (
                 DEFAULT_SEED_PEER_CONCURRENT_UPLOAD_LIMIT

@@ -23,7 +23,7 @@ from dragonfly2_tpu.scheduler.resource.piecestats import (
     PieceCostStats,
 )
 from dragonfly2_tpu.scheduler.resource.task import Piece, Task
-from dragonfly2_tpu.utils.fsm import FSM
+from dragonfly2_tpu.utils.fsm import FSM, freeze_events
 
 
 class PeerState:
@@ -59,7 +59,8 @@ _RECEIVED = [
 ]
 
 # Transition table mirrors peer.go:230-251 (incl. the out-of-order
-# success path: results may arrive before piece reports).
+# success path: results may arrive before piece reports). Frozen once
+# below so all peers share ONE table (see freeze_events).
 _PEER_EVENTS = {
     PeerEvent.REGISTER_EMPTY: ([PeerState.PENDING], PeerState.RECEIVED_EMPTY),
     PeerEvent.REGISTER_TINY: ([PeerState.PENDING], PeerState.RECEIVED_TINY),
@@ -88,7 +89,31 @@ _PEER_EVENTS = {
 }
 
 
+_PEER_EVENTS_FROZEN = freeze_events(_PEER_EVENTS)
+
+# Shared read-only stand-in for peers that have reported no costs yet:
+# the evaluator's fast path snapshots it to (0, 0, 0, 0) — exactly what
+# a fresh per-peer window would answer — so the real window (deque +
+# lock) is only allocated once the first cost actually arrives. Appends
+# never reach this instance (append_piece_cost materializes the peer's
+# own window first).
+_EMPTY_COST_STATS = PieceCostStats()
+
+
 class Peer:
+    # Slotted: at 100k peers the per-instance __dict__ was the second
+    # largest per-peer allocation after the (now shared) FSM table.
+    # announce_channel rides in the slots so the service layer's
+    # ``peer.announce_channel = channel`` upsert still works; it is
+    # read with getattr(..., None) so leaving it unset is fine.
+    __slots__ = (
+        "id", "task", "host", "tag", "application", "priority",
+        "range_header", "finished_pieces", "pieces", "_piece_costs",
+        "cost", "block_parents", "need_back_to_source", "schedule_count",
+        "piece_updated_at", "created_at", "updated_at", "_lock", "fsm",
+        "announce_channel",
+    )
+
     def __init__(self, id: str, task: Task, host: Host, *,
                  tag: str = "", application: str = "", priority: int = 0,
                  range_header: str = "",
@@ -102,17 +127,28 @@ class Peer:
         self.range_header = range_header
         self.finished_pieces: set[int] = set()
         self.pieces: Dict[int, Piece] = {}
-        self._piece_costs = PieceCostStats(piece_cost_window)
+        # Lazily materialized on the first appended cost; window size is
+        # re-validated there. Non-default windows materialize eagerly
+        # (the lazy path could not remember the requested size without
+        # spending the slot it saves).
+        if piece_cost_window == DEFAULT_PIECE_COST_WINDOW:
+            self._piece_costs = None
+        else:
+            self._piece_costs = PieceCostStats(piece_cost_window)
         self.cost: float = 0.0
         self.block_parents: set[str] = set()
         self.need_back_to_source = False
         self.schedule_count = 0
-        self.piece_updated_at = time.time()
-        self.created_at = time.time()
-        self.updated_at = time.time()
+        now = time.time()
+        self.piece_updated_at = now
+        self.created_at = now
+        self.updated_at = now
         self._lock = threading.RLock()
-        self.fsm = FSM(PeerState.PENDING, _PEER_EVENTS,
-                       on_transition=lambda *_: self.touch())
+        self.fsm = FSM(PeerState.PENDING, _PEER_EVENTS_FROZEN,
+                       on_transition=self._touch_transition)
+
+    def _touch_transition(self, *_: object) -> None:
+        self.touch()
 
     def touch(self) -> None:
         self.updated_at = time.time()
@@ -129,15 +165,22 @@ class Peer:
         """Windowed cost history (bounded copy, newest last). The
         evaluator's fast path never calls this — it reads the O(1)
         aggregates via :meth:`piece_cost_stats`."""
-        return self._piece_costs.values()
+        return self.piece_cost_stats().values()
 
     def piece_cost_stats(self) -> PieceCostStats:
-        return self._piece_costs
+        stats = self._piece_costs
+        return stats if stats is not None else _EMPTY_COST_STATS
 
     # -- piece bookkeeping ----------------------------------------------------
 
     def append_piece_cost(self, cost: float) -> None:
-        self._piece_costs.append(cost)
+        stats = self._piece_costs
+        if stats is None:
+            with self._lock:
+                stats = self._piece_costs
+                if stats is None:
+                    stats = self._piece_costs = PieceCostStats()
+        stats.append(cost)
 
     def store_piece(self, piece: Piece) -> None:
         with self._lock:

@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from dragonfly2_tpu.utils import dag as dag_mod
-from dragonfly2_tpu.utils.fsm import FSM
+from dragonfly2_tpu.utils.fsm import FSM, freeze_events
 
 EMPTY_FILE_SIZE = 0
 TINY_FILE_SIZE = 128  # bytes — fits inline in the register response
@@ -71,9 +71,12 @@ class SizeScope(enum.Enum):
     UNKNOW = "unknow"   # content length not yet known
 
 
-@dataclass
+@dataclass(slots=True)
 class Piece:
-    """Piece metadata (reference: scheduler/resource/task.go Piece)."""
+    """Piece metadata (reference: scheduler/resource/task.go Piece).
+
+    Slotted: one Piece per reported piece per peer is the dominant
+    steady-state allocation of a large swarm's resource view."""
 
     number: int
     parent_id: str = ""
@@ -85,7 +88,19 @@ class Piece:
     created_at: float = field(default_factory=time.time)
 
 
+_TASK_EVENTS_FROZEN = freeze_events(_TASK_EVENTS)
+
+
 class Task:
+    __slots__ = (
+        "id", "url", "tag", "application", "type", "digest",
+        "filtered_query_params", "request_header", "piece_length",
+        "url_range", "content_length", "total_piece_count", "direct_piece",
+        "back_to_source_limit", "back_to_source_peers", "peer_failed_count",
+        "pieces", "source_claims", "dag", "created_at", "updated_at",
+        "_lock", "fsm",
+    )
+
     def __init__(
         self,
         id: str,
@@ -123,11 +138,15 @@ class Task:
         # origin claims — the piece-report hot path guards on None.
         self.source_claims = None
         self.dag: dag_mod.DAG = dag_mod.DAG()
-        self.created_at = time.time()
-        self.updated_at = time.time()
+        now = time.time()
+        self.created_at = now
+        self.updated_at = now
         self._lock = threading.RLock()
-        self.fsm = FSM(TaskState.PENDING, _TASK_EVENTS,
-                       on_transition=lambda *_: self.touch())
+        self.fsm = FSM(TaskState.PENDING, _TASK_EVENTS_FROZEN,
+                       on_transition=self._touch_transition)
+
+    def _touch_transition(self, *_: object) -> None:
+        self.touch()
 
     def touch(self) -> None:
         self.updated_at = time.time()

@@ -16,6 +16,7 @@ Flow per download (call stack 3.2 in SURVEY.md):
 from __future__ import annotations
 
 import logging
+import sys
 import threading
 import time
 import uuid
@@ -254,17 +255,22 @@ class SchedulerService:
             raise ServiceError(NOT_FOUND,
                                "LEVEL2 peer downloads back-to-source "
                                "without candidates")
+        # tag/application repeat across the whole fleet ("pytorch",
+        # "inference", ...): intern so every peer and task retains the
+        # one canonical copy, not a per-registration wire decode.
+        tag = sys.intern(req.tag)
+        application = sys.intern(req.application)
         task = self.resource.task_manager.load_or_store(
-            Task(req.task_id, url=req.url, tag=req.tag,
-                 application=req.application,
+            Task(req.task_id, url=req.url, tag=tag,
+                 application=application,
                  filtered_query_params=req.filtered_query_params,
                  request_header=req.request_header,
                  piece_length=req.piece_length,
                  url_range=req.url_range)
         )
         peer = self.resource.peer_manager.load_or_store(
-            Peer(req.peer_id, task, host, tag=req.tag,
-                 application=req.application, priority=req.priority)
+            Peer(req.peer_id, task, host, tag=tag,
+                 application=application, priority=req.priority)
         )
         peer.need_back_to_source = req.need_back_to_source
         if channel is not None:
@@ -490,11 +496,15 @@ class SchedulerService:
     def download_piece_finished(self, report: PieceFinished) -> None:
         """(service_v2.go:1095 handleDownloadPieceFinishedRequest)"""
         peer = self._peer(report.peer_id)
+        # Interned: the retained Piece records would otherwise pin one
+        # fresh wire-decoded copy of the parent id / traffic type PER
+        # PIECE — at swarm scale that is pure duplicate string memory.
         piece = Piece(
-            number=report.piece_number, parent_id=report.parent_id,
+            number=report.piece_number,
+            parent_id=sys.intern(report.parent_id),
             offset=report.offset, length=report.length,
             digest=report.digest, cost=report.cost_ns / 1e9,
-            traffic_type=report.traffic_type,
+            traffic_type=sys.intern(report.traffic_type),
         )
         peer.store_piece(piece)
         peer.task.mark_piece_landed(report.piece_number)
@@ -536,11 +546,13 @@ class SchedulerService:
                                  report.peer_id)
             if peer is None:
                 continue
+            # Same interning contract as the per-call form above.
             piece = Piece(
-                number=report.piece_number, parent_id=report.parent_id,
+                number=report.piece_number,
+                parent_id=sys.intern(report.parent_id),
                 offset=report.offset, length=report.length,
                 digest=report.digest, cost=report.cost_ns / 1e9,
-                traffic_type=report.traffic_type,
+                traffic_type=sys.intern(report.traffic_type),
             )
             peer.store_piece(piece)
             peer.task.mark_piece_landed(report.piece_number)
@@ -674,6 +686,23 @@ class SchedulerService:
         if task is None:
             raise ServiceError(NOT_FOUND, f"task {task_id} not found")
         return task
+
+    def stats_snapshot(self) -> Dict[str, object]:
+        """Control-plane counters + resource-view sizes + resident
+        memory for THIS replica — what the cluster bench polls per
+        replica (wire: the ``Stats`` unary) so per-replica decisions/
+        sec, GC pauses and RSS are bench numbers, not inferences from
+        the driver side."""
+        from dragonfly2_tpu.utils.meminfo import peak_rss_mb, rss_mb
+
+        return {
+            "stats": self.stats.snapshot(),
+            "hosts": len(self.resource.host_manager),
+            "tasks": len(self.resource.task_manager),
+            "peers": len(self.resource.peer_manager),
+            "rss_mb": round(rss_mb(), 1),
+            "peak_rss_mb": round(peak_rss_mb(), 1),
+        }
 
     def _peer(self, peer_id: str) -> Peer:
         peer = self.resource.peer_manager.load(peer_id)

@@ -20,8 +20,28 @@ class InvalidTransitionError(RuntimeError):
         self.state = state
 
 
+def freeze_events(
+    events: Mapping[str, Tuple[Iterable[str], str]],
+) -> Dict[str, Tuple[frozenset, str]]:
+    """Build the frozen transition table ONCE so every FSM instance over
+    the same event map shares it. Before this, each FSM re-froze the
+    table per instance — a dict of frozensets per peer/task, which at
+    100k peers was the single largest per-peer allocation."""
+    return {
+        name: (frozenset(srcs), dst) for name, (srcs, dst) in events.items()
+    }
+
+
+def _is_frozen(events) -> bool:
+    for srcs, _dst in events.values():
+        return isinstance(srcs, frozenset)
+    return True
+
+
 class FSM:
     """Thread-safe event-table state machine."""
+
+    __slots__ = ("_state", "_events", "_lock", "_on_transition")
 
     def __init__(
         self,
@@ -31,12 +51,16 @@ class FSM:
     ):
         """``events`` maps event name → (allowed source states, destination).
 
+        Pass a table pre-built with :func:`freeze_events` to share it
+        across instances (hot-path callers do); a raw mapping is frozen
+        here per instance, preserving the old contract.
+
         ``on_transition(event, src, dst)`` fires after every state change.
         """
         self._state = initial
-        self._events: Dict[str, Tuple[frozenset, str]] = {
-            name: (frozenset(srcs), dst) for name, (srcs, dst) in events.items()
-        }
+        self._events: Dict[str, Tuple[frozenset, str]] = (
+            events if _is_frozen(events) else freeze_events(events)
+        )
         self._lock = threading.Lock()
         self._on_transition = on_transition
 

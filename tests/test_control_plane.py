@@ -148,10 +148,17 @@ class TestIsBadNodeFastPath:
         ev = BaseEvaluator(stats=stats)
         peer = self._running_peer([10.0] * 50)
 
-        def boom():  # pragma: no cover - must never run
-            raise AssertionError("is_bad_node touched the cost history")
+        # Peer is slotted now, so the booby trap is a subclass override
+        # instead of an instance-attribute shadow — same contract: the
+        # fast path must never call the history accessor.
+        class BoobyTrapped(type(peer)):
+            __slots__ = ()
 
-        peer.piece_costs = boom
+            def piece_costs(self):  # pragma: no cover - must never run
+                raise AssertionError(
+                    "is_bad_node touched the cost history")
+
+        peer.__class__ = BoobyTrapped
         assert ev.is_bad_node(peer) is False
         assert stats.bad_node_fast == 1 and stats.bad_node_slow == 0
 
