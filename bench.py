@@ -833,6 +833,69 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
                              else {"skipped": True})})
 
 
+@stage("mlguard")
+def stage_mlguard(state: BenchState, ctx: dict) -> None:
+    """Guarded model lifecycle — the ISSUE-12 poisoned-model rung
+    (dragonfly2_tpu/inference/guardbench.py): a live loopback swarm
+    scheduling through the ML serving stack (RemoteMLEvaluator → gRPC
+    sidecar → manager registry, reload watcher running) while a
+    NaN-poisoned model is published three ways: through the validation
+    gate (must be quarantined OFFLINE, replaying announce traces
+    recorded from this very swarm), force-published into SHADOW mode
+    (canary must reject + quarantine it with the incumbent never
+    leaving the decision path), and force-published LIVE with shadow
+    off (the runtime guard must degrade every poisoned batch to rules,
+    escalate to a manager quarantine, and the watcher must restore the
+    previous version). Documented bounds (docs/CHAOS.md): 100 % task
+    success, decision quality never below the rule baseline, rollback
+    within 2 × reload_interval of exposure. A green run persists to
+    artifacts/bench_state/mlguard_run_*.json; a budget-skipped rung
+    records an explicit skip artifact — never a silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.inference.guardbench import run_mlguard_rung
+
+    # The budget gate lives HERE (no registry min_left): a registry-level
+    # skip would record nothing — this branch records the skip and
+    # persists a {"skipped": true} artifact the record scan ignores.
+    # An explicitly requested single stage always runs.
+    if left() < 60.0 and not ctx.get("single_stage"):
+        state.record(mlguard_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"mlguard_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    rung = run_mlguard_rung(seed=0)
+    state.record(
+        mlguard_downloads=rung["downloads"],
+        mlguard_success_rate=rung["success_rate"],
+        mlguard_failures=rung["failures"][:5],
+        mlguard_gate_rejected=rung["gate"]["rejected_offline"],
+        mlguard_gate_trace_source=rung["gate"]["trace_source"],
+        mlguard_shadow_rollback_s=rung["shadow_phase"]["rollback_s"],
+        mlguard_shadow_incumbent_held=rung["shadow_phase"][
+            "incumbent_held"],
+        mlguard_guard_rollback_s=rung["guard_phase"]["rollback_s"],
+        mlguard_rollback_bound_s=rung["rollback_bound_s"],
+        mlguard_guard_trips=rung["counters"].get("ml_guard_trips"),
+        mlguard_quality_mean=rung["quality_mean"],
+        mlguard_quality_min=rung["quality_min"],
+        mlguard_quarantines=rung["counters"].get("model_quarantines"),
+        mlguard_rollbacks=rung["counters"].get("model_rollbacks"),
+        mlguard_error=rung.get("error"),
+        mlguard_verdict_pass=rung["verdict_pass"],
+    )
+    state.stage_done("mlguard")
+    if rung["verdict_pass"]:
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"mlguard_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            rung)
+
+
 @stage("fanout", min_left=90.0)
 def stage_fanout(state: BenchState, ctx: dict) -> None:
     """Fleet-scale checkpoint fan-out — the ISSUE-9 dissemination
@@ -1267,7 +1330,11 @@ def check_regression_main(stage_name: str) -> None:
       amplification collapse fails the gate.
     - ``scheduler``: fresh top-rung swarm run vs the best recorded
       scheduler run (docs/SCHEDULER.md) — under 0.5× the recorded
-      decisions/sec or over 2× the recorded announce p99 fails."""
+      decisions/sec or over 2× the recorded announce p99 fails.
+    - ``mlguard``: a fresh poisoned-model rung must hold its absolute
+      bounds (gate rejection, 100 % success, rollback ≤ 2 ×
+      reload_interval, quality floor — docs/CHAOS.md); the best
+      record rides along for trend reading."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.uploadbench import check_regression
 
@@ -1286,10 +1353,16 @@ def check_regression_main(stage_name: str) -> None:
         )
 
         result = check_scheduler_regression(STATE_DIR)
+    elif stage_name == "mlguard":
+        from dragonfly2_tpu.inference.guardbench import (
+            check_mlguard_regression,
+        )
+
+        result = check_mlguard_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
-            "(have: dataplane, chaos, fanout, scheduler)")
+            "(have: dataplane, chaos, fanout, scheduler, mlguard)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

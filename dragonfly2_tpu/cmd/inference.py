@@ -52,6 +52,19 @@ def main(argv=None) -> int:
                              "requests is shed with RESOURCE_EXHAUSTED "
                              "(scheduler degrades to rule scoring); "
                              "0 = unbounded")
+    parser.add_argument("--no-shadow", action="store_true",
+                        help="install new active versions directly "
+                             "instead of shadow-loading them behind the "
+                             "incumbent until the canary promotes "
+                             "(docs/SERVING.md guarded rollout)")
+    parser.add_argument("--canary-batches", type=int, default=8,
+                        help="clean shadow score batches required before "
+                             "a new version takes over decisions")
+    parser.add_argument("--canary-latency-budget-s", type=float,
+                        default=0.25,
+                        help="per-batch shadow scoring latency above "
+                             "this rejects (and quarantines) the "
+                             "candidate version")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="inference")
@@ -79,7 +92,10 @@ def main(argv=None) -> int:
         batch_adaptive_wait_s=args.batch_adaptive_wait_s,
         batch_max_rows=args.batch_max_rows or None,
         batch_lanes=args.batch_lanes,
-        batch_queue_depth=args.batch_queue_depth)
+        batch_queue_depth=args.batch_queue_depth,
+        shadow_mode=not args.no_shadow,
+        canary_batches=args.canary_batches,
+        canary_latency_budget_s=args.canary_latency_budget_s)
     service.reload_from_manager()
     service.serve_watcher()
     # Live per-lane serving counters (dispatches, coalesce, sheds, lane

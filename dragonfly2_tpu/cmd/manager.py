@@ -45,6 +45,18 @@ def main(argv=None) -> int:
     parser.add_argument("--jwt-secret", default="",
                         help="HMAC secret for session tokens (default: "
                              "$DF2_MANAGER_JWT_SECRET or random per boot)")
+    parser.add_argument("--model-gate", action="store_true",
+                        help="stage ingested models as CANDIDATE and "
+                             "promote only through the offline "
+                             "validation gate (finite/non-degenerate "
+                             "scores, rank correlation vs rules, "
+                             "latency budget — docs/SERVING.md); "
+                             "rejected versions quarantine")
+    parser.add_argument("--model-gate-min-correlation", type=float,
+                        default=0.2,
+                        help="gate floor: mean Spearman rank "
+                             "correlation of candidate scores vs the "
+                             "rule evaluator over the replayed traces")
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="manager")
@@ -73,7 +85,14 @@ def main(argv=None) -> int:
         object_store = new_object_store(args.object_store)
     else:
         object_store = FilesystemObjectStore(args.object_store_dir)
-    service = ManagerService(db, object_store, metrics=metrics)
+    validation = None
+    if args.model_gate:
+        from dragonfly2_tpu.manager.validation import ValidationConfig
+
+        validation = ValidationConfig(
+            min_rank_correlation=args.model_gate_min_correlation)
+    service = ManagerService(db, object_store, metrics=metrics,
+                             validation=validation)
     auth = None if args.no_auth else AuthService(db, secret=args.jwt_secret)
     # Durable cross-process job plane: preheat jobs land in the DB and
     # standalone schedulers lease them over the internal surface

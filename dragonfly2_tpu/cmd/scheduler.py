@@ -228,17 +228,54 @@ def main(argv=None) -> int:
         # inactive, and daemons' dynconfig only lists active instances.
         manager_adapter.keepalive("")
 
+        # Guarded model lifecycle wiring (docs/SERVING.md): an ML
+        # evaluator escalates runtime guard trips to a registry
+        # quarantine (fleet-wide rollback), and records its announce
+        # feature batches so the manager's validation gate replays REAL
+        # traffic against future candidates. The evaluator was built
+        # before this client existed, hence the late binding.
+        ml_trace_log = None
+        evaluator = service.scheduling.evaluator
+        if hasattr(evaluator, "set_quarantine_hook"):
+            from dragonfly2_tpu.manager.validation import TraceLog
+
+            ml_trace_log = TraceLog()
+            evaluator.set_trace_log(ml_trace_log)
+
+            def quarantine_serving(reason):
+                version = getattr(evaluator, "serving_version", "")
+                if not version:
+                    return False  # version unknown yet: retry next trip
+                mgr.quarantine_model_version(
+                    model_type=getattr(evaluator, "model_name", "mlp"),
+                    version=version, scheduler_id=args.scheduler_id,
+                    reason=f"scheduler runtime guard: {reason}")
+
+            evaluator.set_quarantine_hook(quarantine_serving)
+
         def keepalive_loop():
             import logging as _logging
             import time as _time
 
+            ticks = 0
             while True:
                 _time.sleep(5.0)
+                ticks += 1
                 try:
                     manager_adapter.keepalive("")
                 except Exception:  # noqa: BLE001 — keepalive must not die
                     _logging.getLogger(__name__).exception(
                         "manager keepalive failed")
+                # Ship the trace corpus about once a minute; failures
+                # only cost gate freshness, never the keepalive.
+                if ml_trace_log is not None and ticks % 12 == 0 \
+                        and len(ml_trace_log):
+                    try:
+                        mgr.upload_announce_traces(
+                            args.scheduler_id, ml_trace_log.to_bytes())
+                    except Exception:  # noqa: BLE001
+                        _logging.getLogger(__name__).exception(
+                            "announce-trace upload failed")
 
         _threading.Thread(target=keepalive_loop, daemon=True,
                           name="manager-keepalive").start()

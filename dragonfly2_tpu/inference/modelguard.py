@@ -1,0 +1,90 @@
+"""Score-batch guard + weight-poisoning helpers for the model lifecycle.
+
+One predicate — :func:`guard_reason` — decides whether a score batch is
+safe to rank with, and every consumer shares it: the scheduler-side
+:class:`~dragonfly2_tpu.inference.scorer.MLEvaluator` (live decisions),
+the sidecar's shadow/canary controller (candidate versions on mirrored
+traffic), and the manager's offline validation gate. A loadable model
+whose outputs are NaN/Inf or collapsed to a constant must degrade to
+rule scoring everywhere, with ONE definition of "degenerate" so the
+layers can never disagree about what a poisoned model looks like.
+
+:func:`poison_params` is the other half of the chaos story: the
+``model.weights`` FaultPlan site turns a freshly loaded checkpoint into
+exactly such a model (NaN-poisoned or zero-scaled-to-constant weights)
+without touching the artifact bytes — the failure shape a bad training
+run or a silently corrupted optimizer state produces in the wild.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+#: A batch needs at least this many rows before "all scores equal" is
+#: evidence of a collapsed model rather than a coincidence of a tiny
+#: candidate set (1-2 parents with identical features legitimately score
+#: identically).
+GUARD_MIN_CONSTANT_ROWS = 4
+
+#: Score spread below this (on a batch of >= GUARD_MIN_CONSTANT_ROWS
+#: rows with non-identical features) reads as a collapsed-constant
+#: model: ranking such scores is ranking noise.
+GUARD_MIN_SCORE_SPREAD = 1e-7
+
+
+def guard_reason(scores, features=None) -> Optional[str]:
+    """Why a score batch must NOT be used for ranking, or ``None``.
+
+    Returns ``"nonfinite"`` when any score is NaN/Inf, ``"constant"``
+    when a large-enough batch has (numerically) zero spread. When the
+    input ``features`` are provided and every row is IDENTICAL,
+    identical scores are the only correct answer (a cold-start swarm of
+    indistinguishable fresh peers), so the constant check is waived —
+    without this, a healthy deterministic model could be quarantined
+    fleet-wide for scoring equal inputs equally.
+    """
+    arr = np.asarray(scores, dtype=np.float64)
+    if arr.size == 0:
+        return None
+    if not np.isfinite(arr).all():
+        return "nonfinite"
+    if arr.size >= GUARD_MIN_CONSTANT_ROWS:
+        if float(arr.max() - arr.min()) < GUARD_MIN_SCORE_SPREAD:
+            if features is not None:
+                f = np.asarray(features)
+                if len(f) == arr.size and bool((f == f[0]).all()):
+                    return None
+            return "constant"
+    return None
+
+
+def poison_params(params, mode: str):
+    """Return a structurally identical params tree with poisoned leaves.
+
+    ``mode="nan"`` fills every float leaf with NaN (the bad-training-run
+    shape: loss diverged, optimizer wrote NaNs, checkpoint saved them).
+    ``mode="zero"`` zeroes every float leaf (scale poisoning collapsed
+    to its detectable endpoint: the model outputs its — now zero — bias
+    for every input, a constant score batch). Integer leaves (index
+    tables) are left alone so the poisoned model stays LOADABLE — the
+    whole point is a model that passes every load-time check and fails
+    only on its outputs.
+    """
+    if mode not in ("nan", "zero"):
+        raise ValueError(f"unknown poison mode {mode!r}")
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        arr = np.asarray(node)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return node
+        if mode == "nan":
+            return np.full_like(arr, np.nan)
+        return np.zeros_like(arr)
+
+    return walk(params)

@@ -20,6 +20,7 @@ matching the reference's degradation path.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -30,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
+from dragonfly2_tpu.inference.modelguard import guard_reason
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 from dragonfly2_tpu.scheduler.evaluator.base import (
     BaseEvaluator,
@@ -246,9 +248,26 @@ class MLEvaluator:
     rule-based evaluator for bad-node detection (a statistical property of
     observed piece costs, not a learned one) and as fallback when scoring
     fails.
+
+    Every score batch passes the runtime guard before it ranks anything
+    (:func:`~dragonfly2_tpu.inference.modelguard.guard_reason`): a
+    NaN/Inf or collapsed-constant batch degrades THAT decision to rule
+    scoring and ticks ``ml_guard_trips``; after ``guard_trip_limit``
+    trips the evaluator escalates ONCE through ``on_quarantine`` — the
+    hook owner quarantines the serving version back to the manager,
+    whose rollback the sidecar watcher picks up fleet-wide on its next
+    poll. ``reset_guard()`` re-arms the escalation latch after a model
+    swap. A loadable-but-poisoned model is therefore a non-event: no
+    poisoned batch ever orders parents, and the fleet converges back to
+    the previous good version without an operator in the loop.
     """
 
-    def __init__(self, scorer: ParentScorer | None):
+    def __init__(self, scorer: ParentScorer | None, *,
+                 stats=None, guard_trip_limit: int = 3,
+                 on_quarantine=None, trace_log=None,
+                 track_quality: bool = False):
+        from dragonfly2_tpu.utils.servingstats import SERVING
+
         self._scorer = scorer
         self._fallback = BaseEvaluator()
         # Operators must be able to tell "model live" from "model silently
@@ -260,11 +279,113 @@ class MLEvaluator:
         self.scored_count = 0
         self.fallback_count = 0
         self.shed_count = 0
+        self.guard_trips = 0
         self._logged_failure = False
+        self._logged_guard = False
+        self._stats = stats if stats is not None else SERVING
+        self.guard_trip_limit = guard_trip_limit
+        self._on_quarantine = on_quarantine
+        self._quarantine_fired = False
+        # Version the guard state belongs to: when a version-aware
+        # scorer (the remote one stamps last_version from each reply)
+        # starts serving a DIFFERENT version, trips and the escalation
+        # latch auto-reset — a fresh version starts with a clean slate
+        # and may escalate again. Versionless scorers rely on the owner
+        # calling reset_guard() at swap time.
+        self._guard_version: str | None = None
+        # Guard bookkeeping is mutated from CONCURRENT announce threads
+        # (gRPC pool): the trip counter's read-modify-write and the
+        # escalate-once check-then-act need a lock or two threads at
+        # limit-1 lose an increment / double-fire the quarantine RPC.
+        # The hook itself runs OUTSIDE the lock (it's an RPC);
+        # _quarantine_inflight keeps a second thread from duplicating it
+        # meanwhile.
+        self._guard_lock = threading.Lock()
+        self._quarantine_inflight = False
+        # Optional announce-trace recorder (validation.TraceLog): the
+        # gate's replay corpus is captured here, on the live path.
+        self._trace_log = trace_log
+        # Optional decision-quality ring: per decision, the rule score
+        # of the CHOSEN top parent normalized into [0, 1] against the
+        # rule evaluator's own best/worst over the same candidates
+        # (1.0 == the rule baseline's pick). The mlguard bench rung
+        # bounds its minimum; off by default to keep the hot path lean.
+        self.track_quality = track_quality
+        self.quality_samples: collections.deque = collections.deque(
+            maxlen=4096)
 
     @property
     def has_model(self) -> bool:
         return self._scorer is not None
+
+    def reset_guard(self) -> None:
+        """Re-arm the guard after a model swap: a fresh version starts
+        with a clean trip count and may escalate again."""
+        with self._guard_lock:
+            self._reset_guard_locked()
+
+    def _reset_guard_locked(self) -> None:
+        self.guard_trips = 0
+        self._quarantine_fired = False
+        self._logged_guard = False
+
+    def set_quarantine_hook(self, fn) -> None:
+        """Late-bind the escalation hook (the scheduler CLI builds the
+        evaluator before its manager client exists)."""
+        self._on_quarantine = fn
+
+    def set_trace_log(self, trace_log) -> None:
+        """Late-bind the announce-trace recorder (validation.TraceLog)."""
+        self._trace_log = trace_log
+
+    def _record_quality(self, features: np.ndarray, chosen: int) -> None:
+        if not self.track_quality:
+            return
+        from dragonfly2_tpu.scheduler.evaluator import scoring
+
+        rule = np.asarray(scoring.rule_scores(features), dtype=np.float64)
+        lo, hi = float(rule.min()), float(rule.max())
+        q = 1.0 if hi - lo <= 1e-12 else (float(rule[chosen]) - lo) / (hi - lo)
+        self.quality_samples.append(q)
+
+    def _guard_trip(self, reason: str) -> None:
+        with self._guard_lock:
+            self.guard_trips += 1
+            log_first = not self._logged_guard
+            self._logged_guard = True
+            escalate = (self.guard_trips >= self.guard_trip_limit
+                        and not self._quarantine_fired
+                        and not self._quarantine_inflight
+                        and self._on_quarantine is not None)
+            if escalate:
+                self._quarantine_inflight = True
+        self._stats.tick("ml_guard_trips")
+        if log_first:
+            logging.getLogger(__name__).error(
+                "ML score batch rejected by runtime guard (%s); decision "
+                "fell back to rule scoring (further trips counted, not "
+                "logged)", reason)
+        if not escalate:
+            return
+        # Latch only on a DELIVERED escalation: a transient manager
+        # outage (or a hook returning False — "couldn't act yet", e.g.
+        # no serving version known) leaves the latch unarmed so the
+        # next trip retries instead of silently abandoning the
+        # fleet-wide rollback. The hook runs outside the lock; the
+        # inflight flag keeps concurrent trips from duplicating it.
+        delivered = False
+        try:
+            delivered = self._on_quarantine(reason) is not False
+        except Exception:  # noqa: BLE001 — escalation must never
+            logging.getLogger(__name__).exception(
+                "model quarantine escalation failed; will retry on "
+                "the next guard trip")
+        with self._guard_lock:
+            self._quarantine_inflight = False
+            if delivered:
+                self._quarantine_fired = True
+        if delivered:
+            self._stats.tick("ml_quarantines_reported")
 
     def close(self) -> None:
         """Release the scorer if it owns resources (a micro-batcher's
@@ -285,23 +406,57 @@ class MLEvaluator:
         # pair_features rows). Fresh, not staged: the micro-batcher may
         # hold the rows across an async dispatch window.
         features = build_feature_matrix(parents, child, total_piece_count)
+        if self._trace_log is not None:
+            self._trace_log.record(features)
         try:
             scores = self._scorer.score(features)
         except BatcherSaturatedError:
             self.shed_count += 1
             self.fallback_count += 1
-            return self._fallback.evaluate_parents(parents, child, total_piece_count)
+            self._stats.tick("ml_sheds")
+            self._stats.tick("ml_fallbacks")
+            ranked = self._fallback.evaluate_parents(
+                parents, child, total_piece_count)
+            if self.track_quality:
+                self._record_quality(features, parents.index(ranked[0]))
+            return ranked
         except Exception:
             self.fallback_count += 1
+            self._stats.tick("ml_fallbacks")
             if not self._logged_failure:
                 self._logged_failure = True
                 logging.getLogger(__name__).exception(
                     "ML parent scoring failed; falling back to rule-based "
                     "evaluation (further failures counted, not logged)"
                 )
-            return self._fallback.evaluate_parents(parents, child, total_piece_count)
+            ranked = self._fallback.evaluate_parents(
+                parents, child, total_piece_count)
+            if self.track_quality:
+                self._record_quality(features, parents.index(ranked[0]))
+            return ranked
+        version = getattr(self._scorer, "last_version", "")
+        if version:
+            with self._guard_lock:
+                if version != self._guard_version:
+                    if self._guard_version is not None:
+                        self._reset_guard_locked()
+                    self._guard_version = version
+        reason = guard_reason(scores, features=features)
+        if reason is not None:
+            # The poisoned batch never orders anything: this decision is
+            # the rule evaluator's, and the trip is counted/escalated.
+            self.fallback_count += 1
+            self._stats.tick("ml_fallbacks")
+            self._guard_trip(reason)
+            ranked = self._fallback.evaluate_parents(
+                parents, child, total_piece_count)
+            if self.track_quality:
+                self._record_quality(features, parents.index(ranked[0]))
+            return ranked
         self.scored_count += 1
+        self._stats.tick("ml_scored")
         order = np.argsort(-scores, kind="stable")
+        self._record_quality(features, int(order[0]))
         return [parents[i] for i in order]
 
     def is_bad_node(self, peer: PeerLike) -> bool:

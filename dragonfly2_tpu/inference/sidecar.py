@@ -17,10 +17,13 @@ with rule-based fallback while the sidecar is unreachable or model-less.
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
 import tempfile
 import threading
+import time
+import zlib
 from dataclasses import dataclass
 from typing import Dict, Optional, Sequence
 
@@ -148,7 +151,14 @@ class InferenceService:
                  batch_max_rows: Optional[int] = None,
                  batch_lanes: int = 2,
                  batch_queue_depth: int = 32,
-                 reload_grace_s: float = 35.0):
+                 reload_grace_s: float = 35.0,
+                 shadow_mode: bool = True,
+                 canary_batches: int = 8,
+                 canary_latency_budget_s: float = 0.25,
+                 canary_probe_grace_s: Optional[float] = None,
+                 serving_stats=None):
+        from dragonfly2_tpu.utils.servingstats import SERVING
+
         self.manager = manager  # ManagerService or None (push-only mode)
         self.scheduler_id = scheduler_id
         self.reload_interval = reload_interval
@@ -159,7 +169,38 @@ class InferenceService:
         self.batch_lanes = batch_lanes
         self.batch_queue_depth = batch_queue_depth
         self.reload_grace_s = reload_grace_s
+        # Guarded-rollout knobs (docs/SERVING.md "Model lifecycle &
+        # guarded rollout"): a NEW active version replacing a serving
+        # incumbent loads in SHADOW first — scored on mirrored live
+        # traffic while decisions stay with the incumbent — and promotes
+        # only after ``canary_batches`` clean batches; a guard trip or a
+        # latency blow-out rolls it back and quarantines the version at
+        # the manager. ``canary_probe_grace_s`` (default: one reload
+        # interval) is how long a shadow waits for live traffic before
+        # deterministic synthetic probe batches drive the decision — an
+        # idle sidecar must still converge.
+        self.shadow_mode = shadow_mode
+        self.canary_batches = canary_batches
+        self.canary_latency_budget_s = canary_latency_budget_s
+        self.canary_probe_grace_s = (
+            canary_probe_grace_s if canary_probe_grace_s is not None
+            else reload_interval)
+        self.serving_stats = (serving_stats if serving_stats is not None
+                              else SERVING)
         self._models: Dict[str, _LoadedModel] = {}
+        self._shadows: Dict[str, dict] = {}
+        # Versions this process has SERVED (or promoted): a rollback
+        # restoring one re-installs directly — it was already proven,
+        # and shadow-delaying recovery would extend the incident.
+        self._known_good: set = set()
+        # (name → version) of artifact loads that failed: the watcher
+        # skips a memoized-bad version until the active version changes
+        # instead of re-downloading + re-failing it every poll.
+        self._failed_versions: Dict[str, str] = {}
+        # Quarantine reports that failed to reach the manager; retried
+        # each watcher tick (the memoized skip means there is no other
+        # re-detection path on this process).
+        self._pending_quarantines: list = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
@@ -191,6 +232,15 @@ class InferenceService:
         with self._lock:
             old = self._models.get(name)
             self._models[name] = _LoadedModel(version, scorer, batcher)
+            # A version that serves is (by definition) the rollback
+            # target of whatever replaces it; installs also clear any
+            # memoized load failure and supersede a pending shadow of a
+            # DIFFERENT version (the registry moved on under it).
+            self._known_good.add(version)
+            self._failed_versions.pop(name, None)
+            shadow = self._shadows.get(name)
+            if shadow is not None and shadow["version"] != version:
+                self._shadows.pop(name, None)
             # Prune fired (or cancelled) grace timers on every install:
             # a long-lived sidecar hot-reloads periodically, and keeping
             # every spent Timer until stop() grows the list unboundedly.
@@ -235,6 +285,13 @@ class InferenceService:
 
                 self._health.set_status("", SERVING)
 
+    def serving_version(self, name: str) -> Optional[str]:
+        """Version currently TAKING DECISIONS for a model type (None
+        when nothing is loaded). A shadow-loaded candidate is not it."""
+        with self._lock:
+            model = self._models.get(name)
+        return model.version if model is not None else None
+
     def batcher_stats(self) -> Dict[str, dict]:
         """Per-model micro-batcher pipeline counters (coalesce factor,
         in-flight depth, stage/dispatch overlap, per-bucket hits) for
@@ -247,8 +304,14 @@ class InferenceService:
 
     def reload_from_manager(self) -> bool:
         """Pull every servable model type whose active version changed.
-        Returns True when any (re)load happened. The steady-state poll is
-        metadata-only: artifacts are fetched only after a version check."""
+        Returns True when any (re)load happened — direct install or a
+        SHADOW install (the incumbent keeps taking decisions until the
+        canary promotes). The steady-state poll is metadata-only:
+        artifacts are fetched only after a version check, and a
+        (type, version) whose artifact already failed to load is
+        memoized and skipped until the active version moves on."""
+        from dragonfly2_tpu.utils import faultplan
+
         if self.manager is None:
             return False
         reloaded = False
@@ -264,23 +327,87 @@ class InferenceService:
                     continue
                 with self._lock:
                     current = self._models.get(name)
+                    shadow = self._shadows.get(name)
                     if current is not None and current.version == version:
+                        # Serving IS the active version; a shadow of a
+                        # different version was superseded upstream (a
+                        # rollback landed while it waited) — drop it.
+                        if (shadow is not None
+                                and shadow["version"] != version):
+                            self._shadows.pop(name, None)
                         continue
+                    if shadow is not None and shadow["version"] == version:
+                        continue  # already canarying this version
+                    if self._failed_versions.get(name) == version:
+                        continue  # memoized known-bad artifact
+                    current_version = (current.version if current is not None
+                                       else None)
                 active = self.manager.get_active_model(
                     name, self.scheduler_id)
                 if active is None:
                     continue
-                scorer = builder(active.artifact)
-                # Through install_scorer so the micro-batcher front is
-                # (re)built and the old one drained.
-                self.install_scorer(name, scorer, version=active.version)
-                logger.info("inference sidecar loaded %s version %s",
-                            name, active.version)
+                artifact = active.artifact
+                plan = faultplan.ACTIVE
+                if plan is not None:
+                    rule = plan.check("model.artifact",
+                                      context=f"{name}:{active.version}")
+                    if rule is not None:
+                        artifact = _fault_artifact(artifact, rule)
+                try:
+                    scorer = builder(artifact)
+                except Exception:  # noqa: BLE001 — a bad artifact is a
+                    # memoized verdict, not a poll-cadence retry loop
+                    with self._lock:
+                        self._failed_versions[name] = version
+                    self.serving_stats.tick("model_reload_failures")
+                    logger.exception(
+                        "load of %s version %s failed; memoized — the "
+                        "watcher will not retry until the active version "
+                        "changes", name, version)
+                    continue
+                if (current is None or not self.shadow_mode
+                        or version in self._known_good
+                        or self._incumbent_quarantined(name,
+                                                       current_version)):
+                    # Direct install: first model of this type, shadowing
+                    # disabled, a rollback restoring a version this
+                    # process already proved, or a replace of an
+                    # incumbent the manager has condemned (it must not be
+                    # a shadow baseline).
+                    self.install_scorer(name, scorer,
+                                        version=active.version)
+                    logger.info("inference sidecar loaded %s version %s",
+                                name, active.version)
+                else:
+                    with self._lock:
+                        self._shadows[name] = _new_shadow(
+                            name, active.version, scorer)
+                    logger.info(
+                        "inference sidecar loaded %s version %s in SHADOW "
+                        "mode (incumbent %s keeps serving until the "
+                        "canary promotes)", name, active.version,
+                        current_version)
                 reloaded = True
             except Exception:  # noqa: BLE001 — keep serving + polling
                 logger.exception("reload of %s model failed; keeping the "
                                  "previous version", name)
         return reloaded
+
+    def _incumbent_quarantined(self, name: str,
+                               version: Optional[str]) -> bool:
+        """True when the manager has quarantined the version this
+        process is serving — the incoming active version is then a
+        ROLLBACK-REPLACE and must install directly (comparing a
+        candidate against a condemned baseline proves nothing)."""
+        if version is None:
+            return False
+        state_of = getattr(self.manager, "get_model_version_state", None)
+        if state_of is None:
+            return False
+        try:
+            return state_of(name, version, self.scheduler_id) == "quarantined"
+        except Exception:  # noqa: BLE001 — unknown is "not quarantined"
+            return False
 
     def serve_watcher(self) -> None:
         if self._watcher is not None and self._watcher.is_alive():
@@ -310,6 +437,7 @@ class InferenceService:
         self._grace_timers.clear()
         with self._lock:
             self._grace_active = 0
+            self._shadows.clear()
         stats = self.batcher_stats()
         if stats:
             # The operators' record of how the serving pipeline behaved
@@ -330,9 +458,200 @@ class InferenceService:
     def _watch_loop(self) -> None:
         while not self._stop.wait(self.reload_interval):
             try:
+                self.retry_pending_quarantines()
+            except Exception:
+                logger.exception("pending quarantine retry failed")
+            try:
                 self.reload_from_manager()
             except Exception:
                 logger.exception("model reload failed")
+            try:
+                self.process_shadows()
+            except Exception:
+                logger.exception("canary processing failed")
+
+    # -- shadow / canary ---------------------------------------------------
+
+    def shadow_stats(self) -> Dict[str, dict]:
+        """Per-model shadow/canary progress (version, clean batches,
+        rank agreement with the incumbent, latency) for operators
+        watching a rollout."""
+        with self._lock:
+            shadows = dict(self._shadows)
+            # Snapshot the per-shadow rings under the same lock the
+            # canary appends under — a bare list() racing an append
+            # raises "deque mutated during iteration".
+            rings = {name: list(sh["agreements"])
+                     for name, sh in shadows.items()}
+        out = {}
+        for name, sh in shadows.items():
+            agreements = rings[name]
+            out[name] = {
+                "version": sh["version"],
+                "clean_batches": sh["clean"],
+                "needed_batches": self.canary_batches,
+                "live_batches": sh["live_batches"],
+                "probe_batches": sh["probe_batches"],
+                "age_s": round(time.monotonic() - sh["installed_at"], 3),
+                "agreement_mean": (
+                    round(float(np.mean(agreements)), 4)
+                    if agreements else None),
+                "max_latency_s": round(sh["max_latency_s"], 4),
+            }
+        return out
+
+    def process_shadows(self) -> None:
+        """Drain mirrored live batches through every shadow and decide:
+        promote after ``canary_batches`` clean batches; reject (and
+        quarantine at the manager) on a guard trip or a latency blow-out.
+        Deterministic synthetic probe batches top up the clean-batch
+        budget once mirrored traffic alone hasn't decided by tick time —
+        and, after ``canary_probe_grace_s`` with NO live traffic at all,
+        drive the decision outright — so an idle or lightly-loaded
+        sidecar still converges within ~one reload interval. Called
+        from the watcher tick; callable directly by tests and benches."""
+        with self._lock:
+            shadows = list(self._shadows.items())
+        for name, sh in shadows:
+            decided = False
+            while not decided:
+                try:
+                    inputs, incumbent_scores = sh["queue"].popleft()
+                except IndexError:
+                    break
+                sh["live_batches"] += 1
+                self.serving_stats.tick("shadow_batches")
+                decided = self._canary_step(name, sh, inputs,
+                                            incumbent_scores)
+            if decided:
+                continue
+            # No (more) live traffic: after the grace window, probe.
+            age = time.monotonic() - sh["installed_at"]
+            if (sh["live_batches"] == 0
+                    and age < self.canary_probe_grace_s):
+                continue
+            probes = _probe_batches(
+                name, sh["scorer"],
+                seed=zlib.crc32(sh["version"].encode()),
+                batches=max(self.canary_batches - sh["clean"], 0))
+            for batch in probes:
+                sh["probe_batches"] += 1
+                self.serving_stats.tick("shadow_probe_batches")
+                if self._canary_step(name, sh, batch, None):
+                    break
+
+    def _canary_step(self, name: str, sh: dict, inputs,
+                     incumbent_scores) -> bool:
+        """Score one batch through the shadow and update the verdict.
+        Returns True when the canary DECIDED (promoted or rejected)."""
+        if name == MODEL_NAME_GAT and getattr(inputs, "ndim", 2) == 2 \
+                and inputs.shape[1] != 2:
+            return False  # feature probe against a pair scorer: skip
+        t0 = time.perf_counter()
+        try:
+            scores = np.asarray(sh["scorer"].score(inputs))
+        except Exception as exc:  # noqa: BLE001 — a scoring crash rejects
+            self._reject_shadow(name, sh, f"scoring raised: {exc!r}")
+            return True
+        latency = time.perf_counter() - t0
+        sh["max_latency_s"] = max(sh["max_latency_s"], latency)
+        from dragonfly2_tpu.inference.modelguard import guard_reason
+
+        reason = guard_reason(scores, features=inputs)
+        if reason is not None:
+            self.serving_stats.tick("shadow_guard_trips")
+            self._reject_shadow(name, sh, f"guard trip: {reason}")
+            return True
+        if latency > self.canary_latency_budget_s:
+            self._reject_shadow(
+                name, sh, f"latency {latency:.3f}s over the "
+                f"{self.canary_latency_budget_s}s canary budget")
+            return True
+        if incumbent_scores is not None and len(scores) >= 3:
+            from dragonfly2_tpu.manager.validation import spearman
+
+            agreement = spearman(scores, incumbent_scores)
+            with self._lock:
+                sh["agreements"].append(agreement)
+        sh["clean"] += 1
+        if sh["clean"] >= self.canary_batches:
+            self._promote_shadow(name, sh)
+            return True
+        return False
+
+    def _promote_shadow(self, name: str, sh: dict) -> None:
+        with self._lock:
+            if self._shadows.get(name) is not sh:
+                return  # superseded while scoring
+            self._shadows.pop(name, None)
+        self.serving_stats.tick("canary_promotions")
+        # Through install_scorer: batcher rebuild + incumbent grace-drain
+        # + known-good registration, the same swap path a direct install
+        # takes.
+        self.install_scorer(name, sh["scorer"], version=sh["version"])
+        logger.info(
+            "canary PROMOTED %s version %s after %d clean batches "
+            "(%d live / %d probe, agreement_mean=%s)",
+            name, sh["version"], sh["clean"], sh["live_batches"],
+            sh["probe_batches"],
+            (round(float(np.mean(list(sh["agreements"]))), 4)
+             if sh["agreements"] else None))
+
+    def _reject_shadow(self, name: str, sh: dict, reason: str) -> None:
+        with self._lock:
+            if self._shadows.get(name) is not sh:
+                return
+            self._shadows.pop(name, None)
+            # Memoize: the registry still lists this version active
+            # until the quarantine lands — the next poll must not
+            # re-shadow it.
+            self._failed_versions[name] = sh["version"]
+        self.serving_stats.tick("canary_rollbacks")
+        logger.warning(
+            "canary REJECTED %s version %s (%s) after %d clean batches; "
+            "incumbent keeps serving", name, sh["version"], reason,
+            sh["clean"])
+        self._quarantine_to_manager(name, sh["version"], reason)
+
+    def _quarantine_to_manager(self, name: str, version: str,
+                               reason: str) -> None:
+        """Report a condemned version back to the registry so the
+        rollback is FLEET-wide, not just this process's. A failed
+        report (transient manager outage) parks in a pending list the
+        watcher retries every tick — the local memoization means this
+        sidecar would otherwise never re-detect the version, and the
+        registry would list the poison active forever."""
+        quarantine = getattr(self.manager, "quarantine_version", None)
+        if quarantine is None:
+            return
+        try:
+            quarantine(name, version, self.scheduler_id, reason=reason)
+        except Exception:  # noqa: BLE001 — the local rejection stands
+            with self._lock:
+                entry = (name, version, reason)
+                if entry not in self._pending_quarantines:
+                    self._pending_quarantines.append(entry)
+            logger.exception(
+                "quarantine of %s version %s at the manager failed; "
+                "parked for retry on the next watcher tick", name,
+                version)
+
+    def retry_pending_quarantines(self) -> None:
+        """Re-deliver parked quarantine reports (watcher tick)."""
+        with self._lock:
+            pending = list(self._pending_quarantines)
+        for name, version, reason in pending:
+            try:
+                self.manager.quarantine_version(
+                    name, version, self.scheduler_id, reason=reason)
+            except Exception:  # noqa: BLE001 — keep it parked
+                continue
+            with self._lock:
+                try:
+                    self._pending_quarantines.remove(
+                        (name, version, reason))
+                except ValueError:
+                    pass
 
     # -- gRPC surface ------------------------------------------------------
 
@@ -413,6 +732,14 @@ class InferenceService:
             # evaluator to degrade to rule scoring for this decision
             # instead of queueing behind a saturated serving plane.
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+        with self._lock:
+            shadow = self._shadows.get(request.model_name)
+        if shadow is not None:
+            # Mirror live traffic to the canary: copies, because the
+            # decision is returned NOW and the shadow scores on the
+            # watcher tick. The response stays the incumbent's.
+            shadow["queue"].append(
+                (np.asarray(inputs).copy(), np.asarray(scores).copy()))
         return ModelInferResponse(
             model_name=request.model_name, model_version=model.version,
             outputs=np.asarray(scores),
@@ -435,6 +762,59 @@ class InferenceService:
         return ServerReadyResponse(ready=ready)
 
 
+def _new_shadow(name: str, version: str, scorer) -> dict:
+    """Canary state for one shadow-loaded candidate version."""
+    return {
+        "name": name,
+        "version": version,
+        "scorer": scorer,
+        "clean": 0,
+        "live_batches": 0,
+        "probe_batches": 0,
+        # Mirrored (inputs, incumbent_scores) batches; bounded — the
+        # canary needs a sample of traffic, not all of it.
+        "queue": collections.deque(maxlen=4),
+        # Spearman rank AGREEMENT with the incumbent per mirrored batch
+        # (1.0 = ranks identically; -1.0 = inverts the ranking).
+        "agreements": collections.deque(maxlen=64),
+        "max_latency_s": 0.0,
+        "installed_at": time.monotonic(),
+    }
+
+
+def _probe_batches(name: str, scorer, seed: int, batches: int) -> list:
+    """Deterministic synthetic batches shaped for the model type —
+    feature matrices for the MLP scorer, valid index pairs for the GAT
+    pair scorer."""
+    if batches <= 0:
+        return []
+    if name == MODEL_NAME_GAT:
+        rng = np.random.default_rng(seed)
+        n = max(int(getattr(scorer, "n_real", 2)), 2)
+        return [rng.integers(0, n, size=(12, 2)).astype(np.int32)
+                for _ in range(batches)]
+    from dragonfly2_tpu.manager.validation import synthetic_traces
+
+    return synthetic_traces(seed=seed, batches=batches, rows=12)
+
+
+def _fault_artifact(artifact: bytes, rule) -> bytes:
+    """Apply a ``model.artifact`` FaultPlan rule to the fetched tar
+    payload — the wire-level poisoning shapes (flipped header byte,
+    truncated download) the load path must fail CLEANLY on (memoized
+    skip, previous version keeps serving)."""
+    from dragonfly2_tpu.utils.faultplan import FaultKind
+
+    if rule.kind is FaultKind.CORRUPT and artifact:
+        mutated = bytearray(artifact)
+        mutated[0] ^= 0xFF
+        mutated[len(mutated) // 2] ^= 0xFF
+        return bytes(mutated)
+    if rule.kind is FaultKind.TRUNCATE:
+        return artifact[: max(len(artifact) // 2, 1)]
+    return artifact
+
+
 def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
     """model.tar → ParentScorer (checkpoint load + jit warm-up)."""
     from dragonfly2_tpu.manager.service import untar_to_directory
@@ -446,6 +826,7 @@ def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
         untar_to_directory(artifact, tmp)
         tree, metadata = load_model(tmp)
         params, normalizer, target_norm = mlp_from_tree(tree)
+        params = _maybe_poison_weights(params, MODEL_NAME_MLP)
         hidden = tuple(metadata.config.get("hidden", (128, 128, 64)))
         model = MLPBandwidthPredictor(hidden=hidden)
         return ParentScorer(model, params, normalizer, target_norm)
@@ -453,6 +834,28 @@ def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _maybe_poison_weights(params, context: str):
+    """``model.weights`` FaultPlan site: poison a freshly loaded
+    checkpoint AT LOAD — CORRUPT fills float leaves with NaN (diverged
+    training run), SCALE zeroes them (collapsed-constant output). The
+    model stays perfectly loadable; only the guards can catch it."""
+    from dragonfly2_tpu.utils import faultplan
+
+    plan = faultplan.ACTIVE
+    if plan is None:
+        return params
+    rule = plan.check("model.weights", context=context)
+    if rule is None:
+        return params
+    from dragonfly2_tpu.inference.modelguard import poison_params
+
+    if rule.kind is faultplan.FaultKind.CORRUPT:
+        return poison_params(params, "nan")
+    if rule.kind is faultplan.FaultKind.SCALE:
+        return poison_params(params, "zero")
+    return params
 
 
 def _gat_scorer_from_artifact(artifact: bytes):
@@ -469,6 +872,7 @@ def _gat_scorer_from_artifact(artifact: bytes):
         tree, metadata = load_model(tmp)
         (params, node_features, neighbors, neighbor_vals,
          node_ids) = gat_from_tree(tree)
+        params = _maybe_poison_weights(params, MODEL_NAME_GAT)
         cfg = metadata.config
         model = GraphTransformer(
             hidden=int(cfg.get("hidden", 128)),
@@ -497,11 +901,17 @@ class InferenceClient:
         self.timeout = timeout
 
     def model_infer(self, model_name: str, inputs: np.ndarray) -> np.ndarray:
+        return self.model_infer_full(model_name, inputs)[0]
+
+    def model_infer_full(self, model_name: str,
+                         inputs: np.ndarray) -> tuple:
+        """(scores, serving model version) — the version is what a
+        guard-trip escalation quarantines back to the manager."""
         resp = self._client.ModelInfer(
             ModelInferRequest(model_name=model_name, inputs=inputs),
             timeout=self.timeout,
         )
-        return np.asarray(resp.outputs)
+        return np.asarray(resp.outputs), resp.model_version
 
     def model_ready(self, name: str) -> bool:
         return bool(self._client.ModelReady(
@@ -550,6 +960,10 @@ class _RemoteScorer:
         self.cooldown = cooldown
         self._open_until = 0.0
         self._lock = threading.Lock()
+        # The version the last successful score came from — what a
+        # guard-trip escalation must quarantine. Duck-typed clients
+        # without model_infer_full leave it empty.
+        self.last_version = ""
 
     def score(self, features: np.ndarray) -> np.ndarray:
         import time
@@ -557,9 +971,15 @@ class _RemoteScorer:
         with self._lock:
             if time.monotonic() < self._open_until:
                 raise CircuitOpenError("inference sidecar circuit open")
+        full = getattr(self.client, "model_infer_full", None)
         try:
-            scores = self.client.model_infer(
-                self.model_name, np.asarray(features, dtype=np.float32))
+            if full is not None:
+                scores, version = full(
+                    self.model_name, np.asarray(features, dtype=np.float32))
+            else:
+                scores = self.client.model_infer(
+                    self.model_name, np.asarray(features, dtype=np.float32))
+                version = ""
         except Exception as exc:
             if _is_resource_exhausted(exc):
                 # The sidecar is alive but shedding (bounded admission):
@@ -575,16 +995,32 @@ class _RemoteScorer:
             raise
         with self._lock:
             self._open_until = 0.0
+            if version:
+                self.last_version = version
         return scores
 
 
 class RemoteMLEvaluator(MLEvaluator):
     """The ``ml`` evaluator backed by the sidecar — fills the reference's
     MLAlgorithm TODO (evaluator.go:48). Delegates ranking, fallback
-    counting, and loud first-failure logging to :class:`MLEvaluator`; the
-    remote scorer only adds transport + the circuit breaker."""
+    counting, guard trips, and loud first-failure logging to
+    :class:`MLEvaluator`; the remote scorer adds transport, the circuit
+    breaker, and serving-version tracking (``serving_version`` is what a
+    guard-trip escalation quarantines back to the manager)."""
 
     def __init__(self, client: InferenceClient,
-                 model_name: str = MODEL_NAME_MLP, cooldown: float = 5.0):
-        super().__init__(_RemoteScorer(client, model_name, cooldown))
+                 model_name: str = MODEL_NAME_MLP, cooldown: float = 5.0,
+                 **guard_kwargs):
+        super().__init__(_RemoteScorer(client, model_name, cooldown),
+                         **guard_kwargs)
         self.client = client
+
+    @property
+    def serving_version(self) -> str:
+        """Version of the model behind the last successful score."""
+        return self._scorer.last_version
+
+    @property
+    def model_name(self) -> str:
+        """Registry model type this evaluator scores with."""
+        return self._scorer.model_name

@@ -63,6 +63,33 @@ class ManagerHTTPClient:
             "cluster_id": cluster_id,
         })
 
+    # -- model lifecycle ------------------------------------------------
+
+    def quarantine_model_version(self, *, model_type: str, version: str,
+                                 scheduler_id: int = 0,
+                                 reason: str = "") -> Optional[Dict]:
+        """Runtime-guard escalation: quarantine a poisoned serving
+        version at the registry (fleet-wide rollback — every sidecar's
+        next watcher poll restores the previous good version). Returns
+        the restored row, or None when nothing was restorable."""
+        resp = self._call("POST", "/internal/v1/models/quarantine", {
+            "type": model_type, "version": version,
+            "scheduler_id": scheduler_id, "reason": reason,
+        })
+        return resp.get("restored")
+
+    def upload_announce_traces(self, scheduler_id: int,
+                               payload: bytes) -> None:
+        """Ship recorded announce traces (validation.TraceLog bytes) so
+        the manager's validation gate replays REAL traffic against
+        future candidates of this scheduler."""
+        import base64
+
+        self._call("POST", "/internal/v1/models/traces", {
+            "scheduler_id": scheduler_id,
+            "payload": base64.b64encode(payload).decode(),
+        })
+
     # -- job plane ------------------------------------------------------
 
     def lease_job(self, *, queues: List[str], worker_id: str,

@@ -19,6 +19,13 @@ from typing import Any, Dict, List, Optional
 
 STATE_ACTIVE = "active"
 STATE_INACTIVE = "inactive"
+# Model-lifecycle states (manager/validation.py gate; docs/SERVING.md
+# "Model lifecycle & guarded rollout"). A model row moves
+# candidate → active → inactive (superseded) and any state →
+# quarantined (gate rejection, runtime guard escalation, or rollback);
+# quarantined is terminal — a quarantined version can never re-activate.
+STATE_CANDIDATE = "candidate"
+STATE_QUARANTINED = "quarantined"
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS scheduler_clusters (

@@ -134,6 +134,8 @@ class RestApi:
         r("GET", r"/api/v1/models", self._list_models)
         r("GET", r"/api/v1/models/(?P<id>\d+)", self._get_model)
         r("PATCH", r"/api/v1/models/(?P<id>\d+)", self._update_model)
+        r("POST", r"/api/v1/models/(?P<id>\d+)/rollback",
+          self._rollback_model)
         r("DELETE", r"/api/v1/models/(?P<id>\d+)", self._delete_in("models"))
         # peers (sync-peers results; handlers/peer.go)
         r("GET", r"/api/v1/peers", self._list_peers)
@@ -153,6 +155,14 @@ class RestApi:
         # separately from the user-facing API; mTLS is the hardening path)
         r("POST", r"/internal/v1/schedulers", self._internal_update_scheduler)
         r("POST", r"/internal/v1/keepalive", self._internal_keepalive)
+        # model lifecycle, instance-facing: a scheduler's runtime guard
+        # escalates a poisoned serving version here (fleet-wide
+        # rollback), and ships its recorded announce traces for the
+        # validation gate's replay corpus (docs/SERVING.md)
+        r("POST", r"/internal/v1/models/quarantine",
+          self._internal_quarantine_model)
+        r("POST", r"/internal/v1/models/traces",
+          self._internal_record_traces)
         r("GET", r"/internal/v1/dynconfig/daemon", self._internal_daemon_cfg)
         r("GET", r"/internal/v1/dynconfig/scheduler/(?P<id>\d+)",
           self._internal_scheduler_cfg)
@@ -412,9 +422,35 @@ class RestApi:
     def _update_model(self, identity, m, q, body):
         state = body.get("state")
         if state not in ("active", "inactive"):
+            # candidate/quarantined are lifecycle states the gate and
+            # rollback APIs own — never settable by hand.
             raise HttpError(400, "state must be active|inactive")
-        self.service.set_model_state(int(m.group("id")), state)
+        if self.service.db.get("models", int(m.group("id"))) is None:
+            raise HttpError(404, "model not found")
+        try:
+            self.service.set_model_state(int(m.group("id")), state)
+        except ManagerError as exc:
+            # The only ManagerError left after the existence check is
+            # quarantined-reactivation — refused with conflict
+            # semantics, not a generic bad-request.
+            raise HttpError(409, str(exc))
         return self._get_model(identity, m, q, body)
+
+    def _rollback_model(self, identity, m, q, body):
+        """Quarantine THIS version and (when it was active) restore the
+        previous good one atomically — the operator's big red button
+        (docs/SERVING.md rollback semantics)."""
+        row = self.service.db.get("models", int(m.group("id")))
+        if row is None:
+            raise HttpError(404, "model not found")
+        restored = self.service.quarantine_version(
+            row.type, row.version, row.scheduler_id,
+            reason=body.get("reason", "operator rollback via REST"))
+        out = {"quarantined": _row(self.service.db.get("models", row.id))}
+        out["restored"] = (
+            _row(self.service.db.get("models", restored.id))
+            if restored is not None else None)
+        return out
 
     # -- peers -------------------------------------------------------------
 
@@ -582,6 +618,29 @@ class RestApi:
         self.service.keepalive(
             source_type=body["source_type"], hostname=body["hostname"],
             ip=body["ip"], cluster_id=int(body["cluster_id"]))
+        return {"ok": True}
+
+    def _internal_quarantine_model(self, identity, m, q, body):
+        """Runtime-guard escalation from a scheduler: quarantine the
+        named version; when it was active the previous good version is
+        restored atomically and every sidecar's next watcher poll picks
+        the rollback up."""
+        restored = self.service.quarantine_version(
+            body["type"], body["version"],
+            int(body.get("scheduler_id", 0)),
+            reason=body.get("reason", "scheduler guard escalation"))
+        return {"restored": _row(self.service.db.get("models", restored.id))
+                if restored is not None else None}
+
+    def _internal_record_traces(self, identity, m, q, body):
+        """Recorded announce traces (validation.TraceLog bytes, base64)
+        from a scheduler — the gate replays these against future
+        candidates of that scheduler instead of synthetic batches."""
+        import base64
+
+        self.service.record_announce_traces(
+            int(body.get("scheduler_id", 0)),
+            base64.b64decode(body["payload"]))
         return {"ok": True}
 
     def _internal_daemon_cfg(self, identity, m, q, body):

@@ -30,7 +30,8 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
                   micro_batch: bool = False,
                   batch_adaptive_wait_s: float = 0.0005,
                   batch_lanes: int = 1,
-                  batch_queue_depth: int = 0):
+                  batch_queue_depth: int = 0,
+                  **guard_kwargs):
     """Evaluator factory (evaluator.go:36-57 New).
 
     ``ml``: in-process :class:`MLEvaluator` when a scorer is handed over
@@ -60,7 +61,8 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
                 RemoteMLEvaluator,
             )
 
-            return RemoteMLEvaluator(InferenceClient(sidecar_target))
+            return RemoteMLEvaluator(InferenceClient(sidecar_target),
+                                     **guard_kwargs)
         from dragonfly2_tpu.inference.scorer import MLEvaluator
 
         if micro_batch and scorer is not None:
@@ -69,7 +71,7 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
             scorer = MicroBatcher(
                 scorer, adaptive_wait_s=batch_adaptive_wait_s,
                 lanes=batch_lanes, queue_depth=batch_queue_depth)
-        return MLEvaluator(scorer)
+        return MLEvaluator(scorer, **guard_kwargs)
     if algorithm == ALGORITHM_PLUGIN:
         from importlib.metadata import entry_points
 

@@ -59,6 +59,17 @@ site                    where it fires
                         threshold (context = daemon hostname) and
                         SIGKILLs it mid-write — the failure the durable
                         piece journal + restart-resume path exist for
+``model.artifact``      the inference sidecar's model download
+                        (context = ``<type>:<version>``): ``CORRUPT``
+                        flips tar bytes, ``TRUNCATE`` halves the
+                        payload — the load must fail cleanly, memoize
+                        the bad version, and keep the previous one
+                        serving
+``model.weights``       checkpoint params at sidecar load (context =
+                        model type): ``CORRUPT`` NaN-poisons the float
+                        leaves, ``SCALE`` zeroes them — a perfectly
+                        LOADABLE model only the score-batch guards can
+                        catch (the poisoned-model mlguard rung's shape)
 ======================  =====================================================
 """
 
@@ -83,6 +94,8 @@ class FaultKind(enum.Enum):
     DEADLINE = "deadline_exceeded"        # gRPC DEADLINE_EXCEEDED
     ENOSPC = "enospc"                     # disk full on write
     KILL = "kill"                         # SIGKILL a whole process (bench)
+    SCALE = "scale_poison"                # zero model weights at load
+    #                                       (collapsed-constant scores)
 
 
 @dataclass

@@ -1,0 +1,94 @@
+"""ML serving-health counters — the ``/debug/vars`` ``"serving"`` block.
+
+The ML scheduling loop degrades to rules in several places (saturated
+serving plane, unreachable sidecar, guard-tripped score batches), and
+until this block existed every one of those counters was instance-local
+state on an :class:`~dragonfly2_tpu.inference.scorer.MLEvaluator` — an
+operator could not tell "model live" from "fleet silently rule-falling-
+back" without attaching a debugger. Components default to the
+process-wide :data:`SERVING` scope (what ``/debug/vars`` shows beside
+the ``data_plane``/``scheduler``/``recovery`` blocks); tests and the
+mlguard bench rung inject a fresh instance.
+
+Counter contract (docs/SERVING.md "Model lifecycle & guarded rollout"):
+
+- ``ml_scored`` / ``ml_fallbacks`` / ``ml_sheds`` — decisions ranked by
+  the model, decisions that degraded to rule scoring (any cause), and
+  the subset shed by the serving plane's bounded admission.
+- ``ml_guard_trips`` — score batches REJECTED by the runtime guard
+  (NaN/Inf or collapsed-constant output): the decision fell back to
+  rules and the batch never influenced scheduling.
+- ``ml_quarantines_reported`` — evaluator guard-trip limits that
+  escalated to a manager-side version quarantine (the fleet-wide
+  rollback trigger).
+- ``model_reload_failures`` — sidecar artifact loads that failed; the
+  failing ``(type, version)`` is memoized so the watcher does not
+  re-download + re-fail it every poll.
+- ``shadow_batches`` / ``shadow_probe_batches`` — live traffic mirrored
+  through a shadow-loaded candidate version, and synthetic probe
+  batches scored when no live traffic arrived in time.
+- ``shadow_guard_trips`` — shadow score batches the guard rejected
+  (the canary controller rolls the version back without it ever taking
+  a decision).
+- ``canary_promotions`` / ``canary_rollbacks`` — shadow versions
+  promoted to serving after their clean-batch budget, and versions
+  auto-rolled-back (guard trip or latency regression).
+- ``model_validation_rejections`` — candidate versions the manager's
+  offline validation gate refused to promote.
+- ``model_quarantines`` / ``model_rollbacks`` — registry versions
+  marked quarantined (gate rejection, guard escalation, or operator
+  rollback), and active-version rollbacks that restored the previous
+  good version.
+- ``models_promoted`` — candidate versions the gate promoted to active.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from dragonfly2_tpu.utils.debugmon import register_debug_var
+
+COUNTER_KEYS = (
+    "ml_scored",
+    "ml_fallbacks",
+    "ml_sheds",
+    "ml_guard_trips",
+    "ml_quarantines_reported",
+    "model_reload_failures",
+    "shadow_batches",
+    "shadow_probe_batches",
+    "shadow_guard_trips",
+    "canary_promotions",
+    "canary_rollbacks",
+    "model_validation_rejections",
+    "model_quarantines",
+    "model_rollbacks",
+    "models_promoted",
+)
+
+
+class ServingStats:
+    """Thread-safe ML serving-health counters for one scope."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in COUNTER_KEYS}
+
+    def tick(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def get(self, key: str) -> int:
+        with self._lock:
+            return self._counts.get(key, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+#: Process-wide default scope — published as the ``"serving"`` block.
+SERVING = ServingStats()
+
+register_debug_var("serving", SERVING.snapshot)

@@ -1,0 +1,1063 @@
+"""Guarded model lifecycle (ISSUE 12): staged registry + validation
+gate, runtime score-batch guards, sidecar shadow/canary rollout,
+quarantine → fleet-wide rollback, reload memoization, and the
+poisoned-model chaos rung.
+
+The layers under test share ONE definition of "poisoned"
+(inference/modelguard.guard_reason), so the tests drive each layer with
+the same NaN/constant shapes and assert the same verdict: the bad model
+never orders a parent, and the fleet converges back to the previous
+good version."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.inference.modelguard import (
+    guard_reason,
+    poison_params,
+)
+from dragonfly2_tpu.inference.scorer import MLEvaluator
+from dragonfly2_tpu.utils.servingstats import ServingStats
+from dragonfly2_tpu.manager import (
+    Database,
+    FilesystemObjectStore,
+    ManagerService,
+)
+from dragonfly2_tpu.manager.database import (
+    STATE_ACTIVE,
+    STATE_INACTIVE,
+    STATE_QUARANTINED,
+)
+from dragonfly2_tpu.manager.service import ManagerError
+from dragonfly2_tpu.manager.validation import (
+    TraceLog,
+    ValidationConfig,
+    spearman,
+    synthetic_traces,
+    validate_feature_scorer,
+)
+from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+from tests.test_inference import FakeHost, FakePeer
+
+
+# ----------------------------------------------------------------------
+# Shared tiny model: train the rule-distilled MLP ONCE per module and
+# derive every artifact (good / NaN / zero-collapsed) from it.
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def distilled(tmp_path_factory):
+    from dragonfly2_tpu.inference.guardbench import (
+        train_rule_distilled_mlp,
+        write_model_artifact,
+    )
+
+    base = tmp_path_factory.mktemp("mlguard-model")
+    result = train_rule_distilled_mlp(seed=3, samples=768)
+    return {
+        "result": result,
+        "good_dir": write_model_artifact(str(base), result, "good"),
+        "nan_dir": write_model_artifact(str(base), result, "nan",
+                                        poison="nan"),
+        "zero_dir": write_model_artifact(str(base), result, "zero",
+                                         poison="zero"),
+    }
+
+
+def make_manager(tmp_path, *, gate: bool = True, stats=None,
+                 **config_kw) -> ManagerService:
+    validation = ValidationConfig(**config_kw) if gate else None
+    return ManagerService(
+        Database(), FilesystemObjectStore(str(tmp_path / "objects")),
+        validation=validation, serving_stats=stats or ServingStats())
+
+
+def create(manager, artifact_dir, name="m", **kw):
+    return manager.create_model(name, "mlp", "h", "1.1.1.1", "hn", {},
+                                artifact_dir, **kw)
+
+
+# ----------------------------------------------------------------------
+# Guard predicate + poisoning helpers
+# ----------------------------------------------------------------------
+
+
+class TestGuardReason:
+    def test_finite_varied_scores_pass(self):
+        assert guard_reason(np.array([0.1, 0.9, 0.4, 0.2])) is None
+
+    def test_nan_and_inf_trip(self):
+        assert guard_reason(np.array([0.1, np.nan])) == "nonfinite"
+        assert guard_reason(np.array([np.inf, 0.0, 1.0])) == "nonfinite"
+
+    def test_collapsed_constant_trips_only_on_large_batches(self):
+        # 1-2 identical scores are a tiny candidate set, not a verdict.
+        assert guard_reason(np.array([0.5])) is None
+        assert guard_reason(np.array([0.5, 0.5])) is None
+        assert guard_reason(np.array([0.5] * 4)) == "constant"
+
+    def test_empty_batch_passes(self):
+        assert guard_reason(np.zeros(0)) is None
+
+    def test_identical_features_waive_constant_check(self):
+        """A cold-start swarm of indistinguishable fresh peers yields
+        identical feature rows — identical scores are then CORRECT, not
+        a collapsed model; a healthy version must not be quarantined
+        for scoring equal inputs equally."""
+        same = np.ones((6, FEATURE_DIM), np.float32)
+        varied = np.arange(6 * FEATURE_DIM, dtype=np.float32).reshape(
+            6, FEATURE_DIM)
+        constant = np.full(6, 0.5, np.float32)
+        assert guard_reason(constant, features=same) is None
+        assert guard_reason(constant, features=varied) == "constant"
+        # NaN is never waived, identical inputs or not.
+        assert guard_reason(np.full(6, np.nan), features=same) == \
+            "nonfinite"
+
+    def test_poison_params_shapes_and_dtypes(self):
+        tree = {"w": np.ones((3, 2), np.float32),
+                "nested": {"b": np.zeros(4, np.float64)},
+                "idx": np.arange(5)}
+        nan = poison_params(tree, "nan")
+        assert np.isnan(nan["w"]).all()
+        assert np.isnan(nan["nested"]["b"]).all()
+        # Integer leaves stay intact: the model must remain LOADABLE.
+        assert (nan["idx"] == tree["idx"]).all()
+        zero = poison_params(tree, "zero")
+        assert (zero["w"] == 0).all()
+        with pytest.raises(ValueError):
+            poison_params(tree, "nope")
+
+
+# ----------------------------------------------------------------------
+# Validation gate
+# ----------------------------------------------------------------------
+
+
+class TestValidationGate:
+    def test_good_model_promotes_poison_quarantines(self, distilled,
+                                                    tmp_path):
+        stats = ServingStats()
+        manager = make_manager(tmp_path, stats=stats,
+                               min_rank_correlation=0.5)
+        good = create(manager, distilled["good_dir"])
+        assert good.state == STATE_ACTIVE
+        report = good.evaluation["validation"]
+        assert report["passed"] and report["trace_source"] == "synthetic"
+        assert report["rank_correlation"] >= 0.5
+        assert stats.get("models_promoted") == 1
+
+        for artifact, reason in ((distilled["nan_dir"], "nonfinite"),
+                                 (distilled["zero_dir"], "constant")):
+            row = create(manager, artifact)
+            assert row.state == STATE_QUARANTINED
+            assert reason in ";".join(
+                row.evaluation["validation"]["reasons"])
+        assert stats.get("model_validation_rejections") == 2
+        # The good version is still the single active one.
+        assert manager.get_active_model_version("mlp", 0) == good.version
+
+    def test_gate_replays_recorded_traces(self, distilled, tmp_path):
+        manager = make_manager(tmp_path, min_rank_correlation=0.2)
+        log = TraceLog()
+        rng = np.random.default_rng(0)
+        for batch in synthetic_traces(seed=9, batches=6, rows=8):
+            log.record(batch + rng.normal(0, 0.01, batch.shape))
+        manager.record_announce_traces(0, log.to_bytes())
+        row = create(manager, distilled["good_dir"])
+        assert row.state == STATE_ACTIVE
+        assert row.evaluation["validation"]["trace_source"] == "recorded"
+        assert row.evaluation["validation"]["batches"] == 6
+
+    def test_unloadable_artifact_rejected(self, tmp_path):
+        manager = make_manager(tmp_path)
+        garbage = tmp_path / "garbage"
+        garbage.mkdir()
+        (garbage / "params.npz").write_bytes(b"not a checkpoint")
+        row = create(manager, str(garbage))
+        assert row.state == STATE_QUARANTINED
+        assert row.evaluation["validation"]["checks"]["load"] == "failed"
+
+    def test_skip_validation_bypasses_gate(self, distilled, tmp_path):
+        manager = make_manager(tmp_path)
+        row = create(manager, distilled["nan_dir"], skip_validation=True)
+        assert row.state == STATE_ACTIVE  # the operator-error path the
+        # runtime guards exist for
+
+    def test_unservable_type_passes_trivially(self, tmp_path):
+        manager = make_manager(tmp_path)
+        art = tmp_path / "gnn-art"
+        art.mkdir()
+        (art / "blob.bin").write_bytes(b"x" * 16)
+        row = manager.create_model("g", "gnn", "h", "ip", "hn", {},
+                                   str(art))
+        assert row.state == STATE_ACTIVE
+        assert "servable" in row.evaluation["validation"]["checks"]
+
+    def test_trace_log_roundtrip_and_bounds(self):
+        log = TraceLog(capacity=3)
+        for i in range(5):
+            log.record(np.full((2, FEATURE_DIM), i, np.float32))
+        assert len(log) == 3  # bounded ring keeps the newest
+        clone = TraceLog.from_bytes(log.to_bytes())
+        got = clone.batches()
+        assert len(got) == 3
+        assert got[-1][0, 0] == 4.0
+        # Degenerate records are ignored, not stored.
+        log.record(np.zeros((0, FEATURE_DIM), np.float32))
+        assert len(log) == 3
+
+    def test_spearman_sanity(self):
+        a = np.array([1.0, 2.0, 3.0, 4.0])
+        assert spearman(a, a) == pytest.approx(1.0)
+        assert spearman(a, -a) == pytest.approx(-1.0)
+        assert spearman(a, np.ones(4)) == 0.0
+
+    def test_small_batch_corpus_still_catches_collapsed_model(self):
+        """Recorded traces with 1-2-candidate batches (a small swarm's
+        real shape) must not blind the gate: a collapsed-constant model
+        is caught over the POOLED corpus, and the correlation floor
+        falls back to one pooled Spearman."""
+        tiny = [np.asarray(b[:2], np.float32)
+                for b in synthetic_traces(batches=8, rows=2)]
+
+        class CollapsedScorer:
+            def score(self, batch):
+                return np.full(len(batch), 0.5, np.float32)
+
+        report = validate_feature_scorer(
+            CollapsedScorer(), tiny, ValidationConfig())
+        assert not report.passed
+        assert report.checks["guard"] == "corpus_constant"
+
+        class RuleScorer:
+            def score(self, batch):
+                from dragonfly2_tpu.scheduler.evaluator import scoring
+
+                return np.asarray(scoring.rule_scores(batch))
+
+        report = validate_feature_scorer(
+            RuleScorer(), tiny, ValidationConfig(min_rank_correlation=0.9))
+        assert report.passed
+        assert report.checks["rank_correlation_scope"] == "pooled"
+        assert report.rank_correlation == pytest.approx(1.0)
+
+        class AntiRuleScorer(RuleScorer):
+            def score(self, batch):
+                return -super().score(batch)
+
+        report = validate_feature_scorer(
+            AntiRuleScorer(), tiny, ValidationConfig())
+        assert not report.passed
+        assert report.checks["rank_correlation"] == "below_floor"
+
+    def test_trace_log_concurrent_record_and_serialize(self):
+        """The keepalive ticker serializes the log while announce
+        threads record — must never raise 'deque mutated during
+        iteration'."""
+        log = TraceLog(capacity=16)
+        stop = threading.Event()
+        errors = []
+
+        def recorder():
+            batch = np.ones((4, FEATURE_DIM), np.float32)
+            while not stop.is_set():
+                log.record(batch)
+
+        def serializer():
+            try:
+                for _ in range(200):
+                    TraceLog.from_bytes(log.to_bytes())
+                    log.batches()
+            except Exception as exc:  # noqa: BLE001 — the failure mode
+                errors.append(exc)
+            finally:
+                stop.set()
+
+        threads = [threading.Thread(target=recorder) for _ in range(2)]
+        threads.append(threading.Thread(target=serializer))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_latency_budget_rejects(self):
+        class SlowScorer:
+            def score(self, batch):
+                import time
+
+                time.sleep(0.05)
+                return np.arange(len(batch), dtype=np.float32)
+
+        report = validate_feature_scorer(
+            SlowScorer(), synthetic_traces(batches=2),
+            ValidationConfig(max_batch_latency_s=0.01,
+                             min_rank_correlation=-1.0))
+        assert not report.passed
+        assert report.checks["latency"] == "over_budget"
+
+
+# ----------------------------------------------------------------------
+# Registry invariants under the new states (ISSUE satellite)
+# ----------------------------------------------------------------------
+
+
+class TestRegistryInvariants:
+    def test_concurrent_create_single_active(self, distilled, tmp_path):
+        """Concurrent create_model of one (type, scheduler_id) — with
+        AND without the gate — must end with exactly one active row."""
+        for gate in (False, True):
+            manager = make_manager(tmp_path / f"g{gate}", gate=gate)
+            errors = []
+
+            def worker(i):
+                try:
+                    create(manager, distilled["good_dir"], name=f"m{i}")
+                except Exception as exc:  # noqa: BLE001 — collected
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            rows = manager.list_models()
+            active = [r for r in rows if r.state == STATE_ACTIVE]
+            assert len(rows) == 4 and len(active) == 1
+
+    def test_quarantined_never_reactivates(self, distilled, tmp_path):
+        manager = make_manager(tmp_path)
+        create(manager, distilled["good_dir"])
+        bad = create(manager, distilled["nan_dir"])
+        assert bad.state == STATE_QUARANTINED
+        with pytest.raises(ManagerError, match="quarantined"):
+            manager.set_model_state(bad.id, STATE_ACTIVE)
+        with pytest.raises(ManagerError, match="quarantined"):
+            manager.promote_model(bad.id)
+        # No laundering either: quarantined → inactive would put the
+        # row back in the restorable set (and re-open manual
+        # activation), so ANY manual state change is refused.
+        with pytest.raises(ManagerError, match="quarantined"):
+            manager.set_model_state(bad.id, STATE_INACTIVE)
+
+    def test_stranded_candidate_not_manually_activatable(
+            self, distilled, tmp_path):
+        """A candidate stranded by a gate exception must not be
+        PATCHable straight to active — that would bypass the gate; only
+        validate_model_row + promote_model clears it."""
+        manager = make_manager(tmp_path)
+        real_validate = manager.validate_model_row
+        manager.validate_model_row = lambda *a, **kw: (_ for _ in ()).throw(
+            ConnectionError("object store down"))
+        with pytest.raises(ConnectionError):
+            create(manager, distilled["good_dir"])
+        manager.validate_model_row = real_validate
+        stranded = manager.list_models()[0]
+        assert stranded.state == "candidate"
+        with pytest.raises(ManagerError, match="candidate"):
+            manager.set_model_state(stranded.id, STATE_ACTIVE)
+        # The gate path still clears it.
+        report = manager.validate_model_row(stranded.id)
+        assert report.passed
+        assert manager.promote_model(stranded.id).state == STATE_ACTIVE
+        # Deactivation of a quarantined row is also a no-go target for
+        # rollback restoration: quarantine good, nothing restorable.
+        restored = manager.rollback("mlp", 0, reason="test")
+        assert restored is None  # only the good version existed
+        assert manager.get_active_model_version("mlp", 0) is None
+
+    def test_rollback_restores_previous_and_quarantines_bad(
+            self, distilled, tmp_path):
+        manager = make_manager(tmp_path, gate=False)
+        v1 = create(manager, distilled["good_dir"])
+        v2 = create(manager, distilled["good_dir"])
+        assert manager.get_active_model_version("mlp", 0) == v2.version
+        restored = manager.quarantine_version("mlp", v2.version, 0,
+                                              reason="guard trips")
+        assert restored is not None and restored.version == v1.version
+        states = {r.version: r.state for r in manager.list_models()}
+        assert states[v2.version] == STATE_QUARANTINED
+        assert states[v1.version] == STATE_ACTIVE
+        # Idempotent: a second report of the same version is a no-op.
+        assert manager.quarantine_version("mlp", v2.version, 0) is None
+        assert manager.get_active_model_version("mlp", 0) == v1.version
+
+    def test_rollback_counter_only_on_actual_restore(self, distilled,
+                                                     tmp_path):
+        """Quarantining the only-ever version restores nothing — the
+        model_rollbacks counter must not claim it did."""
+        stats = ServingStats()
+        manager = make_manager(tmp_path, gate=False, stats=stats)
+        only = create(manager, distilled["good_dir"])
+        assert manager.quarantine_version("mlp", only.version, 0) is None
+        assert stats.get("model_quarantines") == 1
+        assert stats.get("model_rollbacks") == 0
+
+    def test_concurrent_quarantine_single_restore(self, distilled,
+                                                  tmp_path):
+        """Two sidecars reporting the same bad version concurrently must
+        restore ONE predecessor, not one each."""
+        manager = make_manager(tmp_path, gate=False)
+        create(manager, distilled["good_dir"])
+        create(manager, distilled["good_dir"])
+        bad = create(manager, distilled["good_dir"])
+        results = []
+
+        def report():
+            results.append(
+                manager.quarantine_version("mlp", bad.version, 0))
+
+        threads = [threading.Thread(target=report) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(1 for r in results if r is not None) == 1
+        active = [r for r in manager.list_models()
+                  if r.state == STATE_ACTIVE]
+        assert len(active) == 1
+
+    def test_manual_reactivation_of_old_row_keeps_invariant(
+            self, distilled, tmp_path):
+        manager = make_manager(tmp_path, gate=False)
+        v1 = create(manager, distilled["good_dir"])
+        create(manager, distilled["good_dir"])
+        manager.set_model_state(v1.id, STATE_ACTIVE)
+        rows = manager.list_models()
+        active = [r for r in rows if r.state == STATE_ACTIVE]
+        assert len(active) == 1 and active[0].id == v1.id
+
+
+# ----------------------------------------------------------------------
+# Runtime guard in MLEvaluator
+# ----------------------------------------------------------------------
+
+
+class _StubScorer:
+    def __init__(self, scores_fn):
+        self._fn = scores_fn
+
+    def score(self, features):
+        return self._fn(len(features))
+
+
+def _peers(n=6):
+    child = FakePeer("child", FakeHost(idc="a"))
+    parents = [FakePeer(f"p{i}", FakeHost(upload_count=5 * i),
+                        _finished=i + 1) for i in range(n)]
+    return parents, child
+
+
+class TestEvaluatorGuard:
+    def test_nan_batch_falls_back_and_escalates_once(self):
+        stats = ServingStats()
+        quarantined = []
+        ev = MLEvaluator(
+            _StubScorer(lambda n: np.full(n, np.nan, np.float32)),
+            stats=stats, guard_trip_limit=2,
+            on_quarantine=quarantined.append)
+        parents, child = _peers()
+        for _ in range(4):
+            ranked = ev.evaluate_parents(parents, child, 10)
+            # The decision is the RULE evaluator's, never the NaN batch.
+            assert sorted(p.id for p in ranked) == sorted(
+                p.id for p in parents)
+        assert ev.guard_trips == 4
+        assert ev.fallback_count == 4
+        assert ev.scored_count == 0
+        assert stats.get("ml_guard_trips") == 4
+        assert stats.get("ml_quarantines_reported") == 1
+        assert quarantined == ["nonfinite"]  # escalated exactly once
+
+    def test_escalation_retries_after_hook_failure_or_false(self):
+        """The latch arms only on a DELIVERED escalation: a transient
+        manager outage (hook raises) or a hook that couldn't act yet
+        (returns False) must leave the retry path open."""
+        calls = []
+
+        def flaky_hook(reason):
+            calls.append(reason)
+            if len(calls) == 1:
+                raise ConnectionError("manager unreachable")
+            if len(calls) == 2:
+                return False  # e.g. serving version not known yet
+            return None  # delivered
+
+        ev = MLEvaluator(
+            _StubScorer(lambda n: np.full(n, np.nan, np.float32)),
+            stats=ServingStats(), guard_trip_limit=1,
+            on_quarantine=flaky_hook)
+        parents, child = _peers()
+        for _ in range(4):
+            ev.evaluate_parents(parents, child, 10)
+        # raised → retried; False → retried; delivered → latched.
+        assert len(calls) == 3
+
+    def test_constant_batch_trips_and_reset_rearms(self):
+        stats = ServingStats()
+        quarantined = []
+        ev = MLEvaluator(_StubScorer(lambda n: np.zeros(n, np.float32)),
+                         stats=stats, guard_trip_limit=1,
+                         on_quarantine=quarantined.append)
+        parents, child = _peers()
+        ev.evaluate_parents(parents, child, 10)
+        assert quarantined == ["constant"]
+        ev.evaluate_parents(parents, child, 10)
+        assert len(quarantined) == 1  # latched
+        ev.reset_guard()
+        ev.evaluate_parents(parents, child, 10)
+        assert len(quarantined) == 2  # re-armed after model swap
+
+    def test_guard_auto_resets_on_version_change(self):
+        """A version-aware scorer (the remote one stamps last_version)
+        re-arms the guard when the serving version moves: trips from
+        version A never condemn version B, and an escalation latch
+        from one incident never silences the next."""
+        quarantined = []
+
+        class VersionedScorer:
+            def __init__(self):
+                self.last_version = "vA"
+                self.scores_fn = lambda n: np.full(n, np.nan, np.float32)
+
+            def score(self, features):
+                return self.scores_fn(len(features))
+
+        scorer = VersionedScorer()
+        ev = MLEvaluator(scorer, stats=ServingStats(), guard_trip_limit=2,
+                         on_quarantine=quarantined.append)
+        parents, child = _peers()
+        for _ in range(2):
+            ev.evaluate_parents(parents, child, 10)
+        assert quarantined == ["nonfinite"] and ev.guard_trips == 2
+        # Rollback lands: healthy version B serves — clean slate.
+        scorer.last_version = "vB"
+        scorer.scores_fn = lambda n: np.arange(n, dtype=np.float32)
+        ev.evaluate_parents(parents, child, 10)
+        assert ev.guard_trips == 0 and ev.scored_count == 1
+        # A LATER poisoned version C escalates again (latch re-armed).
+        scorer.last_version = "vC"
+        scorer.scores_fn = lambda n: np.full(n, np.nan, np.float32)
+        for _ in range(2):
+            ev.evaluate_parents(parents, child, 10)
+        assert quarantined == ["nonfinite", "nonfinite"]
+
+    def test_concurrent_trips_escalate_exactly_once(self):
+        """Guard bookkeeping under concurrent announce threads: no lost
+        increments, and the escalate-once check-then-act never fires
+        duplicate quarantine RPCs."""
+        import time as time_mod
+
+        calls = []
+
+        def slow_hook(reason):
+            calls.append(reason)
+            time_mod.sleep(0.02)  # widen the window a racing thread
+            return None           # would need to double-fire in
+
+        ev = MLEvaluator(
+            _StubScorer(lambda n: np.full(n, np.nan, np.float32)),
+            stats=ServingStats(), guard_trip_limit=4,
+            on_quarantine=slow_hook)
+        parents, child = _peers()
+        threads = [threading.Thread(
+            target=lambda: [ev.evaluate_parents(parents, child, 10)
+                            for _ in range(8)]) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ev.guard_trips == 32  # no lost increments
+        assert len(calls) == 1       # escalated exactly once
+
+    def test_small_constant_batch_is_not_a_trip(self):
+        ev = MLEvaluator(_StubScorer(lambda n: np.zeros(n, np.float32)),
+                         stats=ServingStats())
+        parents, child = _peers(2)
+        ev.evaluate_parents(parents, child, 10)
+        assert ev.guard_trips == 0 and ev.scored_count == 1
+
+    def test_quality_tracking_rule_baseline_is_one(self):
+        from dragonfly2_tpu.scheduler.evaluator import scoring
+        from dragonfly2_tpu.scheduler.evaluator.base import (
+            build_feature_matrix,
+        )
+
+        parents, child = _peers()
+        features = build_feature_matrix(parents, child, 10)
+        rule = scoring.rule_scores(features)
+        # A scorer that IS the rule scores → quality exactly 1.0.
+        ev = MLEvaluator(_StubScorer(lambda n: np.asarray(rule)),
+                         stats=ServingStats(), track_quality=True)
+        ev.evaluate_parents(parents, child, 10)
+        assert list(ev.quality_samples) == [1.0]
+        # A guard-tripped decision is the rule baseline's too.
+        ev2 = MLEvaluator(
+            _StubScorer(lambda n: np.full(n, np.nan, np.float32)),
+            stats=ServingStats(), track_quality=True)
+        ev2.evaluate_parents(parents, child, 10)
+        assert list(ev2.quality_samples) == [1.0]
+
+    def test_trace_log_records_live_features(self):
+        log = TraceLog()
+        ev = MLEvaluator(
+            _StubScorer(lambda n: np.arange(n, dtype=np.float32)),
+            stats=ServingStats(), trace_log=log)
+        parents, child = _peers()
+        ev.evaluate_parents(parents, child, 10)
+        assert len(log) == 1
+        assert log.batches()[0].shape == (len(parents), FEATURE_DIM)
+
+
+# ----------------------------------------------------------------------
+# Sidecar: shadow/canary, reload memoization, deactivate-all
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture()
+def sidecar_env(distilled, tmp_path):
+    from dragonfly2_tpu.inference.sidecar import InferenceService
+
+    stats = ServingStats()
+    manager = make_manager(tmp_path, gate=False, stats=stats)
+    service = InferenceService(
+        manager=manager, canary_batches=2, canary_probe_grace_s=0.0,
+        serving_stats=stats, reload_grace_s=0.2)
+    yield {"manager": manager, "service": service, "stats": stats}
+    service.stop()
+
+
+class TestSidecarLifecycle:
+    def test_poisoned_shadow_rejected_quarantined_rolled_back(
+            self, distilled, sidecar_env):
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        stats = sidecar_env["stats"]
+        good = create(manager, distilled["good_dir"])
+        assert service.reload_from_manager()  # first load: direct
+        assert service.serving_version("mlp") == good.version
+
+        bad = create(manager, distilled["nan_dir"])
+        assert service.reload_from_manager()  # shadow install
+        assert service.serving_version("mlp") == good.version
+        assert service.shadow_stats()["mlp"]["version"] == bad.version
+
+        service.process_shadows()  # probe batches trip the guard
+        assert service.shadow_stats() == {}
+        assert stats.get("canary_rollbacks") == 1
+        assert stats.get("shadow_guard_trips") == 1
+        # Fleet-wide: the manager quarantined the version and restored
+        # the incumbent; the next poll is a no-op for this sidecar.
+        assert manager.get_model_version_state(
+            "mlp", bad.version) == STATE_QUARANTINED
+        assert manager.get_active_model_version("mlp", 0) == good.version
+        assert service.reload_from_manager() is False
+        assert service.serving_version("mlp") == good.version
+
+    def test_healthy_shadow_promotes_on_live_batches(
+            self, distilled, sidecar_env):
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        stats = sidecar_env["stats"]
+        good = create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        v2 = create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        shadow = service._shadows["mlp"]
+        rng = np.random.default_rng(0)
+        for _ in range(2):
+            batch = rng.uniform(0, 50, (6, FEATURE_DIM)).astype(np.float32)
+            incumbent = service._models["mlp"].scorer.score(batch)
+            shadow["queue"].append((batch, incumbent))
+        service.process_shadows()
+        assert service.serving_version("mlp") == v2.version
+        assert stats.get("canary_promotions") == 1
+        assert stats.get("shadow_batches") == 2
+        assert good.version in service._known_good
+
+    def test_model_infer_mirrors_to_shadow(self, distilled, sidecar_env):
+        from dragonfly2_tpu.inference.sidecar import ModelInferRequest
+
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+
+        class Ctx:
+            def abort(self, code, msg):
+                raise AssertionError(f"abort: {code} {msg}")
+
+        features = np.random.default_rng(1).uniform(
+            0, 50, (5, FEATURE_DIM)).astype(np.float32)
+        resp = service.ModelInfer(
+            ModelInferRequest(model_name="mlp", inputs=features), Ctx())
+        # Decisions come from the incumbent while the shadow watches.
+        assert resp.model_version == service.serving_version("mlp")
+        assert len(service._shadows["mlp"]["queue"]) == 1
+
+    def test_latency_blowout_rejects_shadow(self, distilled, sidecar_env):
+        from dragonfly2_tpu.inference.sidecar import _new_shadow
+
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        stats = sidecar_env["stats"]
+        create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+
+        class SlowScorer:
+            def score(self, batch):
+                import time
+
+                time.sleep(0.05)
+                return np.arange(len(batch), dtype=np.float32)
+
+        service.canary_latency_budget_s = 0.01
+        service._shadows["mlp"] = _new_shadow("mlp", "slow-v", SlowScorer())
+        service.process_shadows()
+        assert service.shadow_stats() == {}
+        assert stats.get("canary_rollbacks") == 1
+        assert service._failed_versions["mlp"] == "slow-v"
+
+    def test_failed_quarantine_report_parked_and_retried(
+            self, distilled, sidecar_env):
+        """A canary rejection whose manager report fails (transient
+        outage) must not strand the poison active in the registry: the
+        report parks and the watcher tick re-delivers it."""
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        good = create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        bad = create(manager, distilled["nan_dir"], skip_validation=True)
+        service.reload_from_manager()
+
+        real_quarantine = manager.quarantine_version
+        outage = {"on": True}
+
+        def flaky_quarantine(*a, **kw):
+            if outage["on"]:
+                raise ConnectionError("manager unreachable")
+            return real_quarantine(*a, **kw)
+
+        manager.quarantine_version = flaky_quarantine
+        service.process_shadows()  # canary rejects; report fails
+        assert service._pending_quarantines == [
+            ("mlp", bad.version, "guard trip: nonfinite")]
+        # Registry still (wrongly) lists the poison active — the local
+        # memo holds the line meanwhile.
+        assert manager.get_active_model_version("mlp", 0) == bad.version
+        assert service.serving_version("mlp") == good.version
+        service.retry_pending_quarantines()  # still down: stays parked
+        assert service._pending_quarantines
+        outage["on"] = False
+        service.retry_pending_quarantines()  # watcher tick re-delivers
+        assert service._pending_quarantines == []
+        assert manager.get_active_model_version("mlp", 0) == good.version
+        assert manager.get_model_version_state(
+            "mlp", bad.version) == STATE_QUARANTINED
+
+    def test_reload_memoizes_failing_version(self, distilled, tmp_path):
+        """ISSUE satellite: a corrupt ACTIVE artifact fails ONCE, is
+        memoized, and is not re-downloaded + re-failed every poll; the
+        failure is counted, and a new version clears the memo."""
+        from dragonfly2_tpu.inference.sidecar import InferenceService
+
+        stats = ServingStats()
+        manager = make_manager(tmp_path, gate=False, stats=stats)
+        good = create(manager, distilled["good_dir"])
+
+        fetches = []
+        real_get = manager.get_active_model
+
+        def counting_get(name, scheduler_id=0):
+            fetches.append(name)
+            return real_get(name, scheduler_id)
+
+        manager.get_active_model = counting_get
+        service = InferenceService(manager=manager, serving_stats=stats,
+                                   reload_grace_s=0.2, canary_batches=2,
+                                   canary_probe_grace_s=0.0)
+        try:
+            service.reload_from_manager()
+            assert service.serving_version("mlp") == good.version
+            baseline_fetches = len(fetches)
+
+            garbage = tmp_path / "corrupt-artifact"
+            garbage.mkdir()
+            (garbage / "params.npz").write_bytes(b"junk")
+            create(manager, str(garbage))
+            assert service.reload_from_manager() is False
+            assert stats.get("model_reload_failures") == 1
+            assert len(fetches) == baseline_fetches + 1
+            # Memoized: subsequent polls never re-fetch the artifact.
+            for _ in range(3):
+                assert service.reload_from_manager() is False
+            assert len(fetches) == baseline_fetches + 1
+            assert stats.get("model_reload_failures") == 1
+            assert service.serving_version("mlp") == good.version
+
+            # A NEW version clears the memo and reloads.
+            v3 = create(manager, distilled["good_dir"])
+            assert service.reload_from_manager() is True
+            service.process_shadows()
+            assert service.serving_version("mlp") == v3.version
+        finally:
+            service.stop()
+
+    def test_deactivate_all_keeps_incumbent_serving(self, distilled,
+                                                    sidecar_env):
+        """ISSUE satellite: deactivating every version (active version
+        None) leaves the sidecar serving the incumbent — the version-
+        None → continue path."""
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        good = create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        manager.set_model_state(good.id, STATE_INACTIVE)
+        assert manager.get_active_model_version("mlp", 0) is None
+        assert service.reload_from_manager() is False
+        assert service.serving_version("mlp") == good.version
+
+    def test_rollback_replace_skips_shadow(self, distilled, sidecar_env):
+        """A rollback restoring a version this sidecar already served
+        installs DIRECTLY (shadow-delaying recovery would extend the
+        incident), and a quarantined incumbent is never a baseline."""
+        manager = sidecar_env["manager"]
+        service = sidecar_env["service"]
+        v1 = create(manager, distilled["good_dir"])
+        service.reload_from_manager()
+        v2 = create(manager, distilled["nan_dir"], skip_validation=True)
+        # Simulate the scheduler-side evaluator escalation having
+        # landed while THIS sidecar somehow served the poison (shadow
+        # disabled deployment).
+        service.shadow_mode = False
+        service.reload_from_manager()
+        assert service.serving_version("mlp") == v2.version
+        manager.quarantine_version("mlp", v2.version, 0, reason="guard")
+        assert service.reload_from_manager() is True
+        # Direct install of the restored version — no shadow phase.
+        assert service.serving_version("mlp") == v1.version
+        assert service.shadow_stats() == {}
+
+
+# ----------------------------------------------------------------------
+# FaultPlan sites: model.artifact / model.weights
+# ----------------------------------------------------------------------
+
+
+class TestModelFaultSites:
+    def test_artifact_corrupt_fails_cleanly_and_memoizes(
+            self, distilled, tmp_path):
+        from dragonfly2_tpu.inference.sidecar import InferenceService
+        from dragonfly2_tpu.utils import faultplan
+        from dragonfly2_tpu.utils.faultplan import FaultKind, FaultPlan
+
+        stats = ServingStats()
+        manager = make_manager(tmp_path, gate=False, stats=stats)
+        create(manager, distilled["good_dir"])
+        service = InferenceService(manager=manager, serving_stats=stats,
+                                   reload_grace_s=0.2)
+        plan = FaultPlan(seed=0)
+        plan.add("model.artifact", FaultKind.TRUNCATE, every_nth=1,
+                 match="mlp")
+        faultplan.install(plan)
+        try:
+            assert service.reload_from_manager() is False
+            assert stats.get("model_reload_failures") == 1
+            assert service.serving_version("mlp") is None
+            assert plan.snapshot()["model.artifact"]["total_fires"] == 1
+        finally:
+            faultplan.uninstall()
+            service.stop()
+
+    def test_weights_poison_loads_but_guards_catch(self, distilled,
+                                                   tmp_path):
+        """model.weights produces a LOADABLE scorer whose outputs only
+        the guards can condemn — the exact mlguard-rung failure shape."""
+        from dragonfly2_tpu.inference.sidecar import _scorer_from_artifact
+        from dragonfly2_tpu.manager.service import _tar_directory
+        from dragonfly2_tpu.utils import faultplan
+        from dragonfly2_tpu.utils.faultplan import FaultKind, FaultPlan
+
+        artifact = _tar_directory(distilled["good_dir"])
+        features = synthetic_traces(batches=1, rows=8)[0]
+        for kind, reason in ((FaultKind.CORRUPT, "nonfinite"),
+                             (FaultKind.SCALE, "constant")):
+            plan = FaultPlan(seed=0)
+            plan.add("model.weights", FaultKind.CORRUPT
+                     if kind is FaultKind.CORRUPT else FaultKind.SCALE,
+                     every_nth=1)
+            faultplan.install(plan)
+            try:
+                scorer = _scorer_from_artifact(artifact)
+            finally:
+                faultplan.uninstall()
+            scores = scorer.score(features)
+            assert guard_reason(scores) == reason
+
+
+# ----------------------------------------------------------------------
+# REST surface + /debug/vars serving block
+# ----------------------------------------------------------------------
+
+
+class TestRestAndDebugVars:
+    def test_rollback_endpoint_and_quarantine_409(self, distilled,
+                                                  tmp_path):
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        manager = make_manager(tmp_path, gate=False)
+        v1 = create(manager, distilled["good_dir"])
+        v2 = create(manager, distilled["good_dir"])
+        api = RestApi(manager)
+        code, out = api.dispatch(
+            "POST", f"/api/v1/models/{v2.id}/rollback", {},
+            {"reason": "operator"})
+        assert code == 200
+        assert out["quarantined"]["state"] == STATE_QUARANTINED
+        assert out["restored"]["id"] == v1.id
+        # Manual re-activation of the quarantined row: conflict.
+        code, out = api.dispatch(
+            "PATCH", f"/api/v1/models/{v2.id}", {}, {"state": "active"})
+        assert code == 409
+        # Lifecycle states are not PATCHable by hand.
+        code, _ = api.dispatch(
+            "PATCH", f"/api/v1/models/{v1.id}", {},
+            {"state": "quarantined"})
+        assert code == 400
+        # Rolling back a row with no predecessor: quarantined, nothing
+        # restored.
+        code, out = api.dispatch(
+            "POST", f"/api/v1/models/{v1.id}/rollback", {}, {})
+        assert code == 200 and out["restored"] is None
+
+    def test_internal_quarantine_and_trace_routes(self, distilled,
+                                                  tmp_path):
+        """The instance-facing surface a scheduler's guard escalation
+        and trace uploads ride (cmd/scheduler.py wiring)."""
+        import base64
+
+        from dragonfly2_tpu.manager.rest import RestApi
+
+        manager = make_manager(tmp_path, gate=False)
+        v1 = create(manager, distilled["good_dir"])
+        v2 = create(manager, distilled["good_dir"])
+        api = RestApi(manager)
+        code, out = api.dispatch(
+            "POST", "/internal/v1/models/quarantine", {},
+            {"type": "mlp", "version": v2.version, "scheduler_id": 0,
+             "reason": "guard"}, surface="internal")
+        assert code == 200 and out["restored"]["id"] == v1.id
+        assert manager.get_model_version_state(
+            "mlp", v2.version) == STATE_QUARANTINED
+
+        log = TraceLog()
+        log.record(np.ones((4, FEATURE_DIM), np.float32))
+        code, out = api.dispatch(
+            "POST", "/internal/v1/models/traces", {},
+            {"scheduler_id": 3,
+             "payload": base64.b64encode(log.to_bytes()).decode()},
+            surface="internal")
+        assert code == 200 and out["ok"]
+        assert len(manager.load_announce_traces(3)) == 1
+        # And the internal surface stays internal.
+        code, _ = api.dispatch(
+            "POST", "/internal/v1/models/quarantine", {},
+            {"type": "mlp", "version": v1.version})
+        assert code == 404
+
+    def test_serving_block_on_debug_vars(self):
+        from dragonfly2_tpu.utils import servingstats
+        from dragonfly2_tpu.utils.debugmon import debug_vars
+
+        before = debug_vars()["serving"]
+        servingstats.SERVING.tick("ml_guard_trips")
+        after = debug_vars()["serving"]
+        assert after["ml_guard_trips"] == before["ml_guard_trips"] + 1
+        for key in ("ml_fallbacks", "ml_sheds", "model_rollbacks",
+                    "canary_promotions", "model_reload_failures"):
+            assert key in after
+
+
+# ----------------------------------------------------------------------
+# Bench wiring: a budget-starved mlguard stage records an explicit skip
+# ----------------------------------------------------------------------
+
+
+class TestBenchSkipDiscipline:
+    def test_starved_stage_records_skip_artifact(self, tmp_path,
+                                                 monkeypatch):
+        import importlib.machinery
+        import importlib.util
+
+        loader = importlib.machinery.SourceFileLoader(
+            "df2_bench_for_test", "bench.py")
+        spec = importlib.util.spec_from_loader(loader.name, loader)
+        bench = importlib.util.module_from_spec(spec)
+        loader.exec_module(bench)
+        monkeypatch.setattr(bench, "STATE_DIR", str(tmp_path))
+
+        class State:
+            def __init__(self):
+                self.recorded = {}
+
+            def record(self, **kw):
+                self.recorded.update(kw)
+
+            def stage_done(self, name):
+                pass
+
+        state = State()
+        bench.stage_mlguard(state, {"left": lambda: 10.0,
+                                    "single_stage": False})
+        assert state.recorded.get("mlguard_skipped") is True
+        # Never a silent pass: the verdict key is ABSENT and the
+        # persisted artifact says skipped.
+        assert "mlguard_verdict_pass" not in state.recorded
+        import glob
+        import json
+
+        paths = glob.glob(str(tmp_path / "mlguard_run_*.json"))
+        assert len(paths) == 1
+        with open(paths[0]) as f:
+            assert json.load(f)["skipped"] is True
+        # And the regression-gate's record scan ignores it.
+        from dragonfly2_tpu.inference.guardbench import (
+            best_recorded_mlguard,
+        )
+
+        assert best_recorded_mlguard(str(tmp_path)) is None
+
+
+# ----------------------------------------------------------------------
+# The poisoned-model chaos rung (slow + mlguard)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.mlguard
+class TestMlguardRung:
+    def test_rung_green(self):
+        from dragonfly2_tpu.inference.guardbench import run_mlguard_rung
+
+        rung = run_mlguard_rung(seed=0)
+        assert rung["error"] is None, rung
+        assert rung["success_rate"] == 1.0, rung["failures"]
+        assert rung["gate"]["rejected_offline"]
+        assert rung["gate"]["trace_source"] == "recorded"
+        assert rung["shadow_phase"]["rolled_back"]
+        assert rung["shadow_phase"]["incumbent_held"]
+        assert rung["guard_phase"]["rolled_back"]
+        assert rung["guard_phase"]["rollback_s"] <= rung[
+            "rollback_bound_s"]
+        assert rung["verdict_pass"], rung

@@ -85,6 +85,9 @@ class TestSidecar:
             service.stop()
 
     def test_hot_reload_on_new_version(self, registered_model, tmp_path):
+        """A new active version first loads in SHADOW (the incumbent
+        keeps serving); the canary's clean batches promote it — the
+        guarded-rollout default (docs/SERVING.md)."""
         import tempfile
 
         from dragonfly2_tpu.train.checkpoint import (
@@ -94,7 +97,8 @@ class TestSidecar:
         )
 
         manager = registered_model["manager"]
-        service = InferenceService(manager=manager)
+        service = InferenceService(manager=manager, canary_batches=2,
+                                   canary_probe_grace_s=0.0)
         service.reload_from_manager()
         v1 = service._models["mlp"].version
         result = registered_model["result"]
@@ -108,7 +112,13 @@ class TestSidecar:
         manager.create_model("df2-mlp-t", "mlp", "h", "1.1.1.1", "hn", {},
                              artifact)
         assert service.reload_from_manager() is True
+        # Shadow first: decisions still come from the incumbent.
+        assert service._models["mlp"].version == v1
+        assert service.shadow_stats()["mlp"]["version"] != v1
+        # Canary probes (healthy model, zero grace) promote it.
+        service.process_shadows()
         assert service._models["mlp"].version != v1
+        assert service.shadow_stats() == {}
         service.stop()
 
     def test_unknown_model_aborts(self, registered_model):
@@ -184,7 +194,10 @@ class TestRemoteMLEvaluator:
                 if self.fail_next:
                     self.fail_next = False
                     raise FakeRpcError()
-                return np.zeros(len(inputs), np.float32)
+                # Distinct finite scores: an all-constant batch would
+                # (correctly) trip the runtime guard instead of counting
+                # as a scored decision.
+                return np.arange(len(inputs), dtype=np.float32)
 
         client = FakeClient()
         remote = _RemoteScorer(client, "mlp", cooldown=60.0)
