@@ -896,6 +896,73 @@ def stage_mlguard(state: BenchState, ctx: dict) -> None:
             rung)
 
 
+@stage("replay")
+def stage_replay(state: BenchState, ctx: dict) -> None:
+    """Replay plane — the ISSUE-13 decision-quality A/B
+    (dragonfly2_tpu/scheduler/replaybench.py): record a profiled-cost
+    swarm's full announce decision stream (candidates + features +
+    realized Welford costs + outcomes) into the rotating replay
+    dataset, train a learned piece-cost model + a bandwidth MLP on the
+    corpus, push both through the PR-12 validation gate, and replay
+    the corpus head-to-head through rule vs ML vs learned-cost
+    evaluators — reporting realized-cost regret, rank agreement,
+    bad-node precision/recall and per-decision latency. Determinism is
+    asserted (same corpus + seed ⇒ bit-identical decision sequence,
+    each evaluator replayed twice), and the recorder overhead guard
+    bounds announce p99 with the recorder ON within 5% of OFF
+    (docs/REPLAY.md). A green run persists to
+    artifacts/bench_state/replay_run_*.json — the record `bench.py
+    replay --check-regression` reads; budget-starved runs record an
+    explicit skip artifact, never a silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.scheduler.replaybench import run_replay_ab
+
+    # Budget gate inside the stage (the mlguard lesson): a registry
+    # min_left skip would record nothing.
+    if left() < 120.0 and not ctx.get("single_stage"):
+        state.record(replay_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"replay_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    report = run_replay_ab(seed=0)
+    evaluators = (report.get("ab") or {}).get("evaluators") or {}
+    state.record(
+        replay_corpus_decisions=(report.get("record") or {}).get(
+            "corpus_decisions"),
+        replay_gate={name: g.get("state")
+                     for name, g in (report.get("gate") or {}).items()},
+        replay_deterministic=(report.get("ab") or {}).get("deterministic"),
+        replay_regret_mean_s={name: s.get("regret_mean_s")
+                              for name, s in evaluators.items()},
+        replay_rank_agreement={name: s.get("rank_agreement_mean")
+                               for name, s in evaluators.items()},
+        replay_bad_node={name: {"precision": s.get("bad_node_precision"),
+                                "recall": s.get("bad_node_recall")}
+                         for name, s in evaluators.items()},
+        replay_decision_latency_p99_ms={
+            name: s.get("decision_latency_p99_ms")
+            for name, s in evaluators.items()},
+        replay_regret_within_bound=report.get("regret_within_bound"),
+        replay_recorder_overhead_ratio=(report.get("recorder_overhead")
+                                        or {}).get("p99_ratio"),
+        replay_recorder_overhead_ok=(report.get("recorder_overhead")
+                                     or {}).get("within_bound"),
+        replay_error=report.get("error"),
+        replay_verdict_pass=report.get("verdict_pass"),
+    )
+    state.stage_done("replay")
+    if report.get("verdict_pass"):
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"replay_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            report)
+
+
 @stage("fanout", min_left=90.0)
 def stage_fanout(state: BenchState, ctx: dict) -> None:
     """Fleet-scale checkpoint fan-out — the ISSUE-9 dissemination
@@ -1334,7 +1401,11 @@ def check_regression_main(stage_name: str) -> None:
     - ``mlguard``: a fresh poisoned-model rung must hold its absolute
       bounds (gate rejection, 100 % success, rollback ≤ 2 ×
       reload_interval, quality floor — docs/CHAOS.md); the best
-      record rides along for trend reading."""
+      record rides along for trend reading.
+    - ``replay``: a fresh record→gate→A/B pass must hold its absolute
+      bounds (bit-identical determinism, both models gate-promoted,
+      ML/learned-cost regret within the documented delta of the rule
+      baseline, recorder overhead ≤ 5% — docs/REPLAY.md)."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.uploadbench import check_regression
 
@@ -1359,10 +1430,17 @@ def check_regression_main(stage_name: str) -> None:
         )
 
         result = check_mlguard_regression(STATE_DIR)
+    elif stage_name == "replay":
+        from dragonfly2_tpu.scheduler.replaybench import (
+            check_replay_regression,
+        )
+
+        result = check_replay_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
-            "(have: dataplane, chaos, fanout, scheduler, mlguard)")
+            "(have: dataplane, chaos, fanout, scheduler, mlguard, "
+            "replay)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

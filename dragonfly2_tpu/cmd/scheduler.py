@@ -22,6 +22,89 @@ from dragonfly2_tpu.cmd.common import (
 )
 
 
+def _load_cost_evaluator(registry, current_version):
+    """ACTIVE `cost` version → LearnedCostEvaluator, or None when no
+    active version exists / it already serves. Shared by startup and
+    the reload watcher."""
+    from dragonfly2_tpu.inference.sidecar import (
+        MODEL_NAME_COST,
+        _cost_scorer_from_artifact,
+    )
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+    version = registry.get_active_model_version(MODEL_NAME_COST)
+    if version is None or version == current_version:
+        return None
+    active = registry.get_active_model(MODEL_NAME_COST)
+    if active is None:
+        return None
+    evaluator = new_evaluator(
+        "cost", scorer=_cost_scorer_from_artifact(active.artifact,
+                                                  version=active.version))
+    print(f"learned-cost evaluator serving version {active.version}",
+          flush=True)
+    return evaluator
+
+
+def _watch_cost_registry(service, registry, interval_s: float = 60.0,
+                         registry_factory=None):
+    """Poll the co-located registry and keep the scheduling core's
+    evaluator in sync with the ACTIVE cost version: a newly promoted
+    (or rolled-back-to) version hot-swaps in — without this a scheduler
+    started before the first promotion would stay on rules until
+    restart — and a registry left with NO active version (the serving
+    version was quarantined with nothing restorable) DEMOTES a serving
+    learned-cost evaluator back to rules, honoring the rollback
+    contract's "none -> evaluators rule-fall-back". ``registry`` may be
+    None when opening it failed at startup; the watcher then retries
+    ``registry_factory`` each tick, so fixing the registry on disk
+    never requires a scheduler restart."""
+    import logging
+    import threading
+    import time
+
+    from dragonfly2_tpu.inference.sidecar import MODEL_NAME_COST
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+    def swap_to(evaluator) -> None:
+        old = service.scheduling.evaluator
+        service.scheduling.evaluator = evaluator
+        close = getattr(old, "close", None)
+        if close is not None:
+            close()
+
+    def loop():
+        nonlocal registry
+        while True:
+            time.sleep(interval_s)
+            try:
+                if registry is None:
+                    if registry_factory is None:
+                        return
+                    registry = registry_factory()
+                    print("cost registry opened by the reload watcher",
+                          flush=True)
+                current = getattr(service.scheduling.evaluator,
+                                  "serving_version", None)
+                version = registry.get_active_model_version(MODEL_NAME_COST)
+                if version is None:
+                    if current is not None:
+                        swap_to(new_evaluator("default"))
+                        print("active cost model retired with no "
+                              "restorable predecessor; demoted to the "
+                              "rule evaluator", flush=True)
+                elif version != current:
+                    evaluator = _load_cost_evaluator(registry, current)
+                    if evaluator is not None:
+                        swap_to(evaluator)
+            except Exception:  # noqa: BLE001 — the watcher must not die
+                logging.getLogger(__name__).exception(
+                    "cost model reload check failed")
+
+    threading.Thread(target=loop, daemon=True,
+                     name="cost-model-watcher").start()
+
+
 def build_scheduler(args):
     from dragonfly2_tpu.rpc import serve
     from dragonfly2_tpu.scheduler.evaluator import new_evaluator
@@ -48,10 +131,56 @@ def build_scheduler(args):
         shard_count=args.resource_shards,
         gc_budget_s=args.gc_budget_ms / 1e3))
     storage = Storage(args.data_dir)
-    evaluator = new_evaluator(
-        args.algorithm,
-        sidecar_target=args.inference_sidecar or None,
-    )
+    cost_registry = None
+    if args.algorithm == "cost":
+        # Learned piece-cost evaluator (docs/REPLAY.md): the scorer MUST
+        # come from a gate-promoted ACTIVE `cost` registry version — the
+        # co-located manager db/object-store pair is the only loading
+        # path, so an ungated artifact can never reach this seam. No
+        # active version (or a load failure) degrades to the rule
+        # evaluator; the reload watcher below keeps polling so a later
+        # promotion (or rollback to a different version) is picked up
+        # without a restart — the sidecar reload-watcher contract.
+        evaluator = None
+        if not args.cost_model_db:
+            raise SystemExit("--algorithm cost needs --cost-model-db "
+                             "(co-located manager registry)")
+        def cost_registry_factory():
+            from dragonfly2_tpu.manager import (
+                Database,
+                FilesystemObjectStore,
+                ManagerService,
+            )
+
+            return ManagerService(
+                Database(args.cost_model_db),
+                FilesystemObjectStore(args.cost_object_dir))
+
+        try:
+            cost_registry = cost_registry_factory()
+            evaluator = _load_cost_evaluator(cost_registry, None)
+            if evaluator is None:
+                print("no ACTIVE cost model in the registry; scheduling "
+                      "with the rule evaluator until one is promoted "
+                      "(reload watcher polling)", flush=True)
+        except Exception:
+            import logging as _logging
+
+            _logging.getLogger(__name__).exception(
+                "cost registry open failed; degrading to rules "
+                "(reload watcher will retry opening it)")
+        if evaluator is None:
+            evaluator = new_evaluator("default")
+    else:
+        evaluator = new_evaluator(
+            args.algorithm,
+            sidecar_target=args.inference_sidecar or None,
+        )
+    replay_recorder = None
+    if args.record_replay:
+        from dragonfly2_tpu.scheduler.replaylog import ReplayRecorder
+
+        replay_recorder = ReplayRecorder(storage)
     seed_peer_client = None
     if args.seed_peer:
         # Remote seed daemons over the wire (resource/seed_peer_client.go
@@ -61,7 +190,7 @@ def build_scheduler(args):
         seed_peer_client = GrpcSeedPeerClient(args.seed_peer)
     service = SchedulerService(
         resource=resource,
-        scheduling=Scheduling(evaluator),
+        scheduling=Scheduling(evaluator, recorder=replay_recorder),
         storage=storage,
         network_topology=NetworkTopologyStore(
             # persist_path: a restarted replica warm-starts its probe
@@ -74,6 +203,9 @@ def build_scheduler(args):
     )
     resource.serve()
     service.network_topology.serve()
+    if args.algorithm == "cost":
+        _watch_cost_registry(service, cost_registry,
+                             registry_factory=cost_registry_factory)
     if args.replica_peer:
         # Cross-replica probe anti-entropy: symmetric push-pull of
         # probe-window deltas, bounding mid-window loss to one tick —
@@ -112,7 +244,22 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dir", default="./scheduler-data",
                         help="dataset sink directory")
     parser.add_argument("--algorithm", default="default",
-                        choices=["default", "ml", "plugin"])
+                        choices=["default", "ml", "cost", "plugin"])
+    parser.add_argument("--record-replay", action="store_true",
+                        help="record full announce decision events "
+                             "(candidates + features + realized costs + "
+                             "outcomes) into the data dir's rotating "
+                             "replay dataset for offline replay "
+                             "evaluation and cost-model training "
+                             "(docs/REPLAY.md; zero hot-path work when "
+                             "off)")
+    parser.add_argument("--cost-model-db", default="",
+                        help="manager sqlite path for --algorithm cost "
+                             "(co-located registry; only gate-promoted "
+                             "ACTIVE cost versions load)")
+    parser.add_argument("--cost-object-dir", default="./manager-objects",
+                        help="manager object-store dir holding the cost "
+                             "model artifacts")
     parser.add_argument("--resource-shards", type=int, default=8,
                         help="shards per resource-manager map; announce "
                              "lookups and GC snapshots contend per shard "

@@ -34,6 +34,13 @@ def main(argv=None) -> int:
     parser.add_argument("--train-gat", action="store_true",
                         help="also train + register the GraphTransformer "
                              "(BASELINE config #3) each cycle")
+    parser.add_argument("--train-interval", type=float, default=0.0,
+                        help="seconds between periodic retrain cycles: "
+                             "every interval, hosts with NEW closed "
+                             "dataset segments are retrained + "
+                             "registered without waiting for the next "
+                             "announcer stream EOF (0 = off; cycles and "
+                             "skips counted in TrainerMetrics)")
     parser.add_argument("--profile-dir", default="",
                         help="run train-step loops under "
                              "jax.profiler.trace; XPlane dumps land here "
@@ -84,9 +91,14 @@ def main(argv=None) -> int:
         metrics=metrics)
     server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
     print(f"trainer serving on {server.target}", flush=True)
+    if args.train_interval > 0:
+        service.start_cycle_driver(args.train_interval)
+        print(f"interval cycle driver running every "
+              f"{args.train_interval:g}s", flush=True)
     metrics_server = start_metrics_server(args, metrics.registry)
     debug_monitor = start_debug_monitor(args)
     wait_for_shutdown()
+    service.stop_cycle_driver()
     if metrics_server:
         metrics_server.stop()
     server.stop()

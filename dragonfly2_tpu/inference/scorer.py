@@ -34,11 +34,15 @@ from dragonfly2_tpu.inference.batcher import BatcherSaturatedError
 from dragonfly2_tpu.inference.modelguard import guard_reason
 from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
 from dragonfly2_tpu.scheduler.evaluator.base import (
+    _BAD_STATES,
+    MIN_AVAILABLE_COST_LEN,
+    PEER_STATE_RECEIVED_NORMAL,
+    PEER_STATE_RUNNING,
     BaseEvaluator,
     PeerLike,
     build_feature_matrix,
 )
-from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM, pack_features
 
 
 def _buckets(max_batch: int) -> list[int]:
@@ -461,6 +465,208 @@ class MLEvaluator:
 
     def is_bad_node(self, peer: PeerLike) -> bool:
         return self._fallback.is_bad_node(peer)
+
+
+class CostScorer:
+    """Ranking/threshold facade over a trained piece-cost predictor.
+
+    Wraps the plain :class:`ParentScorer` jit machinery (whose raw
+    output for a ``cost``-type checkpoint is the denormalized predicted
+    ``log1p(cost_seconds)``) with the two views consumers need:
+    ``score`` negates the prediction so HIGHER still means BETTER parent
+    (the ``evaluate_parents`` contract every evaluator shares), and
+    ``predict_cost_s`` maps back to seconds for the learned bad-node
+    threshold. ``version`` carries the registry version the artifact was
+    promoted under — the gate-provenance stamp the evaluator reports.
+    ``typical_cost_s`` is the training corpus's typical piece cost
+    (``expm1`` of the checkpoint's target-normalizer mean) — the
+    calibrated absolute baseline the learned bad-node threshold uses for
+    consistently-slow peers, whose own prediction is correctly high."""
+
+    def __init__(self, scorer: ParentScorer, version: str = "",
+                 typical_cost_s: float = 0.0):
+        self._scorer = scorer
+        self.version = version
+        self.typical_cost_s = typical_cost_s
+        self.max_batch = scorer.max_batch
+
+    def predict_cost_s(self, features: np.ndarray) -> np.ndarray:
+        # Clip before expm1: an out-of-distribution feature row must
+        # produce a large-but-finite cost, not an overflow inf that
+        # reads as a poisoned model. NaN passes through for the guard.
+        return np.expm1(np.clip(self._scorer.score(features), -20.0, 20.0))
+
+    def score(self, features: np.ndarray) -> np.ndarray:
+        return -self._scorer.score(features)
+
+    def close(self) -> None:
+        close = getattr(self._scorer, "close", None)
+        if close is not None:
+            close()
+
+
+class LearnedCostEvaluator:
+    """The ``cost`` evaluator algorithm — learned piece-cost ranking +
+    a learned ``is_bad_node`` seam replacing the 3-sigma threshold
+    (docs/REPLAY.md).
+
+    Ranking: candidates order by ASCENDING predicted cost (the
+    :class:`CostScorer` negation keeps the shared higher-is-better
+    contract). Bad-node: a peer whose LATEST observed piece cost exceeds
+    ``bad_cost_ratio`` x its feature-predicted cost is bad — an absolute
+    threshold that catches a peer that has been consistently terrible
+    from its first sample, which the relative 3-sigma rule structurally
+    cannot (its own history IS the baseline).
+
+    Guard discipline mirrors :class:`MLEvaluator`: every score batch and
+    every bad-node prediction passes :func:`~dragonfly2_tpu.inference.
+    modelguard.guard_reason` first; a tripped batch degrades THAT
+    decision to the inner (rule) evaluator and ticks
+    ``cost_guard_trips`` in the scheduler /debug/vars block — a
+    poisoned cost model never orders parents and never condemns peers.
+
+    The bad-node baseline is ``min(predicted cost for THIS peer's
+    features, corpus-typical cost)``: the per-peer prediction catches a
+    peer performing worse than its features explain (a sudden stall),
+    while the calibrated typical cost catches a peer that has been
+    consistently terrible from its first sample — which the relative
+    3-sigma rule structurally cannot (its own history IS its baseline)
+    and which a per-peer prediction alone also cannot (an accurate
+    model predicts a slow host's slowness and would excuse it).
+    """
+
+    def __init__(self, cost_scorer: CostScorer, *, inner=None,
+                 stats=None, bad_cost_ratio: float = 3.0,
+                 min_predicted_cost_s: float = 1e-4,
+                 bad_node_cache_size: int = 65536):
+        from dragonfly2_tpu.scheduler import controlstats
+
+        self._scorer = cost_scorer
+        self._inner = inner if inner is not None else BaseEvaluator()
+        self._stats = stats if stats is not None else controlstats.STATS
+        self.bad_cost_ratio = bad_cost_ratio
+        # Floor under the predicted cost so a near-zero prediction can't
+        # turn every measured cost into a "bad" verdict.
+        self.min_predicted_cost_s = min_predicted_cost_s
+        self.scored_count = 0
+        self.fallback_count = 0
+        self.guard_trips = 0
+        self._logged_failure = False
+        # is_bad_node verdict cache keyed by (peer id, windowed sample
+        # count, latest cost): the filter hot loop calls is_bad_node
+        # once per CANDIDATE per announce, and each miss is a single-row
+        # jit dispatch — without the cache a 15-candidate filter pays
+        # ~15 sequential device round trips per announce. A peer's
+        # verdict only changes when a new cost lands (the key changes),
+        # so steady-state filters are dict hits. Bounded: cleared on
+        # overflow (cheap; verdicts rebuild on demand).
+        self._bad_node_cache: dict = {}
+        self._bad_node_cache_size = bad_node_cache_size
+
+    @property
+    def serving_version(self) -> str:
+        return getattr(self._scorer, "version", "")
+
+    def close(self) -> None:
+        close = getattr(self._scorer, "close", None)
+        if close is not None:
+            close()
+
+    def _fallback_ranked(self, parents, child, total_piece_count):
+        self.fallback_count += 1
+        self._stats.observe_cost_fallback()
+        return self._inner.evaluate_parents(parents, child,
+                                            total_piece_count)
+
+    def evaluate_parents(
+        self, parents: Sequence[PeerLike], child: PeerLike, total_piece_count: int
+    ) -> list[PeerLike]:
+        if not parents:
+            return []
+        features = build_feature_matrix(parents, child, total_piece_count)
+        try:
+            scores = self._scorer.score(features)
+        except Exception:
+            if not self._logged_failure:
+                self._logged_failure = True
+                logging.getLogger(__name__).exception(
+                    "learned-cost scoring failed; falling back to the "
+                    "inner evaluator (further failures counted, not "
+                    "logged)")
+            return self._fallback_ranked(parents, child, total_piece_count)
+        reason = guard_reason(scores, features=features)
+        if reason is not None:
+            self.guard_trips += 1
+            self._stats.observe_cost_guard_trip()
+            return self._fallback_ranked(parents, child, total_piece_count)
+        self.scored_count += 1
+        order = np.argsort(-scores, kind="stable")
+        return [parents[i] for i in order]
+
+    def is_bad_node(self, peer: PeerLike) -> bool:
+        from dragonfly2_tpu.scheduler.replaylog import welford_snapshot
+
+        state = peer.state()
+        if state in _BAD_STATES:
+            return True
+        n, last, _, _ = welford_snapshot(peer)
+        if n < MIN_AVAILABLE_COST_LEN:
+            return False
+        # The lifetime-append counter (when the stats carry one) marks
+        # every new cost even when the window is full AND the new cost
+        # equals the previous latest — (peer.id, n, last) alone would
+        # pin a stale verdict on a constant-rate link forever.
+        stats_of = getattr(peer, "piece_cost_stats", None)
+        marker = (getattr(stats_of(), "appends", n)
+                  if stats_of is not None else n)
+        cache_key = (peer.id, marker, last)
+        cached = self._bad_node_cache.get(cache_key)
+        if cached is not None:
+            self._stats.observe_bad_node_learned(bad=cached)
+            return cached
+        host = peer.host
+        is_seed = bool(getattr(host.type, "is_seed", bool(host.type)))
+        # The peer judged AS a parent against a fresh child of its own
+        # task (the common announce-time pairing, so the row stays in
+        # the training distribution): the prediction is "what should a
+        # piece from this peer cost".
+        total = getattr(getattr(peer, "task", None), "total_piece_count", 0)
+        row = pack_features(
+            parent_finished_pieces=peer.finished_piece_count(),
+            child_finished_pieces=0,
+            total_pieces=total,
+            upload_count=host.upload_count,
+            upload_failed_count=host.upload_failed_count,
+            free_upload_count=host.free_upload_count(),
+            concurrent_upload_limit=host.concurrent_upload_limit,
+            is_seed=is_seed,
+            seed_ready=is_seed and state in (PEER_STATE_RECEIVED_NORMAL,
+                                             PEER_STATE_RUNNING),
+        )[None, :]
+        try:
+            predicted = float(self._scorer.predict_cost_s(row)[0])
+        except Exception:
+            self._stats.observe_cost_fallback()
+            return self._inner.is_bad_node(peer)
+        if guard_reason(np.asarray([predicted])) is not None:
+            self.guard_trips += 1
+            self._stats.observe_cost_guard_trip()
+            return self._inner.is_bad_node(peer)
+        # Positive baselines only: a nonpositive prediction (an
+        # out-of-distribution row pushed the regressor below zero after
+        # expm1) carries no per-peer signal and must not collapse the
+        # threshold to the floor — the calibrated typical cost stands
+        # in alone.
+        typical = getattr(self._scorer, "typical_cost_s", 0.0)
+        positives = [v for v in (predicted, typical) if v > 0]
+        baseline = min(positives) if positives else self.min_predicted_cost_s
+        bad = last > self.bad_cost_ratio * max(baseline,
+                                               self.min_predicted_cost_s)
+        if len(self._bad_node_cache) >= self._bad_node_cache_size:
+            self._bad_node_cache.clear()
+        self._bad_node_cache[cache_key] = bad
+        self._stats.observe_bad_node_learned(bad=bad)
+        return bad
 
 
 class GATParentScorer:

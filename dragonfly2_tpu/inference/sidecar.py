@@ -39,6 +39,7 @@ logger = logging.getLogger(__name__)
 MODEL_NAME_MLP = "mlp"
 MODEL_NAME_GNN = "gnn"
 MODEL_NAME_GAT = "gat"
+MODEL_NAME_COST = "cost"
 
 
 @message("inference.ModelInferRequest")
@@ -815,8 +816,11 @@ def _fault_artifact(artifact: bytes, rule) -> bytes:
     return artifact
 
 
-def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
-    """model.tar → ParentScorer (checkpoint load + jit warm-up)."""
+def _mlp_checkpoint_from_artifact(artifact: bytes, poison_context: str):
+    """ONE untar/load/poison path for every MLP-layout checkpoint (the
+    bandwidth scorer and the cost predictor share it, so a fix to the
+    cleanup or fault handling can never be missing from one of them).
+    Returns ``(scorer, target_norm)``."""
     from dragonfly2_tpu.manager.service import untar_to_directory
     from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor
     from dragonfly2_tpu.train.checkpoint import load_model, mlp_from_tree
@@ -826,14 +830,35 @@ def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
         untar_to_directory(artifact, tmp)
         tree, metadata = load_model(tmp)
         params, normalizer, target_norm = mlp_from_tree(tree)
-        params = _maybe_poison_weights(params, MODEL_NAME_MLP)
+        params = _maybe_poison_weights(params, poison_context)
         hidden = tuple(metadata.config.get("hidden", (128, 128, 64)))
         model = MLPBandwidthPredictor(hidden=hidden)
-        return ParentScorer(model, params, normalizer, target_norm)
+        return ParentScorer(model, params, normalizer, target_norm), target_norm
     finally:
         import shutil
 
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _scorer_from_artifact(artifact: bytes) -> ParentScorer:
+    """model.tar → ParentScorer (checkpoint load + jit warm-up)."""
+    return _mlp_checkpoint_from_artifact(artifact, MODEL_NAME_MLP)[0]
+
+
+def _cost_scorer_from_artifact(artifact: bytes, version: str = ""):
+    """model.tar (type ``cost``) → CostScorer: the same params +
+    normalizer checkpoint layout as the bandwidth MLP, wrapped so
+    ``score`` ranks by NEGATED predicted cost and ``predict_cost_s``
+    feeds the learned bad-node threshold. The checkpoint's target-
+    normalizer mean doubles as the CALIBRATED typical piece cost of the
+    training corpus — the absolute bad-node baseline (docs/REPLAY.md)."""
+    from dragonfly2_tpu.inference.scorer import CostScorer
+
+    scorer, target_norm = _mlp_checkpoint_from_artifact(
+        artifact, MODEL_NAME_COST)
+    typical = float(np.expm1(float(target_norm.mean[0])))
+    return CostScorer(scorer, version=version,
+                      typical_cost_s=max(typical, 0.0))
 
 
 def _maybe_poison_weights(params, context: str):

@@ -206,7 +206,8 @@ def synthetic_traces(seed: int = 0, batches: int = 16,
 
 
 def validate_feature_scorer(scorer, traces: Sequence[np.ndarray],
-                            config: ValidationConfig) -> ValidationReport:
+                            config: ValidationConfig,
+                            enforce_correlation: bool = True) -> ValidationReport:
     """Replay feature-matrix traces through a candidate scorer and apply
     the promotion criteria.
 
@@ -263,7 +264,14 @@ def validate_feature_scorer(scorer, traces: Sequence[np.ndarray],
             spearman(pooled_scores, np.concatenate(all_rule)), 4)
         report.checks["rank_correlation_scope"] = "pooled"
     if report.rank_correlation is not None:
-        if report.rank_correlation < config.min_rank_correlation:
+        if not enforce_correlation:
+            # A learned-cost candidate ranks by MEASURED realized costs;
+            # legitimate disagreement with the hand-tuned rule weights
+            # is the whole point of training it, so the rule-correlation
+            # floor is recorded as evidence, never enforced. The
+            # non-negotiable guard + latency checks above still gate.
+            report.checks["rank_correlation"] = "informational"
+        elif report.rank_correlation < config.min_rank_correlation:
             report.reasons.append(
                 f"rank correlation {report.rank_correlation} below floor "
                 f"{config.min_rank_correlation}")
@@ -334,28 +342,49 @@ def validate_artifact(model_type: str, artifact: bytes,
     confidence."""
     # Lazy import: sidecar ← manager.service ← (lazily) this module.
     from dragonfly2_tpu.inference.sidecar import (
+        MODEL_NAME_COST,
         MODEL_NAME_GAT,
         MODEL_NAME_MLP,
+        _cost_scorer_from_artifact,
         _gat_scorer_from_artifact,
         _scorer_from_artifact,
     )
 
-    if model_type == MODEL_NAME_MLP:
+    def validate_feature_type(builder, enforce_correlation: bool):
+        # One load→trace-fallback→replay scaffold for every feature-
+        # matrix scorer type (mlp, cost) — a future check added to this
+        # path can never land in one type and miss the other.
         try:
-            scorer = _scorer_from_artifact(artifact)
+            scorer = builder(artifact)
         except Exception as exc:  # noqa: BLE001 — load failure is a verdict
             return ValidationReport(
                 reasons=[f"artifact load failed: {exc!r}"],
                 checks={"load": "failed"}, trace_source="none")
-        if traces:
-            source = "recorded"
-        else:
-            traces = synthetic_traces(config.seed, config.synthetic_batches,
-                                      config.synthetic_rows)
+        replay_traces, source = traces, "recorded"
+        if not replay_traces:
+            replay_traces = synthetic_traces(
+                config.seed, config.synthetic_batches,
+                config.synthetic_rows)
             source = "synthetic"
-        report = validate_feature_scorer(scorer, traces, config)
+        report = validate_feature_scorer(
+            scorer, replay_traces, config,
+            enforce_correlation=enforce_correlation)
         report.trace_source = source
         return report
+
+    if model_type == MODEL_NAME_COST:
+        # Learned piece-cost predictor (docs/REPLAY.md): replays the
+        # same feature-matrix traces through the CostScorer ranking
+        # view. Guard + latency are enforced exactly like the MLP's;
+        # the rule rank-correlation is recorded but NOT enforced — a
+        # cost model trained on realized costs may legitimately invert
+        # hand-tuned rule preferences, and its decision quality is
+        # gated downstream by the `bench.py replay` A/B instead.
+        return validate_feature_type(_cost_scorer_from_artifact,
+                                     enforce_correlation=False)
+    if model_type == MODEL_NAME_MLP:
+        return validate_feature_type(_scorer_from_artifact,
+                                     enforce_correlation=True)
     if model_type == MODEL_NAME_GAT:
         try:
             scorer = _gat_scorer_from_artifact(artifact)

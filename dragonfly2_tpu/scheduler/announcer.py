@@ -18,6 +18,7 @@ from typing import Iterator, Optional, Protocol
 
 from dragonfly2_tpu.scheduler.storage import Storage
 from dragonfly2_tpu.trainer.service import (
+    TrainCostRequest,
     TrainGnnRequest,
     TrainMlpRequest,
     TrainRequest,
@@ -121,18 +122,21 @@ class Announcer:
             return None
         download_files = self.storage.snapshot_download()
         topology_files = self.storage.snapshot_network_topology()
-        if not download_files and not topology_files:
+        replay_files = self.storage.snapshot_replay()
+        if not download_files and not topology_files and not replay_files:
             logger.info("no datasets to upload")
             return None
 
         response = self.trainer_client.train(
-            self._requests(download_files, topology_files)
+            self._requests(download_files, topology_files, replay_files)
         )
         self.storage.remove_download_files(download_files)
         self.storage.remove_network_topology_files(topology_files)
+        self.storage.remove_replay_files(replay_files)
         return response
 
-    def _requests(self, download_files, topology_files) -> Iterator[TrainRequest]:
+    def _requests(self, download_files, topology_files,
+                  replay_files=()) -> Iterator[TrainRequest]:
         base = dict(host_id=self.host_id, ip=self.ip, hostname=self.hostname,
                     scheduler_id=self.scheduler_id)
         for path in topology_files:
@@ -144,6 +148,12 @@ class Announcer:
             for i, chunk in enumerate(self._chunks(path)):
                 yield TrainRequest(
                     **base, mlp=TrainMlpRequest(dataset=chunk, new_file=i == 0)
+                )
+        for path in replay_files:
+            for i, chunk in enumerate(self._chunks(path)):
+                yield TrainRequest(
+                    **base,
+                    cost=TrainCostRequest(dataset=chunk, new_file=i == 0)
                 )
 
     def _chunks(self, path: str) -> Iterator[bytes]:

@@ -82,6 +82,20 @@ class ControlPlaneStats:
         self.source_claims_granted = 0
         self.bad_node_fast = 0
         self.bad_node_slow = 0
+        # Learned-cost seam (docs/REPLAY.md): is_bad_node verdicts served
+        # by the learned piece-cost model vs degraded to the 3-sigma rule
+        # path on a modelguard trip (cost_guard_trips) or scorer failure.
+        self.bad_node_learned = 0
+        self.bad_node_learned_bad = 0
+        self.cost_guard_trips = 0
+        self.cost_fallbacks = 0
+        # Replay recorder (docs/REPLAY.md): decisions captured, events
+        # finalized with outcomes, pending entries evicted unfinished,
+        # candidate sets truncated to the schema arity.
+        self.replay_decisions = 0
+        self.replay_finalized = 0
+        self.replay_evicted = 0
+        self.replay_truncated = 0
         self.gc_ticks = 0
         self.gc_budget_overruns = 0
         self.gc_reclaimed = 0
@@ -144,6 +158,35 @@ class ControlPlaneStats:
         else:
             self.bad_node_slow += 1
 
+    def observe_bad_node_learned(self, *, bad: bool) -> None:
+        # Lock-free for the same reason as observe_bad_node: one tick
+        # per candidate inside the filter hot loop.
+        self.bad_node_learned += 1
+        if bad:
+            self.bad_node_learned_bad += 1
+
+    def observe_cost_guard_trip(self) -> None:
+        self.cost_guard_trips += 1
+
+    def observe_cost_fallback(self) -> None:
+        self.cost_fallbacks += 1
+
+    def observe_replay(self, *, decision: bool = False,
+                       finalized: bool = False, evicted: bool = False,
+                       truncated: bool = False) -> None:
+        # Lock-free and EXACT: the recorder's single capture thread is
+        # the only writer of these counters, and taking the shared
+        # stats lock here would let capture stall announce threads
+        # mid-observe_schedule (the recorder overhead guard's budget).
+        if decision:
+            self.replay_decisions += 1
+        if finalized:
+            self.replay_finalized += 1
+        if evicted:
+            self.replay_evicted += 1
+        if truncated:
+            self.replay_truncated += 1
+
     def observe_gc(self, ms: float, *, overran: bool, reclaimed: int) -> None:
         with self._lock:
             self.gc_ticks += 1
@@ -178,6 +221,14 @@ class ControlPlaneStats:
                 "source_claims_granted": self.source_claims_granted,
                 "bad_node_fast": self.bad_node_fast,
                 "bad_node_slow": self.bad_node_slow,
+                "bad_node_learned": self.bad_node_learned,
+                "bad_node_learned_bad": self.bad_node_learned_bad,
+                "cost_guard_trips": self.cost_guard_trips,
+                "cost_fallbacks": self.cost_fallbacks,
+                "replay_decisions": self.replay_decisions,
+                "replay_finalized": self.replay_finalized,
+                "replay_evicted": self.replay_evicted,
+                "replay_truncated": self.replay_truncated,
                 "gc_ticks": self.gc_ticks,
                 "gc_budget_overruns": self.gc_budget_overruns,
                 "gc_reclaimed": self.gc_reclaimed,

@@ -22,6 +22,7 @@ from dragonfly2_tpu.scheduler.evaluator.scoring import (
 
 ALGORITHM_DEFAULT = "default"
 ALGORITHM_ML = "ml"
+ALGORITHM_COST = "cost"
 ALGORITHM_PLUGIN = "plugin"
 
 
@@ -72,6 +73,21 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
                 scorer, adaptive_wait_s=batch_adaptive_wait_s,
                 lanes=batch_lanes, queue_depth=batch_queue_depth)
         return MLEvaluator(scorer, **guard_kwargs)
+    if algorithm == ALGORITHM_COST:
+        # Learned piece-cost evaluator (docs/REPLAY.md): ranks by
+        # negated predicted cost and replaces the 3-sigma is_bad_node
+        # threshold with the learned one; modelguard-checked with rule
+        # fallback per decision. The scorer MUST be a CostScorer built
+        # from a gate-promoted `cost` registry version
+        # (inference.sidecar._cost_scorer_from_artifact) — there is no
+        # ungated path to this seam.
+        from dragonfly2_tpu.inference.scorer import LearnedCostEvaluator
+
+        if scorer is None:
+            raise ValueError(
+                "algorithm 'cost' needs a CostScorer (build one from a "
+                "gate-promoted 'cost' model via cost_scorer= / scorer=)")
+        return LearnedCostEvaluator(scorer, **guard_kwargs)
     if algorithm == ALGORITHM_PLUGIN:
         from importlib.metadata import entry_points
 
@@ -82,6 +98,7 @@ def new_evaluator(algorithm: str = ALGORITHM_DEFAULT, *, scorer=None,
 
 
 __all__ = [
+    "ALGORITHM_COST",
     "ALGORITHM_DEFAULT",
     "ALGORITHM_ML",
     "ALGORITHM_PLUGIN",

@@ -1,0 +1,283 @@
+"""``bench.py replay`` — decision-quality A/B over a recorded corpus.
+
+The replay plane's proof stage (docs/REPLAY.md), four phases:
+
+1. **Record** — drive a profiled-cost swarm (fast seeds, ordinary peers,
+   a slice of pathologically slow hosts; the slowness visible in the
+   canonical features) through the REAL SchedulerService with the
+   announce-stream recorder installed; the corpus lands in a rotating
+   scheduler-storage ``replay`` dataset and is read back from disk —
+   the same record→rotate→read path production takes.
+2. **Train** — a learned piece-cost model (``train/cost_trainer.py``)
+   and a bandwidth MLP on the corpus's (features → realized cost)
+   examples.
+3. **Gate** — both artifacts enter the manager registry through the
+   PR-12 validation gate (``cost`` and ``mlp`` types), replaying the
+   feature traces recorded from THIS swarm; only gate-promoted ACTIVE
+   versions reach the evaluators — there is no ungated path.
+4. **A/B** — replay the corpus through rule vs ML vs learned-cost
+   evaluators head-to-head (each twice: same corpus + seed must yield a
+   bit-identical decision sequence), scoring realized-cost regret, rank
+   agreement, bad-node precision/recall and per-decision latency; plus
+   the recorder overhead guard (announce p99 with recorder on within
+   5% of off).
+
+Verdict (green → artifact persisted, ``--check-regression`` gate):
+deterministic replays, both models gate-promoted, ML and learned-cost
+regret within ``REGRET_DELTA_BOUND`` of the rule baseline's (deltas
+always reported), recorder overhead within bound.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional
+
+import numpy as np
+
+#: An ML/learned-cost evaluator may exceed the rule baseline's mean
+#: realized-cost regret by at most this much before the stage goes red:
+#: the larger of 10% of the rule regret or 2 ms absolute (a micro-regret
+#: corpus must not fail on noise). Deltas are reported either way.
+REGRET_REL_BOUND = 0.10
+REGRET_ABS_BOUND_S = 0.002
+
+#: Minimum corpus size before the A/B means anything.
+MIN_CORPUS_DECISIONS = 100
+
+
+def _regret_within_bound(candidate: Optional[float],
+                         baseline: Optional[float]) -> Optional[bool]:
+    if candidate is None or baseline is None:
+        return None
+    return candidate <= baseline + max(REGRET_REL_BOUND * abs(baseline),
+                                       REGRET_ABS_BOUND_S)
+
+
+def run_replay_ab(*, seed: int = 0, record_peers: int = 600,
+                  workers: int = 4,
+                  overhead_guard: bool = True) -> Dict[str, object]:
+    from dragonfly2_tpu.inference.scorer import (
+        LearnedCostEvaluator,
+        MLEvaluator,
+    )
+    from dragonfly2_tpu.inference.sidecar import (
+        MODEL_NAME_COST,
+        MODEL_NAME_MLP,
+        _cost_scorer_from_artifact,
+        _scorer_from_artifact,
+    )
+    from dragonfly2_tpu.manager import (
+        Database,
+        FilesystemObjectStore,
+        ManagerService,
+    )
+    from dragonfly2_tpu.manager.validation import ValidationConfig
+    from dragonfly2_tpu.scheduler import replay as rp
+    from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+    from dragonfly2_tpu.scheduler.loadbench import (
+        run_recorder_overhead_guard,
+        run_swarm_bench,
+    )
+    from dragonfly2_tpu.scheduler.replaylog import ReplayRecorder
+    from dragonfly2_tpu.scheduler.storage.storage import Storage, StorageConfig
+    from dragonfly2_tpu.train.checkpoint import (
+        ModelMetadata,
+        mlp_tree,
+        save_model,
+    )
+    from dragonfly2_tpu.train.cost_trainer import (
+        CostTrainConfig,
+        cost_examples_from_corpus,
+        cost_tree,
+        train_cost,
+    )
+    from dragonfly2_tpu.train.mlp_trainer import MLPTrainConfig, train_mlp
+
+    report: Dict[str, object] = {"seed": seed, "record_peers": record_peers}
+    workdir = tempfile.mkdtemp(prefix="df2-replaybench-")
+    evaluators: Dict[str, object] = {}
+    try:
+        # -- phase 1: record ------------------------------------------------
+        storage = Storage(os.path.join(workdir, "sched"),
+                          StorageConfig(max_size=256 * 1024, buffer_size=25))
+        recorder = ReplayRecorder(storage)
+        rung = run_swarm_bench(record_peers, workers=workers,
+                               recorder=recorder, cost_profile="profiled",
+                               profile_seed=seed)
+        # run_swarm_bench already finalized + flushed the recorder.
+        recorder.close()
+        corpus = rp.corpus_from_storage(storage)
+        report["record"] = {
+            "decisions": rung["decisions"],
+            "replay_decisions": rung["replay_decisions"],
+            "replay_finalized": rung["replay_finalized"],
+            "replay_files": len(storage.replay.all_files()),
+            "corpus_decisions": len(corpus),
+            "errors": rung["errors"],
+        }
+        if len(corpus) < MIN_CORPUS_DECISIONS:
+            report["error"] = (f"corpus too small: {len(corpus)} < "
+                               f"{MIN_CORPUS_DECISIONS}")
+            report["verdict_pass"] = False
+            return report
+
+        # -- phase 2: train -------------------------------------------------
+        X, y = cost_examples_from_corpus(corpus)
+        report["train"] = {"examples": int(len(X))}
+        cost_result = train_cost(
+            X, y, CostTrainConfig(hidden=(32, 16), epochs=25,
+                                  batch_size=512, seed=seed))
+        report["train"]["cost_mae_s"] = round(cost_result.mae, 5)
+        # Bandwidth twin for the ML evaluator: same features, realized
+        # MB/s label (piece length is 4 MiB in the loadbench swarm).
+        piece_mb = 4.0
+        y_bw = piece_mb / np.maximum(y, 1e-4)
+        mlp_result = train_mlp(
+            X, y_bw.astype(np.float32),
+            MLPTrainConfig(hidden=(32, 16), epochs=25, batch_size=512,
+                           seed=seed))
+        report["train"]["mlp_rmse_mb_s"] = round(mlp_result.mse ** 0.5, 4)
+        report["train"]["mlp_mae_mb_s"] = round(mlp_result.mae, 4)
+
+        # -- phase 3: gate --------------------------------------------------
+        manager = ManagerService(
+            Database(os.path.join(workdir, "manager.db")),
+            FilesystemObjectStore(os.path.join(workdir, "objects")),
+            validation=ValidationConfig())
+        traces = [np.stack([rp._row_array(c) for c in e.candidates])
+                  for e in corpus if e.candidates]
+        gate: Dict[str, object] = {}
+        for name, tree, evaluation, hidden in (
+            (MODEL_NAME_COST, cost_tree(cost_result),
+             {"mse": cost_result.mse, "mae": cost_result.mae,
+              "n_samples": cost_result.n_samples}, (32, 16)),
+            (MODEL_NAME_MLP,
+             mlp_tree(mlp_result.params, mlp_result.normalizer,
+                      mlp_result.target_norm),
+             {"mse": mlp_result.mse, "mae": mlp_result.mae,
+              "n_samples": int(len(X))}, (32, 16)),
+        ):
+            art_dir = os.path.join(workdir, f"artifact-{name}")
+            save_model(art_dir, tree, ModelMetadata(
+                model_id=f"replay-{name}", model_type=name,
+                evaluation=dict(evaluation),
+                config={"hidden": list(hidden)}))
+            row = manager.create_model(
+                model_id=f"replay-{name}", model_type=name,
+                host_id="replay-bench", ip="127.0.0.1",
+                hostname="replaybench", evaluation=dict(evaluation),
+                artifact_dir=art_dir, scheduler_id=0, traces=traces)
+            gate[name] = {
+                "state": row.state,
+                "version": row.version,
+                "validation": (row.evaluation or {}).get("validation"),
+            }
+        report["gate"] = gate
+        gates_green = all(g["state"] == "active" for g in gate.values())
+
+        # -- phase 4: A/B ---------------------------------------------------
+        evaluators["rule"] = BaseEvaluator()
+        if gate[MODEL_NAME_MLP]["state"] == "active":
+            active = manager.get_active_model(MODEL_NAME_MLP)
+            evaluators["ml"] = MLEvaluator(
+                _scorer_from_artifact(active.artifact))
+        if gate[MODEL_NAME_COST]["state"] == "active":
+            active = manager.get_active_model(MODEL_NAME_COST)
+            evaluators["cost"] = LearnedCostEvaluator(
+                _cost_scorer_from_artifact(active.artifact,
+                                           version=active.version))
+        ab = rp.replay_ab(corpus, evaluators, seed=seed)
+        report["ab"] = ab
+
+        if overhead_guard:
+            report["recorder_overhead"] = run_recorder_overhead_guard()
+
+        # -- verdict --------------------------------------------------------
+        scored = ab["evaluators"]
+        rule_regret = scored.get("rule", {}).get("regret_mean_s")
+        regret_ok: Dict[str, object] = {}
+        for name in ("ml", "cost"):
+            regret_ok[name] = _regret_within_bound(
+                scored.get(name, {}).get("regret_mean_s"), rule_regret)
+        report["regret_within_bound"] = regret_ok
+        report["regret_bounds"] = {"relative": REGRET_REL_BOUND,
+                                   "absolute_s": REGRET_ABS_BOUND_S}
+        overhead_ok = (report["recorder_overhead"]["within_bound"]
+                       if overhead_guard else True)
+        report["verdict_pass"] = bool(
+            ab["deterministic"]
+            and gates_green
+            and all(v is True for v in regret_ok.values())
+            and overhead_ok
+            and not rung["errors"])
+        return report
+    except Exception as exc:  # noqa: BLE001 — the stage must report
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["verdict_pass"] = False
+        return report
+    finally:
+        for ev in evaluators.values():
+            close = getattr(ev, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001
+                    pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def best_recorded_replay_run(state_dir: str):
+    """Best persisted ``replay_run_*.json`` (largest corpus, tiebroken
+    by lowest learned-cost regret); skip artifacts are ignored."""
+    import glob
+    import json
+
+    best = None
+    for path in glob.glob(os.path.join(state_dir, "replay_run_*.json")):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if data.get("skipped") or not data.get("verdict_pass"):
+            continue
+        corpus = (data.get("record") or {}).get("corpus_decisions", 0)
+        evaluators = (data.get("ab") or {}).get("evaluators") or {}
+        cost_regret = (evaluators.get("cost") or {}).get("regret_mean_s")
+        # Larger corpus wins; equal corpora tiebreak on the LOWER
+        # learned-cost regret (deterministic across filesystems).
+        key = (corpus, -(cost_regret if cost_regret is not None
+                         else float("inf")))
+        if best is None or key > best["_key"]:
+            best = {
+                "_key": key,
+                "file": os.path.basename(path),
+                "corpus_decisions": corpus,
+                "evaluators": evaluators,
+            }
+    if best is not None:
+        best.pop("_key")
+    return best
+
+
+def check_replay_regression(state_dir: str) -> Dict[str, object]:
+    """``bench.py replay --check-regression``: a fresh (smaller) A/B
+    must hold the stage's ABSOLUTE bounds — determinism, both gates
+    promoting, regret within the documented delta of rule, recorder
+    overhead within 5% — like the mlguard gate; the best record rides
+    along for trend reading."""
+    fresh = run_replay_ab(record_peers=400)
+    return {
+        "fresh_verdict_pass": fresh.get("verdict_pass"),
+        "fresh_deterministic": (fresh.get("ab") or {}).get("deterministic"),
+        "fresh_regret": {
+            name: (scored or {}).get("regret_mean_s")
+            for name, scored in
+            ((fresh.get("ab") or {}).get("evaluators") or {}).items()},
+        "fresh_error": fresh.get("error"),
+        "best_recorded": best_recorded_replay_run(state_dir),
+        "passed": bool(fresh.get("verdict_pass")),
+    }

@@ -60,12 +60,17 @@ class SchedulingConfig:
 
 class Scheduling:
     def __init__(self, evaluator, config: SchedulingConfig | None = None,
-                 stats: controlstats.ControlPlaneStats | None = None):
+                 stats: controlstats.ControlPlaneStats | None = None,
+                 recorder=None):
         self.evaluator = evaluator
         self.config = config or SchedulingConfig()
         # Control-plane counters (/debug/vars "scheduler"): filter and
         # evaluate phase timings land here per find_candidate_parents.
         self.stats = stats if stats is not None else controlstats.STATS
+        # Optional announce-stream recorder (replaylog.ReplayRecorder):
+        # decision events for the offline replay plane. None = zero work
+        # on the hot path (docs/REPLAY.md).
+        self.recorder = recorder
 
     def apply_dynconfig(self, cfg: dict) -> None:
         """Manager-pushed overrides for the dynconfig-tunable limits
@@ -192,7 +197,11 @@ class Scheduling:
             candidates, peer, peer.task.total_piece_count
         )
         self.stats.observe_evaluate((perf_counter() - t1) * 1e3)
-        return list(ranked[: self.config.candidate_parent_limit])
+        delivered = list(ranked[: self.config.candidate_parent_limit])
+        if self.recorder is not None:
+            self.recorder.record_decision(
+                peer, candidates, delivered, peer.task.total_piece_count)
+        return delivered
 
     def find_partial_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
         """Best-effort mesh assist for a BACK_TO_SOURCE claimant (the
@@ -292,3 +301,5 @@ class Scheduling:
             raise ScheduleError(f"peer {peer.id} channel closed")
         peer.task.back_to_source_peers.add(peer.id)
         self.stats.observe_back_to_source()
+        if self.recorder is not None:
+            self.recorder.record_back_to_source(peer)

@@ -611,6 +611,7 @@ class SchedulerService:
             self.metrics.traffic.labels(type="p2p").inc(
                 max(peer.task.content_length, 0))
         self._create_download_record(peer)
+        self._record_replay_outcome(peer)
 
     def download_peer_back_to_source_finished(
         self, peer_id: str, content_length: int, total_piece_count: int,
@@ -636,6 +637,7 @@ class SchedulerService:
             self.metrics.traffic.labels(type="back_to_source").inc(
                 max(content_length, 0))
         self._create_download_record(peer)
+        self._record_replay_outcome(peer)
 
     def download_peer_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
@@ -646,6 +648,7 @@ class SchedulerService:
         if self.metrics:
             self.metrics.download_peer_failure.inc()
         self._create_download_record(peer)
+        self._record_replay_outcome(peer)
 
     def download_peer_back_to_source_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
@@ -667,12 +670,14 @@ class SchedulerService:
         task.content_length = -1
         task.total_piece_count = 0
         self._create_download_record(peer)
+        self._record_replay_outcome(peer)
 
     def leave_peer(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
         if peer.task.source_claims is not None:
             peer.task.source_claims.release(peer_id)
         peer.leave()
+        self._record_replay_outcome(peer)
         peer.task.delete_peer_in_edges(peer.id)
         peer.task.delete_peer_out_edges(peer)
         self.resource.peer_manager.delete(peer_id)
@@ -769,6 +774,15 @@ class SchedulerService:
     # ------------------------------------------------------------------
     # Dataset sink (service_v1.go:1418 createDownloadRecord)
     # ------------------------------------------------------------------
+
+    def _record_replay_outcome(self, peer: Peer) -> None:
+        """Finalize the replay plane's pending decision events for a
+        peer that just reached a terminal state (realized candidate
+        costs are read at this moment). Zero work when no recorder is
+        installed on the scheduling core (docs/REPLAY.md)."""
+        recorder = getattr(self.scheduling, "recorder", None)
+        if recorder is not None:
+            recorder.record_outcome(peer)
 
     def _create_download_record(self, peer: Peer) -> None:
         if self.storage is None:

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Iterator, List, Type
 
-from dragonfly2_tpu.schema import Download, NetworkTopology
+from dragonfly2_tpu.schema import Download, NetworkTopology, ReplayDecision
 from dragonfly2_tpu.schema.io import (
     CsvRecordWriter,
     csv_to_parquet,
@@ -31,6 +31,7 @@ from dragonfly2_tpu.schema.io import (
 
 DOWNLOAD_FILE_PREFIX = "download"
 NETWORK_TOPOLOGY_FILE_PREFIX = "networktopology"
+REPLAY_FILE_PREFIX = "replay"
 CSV_EXT = ".csv"
 
 
@@ -216,6 +217,13 @@ class Storage:
         self.network_topology = _RotatingDataset(
             base_dir, NETWORK_TOPOLOGY_FILE_PREFIX, NetworkTopology, config
         )
+        # Replay-plane decision corpus (docs/REPLAY.md): same rotation /
+        # snapshot / removal machinery as the training datasets — a
+        # decision recorded just before a rotation replays identically
+        # from the rotated backup (regression-tested).
+        self.replay = _RotatingDataset(
+            base_dir, REPLAY_FILE_PREFIX, ReplayDecision, config
+        )
 
     # Interface names mirror storage.go:59-89.
     def create_download(self, record: Download) -> None:
@@ -224,17 +232,26 @@ class Storage:
     def create_network_topology(self, record: NetworkTopology) -> None:
         self.network_topology.create(record)
 
+    def create_replay(self, record: ReplayDecision) -> None:
+        self.replay.create(record)
+
     def list_download(self) -> List[Download]:
         return list(self.download.records())
 
     def list_network_topology(self) -> List[NetworkTopology]:
         return list(self.network_topology.records())
 
+    def list_replay(self) -> List[ReplayDecision]:
+        return list(self.replay.records())
+
     def download_count(self) -> int:
         return self.download.count()
 
     def network_topology_count(self) -> int:
         return self.network_topology.count()
+
+    def replay_count(self) -> int:
+        return self.replay.count()
 
     def open_download(self) -> List[str]:
         """Paths of all download dataset files, oldest first (announcer
@@ -253,6 +270,13 @@ class Storage:
     def snapshot_network_topology(self) -> List[str]:
         return self.network_topology.take_snapshot()
 
+    def snapshot_replay(self) -> List[str]:
+        return self.replay.take_snapshot()
+
+    def open_replay(self) -> List[str]:
+        self.replay.flush()
+        return self.replay.all_files()
+
     def remove_download_files(self, paths: List[str]) -> None:
         self.download.remove_files(paths)
 
@@ -264,3 +288,9 @@ class Storage:
 
     def clear_network_topology(self) -> None:
         self.network_topology.clear()
+
+    def remove_replay_files(self, paths: List[str]) -> None:
+        self.replay.remove_files(paths)
+
+    def clear_replay(self) -> None:
+        self.replay.clear()

@@ -21,6 +21,8 @@ from dragonfly2_tpu.schema.records import (
     MAX_DEST_HOSTS,
     MAX_PARENTS,
     MAX_PIECES_PER_PARENT,
+    MAX_REPLAY_CANDIDATES,
+    REPLAY_SCHEMA_VERSION,
     CPU,
     CPUTimes,
     Build,
@@ -35,6 +37,9 @@ from dragonfly2_tpu.schema.records import (
     Parent,
     Piece,
     Probes,
+    ReplayCandidate,
+    ReplayDecision,
+    ReplayFeatureRow,
     SrcHost,
     Task,
     column_spec,
@@ -46,6 +51,8 @@ __all__ = [
     "MAX_DEST_HOSTS",
     "MAX_PARENTS",
     "MAX_PIECES_PER_PARENT",
+    "MAX_REPLAY_CANDIDATES",
+    "REPLAY_SCHEMA_VERSION",
     "CPU",
     "CPUTimes",
     "Build",
@@ -60,6 +67,9 @@ __all__ = [
     "Parent",
     "Piece",
     "Probes",
+    "ReplayCandidate",
+    "ReplayDecision",
+    "ReplayFeatureRow",
     "SrcHost",
     "Task",
     "column_spec",

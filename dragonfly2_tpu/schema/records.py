@@ -209,6 +209,93 @@ class Download:
 
 
 # --------------------------------------------------------------------------
+# Replay-plane records → decision corpus (offline evaluator scoring +
+# learned piece-cost training data)
+# --------------------------------------------------------------------------
+
+#: Fixed candidate arity per recorded decision. The scheduling filter
+#: samples ``filter_parent_limit`` (default 15, dynconfig-tunable) DAG
+#: vertices per announce; 16 covers the default with headroom and keeps
+#: the flattened row width static. The recorder truncates (and counts)
+#: wider candidate sets.
+MAX_REPLAY_CANDIDATES = 16
+
+#: Bump when the decision layout changes incompatibly; the replay
+#: harness refuses corpora whose version it does not understand instead
+#: of silently mis-scoring them.
+REPLAY_SCHEMA_VERSION = 1
+
+
+@dataclass
+class ReplayFeatureRow:
+    """One candidate's canonical (parent, child) feature vector.
+
+    Field order mirrors ``scoring.FEATURE_NAMES`` EXACTLY (asserted in
+    :mod:`dragonfly2_tpu.scheduler.replaylog` and regression-tested) so
+    a recorded row round-trips bit-identically through
+    ``build_feature_matrix`` on replay."""
+
+    parent_finished_pieces: float = 0.0
+    child_finished_pieces: float = 0.0
+    total_pieces: float = 0.0
+    upload_count: float = 0.0
+    upload_failed_count: float = 0.0
+    free_upload_count: float = 0.0
+    concurrent_upload_limit: float = 0.0
+    is_seed: float = 0.0
+    seed_ready: float = 0.0
+    idc_match: float = 0.0
+    location_matches: float = 0.0
+
+
+@dataclass
+class ReplayCandidate:
+    """One post-filter candidate parent at decision time.
+
+    ``cost_*`` is the candidate's windowed Welford piece-cost snapshot
+    WHEN the decision was made (what ``is_bad_node`` judged from);
+    ``realized_*`` is the snapshot when the child's outcome landed — the
+    per-candidate realized cost the replay harness scores regret
+    against. ``realized_cost`` is the windowed mean (-1.0 when the
+    candidate never reported a cost by outcome time)."""
+
+    id: str = ""
+    rank: int = -1  # position in the delivered ranking; -1 = filtered out of top-k
+    features: ReplayFeatureRow = field(default_factory=ReplayFeatureRow)
+    cost_n: int = 0
+    cost_last: float = 0.0
+    cost_prior_mean: float = 0.0
+    cost_prior_pstd: float = 0.0
+    realized_n: int = 0
+    realized_cost: float = -1.0
+
+
+@dataclass
+class ReplayDecision:
+    """One recorded scheduling decision + its eventual outcome.
+
+    The full decision event the offline replay plane re-drives: the
+    post-filter candidate set with feature matrix and cost statistics,
+    the verdict (ranked parents vs back-to-source), the chosen (top-
+    ranked) parent, and the child's terminal state once known. Appended
+    to the scheduler's rotating dataset sink next to Download /
+    NetworkTopology records (docs/REPLAY.md)."""
+
+    version: int = REPLAY_SCHEMA_VERSION
+    seq: int = 0
+    task_id: str = ""
+    peer_id: str = ""
+    total_piece_count: int = 0
+    verdict: str = ""  # "parents" | "back_to_source"
+    chosen: str = ""   # ranked[0] id for "parents" verdicts
+    outcome: str = ""  # child peer FSM state at finalize ("" = evicted unfinished)
+    outcome_cost: float = 0.0
+    decided_at: int = 0    # nanoseconds
+    finalized_at: int = 0  # nanoseconds
+    candidates: List[ReplayCandidate] = list_field(MAX_REPLAY_CANDIDATES)
+
+
+# --------------------------------------------------------------------------
 # Network-topology records → GNN training data
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,155 @@
+"""Learned piece-cost predictor (TpuGraphs-style) over replay corpora.
+
+Trains a small MLP mapping the canonical (parent, child) feature vector
+(``scoring.FEATURE_NAMES`` — the exact layout the announce path stages
+through ``build_feature_matrix``) to the parent's REALIZED windowed mean
+piece cost in seconds, as recorded by the replay plane
+(:mod:`dragonfly2_tpu.scheduler.replaylog`) or the loadbench corpus
+capture. The resulting predictor replaces hand-tuned heuristics two ways
+(docs/REPLAY.md):
+
+- ranking: lower predicted cost = better parent (the
+  :class:`~dragonfly2_tpu.inference.scorer.LearnedCostEvaluator` ranks
+  by negated prediction), and
+- bad-node detection: a peer whose LATEST observed cost exceeds a
+  multiple of its feature-predicted cost is bad — an absolute, learned
+  threshold in place of the relative 3-sigma rule, which is blind to a
+  peer that has been consistently terrible from its first sample.
+
+Mechanically this is the MLP trainer's pjit pipeline (state replicated,
+batch sharded over the ``data`` mesh axis, log1p-normalized positive
+target) pointed at a different label; the checkpoint is the same
+params + feature/target-normalizer tree, registered at the manager as
+model type ``"cost"`` and gated by the PR-12 validation gate before any
+evaluator may load it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor, Normalizer
+from dragonfly2_tpu.train.mlp_trainer import MLPTrainConfig, train_mlp
+
+#: Registry model type (manager/models single-active invariant is per
+#: (type, scheduler_id), so "cost" versions never evict "mlp" ones).
+MODEL_TYPE_COST = "cost"
+
+#: Below this many (feature row, realized cost) examples a cost model is
+#: noise and must not be trained/registered (same stance as the other
+#: trainers' min-records gates).
+MIN_COST_EXAMPLES = 32
+
+
+@dataclass(frozen=True)
+class CostTrainConfig:
+    """Cost-predictor training knobs. Deliberately smaller than the
+    bandwidth MLP's defaults: the feature space is 11-dimensional and
+    the corpus is one scheduler's recent decisions, not a fleet-month
+    of downloads."""
+
+    hidden: Sequence[int] = (64, 32)
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-4
+    # Small batches on a small corpus: the optimizer needs STEPS, not
+    # batch width — 3 epochs at batch 4096 over a 4k-decision corpus is
+    # ~6 steps and leaves a near-constant (measured: slightly INVERTED)
+    # predictor that still passes the degenerate-output gate; 25 epochs
+    # at 512 reaches corr ~0.999 on the loadbench corpus in ~3 s on one
+    # CPU core.
+    batch_size: int = 512
+    epochs: int = 25
+    seed: int = 0
+    eval_fraction: float = 0.15
+    max_seconds: float | None = None
+
+
+@dataclass
+class CostTrainResult:
+    params: dict
+    normalizer: Normalizer
+    target_norm: Normalizer  # over log1p(cost_s)
+    config: CostTrainConfig
+    # Registry metrics on the raw seconds scale.
+    mse: float
+    mae: float
+    samples_per_sec: float
+    n_samples: int = 0
+    history: list = field(default_factory=list)
+
+    @property
+    def model(self) -> MLPBandwidthPredictor:
+        return MLPBandwidthPredictor(hidden=tuple(self.config.hidden))
+
+
+def cost_examples_from_corpus(
+    events: Sequence,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(X [n, FEATURE_DIM] float32, y [n] seconds) from replay decision
+    events: one example per candidate that realized at least one piece
+    cost by outcome time. Decision-time features, outcome-time label —
+    exactly the prediction the evaluator seam needs."""
+    from dragonfly2_tpu.scheduler.replay import _row_array
+
+    rows: List[np.ndarray] = []
+    costs: List[float] = []
+    for event in events:
+        for cand in getattr(event, "candidates", ()) or ():
+            if cand.realized_n >= 1 and cand.realized_cost >= 0:
+                rows.append(_row_array(cand))
+                costs.append(float(cand.realized_cost))
+    if not rows:
+        from dragonfly2_tpu.scheduler.evaluator.scoring import FEATURE_DIM
+
+        return (np.zeros((0, FEATURE_DIM), np.float32),
+                np.zeros(0, np.float32))
+    return np.stack(rows).astype(np.float32), np.asarray(costs, np.float32)
+
+
+def train_cost(
+    X: np.ndarray,
+    y: np.ndarray,
+    config: CostTrainConfig = CostTrainConfig(),
+    mesh=None,
+) -> CostTrainResult:
+    """Train the cost predictor. ``y`` is realized piece cost in
+    SECONDS (positive); the underlying loop regresses log1p(y)
+    standardized, so sub-second and multi-second costs share a sane
+    scale."""
+    if len(X) < MIN_COST_EXAMPLES:
+        raise ValueError(
+            f"{len(X)} cost examples < {MIN_COST_EXAMPLES}; refusing to "
+            "train a noise model")
+    mlp_config = MLPTrainConfig(
+        hidden=tuple(config.hidden),
+        learning_rate=config.learning_rate,
+        weight_decay=config.weight_decay,
+        batch_size=config.batch_size,
+        epochs=config.epochs,
+        seed=config.seed,
+        eval_fraction=config.eval_fraction,
+        max_seconds=config.max_seconds,
+    )
+    result = train_mlp(X, np.asarray(y, np.float32), mlp_config, mesh)
+    return CostTrainResult(
+        params=result.params,
+        normalizer=result.normalizer,
+        target_norm=result.target_norm,
+        config=config,
+        mse=result.mse,
+        mae=result.mae,
+        samples_per_sec=result.samples_per_sec,
+        n_samples=len(X),
+        history=result.history,
+    )
+
+
+def cost_tree(result: CostTrainResult) -> dict:
+    """Checkpoint tree — same layout as the bandwidth MLP's
+    (params + both normalizers), so the artifact path is shared."""
+    from dragonfly2_tpu.train.checkpoint import mlp_tree
+
+    return mlp_tree(result.params, result.normalizer, result.target_norm)

@@ -12,6 +12,7 @@ from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.trainer.training import Training, TrainingConfig
 from dragonfly2_tpu.trainer.service import (
     TRAINER_SPEC,
+    TrainCostRequest,
     TrainerService,
     TrainGnnRequest,
     TrainMlpRequest,
@@ -26,6 +27,7 @@ __all__ = [
     "TrainerService",
     "TRAINER_SPEC",
     "TrainRequest",
+    "TrainCostRequest",
     "TrainGnnRequest",
     "TrainMlpRequest",
     "TrainResponse",

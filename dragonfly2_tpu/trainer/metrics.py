@@ -20,7 +20,17 @@ class TrainerMetrics:
             namespace=ns, subsystem=sub, registry=self.registry)
         self.dataset_bytes = Counter(
             "dataset_bytes", "Dataset bytes ingested, by type.",
-            labelnames=("type",),  # gnn | mlp
+            labelnames=("type",),  # gnn | mlp | cost
+            namespace=ns, subsystem=sub, registry=self.registry)
+        self.train_cycles = Counter(
+            "train_cycles_total",
+            "Interval-driver cycles that retrained a host (new segments "
+            "had arrived).",
+            namespace=ns, subsystem=sub, registry=self.registry)
+        self.train_cycle_skips = Counter(
+            "train_cycle_skips_total",
+            "Interval-driver cycles skipped for a host (no new "
+            "segments since the last cycle).",
             namespace=ns, subsystem=sub, registry=self.registry)
         self.training_duration = Histogram(
             "training_duration_seconds", "One training job's duration.",

@@ -19,6 +19,7 @@ from dragonfly2_tpu.rpc import MethodKind, ServiceSpec, message
 from dragonfly2_tpu.trainer.storage import (
     DOWNLOAD_PREFIX,
     NETWORK_TOPOLOGY_PREFIX,
+    REPLAY_PREFIX,
     TrainerStorage,
 )
 from dragonfly2_tpu.trainer.training import Training
@@ -38,6 +39,16 @@ class TrainMlpRequest:
     new_file: bool = False
 
 
+@message("trainer.TrainCostRequest")
+class TrainCostRequest:
+    """Replay-plane decision corpus chunks (scheduler storage's rotated
+    ``replay.*.csv`` files) — the learned piece-cost model's training
+    data (docs/REPLAY.md)."""
+
+    dataset: bytes = b""
+    new_file: bool = False
+
+
 @message("trainer.TrainRequest")
 class TrainRequest:
     host_id: str = ""
@@ -49,6 +60,7 @@ class TrainRequest:
     scheduler_id: int = 0
     gnn: Optional[TrainGnnRequest] = None
     mlp: Optional[TrainMlpRequest] = None
+    cost: Optional[TrainCostRequest] = None
 
 
 @message("trainer.TrainResponse")
@@ -61,6 +73,13 @@ TRAINER_SPEC = ServiceSpec(
     name="df2.trainer.Trainer",
     methods={"Train": MethodKind.STREAM_UNARY},
 )
+
+
+def _context_active(context) -> bool:
+    """True when the RPC is still live. Duck-typed: in-process test
+    harnesses may pass contexts without ``is_active``."""
+    is_active = getattr(context, "is_active", None)
+    return bool(is_active()) if callable(is_active) else True
 
 
 class TrainerService:
@@ -83,6 +102,13 @@ class TrainerService:
         self.train_async = train_async
         self.metrics = metrics  # TrainerMetrics or None
         self._jobs: list[threading.Thread] = []
+        # host_id -> (ip, hostname, scheduler_id) of every source that
+        # streamed datasets this process — what the interval cycle
+        # driver retrains from without an operator (or an announcer EOF)
+        # kicking each cycle.
+        self._host_identities: dict = {}
+        self._cycle_stop = threading.Event()
+        self._cycle_thread: Optional[threading.Thread] = None
 
     def Train(self, request_iterator, context) -> TrainResponse:
         first: Optional[TrainRequest] = None
@@ -119,6 +145,17 @@ class TrainerService:
                     if self.metrics:
                         self.metrics.dataset_bytes.labels(type="mlp").inc(
                             len(req.mlp.dataset))
+                if req.cost is not None:
+                    written.append(
+                        self.storage.append(
+                            REPLAY_PREFIX, req.host_id,
+                            req.cost.dataset, req.cost.new_file,
+                        )
+                    )
+                    accepted += len(req.cost.dataset)
+                    if self.metrics:
+                        self.metrics.dataset_bytes.labels(type="cost").inc(
+                            len(req.cost.dataset))
         except Exception:
             if self.metrics:
                 self.metrics.train_request_failure.inc()
@@ -137,8 +174,29 @@ class TrainerService:
         if first is None:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty Train stream")
 
+        if not _context_active(context):
+            # The client died mid-upload but its cancellation raced the
+            # final ReceiveMessage: grpc surfaces that ordering as a
+            # CLEAN end of stream (grpc/_server.py _look_for_request
+            # raises StopIteration when the receive loop drained before
+            # the CANCELLED state landed), so the except-path rollback
+            # above never fired. A half-uploaded dataset must not
+            # survive either way — the announcer retries with the FULL
+            # snapshot next tick, and keeping the partial segments would
+            # duplicate every delivered record. This was the
+            # order-dependent test_failed_stream_rolls_back_segments
+            # flake: load delayed cancellation processing past the
+            # drained receive queue.
+            if self.metrics:
+                self.metrics.train_request_failure.inc()
+            self.storage.discard_files(sorted(set(written)))
+            context.abort(grpc.StatusCode.CANCELLED,
+                          "Train stream terminated mid-upload")
+
         if self.metrics:
             self.metrics.train_request_count.inc()
+        self._host_identities[first.host_id] = (
+            first.ip, first.hostname, first.scheduler_id)
         if self.train_async:
             self._jobs = [j for j in self._jobs if j.is_alive()]
             job = threading.Thread(
@@ -170,3 +228,50 @@ class TrainerService:
         for job in self._jobs:
             job.join(timeout)
         self._jobs = [j for j in self._jobs if j.is_alive()]
+
+    # -- interval cycle driver (df2-trainer --train-interval) --------------
+
+    def run_training_cycle(self) -> dict:
+        """One continuous-learning cycle: retrain + register for every
+        source host with NEW closed dataset segments; hosts with nothing
+        new are skipped. Counted in TrainerMetrics (``train_cycles`` /
+        ``train_cycle_skips``) so the loop's liveness is observable."""
+        trained, skipped = [], []
+        for host_id, (ip, hostname, scheduler_id) in list(
+                self._host_identities.items()):
+            if self.storage.has_closed_segments(host_id):
+                self._safe_train(ip, hostname, host_id, scheduler_id)
+                trained.append(host_id)
+                if self.metrics:
+                    self.metrics.train_cycles.inc()
+            else:
+                skipped.append(host_id)
+                if self.metrics:
+                    self.metrics.train_cycle_skips.inc()
+        return {"trained": trained, "skipped": skipped}
+
+    def start_cycle_driver(self, interval_s: float) -> None:
+        """Retrain on a timer whenever new dataset segments arrived —
+        the continuous-learning loop runs without an operator (or a
+        stream EOF) kicking each cycle. Idempotent; ``stop_cycle_driver``
+        (or process exit — the thread is a daemon) ends it."""
+        if interval_s <= 0 or self._cycle_thread is not None:
+            return
+
+        def loop() -> None:
+            while not self._cycle_stop.wait(interval_s):
+                try:
+                    self.run_training_cycle()
+                except Exception:  # noqa: BLE001 — the driver must not die
+                    logger.exception("interval training cycle failed")
+
+        self._cycle_stop.clear()
+        self._cycle_thread = threading.Thread(
+            target=loop, name="trainer-cycle-driver", daemon=True)
+        self._cycle_thread.start()
+
+    def stop_cycle_driver(self) -> None:
+        self._cycle_stop.set()
+        if self._cycle_thread is not None:
+            self._cycle_thread.join(timeout=5)
+            self._cycle_thread = None

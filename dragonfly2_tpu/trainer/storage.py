@@ -20,11 +20,13 @@ import re
 import threading
 from typing import Iterator, List, Tuple, Type
 
-from dragonfly2_tpu.schema import Download, NetworkTopology
+from dragonfly2_tpu.schema import Download, NetworkTopology, ReplayDecision
 from dragonfly2_tpu.schema.io import read_csv_records
 
 DOWNLOAD_PREFIX = "download"
 NETWORK_TOPOLOGY_PREFIX = "networktopology"
+REPLAY_PREFIX = "replay"
+_PREFIXES = (DOWNLOAD_PREFIX, NETWORK_TOPOLOGY_PREFIX, REPLAY_PREFIX)
 _SAFE_HOST = re.compile(r"[^A-Za-z0-9._-]")
 _SEG_RE = re.compile(r"\.(\d+)\.csv$")
 
@@ -118,14 +120,23 @@ class TrainerStorage:
     def network_topology_files(self, host_id: str) -> List[str]:
         return self._segments(NETWORK_TOPOLOGY_PREFIX, host_id)
 
-    def snapshot(self, host_id: str) -> Tuple[List[str], List[str]]:
-        """(download files, topology files) that are safe to train from:
-        closed segments only — a concurrent ingest stream's open segment is
-        left alone and picked up by the next training round."""
+    def replay_files(self, host_id: str) -> List[str]:
+        return self._segments(REPLAY_PREFIX, host_id)
+
+    def snapshot(self, host_id: str) -> Tuple[List[str], List[str], List[str]]:
+        """(download, topology, replay) files that are safe to train
+        from: closed segments only — a concurrent ingest stream's open
+        segment is left alone and picked up by the next training round."""
         return (
             self._closed_segments(DOWNLOAD_PREFIX, host_id),
             self._closed_segments(NETWORK_TOPOLOGY_PREFIX, host_id),
+            self._closed_segments(REPLAY_PREFIX, host_id),
         )
+
+    def has_closed_segments(self, host_id: str) -> bool:
+        """Any trainable data for a host? (The interval cycle driver's
+        skip predicate — docs/REPLAY.md continuous-learning loop.)"""
+        return any(any(files) for files in self.snapshot(host_id))
 
     def _records(self, record_type: Type, paths: List[str]) -> Iterator:
         for path in paths:
@@ -141,11 +152,17 @@ class TrainerStorage:
         paths = self.network_topology_files(host_id) if paths is None else paths
         return list(self._records(NetworkTopology, paths))
 
+    def list_replay(
+        self, host_id: str, paths: List[str] | None = None
+    ) -> List[ReplayDecision]:
+        paths = self.replay_files(host_id) if paths is None else paths
+        return list(self._records(ReplayDecision, paths))
+
     # -- lifecycle ------------------------------------------------------------
 
     def clear_host(self, host_id: str) -> None:
         self.close_host(host_id)
-        for prefix in (DOWNLOAD_PREFIX, NETWORK_TOPOLOGY_PREFIX):
+        for prefix in _PREFIXES:
             for path in self._segments(prefix, host_id):
                 os.remove(path)
 

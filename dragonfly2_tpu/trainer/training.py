@@ -24,12 +24,19 @@ from dragonfly2_tpu.data.features import graph_from_table, pair_examples_from_ta
 from dragonfly2_tpu.schema import Download, NetworkTopology
 from dragonfly2_tpu.schema.io import records_to_table
 from dragonfly2_tpu.train import (
+    CostTrainConfig,
     GATTrainConfig,
     GNNTrainConfig,
     MLPTrainConfig,
+    train_cost,
     train_gat,
     train_gnn,
     train_mlp,
+)
+from dragonfly2_tpu.train.cost_trainer import (
+    MIN_COST_EXAMPLES,
+    cost_examples_from_corpus,
+    cost_tree,
 )
 from dragonfly2_tpu.train.checkpoint import (
     ModelMetadata,
@@ -40,6 +47,7 @@ from dragonfly2_tpu.train.checkpoint import (
 )
 from dragonfly2_tpu.trainer.storage import TrainerStorage
 from dragonfly2_tpu.utils.idgen import (
+    cost_model_id_v1,
     gat_model_id_v1,
     gnn_model_id_v1,
     mlp_model_id_v1,
@@ -50,6 +58,7 @@ logger = logging.getLogger(__name__)
 MODEL_TYPE_GNN = "gnn"
 MODEL_TYPE_MLP = "mlp"
 MODEL_TYPE_GAT = "gat"
+MODEL_TYPE_COST = "cost"
 
 
 class ModelRegistry(Protocol):
@@ -78,11 +87,15 @@ class TrainingConfig:
     # scale-out model is this framework's extension, so it defaults off.
     gat: GATTrainConfig = field(default_factory=GATTrainConfig)
     train_gat_model: bool = False
+    # Learned piece-cost predictor over replay-plane decision corpora
+    # (docs/REPLAY.md) — trained whenever replay segments arrive.
+    cost: CostTrainConfig = field(default_factory=CostTrainConfig)
     # Minimum records before a model is trained at all (tiny datasets
     # produce garbage models that would evict good ones in the registry).
     min_gnn_records: int = 8
     min_mlp_records: int = 8
     min_gat_records: int = 8
+    min_cost_records: int = MIN_COST_EXAMPLES
 
 
 @dataclass
@@ -91,9 +104,11 @@ class TrainOutcome:
     gnn_model_id: Optional[str] = None
     mlp_model_id: Optional[str] = None
     gat_model_id: Optional[str] = None
+    cost_model_id: Optional[str] = None
     gnn_evaluation: dict = field(default_factory=dict)
     mlp_evaluation: dict = field(default_factory=dict)
     gat_evaluation: dict = field(default_factory=dict)
+    cost_evaluation: dict = field(default_factory=dict)
     errors: list = field(default_factory=list)
 
 
@@ -134,7 +149,8 @@ class Training:
         other's models (manager/models/model.go:44)."""
         outcome = TrainOutcome(host_id=host_id)
         with self._train_lock:
-            download_files, topology_files = self.storage.snapshot(host_id)
+            (download_files, topology_files,
+             replay_files) = self.storage.snapshot(host_id)
             # Both graph jobs consume the identical topology snapshot:
             # parse the records and build the Graph ONCE per cycle.
             n_topology, graph = 0, None
@@ -170,7 +186,14 @@ class Training:
                 except Exception as exc:  # noqa: BLE001
                     logger.exception("trainGAT failed for %s", host_id)
                     outcome.errors.append(f"gat: {exc}")
-            self.storage.discard_files(download_files + topology_files)
+            try:
+                self._train_cost(ip, hostname, host_id, scheduler_id,
+                                 replay_files, outcome)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("trainCost failed for %s", host_id)
+                outcome.errors.append(f"cost: {exc}")
+            self.storage.discard_files(
+                download_files + topology_files + replay_files)
         return outcome
 
     # -- jobs -----------------------------------------------------------------
@@ -286,6 +309,40 @@ class Training:
         )
         outcome.mlp_model_id = model_id
         outcome.mlp_evaluation = evaluation
+
+    def _train_cost(self, ip, hostname, host_id, scheduler_id, files,
+                    outcome: TrainOutcome) -> None:
+        """Learned piece-cost job (docs/REPLAY.md): replay-plane
+        decision events -> (features, realized cost) examples -> cost
+        predictor, registered as type 'cost' (the manager's validation
+        gate decides whether it ever serves)."""
+        if not files:
+            return
+        records = self.storage.list_replay(host_id, files)
+        X, y = cost_examples_from_corpus(records)
+        if len(X) < self.config.min_cost_records:
+            logger.info(
+                "skip cost model for %s: %d examples < %d",
+                host_id, len(X), self.config.min_cost_records,
+            )
+            return
+        job_start = time.monotonic()
+        result = train_cost(X, y, self.config.cost, self.mesh)
+        self._observe_job("cost", time.monotonic() - job_start,
+                          result.samples_per_sec)
+        evaluation = {"mse": result.mse, "mae": result.mae,
+                      "n_samples": len(X)}
+        model_id = cost_model_id_v1(ip, hostname)
+        self._register(
+            model_id,
+            MODEL_TYPE_COST,
+            host_id, ip, hostname, scheduler_id,
+            evaluation,
+            tree=cost_tree(result),
+            config={"hidden": list(result.config.hidden)},
+        )
+        outcome.cost_model_id = model_id
+        outcome.cost_evaluation = evaluation
 
     def _register(self, model_id, model_type, host_id, ip, hostname,
                   scheduler_id, evaluation, tree, config) -> None:

@@ -132,3 +132,8 @@ def mlp_model_id_v1(ip: str, hostname: str) -> str:
 def gat_model_id_v1(ip: str, hostname: str) -> str:
     """Config #3 (GraphTransformer) follows the same binding scheme."""
     return sha256_from_strings(ip, hostname, "GAT")
+
+
+def cost_model_id_v1(ip: str, hostname: str) -> str:
+    """Learned piece-cost predictor (replay plane, docs/REPLAY.md)."""
+    return sha256_from_strings(ip, hostname, "COST")
