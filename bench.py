@@ -963,6 +963,66 @@ def stage_replay(state: BenchState, ctx: dict) -> None:
             report)
 
 
+@stage("obs")
+def stage_obs(state: BenchState, ctx: dict) -> None:
+    """Observability plane — the ISSUE-14 fleet-tracing stage
+    (dragonfly2_tpu/client/obsbench.py): a live loopback swarm under a
+    tail-sampling tracer with a ZERO head fraction. The clean warm-up
+    task's trace must be dropped; a task disrupted by a seeded
+    mid-download piece-body STALL must breach the SLO and be
+    tail-captured END TO END (daemon + scheduler spans, one trace id),
+    with the critical-path analyzer naming the injected stall as the
+    dominant contributor; every registered /debug/vars stats block must
+    scrape at /metrics in Prometheus text format; and the overhead
+    guards must hold tracing-on within 1.05× of tracing-off on both
+    the announce p99 and loopback MB/s (docs/OBSERVABILITY.md). A
+    green run persists to artifacts/bench_state/obs_run_*.json; a
+    budget-skipped stage records an explicit skip artifact, never a
+    silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.client.obsbench import run_obs_stage
+
+    # Budget gate inside the stage (the mlguard lesson): a registry
+    # min_left skip would record nothing.
+    if left() < 90.0 and not ctx.get("single_stage"):
+        state.record(obs_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"obs_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    report = run_obs_stage(seed=0)
+    rung = report["rung"]
+    state.record(
+        obs_warm_trace_dropped=rung.get("warm_trace_dropped"),
+        obs_disrupted_ttlb_s=rung.get("disrupted_ttlb_s"),
+        obs_tail_reasons=rung.get("tail_reasons"),
+        obs_dominant=(rung.get("analyzer") or {}).get("dominant"),
+        obs_metrics_blocks=(rung.get("metrics_scrape") or {}).get(
+            "blocks"),
+        obs_metrics_all_exported=(rung.get("metrics_scrape") or {}).get(
+            "all_blocks_exported"),
+        obs_announce_p99_ratio=report["announce_guard"].get("p99_ratio"),
+        obs_announce_within_bound=report["announce_guard"].get(
+            "within_bound"),
+        obs_loopback_ratio=report["loopback_guard"].get(
+            "throughput_ratio"),
+        obs_loopback_within_bound=report["loopback_guard"].get(
+            "within_bound"),
+        obs_failures=rung.get("failures", [])[:5],
+        obs_verdict_pass=report["verdict_pass"],
+    )
+    state.stage_done("obs")
+    if report["verdict_pass"]:
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"obs_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            report)
+
+
 @stage("fanout", min_left=90.0)
 def stage_fanout(state: BenchState, ctx: dict) -> None:
     """Fleet-scale checkpoint fan-out — the ISSUE-9 dissemination
@@ -1405,7 +1465,12 @@ def check_regression_main(stage_name: str) -> None:
     - ``replay``: a fresh record→gate→A/B pass must hold its absolute
       bounds (bit-identical determinism, both models gate-promoted,
       ML/learned-cost regret within the documented delta of the rule
-      baseline, recorder overhead ≤ 5% — docs/REPLAY.md)."""
+      baseline, recorder overhead ≤ 5% — docs/REPLAY.md).
+    - ``obs``: a fresh observability stage must hold its absolute
+      bounds (disrupted task tail-captured end to end, analyzer blames
+      the injected stall, every stats block scrapeable, tracing
+      overhead ≤ 1.05× on announce p99 and loopback MB/s —
+      docs/OBSERVABILITY.md)."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.uploadbench import check_regression
 
@@ -1436,11 +1501,15 @@ def check_regression_main(stage_name: str) -> None:
         )
 
         result = check_replay_regression(STATE_DIR)
+    elif stage_name == "obs":
+        from dragonfly2_tpu.client.obsbench import check_obs_regression
+
+        result = check_obs_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
             "(have: dataplane, chaos, fanout, scheduler, mlguard, "
-            "replay)")
+            "replay, obs)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

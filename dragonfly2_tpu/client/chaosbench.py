@@ -563,7 +563,8 @@ class DaemonProc:
                  timeout: float = 0.0, poll_interval: float = 0.0,
                  piece_concurrency: int = 0, serve_rpc: bool = False,
                  host_type: str = "", fallback_wait: float = 0.0,
-                 scheduler_grace: float = 0.0):
+                 scheduler_grace: float = 0.0,
+                 extra_args: "Sequence[str]" = ()):
         import os
         import queue as queue_mod
         import subprocess
@@ -597,6 +598,9 @@ class DaemonProc:
             cmd += ["--fallback-wait", str(fallback_wait)]
         if scheduler_grace > 0:
             cmd += ["--scheduler-grace", str(scheduler_grace)]
+        # Observability (and future) daemon_proc knobs pass through
+        # verbatim — e.g. ("--trace-dir", d, "--metrics-port", "0").
+        cmd += list(extra_args)
         self.proc = subprocess.Popen(
             cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True, env=env)
@@ -691,7 +695,8 @@ def run_daemon_kill_rung(*, size_bytes: int = 4 << 20,
                          kill_fraction: float = DAEMON_KILL_FRACTION,
                          download_rate: float = 2 * (1 << 20),
                          timeout_s: float = 60.0,
-                         root: str | None = None) -> dict:
+                         root: str | None = None,
+                         daemon_extra_args: Sequence[str] = ()) -> dict:
     """The ISSUE-8 chaos rung: SIGKILL a daemon PROCESS mid-download,
     restart it on the same storage root, and bound the damage.
 
@@ -753,7 +758,8 @@ def run_daemon_kill_rung(*, size_bytes: int = 4 << 20,
             big_url = origin.url("/dk/big")
             victim = DaemonProc(
                 victim_root, [target], hostname="dk-victim",
-                piece_size=piece_size, download_rate=download_rate)
+                piece_size=piece_size, download_rate=download_rate,
+                extra_args=daemon_extra_args)
             victim.download(warm_url)
             warm1 = victim.result(timeout=left())
             if not warm1.get("ok"):
@@ -800,7 +806,7 @@ def run_daemon_kill_rung(*, size_bytes: int = 4 << 20,
             # must be a RESUME end to end.
             restarted = DaemonProc(
                 victim_root, [target], hostname="dk-victim",
-                piece_size=piece_size)
+                piece_size=piece_size, extra_args=daemon_extra_args)
             restarted.download(big_url)
             big2 = restarted.result(timeout=left())
             stats = restarted.stats(timeout=left())

@@ -79,7 +79,18 @@ def main(argv=None) -> int:
                              "(ObtainSeeds for preheat triggers); the "
                              "DAEMON line gains a third field with the "
                              "rpc target")
+    # Observability passthrough (the SAME flag set as cmd/common, via
+    # the shared helper, so the fan-out/chaos spawners forward an
+    # operator's flags verbatim).
+    from dragonfly2_tpu.cmd.common import add_observability_flags
+
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
+
+    if args.trace_dir or args.otlp_endpoint:
+        from dragonfly2_tpu.cmd.common import init_tracing
+
+        init_tracing(args, "daemon-proc")
 
     if args.piece_size > 0:
         from dragonfly2_tpu.client import peer_task as peer_task_mod
@@ -133,6 +144,13 @@ def main(argv=None) -> int:
 
     suffix = f" {rpc.target}" if rpc is not None else ""
     emit(f"DAEMON {daemon.host_id} {daemon.upload.address}{suffix}")
+    if args.metrics_port >= 0:
+        # After the DAEMON line (the spawner parses stdout's first
+        # line); the bridged registry carries data_plane/recovery/
+        # observability for this process.
+        from dragonfly2_tpu.cmd.common import start_metrics_server
+
+        start_metrics_server(args)
 
     def run_download(url: str) -> None:
         fresh = {"bytes": 0, "pieces": 0}

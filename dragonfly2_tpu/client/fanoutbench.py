@@ -201,7 +201,8 @@ def run_fanout_rung(n_daemons: int, blobs: Dict[str, bytes], *,
                     preheated: bool = False, seed: int = 0,
                     md5_sample: int = 2, mode: str = "threads",
                     piece_size: int = DEFAULT_PIECE_SIZE,
-                    root: str | None = None) -> dict:
+                    root: str | None = None,
+                    daemon_extra_args: Sequence[str] = ()) -> dict:
     """One fleet rung. ``mode="threads"`` runs the daemons in-process
     (hermetic, what the tier-1 smoke uses); ``mode="procs"`` runs each
     daemon as a REAL ``daemon_proc`` subprocess against a gRPC
@@ -214,7 +215,8 @@ def run_fanout_rung(n_daemons: int, blobs: Dict[str, bytes], *,
         return _run_fanout_rung_procs(
             n_daemons, blobs, origin_rate_bps=origin_rate_bps,
             preheated=preheated, seed=seed, md5_sample=md5_sample,
-            piece_size=piece_size, root=root)
+            piece_size=piece_size, root=root,
+            daemon_extra_args=daemon_extra_args)
     import os
     import random
 
@@ -385,7 +387,8 @@ def run_fanout_rung(n_daemons: int, blobs: Dict[str, bytes], *,
 def _run_fanout_rung_procs(n_daemons: int, blobs: Dict[str, bytes], *,
                            origin_rate_bps: float, preheated: bool,
                            seed: int, md5_sample: int, piece_size: int,
-                           root: str | None) -> dict:
+                           root: str | None,
+                           daemon_extra_args: Sequence[str] = ()) -> dict:
     """Process-fleet rung: one gRPC scheduler served from THIS process
     (so the claim/decision counters stay readable), N ``daemon_proc``
     children on the native data plane, and — for the preheated variant
@@ -450,6 +453,9 @@ def _run_fanout_rung_procs(n_daemons: int, blobs: Dict[str, bytes], *,
         # Fleet spawn shares two cores: a cold 32-proc wave can take
         # >30 s to all reach their DAEMON line.
         startup_timeout=240.0,
+        # Observability flags (--trace-dir/--metrics-port/...) forward
+        # verbatim to every spawned daemon_proc.
+        extra_args=tuple(daemon_extra_args),
     )
     procs: List[DaemonProc] = []
     seed_proc = None

@@ -62,6 +62,7 @@ from dragonfly2_tpu.scheduler.service import (
     RegisterPeerResponse,
 )
 from dragonfly2_tpu.utils import digest as digestutil
+from dragonfly2_tpu.utils import tracing
 from dragonfly2_tpu.utils.backoff import full_jitter
 from dragonfly2_tpu.utils.hosttypes import HostType
 
@@ -434,18 +435,68 @@ class PeerTaskConductor:
         self._sched_fail_since: Optional[float] = None
         self._last_progress_at = time.monotonic()
         self._last_refresh_at = time.monotonic()
+        # Task trace context (trace_id, span_id) of the root span —
+        # worker/syncer/reporter threads adopt it explicitly (fresh
+        # threads carry no contextvars), and the tail-sampling verdict
+        # at task end promotes or discards the whole trace. None until
+        # run() opens the root span (and forever, when tracing is off).
+        self._trace_ctx: "Optional[tuple]" = None
+        # Why this task left the happy path (degrade-to-source reasons
+        # feed the tail-sampling keep decision).
+        self._degraded_reason = ""
+        self._first_decision_seen = False
 
     # -- public entry ------------------------------------------------------
 
     def run(self) -> PeerTaskResult:
         # The conductor's task-level span (peertask_conductor.go:255
         # SpanRegisterTask): child rpc.client spans hang off it, so one
-        # trace covers register → schedule → pieces → finish.
-        from dragonfly2_tpu.utils.tracing import default_tracer
-
-        with default_tracer().span("peer_task.run", task_id=self.task_id,
-                                   peer_id=self.peer_id):
+        # trace covers register → schedule → pieces → finish. At task
+        # end the tail sampler gets its verdict: an SLO breach (failed /
+        # degraded-to-source / slow; failover promotes at the failover
+        # site) ships the buffered trace, a clean fast task drops it.
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
             return self._run()
+        begin = time.monotonic()
+        with tracer.span("peer_task.run", task_id=self.task_id,
+                         peer_id=self.peer_id, url=self.url) as rec:
+            self._trace_ctx = tracing.current_trace_context()
+            # This conductor OWNS the trace's verdict (the promote/
+            # finish below) — only promised traces may buffer.
+            tracer.expect_trace(self._trace_ctx[0])
+            self.reporter.trace_ctx = self._trace_ctx
+            try:
+                result = self._run()
+            except BaseException:
+                # An escaping exception is a failed task: keep the
+                # trace (the root span closes after this and writes
+                # through under the promotion).
+                tracer.promote_trace(self._trace_ctx[0], "failed")
+                raise
+            rec["attrs"].update(
+                success=result.success, error=result.error,
+                resumed_pieces=result.resumed_pieces,
+                degraded=self._degraded_reason)
+        elapsed = time.monotonic() - begin
+        reason = self._trace_keep_reason(result, elapsed, tracer)
+        if reason:
+            tracer.promote_trace(self._trace_ctx[0], reason)
+        else:
+            tracer.finish_trace(self._trace_ctx[0])
+        return result
+
+    def _trace_keep_reason(self, result: PeerTaskResult, elapsed: float,
+                           tracer) -> str:
+        """The tail-sampling SLO verdict for this task ('' = in SLO)."""
+        if not result.success:
+            return "failed"
+        if self._degraded_reason:
+            return "degraded_to_source"
+        sampler = getattr(tracer, "sampler", None)
+        if sampler is not None and elapsed > sampler.slow_slo_s:
+            return "slow"
+        return ""
 
     def _run(self) -> PeerTaskResult:
         self._started_at = time.monotonic()
@@ -459,13 +510,17 @@ class PeerTaskConductor:
                 priority=self.priority,
             )
             try:
-                resp = self.scheduler.register_peer(register, channel=self.channel)
+                with tracing.default_tracer().span("peer_task.register",
+                                           task_id=self.task_id):
+                    resp = self.scheduler.register_peer(
+                        register, channel=self.channel)
                 self._registered = True
             except Exception as exc:
                 # Scheduler unreachable → degrade to pure back-to-source,
                 # like the conductor's dummy-scheduler fallback
                 # (peertask_conductor.go:285-289).
                 logger.warning("register failed (%s); back-to-source", exc)
+                self._degraded_reason = "register_failed"
                 return self._run_back_to_source(report=False)
 
             from dragonfly2_tpu.scheduler.resource.task import SizeScope
@@ -488,6 +543,7 @@ class PeerTaskConductor:
                 self.scheduler.download_peer_started(self.peer_id)
             except Exception as exc:
                 logger.warning("download started failed (%s); back-to-source", exc)
+                self._degraded_reason = "started_failed"
                 return self._run_back_to_source(report=False)
 
             if resumed:
@@ -525,6 +581,11 @@ class PeerTaskConductor:
         self._resumed_bytes = sum(p.length for p in resumed)
         self.recovery.tick("tasks_resumed")
         self.recovery.tick("resume_pieces_reused", len(resumed))
+        tracer = tracing.default_tracer()
+        if tracer.enabled:
+            tracer.emit("peer_task.resume", start=time.time(),
+                        duration_s=0.0, pieces=self._resumed_pieces,
+                        nbytes=self._resumed_bytes)
         meta = self.store.meta
         if meta.content_length >= 0:
             # The journal knows the task shape even when the scheduler
@@ -569,6 +630,7 @@ class PeerTaskConductor:
                     # Scheduler went UNAVAILABLE mid-task and nothing is
                     # progressing: degrade after the bounded grace
                     # instead of burning the full task deadline.
+                    self._degraded_reason = "scheduler_stalled"
                     self.recovery.tick("scheduler_degraded_to_source")
                     logger.warning(
                         "peer %s: scheduler unresponsive past %.1fs grace; "
@@ -577,6 +639,7 @@ class PeerTaskConductor:
                     return self._run_back_to_source(report=False)
                 continue
             self._touch_progress()
+            self._note_first_decision(type(decision).__name__)
             if isinstance(decision, NeedBackToSource):
                 logger.info("peer %s told to back-to-source: %s",
                             self.peer_id, decision.reason)
@@ -584,6 +647,7 @@ class PeerTaskConductor:
             if isinstance(decision, ScheduleFailed):
                 logger.warning("peer %s scheduling failed (%s); "
                                "back-to-source", self.peer_id, decision.reason)
+                self._degraded_reason = "schedule_failed"
                 return self._run_back_to_source(report=False)
             if isinstance(decision, CandidateParents):
                 for parent in decision.parents:
@@ -599,6 +663,22 @@ class PeerTaskConductor:
                               storage=self.store, error=self._error,
                               resumed_pieces=self._resumed_pieces,
                               resumed_bytes=self._resumed_bytes)
+
+    def _note_first_decision(self, kind: str) -> None:
+        """Emit the schedule-wait span once: registration → the first
+        scheduler decision reaching this conductor (the interval the
+        announce p99 promises to keep small, seen from the peer)."""
+        if self._first_decision_seen:
+            return
+        self._first_decision_seen = True
+        tracer = tracing.default_tracer()
+        if not tracer.enabled or self._trace_ctx is None:
+            return
+        wait_s = time.monotonic() - self._started_at
+        tracer.emit("peer_task.schedule_wait",
+                    start=time.time() - wait_s, duration_s=wait_s,
+                    parent=self._trace_ctx, decision=kind,
+                    peer_id=self.peer_id)
 
     def _maybe_refresh_parents(self) -> None:
         """Periodic LIGHT parent refresh while the download runs: a
@@ -728,6 +808,7 @@ class PeerTaskConductor:
         return status, body
 
     def _sync_parent(self, parent: ParentInfo) -> None:
+        tracing.adopt_trace_context(self._trace_ctx)
         failures = 0
         # Partial-parent grace: a parent offered at registration may not
         # have CREATED its store yet (it registers, then attaches
@@ -863,6 +944,9 @@ class PeerTaskConductor:
             t.start()
 
     def _piece_worker(self) -> None:
+        # Fresh thread, fresh contextvar context: adopt the task trace
+        # so piece spans (and the RPCs under them) join the root.
+        tracing.adopt_trace_context(self._trace_ctx)
         while not self._done.is_set():
             try:
                 req = self.dispatcher.get(timeout=0.2)
@@ -873,53 +957,80 @@ class PeerTaskConductor:
             with self._written_lock:
                 if req.piece.num in self._written:
                     continue
-            self.shaper.wait_n(self.task_id, req.piece.length)
-            begin = time.monotonic_ns()
-            fetched_md5: str | None = None
-            try:
-                if (self.store is not None
-                        and not self.store.has_piece(req.piece.num)):
-                    # Streaming data plane (C++ when available, pooled
-                    # keep-alive Python otherwise): socket → pwrite at
-                    # the piece offset → incremental md5, never a whole
-                    # piece in a Python bytes object.
-                    if self.native_fetcher is not None:
-                        fetched_md5 = self._download_piece_native(req)
-                    else:
-                        fetched_md5 = self._download_piece_streamed(req)
-                    data = None
+            tracer = tracing.default_tracer()
+            if tracer.enabled:
+                with tracer.span("piece.fetch", piece=req.piece.num,
+                                 parent_id=req.dst_peer_id,
+                                 nbytes=req.piece.length) as rec:
+                    if not self._fetch_one_piece(req, rec.get("attrs")):
+                        return
+            elif not self._fetch_one_piece(req, None):
+                return
+
+    def _fetch_one_piece(self, req: DownloadPieceRequest,
+                         span_attrs: "dict | None") -> bool:
+        """Fetch+store one dispatched piece (the loop body of
+        ``_piece_worker``); returns False only on a fatal error that
+        must stop the worker. ``span_attrs`` is the live ``piece.fetch``
+        span's attr dict (None with tracing off) — outcomes land there
+        so the critical-path analyzer can tell a stored piece from a
+        park or a failure."""
+        self.shaper.wait_n(self.task_id, req.piece.length)
+        begin = time.monotonic_ns()
+        fetched_md5: str | None = None
+        try:
+            if (self.store is not None
+                    and not self.store.has_piece(req.piece.num)):
+                # Streaming data plane (C++ when available, pooled
+                # keep-alive Python otherwise): socket → pwrite at
+                # the piece offset → incremental md5, never a whole
+                # piece in a Python bytes object.
+                if self.native_fetcher is not None:
+                    fetched_md5 = self._download_piece_native(req)
                 else:
-                    data = self.downloader.download_piece(req)
-            except DownloadPieceError as exc:
-                logger.debug("piece %d from %s failed: %s",
-                             req.piece.num, req.dst_peer_id, exc)
-                if exc.fatal:
-                    # Disk full: no other parent can fix this — fail the
-                    # task fast instead of hanging workers on a doomed
-                    # requeue loop.
-                    self.recovery.tick("enospc_fail_fast")
-                    self._fail(f"disk full: {exc}")
-                    return
-                if exc.not_ready and self._note_piece_not_ready(req):
-                    # Partial parent hasn't landed the piece yet: parked
-                    # (re-offered by the next metadata sync) — no
-                    # corruption/blacklist tick, no retry-budget burn,
-                    # no scheduler piece-failed report.
-                    continue
-                self.dispatcher.report(DownloadPieceResult(
-                    req.dst_peer_id, req.piece.num, fail=True))
-                self._report_piece_failed(req.dst_peer_id, req.piece.num)
-                # Requeue for another parent (or the same one later),
-                # under the per-piece retry budget + jittered backoff.
-                self._note_piece_failure(req.piece.num)
-                continue
-            cost = time.monotonic_ns() - begin
-            self.dispatcher.report(DownloadPieceResult(
-                req.dst_peer_id, req.piece.num, fail=False, cost_ns=cost))
-            if fetched_md5 is not None:
-                self._record_fetched_piece(req, fetched_md5, cost)
+                    fetched_md5 = self._download_piece_streamed(req)
+                data = None
             else:
-                self._store_piece(req, data, cost)
+                data = self.downloader.download_piece(req)
+        except DownloadPieceError as exc:
+            logger.debug("piece %d from %s failed: %s",
+                         req.piece.num, req.dst_peer_id, exc)
+            if exc.fatal:
+                # Disk full: no other parent can fix this — fail the
+                # task fast instead of hanging workers on a doomed
+                # requeue loop.
+                if span_attrs is not None:
+                    span_attrs["outcome"] = "fatal"
+                self.recovery.tick("enospc_fail_fast")
+                self._fail(f"disk full: {exc}")
+                return False
+            if exc.not_ready and self._note_piece_not_ready(req):
+                # Partial parent hasn't landed the piece yet: parked
+                # (re-offered by the next metadata sync) — no
+                # corruption/blacklist tick, no retry-budget burn,
+                # no scheduler piece-failed report.
+                if span_attrs is not None:
+                    span_attrs["outcome"] = "not_ready"
+                return True
+            if span_attrs is not None:
+                span_attrs["outcome"] = "failed"
+            self.dispatcher.report(DownloadPieceResult(
+                req.dst_peer_id, req.piece.num, fail=True))
+            self._report_piece_failed(req.dst_peer_id, req.piece.num)
+            # Requeue for another parent (or the same one later),
+            # under the per-piece retry budget + jittered backoff.
+            self._note_piece_failure(req.piece.num)
+            return True
+        cost = time.monotonic_ns() - begin
+        if span_attrs is not None:
+            span_attrs["outcome"] = "stored"
+        self.dispatcher.report(DownloadPieceResult(
+            req.dst_peer_id, req.piece.num, fail=False, cost_ns=cost))
+        if fetched_md5 is not None:
+            self._record_fetched_piece(req, fetched_md5, cost)
+        else:
+            self._store_piece(req, data, cost)
+        return True
 
     def _download_piece_native(self, req: DownloadPieceRequest) -> str:
         """C data plane: the piece streams socket → data file inside one
@@ -1089,12 +1200,16 @@ class PeerTaskConductor:
         self.shaper.record(self.task_id, piece.length)
         if self.metrics:
             self.metrics.download_traffic.labels(type="p2p").inc(piece.length)
+        # The calling worker is inside its piece.fetch span: hand the
+        # span identity to the report batcher so the batch span links
+        # back to the member pieces it carries.
         self.reporter.report(PieceFinished(
             peer_id=self.peer_id, piece_number=piece.num,
             parent_id=req.dst_peer_id, offset=piece.offset,
             length=piece.length, digest=f"md5:{piece.md5}" if piece.md5 else "",
             cost_ns=cost_ns, traffic_type=TRAFFIC_REMOTE_PEER,
-        ))
+        ), trace_link=(tracing.current_trace_context()
+                       if tracing.default_tracer().enabled else None))
         self._check_finished()
 
     def _notify_piece_sink(self, piece_num: int) -> None:
@@ -1210,6 +1325,16 @@ class PeerTaskConductor:
     # -- back-to-source (pullPiecesFromSource / DownloadSource) ------------
 
     def _run_back_to_source(self, report: bool = True) -> PeerTaskResult:
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return self._run_back_to_source_impl(report)
+        with tracer.span("peer_task.back_to_source", report=report,
+                         degraded=self._degraded_reason) as rec:
+            result = self._run_back_to_source_impl(report)
+            rec["attrs"]["success"] = result.success
+            return result
+
+    def _run_back_to_source_impl(self, report: bool = True) -> PeerTaskResult:
         # Hybrid-mode flag read by _check_finished: mesh syncers/workers
         # stay live during back-to-source, and the task-level finish
         # belongs to THIS flow.
@@ -1408,6 +1533,21 @@ class PeerTaskConductor:
             return ("wait",)
 
         def fetch_run(first: int, count: int) -> "Exception | None":
+            """Span-wrapped ``fetch_run_impl``: one ``source.fetch_run``
+            span per ranged GET, carrying the run shape and its claim
+            attribution (a scheduler-leased disjoint run vs the local
+            sequential fallback) for the critical-path analyzer."""
+            tracer = tracing.default_tracer()
+            if not tracer.enabled:
+                return fetch_run_impl(first, count)
+            with tracer.span("source.fetch_run", first=first, count=count,
+                             claimed=not mode["local"]) as rec:
+                err = fetch_run_impl(first, count)
+                if err is not None:
+                    rec["attrs"]["error"] = f"{type(err).__name__}: {err}"
+                return err
+
+        def fetch_run_impl(first: int, count: int) -> "Exception | None":
             """ONE ranged GET covering pieces [first, first+count), split
             into pieces as the stream arrives. Per-piece semantics are
             identical to the old one-GET-per-piece loop: incremental
@@ -1541,6 +1681,7 @@ class PeerTaskConductor:
             mesh that stalls past source_fallback_wait degrades the
             whole task ONE WAY to local sequential claims (origin
             completes the file regardless of swarm health)."""
+            tracing.adopt_trace_context(self._trace_ctx)
             while not self._done.is_set():
                 claimed = claim()
                 if claimed is None:

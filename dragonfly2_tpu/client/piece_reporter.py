@@ -65,7 +65,15 @@ class PieceReportBatcher:
         if recovery is None:
             from dragonfly2_tpu.client.recovery import RECOVERY as recovery
         self.recovery = recovery
+        # Buffered (report, trace_link) pairs: the link is the member
+        # piece's piece.fetch span identity, carried so the batch-flush
+        # span can link back to the pieces it coalesced (None when
+        # tracing is off — zero retained state).
         self._buf: List = []
+        # Task trace context the owning conductor binds at run() start;
+        # deadline-timer deliveries (fresh threads) parent their batch
+        # span here instead of starting orphan traces.
+        self.trace_ctx = None
         self._lock = threading.Lock()
         # Serializes deliveries: flush()/close() must not return while a
         # deadline-timer delivery is still in flight, or the conductor's
@@ -79,18 +87,20 @@ class PieceReportBatcher:
 
     # -- producer side -----------------------------------------------------
 
-    def report(self, piece_finished) -> None:
+    def report(self, piece_finished, trace_link=None) -> None:
         """Buffer one report; may flush inline (count trigger) or arm the
         deadline timer. After ``close()`` a straggler report (a worker
         finishing its last piece during shutdown) is delivered
-        immediately instead of being silently dropped."""
+        immediately instead of being silently dropped. ``trace_link`` is
+        the reporting piece's span identity (trace_id, span_id) for the
+        batch span's links, or None with tracing off."""
         straggler = None
         trigger = False
         with self._lock:
             if self._closed:
-                straggler = [piece_finished]
+                straggler = [(piece_finished, trace_link)]
             else:
-                self._buf.append(piece_finished)
+                self._buf.append((piece_finished, trace_link))
                 if len(self._buf) >= self.flush_count:
                     trigger = True
                 elif self._timer is None and self.flush_deadline > 0:
@@ -151,12 +161,33 @@ class PieceReportBatcher:
                 logger.debug("on_delivery hook failed", exc_info=True)
 
     def _deliver_locked(self, batch: List) -> None:
-        """Send pending + one batch; caller holds ``_deliver_lock``."""
+        """Send pending + one batch of (report, link) pairs; caller
+        holds ``_deliver_lock``. The flush rides one ``piece.report_batch``
+        span parented under the task trace, carrying links to the member
+        piece spans — the coalescing is visible in the trace, not just
+        in the rpcs_saved counter."""
+        from dragonfly2_tpu.utils.tracing import default_tracer
+
+        tracer = default_tracer()
+        if not tracer.enabled or self.trace_ctx is None:
+            return self._deliver_batch(batch)
+        # remote_parent below both parents the span AND binds the
+        # contextvar for the RPC inside it — timer threads need nothing
+        # more, and a worker thread's own piece.fetch context must not
+        # be clobbered for the rest of its span.
+        links = [link for _, link in (self._pending + batch)
+                 if link is not None]
+        with tracer.span("piece.report_batch", remote_parent=self.trace_ctx,
+                         links=links, pieces=len(batch),
+                         pending=len(self._pending)):
+            return self._deliver_batch(batch)
+
+    def _deliver_batch(self, batch: List) -> None:
         batched = getattr(self.scheduler, "download_pieces_finished", None)
         if batched is None:
             # Legacy scheduler: per-piece calls, per-piece error
             # isolation (no batched flush to retry).
-            for report in self._pending + batch:
+            for report, _link in self._pending + batch:
                 try:
                     self.scheduler.download_piece_finished(report)
                 except Exception:
@@ -173,7 +204,7 @@ class PieceReportBatcher:
         retried = False
         for attempt in range(self.retry_limit + 1):
             try:
-                batched(todo)
+                batched([report for report, _link in todo])
             except Exception:
                 logger.debug("batched piece report failed (%d pieces, "
                              "attempt %d)", len(todo), attempt + 1,

@@ -40,9 +40,25 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
                              "literal value 'auto' uses the standard "
                              "layout under $DF2_HOME (default: console "
                              "only)")
+    add_observability_flags(parser)
+    parser.add_argument("--pprof-port", type=int, default=-1,
+                        help="debug monitor on this port (/debug/threads, "
+                             "/debug/profile?seconds=N, /debug/vars — the "
+                             "reference's pprof/statsview role; 0 = "
+                             "ephemeral, -1 = disabled)")
+
+
+def add_observability_flags(parser: argparse.ArgumentParser) -> None:
+    """The tracing + metrics knobs, shared by ``add_common_flags`` and
+    the light bench subprocess entrypoints (``scheduler/replica.py``,
+    ``client/daemon_proc.py``) — ONE set of defaults, so operator
+    services and bench fleets can never drift on observability
+    behavior."""
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help="serve Prometheus /metrics on this port "
-                             "(0 = ephemeral, -1 = disabled)")
+                             "(native collectors + every debug-vars "
+                             "stats block via the bridge; 0 = "
+                             "ephemeral, -1 = disabled)")
     parser.add_argument("--trace-dir", default="",
                         help="write JSONL span traces here (rotated); "
                              "trace ids propagate across services via "
@@ -51,23 +67,58 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
                         help="export spans to this OTLP/HTTP collector "
                              "base URL, e.g. http://collector:4318 — the "
                              "reference's --jaeger role (default: off)")
-    parser.add_argument("--pprof-port", type=int, default=-1,
-                        help="debug monitor on this port (/debug/threads, "
-                             "/debug/profile?seconds=N, /debug/vars — the "
-                             "reference's pprof/statsview role; 0 = "
-                             "ephemeral, -1 = disabled)")
+    parser.add_argument("--trace-sample", type=float, default=0.05,
+                        help="head-sampled fraction of traces written "
+                             "through immediately; the rest buffer in "
+                             "bounded memory and ship only when their "
+                             "task breached an SLO (tail sampling; 1.0 "
+                             "= record every span, the legacy behavior)")
+    parser.add_argument("--trace-slo-s", type=float, default=30.0,
+                        help="task-duration SLO for tail sampling: a "
+                             "task slower than this promotes its whole "
+                             "trace (failed / degraded / failovered "
+                             "tasks always promote)")
+    parser.add_argument("--trace-tail-buffer", type=int, default=512,
+                        help="max concurrently buffered traces awaiting "
+                             "a tail verdict (oldest evicted, counted "
+                             "in the observability stats block)")
+
+
+#: Services whose process contains the task-lifecycle verdict sites
+#: (conductor run / scheduler terminal handlers) that promote or finish
+#: tail-buffered traces. Only these install a tail sampler: a process
+#: with no verdict call sites (sidecar, manager, trainer, the
+#: daemon-gateway CLIs) would buffer ~95% of its spans awaiting a
+#: verdict nobody ever delivers — there, every span writes through.
+TAIL_CAPABLE_SERVICES = frozenset((
+    "dfdaemon", "dfget", "scheduler", "daemon-proc", "scheduler-replica",
+))
 
 
 def init_tracing(args, service_name: str) -> None:
     """Install the process-wide tracer when --trace-dir or
     --otlp-endpoint was given (the reference's jaeger bootstrap,
-    cmd/dependency/dependency.go:263-295)."""
+    cmd/dependency/dependency.go:263-295), with tail-based sampling on
+    the task-lifecycle services unless --trace-sample 1.0 asked for
+    every span."""
     if getattr(args, "trace_dir", "") or getattr(args, "otlp_endpoint", ""):
-        from dragonfly2_tpu.utils.tracing import Tracer, set_default_tracer
+        from dragonfly2_tpu.utils.tracing import (
+            TailSampler,
+            Tracer,
+            set_default_tracer,
+        )
 
+        fraction = getattr(args, "trace_sample", 1.0)
+        sampler = None
+        if fraction < 1.0 and service_name in TAIL_CAPABLE_SERVICES:
+            sampler = TailSampler(
+                head_fraction=fraction,
+                max_traces=getattr(args, "trace_tail_buffer", 512),
+                slow_slo_s=getattr(args, "trace_slo_s", 30.0))
         set_default_tracer(Tracer(
             service_name, out_dir=args.trace_dir,
-            otlp_endpoint=getattr(args, "otlp_endpoint", "")))
+            otlp_endpoint=getattr(args, "otlp_endpoint", ""),
+            sampler=sampler))
 
 
 def parse_with_config(parser: argparse.ArgumentParser, argv=None):
@@ -175,15 +226,27 @@ def start_debug_monitor(args):
     return mon
 
 
-def start_metrics_server(args, registry):
+def start_metrics_server(args, registry=None):
     """Start the /metrics endpoint when --metrics-port was given.
+
+    Every endpoint also carries the debug-vars bridge
+    (utils/prombridge.py): the service's native collectors (when it has
+    a registry) plus every registered stats block — data_plane /
+    scheduler / recovery / serving / observability / … — in Prometheus
+    text format. Services without native collectors pass no registry
+    and still get a fully populated endpoint.
 
     Returns the MetricsServer or None; callers print its address.
     """
-    if getattr(args, "metrics_port", -1) < 0 or registry is None:
+    if getattr(args, "metrics_port", -1) < 0:
         return None
+    from dragonfly2_tpu.utils import prombridge
     from dragonfly2_tpu.utils.metricsserver import MetricsServer
 
+    if registry is None:
+        registry = prombridge.bridge_registry()
+    else:
+        prombridge.attach(registry)
     server = MetricsServer(registry, host="0.0.0.0", port=args.metrics_port)
     server.start()
     print(f"metrics on {server.address}/metrics", flush=True)

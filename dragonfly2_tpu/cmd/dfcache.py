@@ -13,7 +13,12 @@ import argparse
 import json
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    init_tracing,
+    parse_with_config,
+)
 
 
 def _daemon(storage_dir: str):
@@ -39,6 +44,7 @@ def main(argv=None) -> int:
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfcache")
+    init_tracing(args, "dfcache")
 
     if bool(args.daemon) == bool(args.storage_dir):
         parser.error("exactly one of --daemon / --storage-dir is required")

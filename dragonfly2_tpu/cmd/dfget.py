@@ -16,7 +16,12 @@ import argparse
 import sys
 import tempfile
 
-from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    init_tracing,
+    parse_with_config,
+)
 
 
 def main(argv=None) -> int:
@@ -96,6 +101,7 @@ def main(argv=None) -> int:
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfget")
+    init_tracing(args, "dfget")
 
     headers = {}
     for item in args.header:

@@ -9,7 +9,12 @@ from __future__ import annotations
 import argparse
 import sys
 
-from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    init_tracing,
+    parse_with_config,
+)
 
 
 def main(argv=None) -> int:
@@ -27,6 +32,7 @@ def main(argv=None) -> int:
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="dfstore")
+    init_tracing(args, "dfstore")
 
     from dragonfly2_tpu.client.objectstorage_gateway import DfstoreClient
 

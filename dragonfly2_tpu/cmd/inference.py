@@ -14,6 +14,8 @@ from dragonfly2_tpu.cmd.common import (
     init_logging,
     init_tracing,
     parse_with_config,
+    start_debug_monitor,
+    start_metrics_server,
     wait_for_shutdown,
 )
 
@@ -104,6 +106,10 @@ def main(argv=None) -> int:
     from dragonfly2_tpu.utils.debugmon import register_debug_var
 
     register_debug_var("inference_batcher_stats", service.batcher_stats)
+    # No native prometheus collectors here — the bridged registry
+    # exports the batcher/serving stats blocks at /metrics.
+    metrics_server = start_metrics_server(args)
+    debug_monitor = start_debug_monitor(args)
     server = serve([(INFERENCE_SPEC, service)],
                    host=args.host, port=args.port)
     # Share the server's health service: hot-reload grace windows flip
@@ -113,6 +119,10 @@ def main(argv=None) -> int:
     wait_for_shutdown()
     service.stop()  # marks NOT_SERVING before the listener dies
     server.stop()
+    if metrics_server is not None:
+        metrics_server.stop()
+    if debug_monitor is not None:
+        debug_monitor.stop()
     return 0
 
 

@@ -18,7 +18,12 @@ import urllib.error
 import urllib.request
 from collections import Counter
 
-from dragonfly2_tpu.cmd.common import add_common_flags, parse_with_config, init_logging
+from dragonfly2_tpu.cmd.common import (
+    add_common_flags,
+    init_logging,
+    init_tracing,
+    parse_with_config,
+)
 
 
 def percentile(sorted_vals, p: float):
@@ -150,6 +155,7 @@ def main(argv=None) -> int:
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
     init_logging(args.verbose, args.log_dir, service="stress")
+    init_tracing(args, "stress")
 
     result = run_stress(
         args.url, proxy=args.proxy, daemon=args.daemon,

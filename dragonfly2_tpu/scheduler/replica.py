@@ -97,7 +97,19 @@ def main(argv=None) -> int:
                              "holds 1 worker)")
     parser.add_argument("--serve-gc", action="store_true",
                         help="run the interval GC (cluster rungs)")
+    # Observability passthrough (the SAME flag set as cmd/common, via
+    # the shared helper, so chaos/cluster spawners can forward an
+    # operator's flags verbatim): spans + /metrics on a bench replica
+    # without paying the full df2-scheduler bootstrap.
+    from dragonfly2_tpu.cmd.common import add_observability_flags
+
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
+
+    if args.trace_dir or args.otlp_endpoint:
+        from dragonfly2_tpu.cmd.common import init_tracing
+
+        init_tracing(args, "scheduler-replica")
 
     _, server = build_replica(
         args.data_dir, host=args.host, port=args.port,
@@ -107,8 +119,14 @@ def main(argv=None) -> int:
         gc_budget_s=args.gc_budget_ms / 1e3,
         gc_interval=args.gc_interval,
         max_workers=args.max_workers, serve_gc=args.serve_gc)
-    # The supervisor parses this single line for the bound target.
+    # The supervisor parses this single line for the bound target —
+    # keep it FIRST on stdout (the metrics server below prints its own
+    # address line, which must not displace it).
     print(f"REPLICA {server.target}", flush=True)
+    if args.metrics_port >= 0:
+        from dragonfly2_tpu.cmd.common import start_metrics_server
+
+        start_metrics_server(args)
     # Serve until killed (the rung's whole point is that we never get a
     # clean shutdown path).
     threading.Event().wait()

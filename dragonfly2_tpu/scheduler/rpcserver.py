@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils import tracing
 
 from dragonfly2_tpu.rpc.codec import message
 from dragonfly2_tpu.rpc.service import MethodKind, ServiceSpec
@@ -211,6 +212,7 @@ class WireRegisterPeer:
     piece_length: int = 0
     need_back_to_source: bool = False
     url_range: str = ""
+    reestablish: bool = False  # failover re-home, not a fresh register
 
 
 @message("scheduler.WirePeerEvent")
@@ -507,18 +509,49 @@ class SchedulerRpcService:
         outbound: "queue.Queue" = queue.Queue()
         channel = _StreamChannel(outbound)
         done = object()
+        # The stream's invocation metadata carries the TASK trace
+        # context (injected when the daemon opened the stream inside its
+        # peer_task.run span): the pump thread adopts it so every
+        # dispatched handler's spans — register, schedule, filter/
+        # evaluate, piece batches — join the daemon's trace id. The
+        # rpc-layer server span lives on the response-iterating thread
+        # and cannot cover the pump.
+        remote_ctx = tracing.extract_metadata(context.invocation_metadata())
+        # Whether this stream delivered a task-terminal event (or a
+        # size-scope fast path that legitimately has none): only then is
+        # a stream close CLEAN. A stream that just stops — daemon
+        # SIGKILL, network loss, operator Ctrl-C — is an anomaly, and
+        # its scheduler-side spans are exactly what tail sampling must
+        # keep (nothing else will ever promote them: the peer vanished).
+        stream_state = {"terminal": False}
 
         def pump() -> None:
+            tracing.adopt_trace_context(remote_ctx)
+            if remote_ctx is not None:
+                # This stream owns the scheduler-side verdict for the
+                # task trace (the finish/promote in the finally below):
+                # promise it so the dispatch handlers' spans may buffer.
+                tracing.default_tracer().expect_trace(remote_ctx[0])
             try:
                 for req in request_iterator:
                     if self.service.metrics:
                         self.service.metrics.announce_peer_count.inc()
-                    self._dispatch(req, channel, outbound)
+                    self._dispatch(req, channel, outbound, stream_state)
             except Exception as exc:
                 logger.debug("announce stream pump ended: %s", exc)
             finally:
                 channel.closed = True
                 outbound.put(done)
+                if remote_ctx is not None:
+                    tracer = tracing.default_tracer()
+                    if stream_state["terminal"] or self._peer_terminal(
+                            stream_state.get("peer_id", "")):
+                        # Clean close: anything still buffered was
+                        # in-SLO (breaches promoted at their terminal
+                        # handlers).
+                        tracer.finish_trace(remote_ctx[0])
+                    else:
+                        tracer.promote_trace(remote_ctx[0], "stream_lost")
 
         threading.Thread(target=pump, name="announce-pump", daemon=True).start()
         while True:
@@ -537,7 +570,28 @@ class SchedulerRpcService:
             isinstance(req, WirePeerEvent) and req.event == "started"
         )
 
-    def _dispatch(self, req, channel, outbound: "queue.Queue") -> None:
+    #: WirePeerEvent kinds after which a stream close is CLEAN.
+    _TERMINAL_EVENTS = frozenset((
+        "finished", "back_to_source_finished",
+        "failed", "back_to_source_failed",
+    ))
+
+    def _peer_terminal(self, peer_id: str) -> bool:
+        """True when the stream's peer reached a terminal FSM state by
+        some OTHER route — a terminal event can land on a failed-over
+        session or still sit in the closing client's send queue, and a
+        stream closed after the peer finished is a clean close, not a
+        lost one."""
+        if not peer_id:
+            return False
+        from dragonfly2_tpu.scheduler.resource.peer import PeerState
+
+        peer = self.service.resource.peer_manager.load(peer_id)
+        return peer is not None and peer.fsm.is_state(
+            PeerState.SUCCEEDED, PeerState.FAILED, PeerState.LEAVE)
+
+    def _dispatch(self, req, channel, outbound: "queue.Queue",
+                  stream_state: "dict | None" = None) -> None:
         svc = self.service
         try:
             if isinstance(req, WireRegisterPeer):
@@ -551,9 +605,19 @@ class SchedulerRpcService:
                         piece_length=req.piece_length,
                         need_back_to_source=req.need_back_to_source,
                         url_range=req.url_range,
+                        reestablish=req.reestablish,
                     ),
                     channel=channel,
                 )
+                if stream_state is not None:
+                    stream_state["peer_id"] = req.peer_id
+                    if (resp.size_scope == SizeScope.EMPTY
+                            or (resp.size_scope == SizeScope.TINY
+                                and resp.direct_piece)):
+                        # Size-scope fast path: the client returns
+                        # straight from register — no terminal event
+                        # ever comes, and that close is clean.
+                        stream_state["terminal"] = True
                 outbound.put(WireRegisterResponse(
                     size_scope=resp.size_scope.value,
                     direct_piece=resp.direct_piece,
@@ -562,6 +626,9 @@ class SchedulerRpcService:
                 ))
             elif isinstance(req, WirePeerEvent):
                 self._peer_event(req)
+                if (stream_state is not None
+                        and req.event in self._TERMINAL_EVENTS):
+                    stream_state["terminal"] = True
             elif isinstance(req, WirePieceFinished):
                 svc.download_piece_finished(PieceFinished(
                     peer_id=req.peer_id, piece_number=req.piece_number,
@@ -834,6 +901,7 @@ class GrpcSchedulerClient:
             piece_length=req.piece_length,
             need_back_to_source=req.need_back_to_source,
             url_range=req.url_range,
+            reestablish=req.reestablish,
         ))
         reader = threading.Thread(
             target=self._read_loop, args=(session, channel),
@@ -1049,7 +1117,7 @@ class _PeerSessionState:
     the reporter and the conductor must re-home ONCE."""
 
     __slots__ = ("request", "channel", "target", "started",
-                 "back_to_source_started", "pieces", "lock")
+                 "back_to_source_started", "pieces", "lock", "trace_ctx")
 
     def __init__(self, request: RegisterPeerRequest, channel, target: str):
         self.request = request
@@ -1059,6 +1127,12 @@ class _PeerSessionState:
         self.back_to_source_started = False
         self.pieces: Dict[int, PieceFinished] = {}
         self.lock = threading.Lock()
+        # The task trace active when the peer registered (None with
+        # tracing off): a failover/re-home — which runs on whatever
+        # thread noticed the dead replica — re-registers UNDER this
+        # context, so the re-established session on the new replica
+        # continues the SAME task trace.
+        self.trace_ctx = tracing.current_trace_context()
 
 
 class BalancedSchedulerClient:
@@ -1807,7 +1881,13 @@ class BalancedSchedulerClient:
         decisions into the SAME conductor channel), then every piece
         reported so far (so finished counts / task metadata are truthful
         and duplicate redeliveries stay upserts)."""
-        req = state.request
+        import dataclasses
+
+        # Wire-flag the re-home (reestablish=True): the server's upsert
+        # branch tail-keeps the trace only for THESE, not for a benign
+        # client register retry that lands in the same branch.
+        # state.request stays pristine.
+        req = dataclasses.replace(state.request, reestablish=True)
         self._register_at(cli, req, state.channel)
         if state.started:
             cli.download_peer_started(req.peer_id)
@@ -1824,7 +1904,27 @@ class BalancedSchedulerClient:
                        avoid: str = "") -> GrpcSchedulerClient:
         """Walk the ring (excluding ``avoid`` until last) and move the
         peer's session to the first replica that takes it. Caller holds
-        ``state.lock``. Raises the last walk error when nothing does."""
+        ``state.lock``. Raises the last walk error when nothing does.
+
+        Rides one ``sched_client.failover`` span under the task trace
+        (the re-register inside inherits the context, so the NEW
+        replica's spans join the same trace id), and a failover is an
+        SLO breach by definition — the trace promotes out of the tail
+        buffer whether or not the re-home succeeds."""
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return self._rehome_impl(peer_id, state, avoid)
+        if state.trace_ctx is not None:
+            tracer.promote_trace(state.trace_ctx[0], "failover")
+        with tracer.span("sched_client.failover",
+                         remote_parent=state.trace_ctx, peer_id=peer_id,
+                         avoid=avoid) as rec:
+            cli = self._rehome_impl(peer_id, state, avoid)
+            rec["attrs"]["target"] = state.target
+            return cli
+
+    def _rehome_impl(self, peer_id: str, state: _PeerSessionState,
+                     avoid: str = "") -> GrpcSchedulerClient:
         last: Optional[Exception] = None
 
         def candidates():

@@ -23,6 +23,7 @@ from typing import List, Optional, Protocol, Sequence
 
 from dragonfly2_tpu.scheduler import controlstats
 from dragonfly2_tpu.scheduler.resource.peer import Peer, PeerState
+from dragonfly2_tpu.utils import tracing
 from dragonfly2_tpu.utils.dag import CycleError, VertexNotFoundError
 from dragonfly2_tpu.utils.hosttypes import HostType
 
@@ -187,17 +188,37 @@ class Scheduling:
         if not peer.fsm.is_state(PeerState.RUNNING):
             logger.debug("peer %s state %s cannot schedule", peer.id, peer.fsm.current)
             return []
+        # Trace instrumentation follows the faultplan discipline: one
+        # enabled check when tracing is off; per-phase spans (not
+        # per-candidate) when on, so the announce p99 overhead guard's
+        # 1.05 bound holds at swarm rates.
+        tracer = tracing.default_tracer()
+        counts = {"bad_node": 0, "sampled": 0} if tracer.enabled else None
         t0 = perf_counter()
-        candidates = self._filter_candidate_parents(peer, blocklist)
+        candidates = self._filter_candidate_parents(peer, blocklist, counts)
         t1 = perf_counter()
         self.stats.observe_filter((t1 - t0) * 1e3)
+        if counts is not None:
+            tracer.emit("sched.filter", start=time.time() - (t1 - t0),
+                        duration_s=t1 - t0, peer_id=peer.id,
+                        sampled=counts["sampled"],
+                        bad_nodes=counts["bad_node"],
+                        passed=len(candidates))
         if not candidates:
             return []
         ranked = self.evaluator.evaluate_parents(
             candidates, peer, peer.task.total_piece_count
         )
-        self.stats.observe_evaluate((perf_counter() - t1) * 1e3)
+        t2 = perf_counter()
+        self.stats.observe_evaluate((t2 - t1) * 1e3)
         delivered = list(ranked[: self.config.candidate_parent_limit])
+        if counts is not None:
+            tracer.emit("sched.evaluate", start=time.time() - (t2 - t1),
+                        duration_s=t2 - t1, peer_id=peer.id,
+                        evaluator=type(self.evaluator).__name__,
+                        candidates=len(candidates),
+                        delivered=len(delivered),
+                        chosen=delivered[0].id if delivered else "")
         if self.recorder is not None:
             self.recorder.record_decision(
                 peer, candidates, delivered, peer.task.total_piece_count)
@@ -239,13 +260,16 @@ class Scheduling:
         )
         return ranked[0]
 
-    def _filter_candidate_parents(self, peer: Peer, blocklist: set[str]) -> List[Peer]:
+    def _filter_candidate_parents(self, peer: Peer, blocklist: set[str],
+                                  counts: "dict | None" = None) -> List[Peer]:
         """(scheduling.go:465-536) — the six filters, applied to a random
         sample of filter_parent_limit peers from the task DAG.
 
         Child-side (per-announce) values — host id, DAG handle, the
         evaluator's bad-node check — are bound once outside the loop so
-        every candidate pays only its own per-parent work.
+        every candidate pays only its own per-parent work. ``counts``
+        (tracing on only) collects the sampled size and bad-node
+        verdicts for the ``sched.filter`` span.
         """
         task = peer.task
         dag = task.dag
@@ -255,6 +279,8 @@ class Scheduling:
         is_bad_node = self.evaluator.is_bad_node
         out = []
         for candidate in dag.random_vertices(self.config.filter_parent_limit):
+            if counts is not None:
+                counts["sampled"] += 1
             if candidate.id in blocklist:
                 continue
             # Cycle-safe (also rejects self and duplicate edges).
@@ -265,6 +291,8 @@ class Scheduling:
             if candidate.host.id == peer_host_id:
                 continue
             if is_bad_node(candidate):
+                if counts is not None:
+                    counts["bad_node"] += 1
                 continue
             # A normal-host parent must itself have a source of pieces:
             # a parent, back-to-source, a completed download — or an

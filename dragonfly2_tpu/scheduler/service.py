@@ -38,6 +38,7 @@ from dragonfly2_tpu.scheduler.resource.task import (
 )
 from dragonfly2_tpu.scheduler.scheduling.core import ScheduleError, Scheduling
 from dragonfly2_tpu.scheduler.storage.storage import Storage
+from dragonfly2_tpu.utils import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -70,6 +71,12 @@ class RegisterPeerRequest:
     # dfget --range spec ("a-b"); rides to seed triggers so a seed
     # downloads the same window the task id was derived from.
     url_range: str = ""
+    # Set ONLY by the failover/re-home path (BalancedSchedulerClient
+    # _reestablish): this registration moves an in-flight session off a
+    # lost replica. Distinguishes a true failover from a benign client
+    # register retry — both land in the idempotent-upsert branch, but
+    # only the failover is an SLO breach worth tail-keeping the trace.
+    reestablish: bool = False
 
 
 @dataclass
@@ -234,6 +241,18 @@ class SchedulerService:
 
     def register_peer(self, req: RegisterPeerRequest,
                       channel=None) -> RegisterPeerResponse:
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return self._register_peer_impl(req, channel)
+        with tracer.span("sched.register", peer_id=req.peer_id,
+                         task_id=req.task_id, priority=req.priority) as rec:
+            resp = self._register_peer_impl(req, channel)
+            rec["attrs"]["size_scope"] = getattr(
+                resp.size_scope, "name", str(resp.size_scope))
+            return resp
+
+    def _register_peer_impl(self, req: RegisterPeerRequest,
+                            channel=None) -> RegisterPeerResponse:
         if self.metrics:
             self.metrics.register_peer_count.inc()
         host = self.resource.host_manager.load(req.host_id)
@@ -286,6 +305,14 @@ class SchedulerService:
         # visible on /debug/vars.
         if not peer.fsm.is_state(PeerState.PENDING):
             self.stats.observe_reregistration()
+            if req.reestablish:
+                # The failover/re-home path landing here — tail-keep
+                # the task's trace on this replica too (the daemon side
+                # promoted at the failover). A benign register RETRY
+                # (first attempt landed, reply lost) also takes this
+                # branch and must NOT promote — only the wire-flagged
+                # re-establish does.
+                tracing.promote_current_trace("failover")
             return self._scope_response(task, task.size_scope())
 
         # Priority ladder (service_v2.go:1308-1375 downloadTaskBySeedPeer;
@@ -528,6 +555,14 @@ class SchedulerService:
         mid-batch is skipped (its NOT_FOUND would otherwise drop the
         rest of the batch) — matching the per-call form, where each
         report fails independently."""
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return self._pieces_finished_impl(reports)
+        with tracer.span("sched.piece_batch", pieces=len(reports)):
+            return self._pieces_finished_impl(reports)
+
+    def _pieces_finished_impl(self,
+                              reports: Sequence[PieceFinished]) -> None:
         peers: Dict[str, Optional[Peer]] = {}
         parents: Dict[str, Optional[Peer]] = {}
         stored = 0
@@ -588,6 +623,25 @@ class SchedulerService:
         self._schedule_timed(peer)
 
     def _schedule_timed(self, peer: Peer) -> None:
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return self._schedule_timed_impl(peer)
+        with tracer.span("sched.schedule", peer_id=peer.id,
+                         task_id=peer.task.id,
+                         schedule_count=peer.schedule_count) as rec:
+            try:
+                self._schedule_timed_impl(peer, rec["attrs"])
+            except BaseException:
+                # A scheduling failure (ScheduleError exhausting the
+                # retry ladder) degrades the peer to back-to-source on
+                # the daemon side — keep THIS side's spans too, or the
+                # trace that explains the degrade ends daemon-only when
+                # the announce stream closes.
+                tracing.promote_current_trace("degraded_to_source")
+                raise
+
+    def _schedule_timed_impl(self, peer: Peer,
+                             span_attrs: "dict | None" = None) -> None:
         start = time.perf_counter()
         decided = False
         try:
@@ -596,12 +650,15 @@ class SchedulerService:
         finally:
             elapsed = time.perf_counter() - start
             self.stats.observe_schedule(elapsed * 1e3, decided=bool(decided))
+            if span_attrs is not None:
+                span_attrs["decided"] = bool(decided)
             if self.metrics:
                 self.metrics.schedule_duration.observe(elapsed)
 
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
+        self._tail_verdict(cost_seconds)
         if peer.fsm.is_state(PeerState.SUCCEEDED):
             return  # duplicate terminal report (failover replay / race)
         peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
@@ -619,6 +676,7 @@ class SchedulerService:
     ) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
+        self._tail_verdict(cost_seconds)
         # Idempotent on an already-Succeeded peer: the hybrid fan-out
         # path can complete via the MESH a beat before the
         # NeedBackToSource decision is consumed (the conductor then
@@ -641,6 +699,7 @@ class SchedulerService:
 
     def download_peer_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
+        tracing.promote_current_trace("failed")
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
         if peer.task.source_claims is not None:
             peer.task.source_claims.release(peer_id)
@@ -652,6 +711,7 @@ class SchedulerService:
 
     def download_peer_back_to_source_failed(self, peer_id: str) -> None:
         peer = self._peer(peer_id)
+        tracing.promote_current_trace("failed")
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
         if self.metrics:
             self.metrics.download_peer_failure.inc()
@@ -671,6 +731,17 @@ class SchedulerService:
         task.total_piece_count = 0
         self._create_download_record(peer)
         self._record_replay_outcome(peer)
+
+    @staticmethod
+    def _tail_verdict(cost_seconds: float) -> None:
+        """Scheduler-side tail-sampling verdict at a successful task
+        end: a task slower than the tracer's SLO keeps its trace HERE
+        too (the daemon promotes its own half with the same shared
+        trace id; both sides decide locally from the same number)."""
+        tracer = tracing.default_tracer()
+        sampler = getattr(tracer, "sampler", None)
+        if (sampler is not None and cost_seconds > sampler.slow_slo_s):
+            tracing.promote_current_trace("slow")
 
     def leave_peer(self, peer_id: str) -> None:
         peer = self._peer(peer_id)

@@ -98,14 +98,29 @@ def register_debug_var(name: str, fn) -> None:
         _VARS[name] = fn
 
 
-def debug_vars() -> dict:
+def registered_debug_vars() -> dict:
+    """Snapshot of the registered blocks (name → callable) — the
+    Prometheus bridge (utils/prombridge.py) walks this to export every
+    stats block a process publishes."""
+    with _VARS_LOCK:
+        return dict(_VARS)
+
+
+def process_vars(full: bool = False) -> dict:
+    """The base process vars (no registered blocks) — also what the
+    Prometheus bridge exports as the ``process`` pseudo-block."""
     out = {
         "uptime_seconds": round(time.time() - _START_TIME, 1),
         "threads": threading.active_count(),
         "gc_counts": gc.get_count(),
-        "gc_objects": len(gc.get_objects()),
         "python": sys.version.split()[0],
     }
+    if full:
+        # len(gc.get_objects()) is an O(live heap) stop-the-world scan —
+        # hundreds of ms on a 100k-peer scheduler, per poll. Opt-in via
+        # /debug/vars?full=1; the default answers from gc.get_count()'s
+        # per-generation counters, which are O(1).
+        out["gc_objects"] = len(gc.get_objects())
     try:
         import resource
 
@@ -115,6 +130,11 @@ def debug_vars() -> dict:
         pass
     if "jax" in sys.modules:
         out["jax"] = sys.modules["jax"].__version__
+    return out
+
+
+def debug_vars(full: bool = False) -> dict:
+    out = process_vars(full=full)
     with _VARS_LOCK:
         published = list(_VARS.items())
     for name, fn in published:
@@ -149,7 +169,9 @@ class DebugMonitor(ThreadedHTTPService):
                 if parsed.path == "/debug/threads":
                     return self._send(200, thread_dump())
                 if parsed.path == "/debug/vars":
-                    return self._send(200, json.dumps(debug_vars()),
+                    q = parse_qs(parsed.query)
+                    full = q.get("full", ["0"])[0] not in ("0", "", "false")
+                    return self._send(200, json.dumps(debug_vars(full=full)),
                                       "application/json")
                 if parsed.path == "/debug/profile":
                     q = parse_qs(parsed.query)

@@ -91,12 +91,17 @@ class OTLPSpanExporter:
 
     def __init__(self, endpoint: str, service: str,
                  flush_interval: float = 2.0, max_batch: int = 256,
-                 max_queue: int = 4096, timeout: float = 5.0):
+                 max_queue: int = 4096, timeout: float = 5.0, stats=None):
         self.url = endpoint.rstrip("/") + "/v1/traces"
         self.service = service
         self.flush_interval = flush_interval
         self.max_batch = max_batch
         self.timeout = timeout
+        # Every drop path ticks the "observability" stats block — a
+        # best-effort exporter whose losses are uncounted is invisible.
+        if stats is None:
+            from dragonfly2_tpu.utils.obsstats import OBS as stats
+        self.stats = stats
         self._queue: "queue.Queue[dict]" = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         # Serializes drain+POST so flush() returning means any batch the
@@ -130,6 +135,8 @@ class OTLPSpanExporter:
             except (queue.Empty, queue.Full):
                 pass
             self.dropped += 1
+            self.stats.tick("otlp_enqueue_drops")
+            self.stats.tick("otlp_spans_dropped")
 
     def _drain(self) -> List[dict]:
         batch: List[dict] = []
@@ -151,8 +158,11 @@ class OTLPSpanExporter:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 resp.read()
             self.exported += len(batch)
+            self.stats.tick("otlp_spans_exported", len(batch))
         except Exception as exc:  # noqa: BLE001 — best-effort delivery
             self.dropped += len(batch)
+            self.stats.tick("otlp_ship_failures")
+            self.stats.tick("otlp_spans_dropped", len(batch))
             now = time.monotonic()
             if now - self._last_warn > 60.0:
                 self._last_warn = now
