@@ -472,9 +472,14 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
     3. the concurrency-density rung — ≥256 concurrent keep-alive piece
        streams against one seed, every body md5-verified, server thread
        count bounded at a CONSTANT (the threaded engine held ~1 thread
-       per connection).
+       per connection),
+    4. the ISSUE-15 DOWNLOAD density rung — 8/32/128 concurrent tasks
+       against ONE real daemon on the async download engine, download
+       threads bounded at dl_workers+2 at every rung (the threaded
+       engine grew with task count) and the 128-task aggregate MB/s ≥
+       a same-process thread-engine baseline.
 
-    A green run (both verdicts) persists to
+    A green run (all verdicts) persists to
     artifacts/bench_state/dataplane_run_<tag>.json — the record
     `bench.py dataplane --check-regression` gates future PRs against."""
     left = ctx["left"]
@@ -548,7 +553,33 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
         dataplane_density_md5_ok=density["md5_ok"],
         dataplane_density_verdict_pass=density["verdict_pass"],
     )
-    verdict = bool(upload_pass and density["verdict_pass"])
+    if left() < 12.0:
+        # Budget-starved download rung: record the skip explicitly so
+        # it never reads as a pass OR a regression, and persist nothing
+        # (a record without the download rung would let the
+        # check-regression gate grade against a partial green).
+        state.record(dataplane_dl_density_skipped=True,
+                     dataplane_verdict_pass=bool(
+                         upload_pass and density["verdict_pass"]))
+        state.stage_done("dataplane")
+        return
+    from dragonfly2_tpu.client.dataplane import run_download_density_rung
+
+    dl_density = run_download_density_rung(
+        timeout_s=max(min(left() * 0.8, 120.0), 12.0))
+    state.record(
+        dataplane_dl_density_top_mb_per_s=dl_density["top_rung_mb_per_s"],
+        dataplane_dl_density_thread_bound=dl_density["thread_bound"],
+        dataplane_dl_density_threads_bounded=dl_density["threads_bounded"],
+        dataplane_dl_density_vs_thread_engine=dl_density.get(
+            "vs_thread_engine"),
+        dataplane_dl_density_rungs={
+            n: {k: v for k, v in r.items() if k != "census_peak"}
+            for n, r in dl_density["rungs"].items()},
+        dataplane_dl_density_verdict_pass=dl_density["verdict_pass"],
+    )
+    verdict = bool(upload_pass and density["verdict_pass"]
+                   and dl_density["verdict_pass"])
     state.record(dataplane_verdict_pass=verdict)
     state.stage_done("dataplane")
     if verdict:
@@ -558,7 +589,8 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
                 f"dataplane_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
             {"ladder": {str(k): v for k, v in ladder.items()},
              "upload_loopback": upload,
-             "density": density})
+             "density": density,
+             "download_density": dl_density})
 
 
 @stage("scheduler", min_left=15.0)
@@ -1448,7 +1480,10 @@ def check_regression_main(stage_name: str) -> None:
     artifacts/bench_state record, exiting non-zero on regression.
 
     - ``dataplane``: fresh upload-loopback rung vs the best recorded
-      MB/s (docs/DATAPLANE.md fraction).
+      MB/s (docs/DATAPLANE.md fraction), PLUS a fresh download density
+      rung + async-engine loopback — fails on a download thread-census
+      breach at any rung, a density aggregate under 0.5× the best
+      record, or a single-task loopback under 0.9× the recorded MB/s.
     - ``chaos``: fresh fault ladder + daemon-kill rung vs the best
       recorded chaos run (docs/CHAOS.md) — any lost verdict or a
       goodput-retention collapse fails the gate.
@@ -1472,9 +1507,15 @@ def check_regression_main(stage_name: str) -> None:
       overhead ≤ 1.05× on announce p99 and loopback MB/s —
       docs/OBSERVABILITY.md)."""
     if stage_name == "dataplane":
+        from dragonfly2_tpu.client.dataplane import (
+            check_download_regression,
+        )
         from dragonfly2_tpu.client.uploadbench import check_regression
 
-        result = check_regression(STATE_DIR)
+        upload = check_regression(STATE_DIR)
+        download = check_download_regression(STATE_DIR)
+        result = {"upload": upload, "download": download,
+                  "passed": bool(upload["passed"] and download["passed"])}
     elif stage_name == "chaos":
         from dragonfly2_tpu.client.chaosbench import check_chaos_regression
 

@@ -88,6 +88,19 @@ class DaemonConfig:
     # process-wide /debug/vars "data_plane" block); benches inject a
     # per-run instance.
     dataplane_stats: object = None
+    # Download engine (client/download_async): "async" runs metadata
+    # syncs, piece fetches and coalesced source runs as nonblocking
+    # state machines on a fixed daemon-wide pool of dl_workers event
+    # loops — download threads become a CONSTANT independent of
+    # concurrent task count; "threads" pins the historical
+    # thread-per-worker engine (syncer + piece-worker + back-source
+    # threads per task).
+    download_engine: str = "async"
+    dl_workers: int = 0  # 0 = engine default (DEFAULT_DL_WORKERS)
+    # Daemon-wide cap on concurrently streaming body ops (piece fetches
+    # + source runs); past it, streams queue FIFO in the engine. 0 =
+    # engine default (DEFAULT_DL_MAX_STREAMS).
+    dl_max_streams: int = 0
 
 
 class Daemon:
@@ -125,6 +138,16 @@ class Daemon:
         self.shaper: TrafficShaper = new_traffic_shaper(
             config.traffic_shaper_type, config.total_download_rate_bps
         )
+        if config.download_engine == "async":
+            from dragonfly2_tpu.client.download_async import (
+                DownloadLoopEngine,
+            )
+
+            self.dl_engine = DownloadLoopEngine(
+                workers=config.dl_workers, stats=config.dataplane_stats,
+                max_streams=config.dl_max_streams)
+        else:
+            self.dl_engine = None
         self.host_id = idgen.host_id_v1(config.hostname, self.upload.port)
         self.prober = None
         # Constructed eagerly: its per-task in-flight dedup only works as
@@ -149,6 +172,8 @@ class Daemon:
         if self._started:
             return
         self.upload.start()
+        if self.dl_engine is not None:
+            self.dl_engine.start()
         self.shaper.start()
         # host_id depends on the bound port only when port=0 was requested;
         # recompute now that the listener exists.
@@ -210,6 +235,8 @@ class Daemon:
         if self.prober is not None:
             self.prober.stop()
         self.shaper.stop()
+        if self.dl_engine is not None:
+            self.dl_engine.stop()
         self.upload.stop()
         self.storage.persist_all()
         # Clean-shutdown sentinel: the next start on this root skips
@@ -364,6 +391,7 @@ class Daemon:
                 priority=priority,
                 recovery_stats=self.config.recovery_stats,
                 dataplane_stats=self.config.dataplane_stats,
+                engine=self.dl_engine,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
@@ -526,6 +554,7 @@ class SeedPeerDaemonClient:
                            if seed_range else None),
                 recovery_stats=daemon.config.recovery_stats,
                 dataplane_stats=daemon.config.dataplane_stats,
+                engine=daemon.dl_engine,
             )
             # Seeds go straight to source (StartSeedTask → back-source);
             # register first so the peer exists in the scheduler's DAG.

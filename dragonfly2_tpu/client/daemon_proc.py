@@ -74,6 +74,19 @@ def main(argv=None) -> int:
     parser.add_argument("--scheduler-grace", type=float, default=5.0,
                         help="scheduler-silence window before degrading "
                              "to back-to-source")
+    parser.add_argument("--dl-engine", default="async",
+                        choices=("async", "threads"),
+                        help="download engine: 'async' = the fixed "
+                             "dl-loop event-loop pool (constant thread "
+                             "count), 'threads' = the historical "
+                             "thread-per-worker engine")
+    parser.add_argument("--dl-workers", type=int, default=0,
+                        help="event-loop worker count for the async "
+                             "download engine (0 = engine default)")
+    parser.add_argument("--dl-max-streams", type=int, default=0,
+                        help="daemon-wide cap on concurrently streaming "
+                             "piece/source-run bodies (0 = engine "
+                             "default)")
     parser.add_argument("--serve-rpc", action="store_true",
                         help="also serve the daemon gRPC surface "
                              "(ObtainSeeds for preheat triggers); the "
@@ -128,6 +141,9 @@ def main(argv=None) -> int:
         total_download_rate_bps=args.download_rate or INF,
         persist_every_pieces=args.persist_every,
         task_options=options,
+        download_engine=args.dl_engine,
+        dl_workers=args.dl_workers,
+        dl_max_streams=args.dl_max_streams,
     ))
     daemon.start()
     rpc = None

@@ -190,6 +190,12 @@ class PieceDispatcher:
         the same failure penalty as a transport error."""
         with self._lock:
             self._avoid.setdefault(piece_num, set()).add(peer_id)
+            # The WIRE fetch reported success before the store's md5
+            # check ran, so the piece sits in _downloaded — un-mark it,
+            # or _get_desired purges every re-enqueued request for it as
+            # already-done and the re-fetch can only come from the
+            # (source_fallback_wait-slow) origin path.
+            self._downloaded.discard(piece_num)
             last = self._score.get(peer_id, MAX_SCORE_NS)
             self._score[peer_id] = (last + MIN_SCORE_NS) // 2
 
@@ -212,6 +218,15 @@ class PieceDispatcher:
     def is_downloaded(self, piece_num: int) -> bool:
         with self._lock:
             return piece_num in self._downloaded
+
+    def pending(self) -> bool:
+        """Any request enqueued (a superset of what ``get`` would hand
+        out — banned/landed entries get purged by the next ``get``).
+        The async pump's lost-wakeup re-check: a racer's ``put`` is
+        visible here before its pump call could have observed the
+        pump's transient in-flight slot."""
+        with self._lock:
+            return any(self._requests.values())
 
     def scores(self) -> Dict[str, int]:
         with self._lock:
@@ -253,7 +268,8 @@ class PieceDownloader:
 
     def __init__(self, timeout: float = 30.0, scheme: str = "http",
                  pool_per_addr: int = 4, chunk_size: int = 64 * 1024,
-                 stats=None):
+                 stats=None, pool_idle_ttl: float = 60.0,
+                 pool_max_total: int = 256):
         self.timeout = timeout
         self.scheme = scheme
         self.chunk_size = chunk_size
@@ -264,7 +280,9 @@ class PieceDownloader:
         # test can prove no read ever materializes a whole piece.
         self.chunk_hook: Optional[Callable[[int], None]] = None
         self._pool = HTTPConnectionPool(per_host=pool_per_addr,
-                                        timeout=timeout)
+                                        timeout=timeout,
+                                        idle_ttl=pool_idle_ttl,
+                                        max_total=pool_max_total)
 
     # -- connection pool (shared HTTPConnectionPool, keyed per parent) -----
 

@@ -158,6 +158,280 @@ class QueueChannel:
 
 
 # ----------------------------------------------------------------------
+# Back-to-source claim state (shared by both run drivers)
+# ----------------------------------------------------------------------
+
+
+class _SourceClaimer:
+    """Back-to-source claim-side state shared by the threaded and
+    event-loop run drivers: the sequential local cursor, the one-way
+    remote→local mode degrade, the in-flight piece holds the re-sweep
+    must skip, and the error/abort ledger. Extracted verbatim from the
+    old closure set so both drivers claim with IDENTICAL semantics —
+    dispatcher steering, lease disjointness and the mesh-stall
+    fallback cannot diverge between engines."""
+
+    def __init__(self, conductor: "PeerTaskConductor", total: int,
+                 run_len: int):
+        self.c = conductor
+        self.total = total
+        self.run_len = run_len
+        self.lock = threading.Lock()
+        self.cursor = 0
+        self.errors: List[str] = []
+        # First error aborts the REMAINING work (claimants stop): a dead
+        # source fails in seconds instead of grinding through N doomed
+        # fetches before anyone looks at `errors`.
+        self.abort = threading.Event()
+        # Pieces some fetcher is currently working (kept through its
+        # whole retry ladder): the re-sweep below must never double-claim
+        # a run another fetcher holds in flight.
+        self.inflight: set = set()
+        # Swarm-coordinated origin claims (fan-out dissemination): when
+        # the scheduler exposes the claim ledger AND this peer is
+        # registered, origin fetches claim only DISJOINT leased runs and
+        # the mesh delivers the rest. Any claim failure or mesh stall
+        # degrades ONE WAY to local sequential claims — liveness never
+        # depends on the scheduler or the mesh.
+        self.local = not (
+            conductor._registered and conductor.opts.source_claims
+            and getattr(conductor.scheduler, "claim_source_run", None)
+            is not None)
+
+    def is_local(self) -> bool:
+        with self.lock:
+            return self.local
+
+    def note_error(self, msg: str) -> None:
+        with self.lock:
+            self.errors.append(msg)
+        self.abort.set()
+
+    def fallback_to_local(self) -> bool:
+        """One-way degrade to local sequential claims (claim failure /
+        mesh stall); True when THIS call performed the flip."""
+        with self.lock:
+            if self.local:
+                return False
+            self.local = True
+            self.cursor = 0
+            return True
+
+    def hold(self, first: int, count: int) -> None:
+        with self.lock:
+            self.inflight.update(range(first, first + count))
+
+    def release(self, first: int, count: int) -> None:
+        with self.lock:
+            self.inflight.difference_update(range(first, first + count))
+
+    def _claimable(self, n: int) -> bool:
+        return n not in self.inflight and not self.c.store.has_piece(n)
+
+    def local_claim(self) -> "tuple[int, int] | None":
+        """Next run of ≤run_len CONTIGUOUS missing pieces (pieces
+        already stored — e.g. partial p2p progress before the
+        back-to-source decision, or mesh deliveries during the hybrid
+        phase — break runs rather than being re-fetched)."""
+        with self.lock:
+            if self.abort.is_set():
+                return None
+            while (self.cursor < self.total
+                   and not self._claimable(self.cursor)):
+                self.cursor += 1
+            if self.cursor >= self.total:
+                return None
+            start = self.cursor
+            n = 0
+            while (n < self.run_len and start + n < self.total
+                   and self._claimable(start + n)):
+                n += 1
+            self.cursor = start + n
+            return start, n
+
+    def remote_claim(self) -> "tuple | None":
+        """One scheduler claim poll → ('run', first, count), ('wait',),
+        ('retry',) after a mode flip, or None (origin work exhausted AND
+        the file is locally complete). Claim replies double as mesh
+        discovery: every reply's partial parents get a syncer."""
+        from dragonfly2_tpu.scheduler.service import SourceClaimRequest
+
+        c = self.c
+        try:
+            reply = c.scheduler.claim_source_run(SourceClaimRequest(
+                peer_id=c.peer_id, task_id=c.task_id,
+                total_pieces=self.total, run_len=self.run_len))
+            # Duck-typed scheduler stand-ins may accept the call and
+            # return garbage — a malformed reply degrades like a failed
+            # one.
+            parents = list(reply.parents)
+            first, count = int(reply.first), int(reply.count)
+        except Exception as exc:  # noqa: BLE001 — degrade, don't die
+            logger.debug("source claim failed (%s); degrading to "
+                         "local claims", exc)
+            c.recovery.tick("source_claim_fallbacks")
+            # Keyed by failure shape so a fleet report can tell a
+            # saturated scheduler (DeadlineExceeded) from a legacy one
+            # (AttributeError) at a glance.
+            c.recovery.tick(
+                f"source_claim_fallback_{type(exc).__name__}")
+            with self.lock:
+                self.local = True
+            return ("retry",)
+        for pid, addr in parents:
+            c._start_syncer(ParentInfo(pid, addr))
+        if first >= 0:
+            return ("run", first, count)
+        if c._source_complete():
+            return None
+        if (bool(getattr(reply, "done", False)) and not parents
+                and not c._mesh_feeding()):
+            # Every piece has landed SOMEWHERE (done: nobody else is
+            # fetching from the origin, so local refetch duplicates a
+            # bounded amount) but the swarm offers this peer no parent
+            # and no syncer is live — the landed copies are unreachable
+            # from here, and no amount of waiting delivers them. Degrade
+            # to local claims NOW instead of idling out the full
+            # source_fallback_wait window. A plain "wait" (not done)
+            # keeps the stall discipline: other claimants are still
+            # fetching, and their pieces become offerable parents the
+            # moment they land.
+            if self.fallback_to_local():
+                c.recovery.tick("source_mesh_unreachable_fallbacks")
+                logger.warning(
+                    "task %s: file fully landed in an unreachable mesh "
+                    "(no parents offered, no live syncer); claiming "
+                    "from origin", c.task_id[:16])
+            return ("retry",)
+        return ("wait",)
+
+    def claim(self) -> "tuple | None":
+        if self.abort.is_set():
+            return None
+        if not self.is_local():
+            return self.remote_claim()
+        granted = self.local_claim()
+        if granted is not None:
+            return ("run", granted[0], granted[1])
+        # Cursor exhausted. In pure-local mode that used to mean done —
+        # but mesh deliveries may still be in flight (the hybrid phase),
+        # and a mesh fetch that later FAILS re-opens a hole behind the
+        # cursor: re-sweep (skipping runs other fetchers hold in flight)
+        # until the file is complete.
+        if self.c._source_complete():
+            return None
+        with self.lock:
+            self.cursor = 0
+        return ("wait",)
+
+    def clip(self, first: int, count: int) -> "List[tuple]":
+        """Locally-MISSING subruns of a granted run: a remote grant can
+        race pieces landing here (mesh delivery, journal-resume replay
+        still propagating) — re-downloading them would both waste origin
+        bytes and re-fire piece sinks for bytes already on disk."""
+        subruns: List[tuple] = []
+        sub_first, sub_n = -1, 0
+        for num in range(first, first + count):
+            if self.c.store.has_piece(num):
+                if sub_n:
+                    subruns.append((sub_first, sub_n))
+                sub_first, sub_n = -1, 0
+                continue
+            if sub_n == 0:
+                sub_first = num
+            sub_n += 1
+        if sub_n:
+            subruns.append((sub_first, sub_n))
+        return subruns
+
+
+# ----------------------------------------------------------------------
+# Metadata sync engines
+# ----------------------------------------------------------------------
+
+
+class _SyncState:
+    """Per-parent metadata-sync pacing/budget state, shared by the
+    thread and event-loop sync engines (see ``_sync_poll_result``)."""
+
+    __slots__ = ("failures", "not_ready_until", "seen_pieces", "interval")
+
+    def __init__(self, opts: "PeerTaskOptions"):
+        self.failures = 0
+        # Partial-parent grace: a parent offered at registration may not
+        # have CREATED its store yet (it registers, then attaches
+        # storage) — its 404s within this window are "not ready", not
+        # failures, or every cold fan-out child would burn its sync
+        # budget on the very parents it is supposed to wait for.
+        self.not_ready_until = (time.monotonic()
+                                + opts.metadata_not_ready_grace)
+        # Idle-adaptive pacing: fast polls while the parent produces,
+        # doubling toward metadata_idle_poll_cap while it doesn't — a
+        # 32-daemon fleet polling every idle parent at the fast
+        # interval measurably starves the transfers the polls feed.
+        self.seen_pieces = -1
+        self.interval = opts.metadata_poll_interval
+
+
+class _AsyncSyncer:
+    """Thread-shaped handle for an event-loop metadata syncer: one
+    keep-alive ``BufferedGetOp`` per poll over the ENGINE-WIDE socket
+    pool (one pooled connection per parent per daemon, not per task),
+    pacing parked on the engine's timer wheel. Pacing, budgets and the
+    piece/availability plumbing are the conductor's ``_sync_poll_result``
+    — byte-for-byte the thread syncer's semantics."""
+
+    def __init__(self, conductor: "PeerTaskConductor", parent: ParentInfo):
+        self.conductor = conductor
+        self.parent = parent
+        self.state = _SyncState(conductor.opts)
+        self._done = threading.Event()
+
+    # thread-compatible surface (the conductor's syncer map)
+    def is_alive(self) -> bool:
+        return not self._done.is_set()
+
+    def join(self, timeout: "float | None" = None) -> None:
+        self._done.wait(timeout)
+
+    def start(self) -> None:
+        self._poll()
+
+    def _poll(self) -> None:
+        from dragonfly2_tpu.client.download_async import BufferedGetOp
+
+        c = self.conductor
+        if (self._done.is_set() or c._sync_stop.is_set()
+                or self.parent.peer_id in c._banned_parents):
+            self._done.set()
+            return
+        try:
+            c.engine.submit(BufferedGetOp(
+                c.task_id, self.parent.addr,
+                f"/metadata/{c.task_id}?peerId={self.parent.peer_id}",
+                timeout=c.opts.metadata_timeout, stats=c.stats,
+                callback=self._on_poll))
+        except RuntimeError:  # engine stopped (daemon shutdown)
+            self._done.set()
+
+    def _on_poll(self, status, headers, body, err) -> None:
+        c = self.conductor
+        try:
+            wait = c._sync_poll_result(self.parent, self.state,
+                                       status, body or b"", err)
+        except Exception:  # noqa: BLE001 — a dead syncer, not a dead loop
+            logger.exception("async metadata sync failed")
+            wait = None
+        if wait is None or c._sync_stop.is_set():
+            self._done.set()
+            return
+        try:
+            c.engine.call_later(wait, self._poll)
+        except RuntimeError:
+            self._done.set()
+
+
+# ----------------------------------------------------------------------
 # Conductor
 # ----------------------------------------------------------------------
 
@@ -326,6 +600,7 @@ class PeerTaskConductor:
         priority: int = 0,
         dataplane_stats=None,
         recovery_stats=None,
+        engine=None,
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -357,6 +632,16 @@ class PeerTaskConductor:
         # Module-level import (not lazy): any process that CAN download
         # publishes the "recovery" debug block from startup.
         self.recovery = recovery_stats if recovery_stats is not None else RECOVERY
+        # Daemon-wide event-loop download engine (client/download_async).
+        # None = the historical thread-per-worker engine: per-task sync/
+        # piece/back-source threads. With an engine, metadata syncs,
+        # piece fetches and coalesced source runs all run as nonblocking
+        # state machines on the engine's fixed dl-loop pool, and this
+        # conductor spawns ZERO download threads.
+        self.engine = engine
+        self._async_lock = threading.Lock()
+        self._inflight_pieces = 0
+        self._async_ops: set = set()
         self.channel = QueueChannel()
         # Swarm-visibility for rarest-first dispatch: per-parent piece
         # inventories from metadata syncs and the derived availability
@@ -383,6 +668,13 @@ class PeerTaskConductor:
             pending_cap=self.opts.report_pending_cap,
             on_delivery=self._note_scheduler,
             recovery=self.recovery)
+        if self.engine is not None:
+            # Count-triggered batch flushes otherwise run their RPC
+            # (plus the retry ladder's jittered sleeps) on whichever
+            # thread reported the 16th piece — a dl-loop in engine
+            # mode. Route them to the engine's dl-ctl runner so a slow
+            # scheduler never stalls the byte-moving loops.
+            self.reporter.flush_executor = self.engine.offload
         # Keep-alive pool for parent metadata polls (one conn per
         # parent): syncers poll at metadata_poll_interval, and a
         # connection per poll would make the fleet's metadata plane a
@@ -753,6 +1045,15 @@ class PeerTaskConductor:
         feeding = any(t.is_alive() for t in self._syncers.values())
         return not feeding and now - self._started_at > grace
 
+    def _mesh_feeding(self) -> bool:
+        """Is any LIVE metadata syncer still connected to a parent? A
+        source claimer told to WAIT (other claimants hold the leases)
+        only profits from waiting while the mesh can actually deliver
+        those pieces here — with no live syncer there is no path for
+        them, and the claimer should degrade to local claims NOW instead
+        of idling out the full ``source_fallback_wait`` window."""
+        return any(t.is_alive() for t in self._syncers.values())
+
     # -- piece metadata sync per parent (synchronizer role) ----------------
 
     def _start_syncer(self, parent: ParentInfo) -> None:
@@ -775,6 +1076,11 @@ class PeerTaskConductor:
             # parent; an uncapped refresh stream would accrete one loop
             # per parent ever offered and the fleet's poll traffic
             # would swamp the mesh it feeds.
+            return
+        if self.engine is not None:
+            syncer = _AsyncSyncer(self, parent)
+            self._syncers[parent.peer_id] = syncer
+            syncer.start()
             return
         t = threading.Thread(
             target=self._sync_parent, args=(parent,),
@@ -809,77 +1115,97 @@ class PeerTaskConductor:
 
     def _sync_parent(self, parent: ParentInfo) -> None:
         tracing.adopt_trace_context(self._trace_ctx)
-        failures = 0
-        # Partial-parent grace: a parent offered at registration may not
-        # have CREATED its store yet (it registers, then attaches
-        # storage) — its 404s within this window are "not ready", not
-        # failures, or every cold fan-out child would burn its sync
-        # budget on the very parents it is supposed to wait for.
-        not_ready_until = time.monotonic() + self.opts.metadata_not_ready_grace
-        # Idle-adaptive pacing: fast polls while the parent produces,
-        # doubling toward metadata_idle_poll_cap while it doesn't — a
-        # 32-daemon fleet polling every idle parent at the fast
-        # interval measurably starves the transfers the polls feed.
-        seen_pieces = -1
-        interval = self.opts.metadata_poll_interval
+        state = _SyncState(self.opts)
         while not self._sync_stop.is_set():
             if parent.peer_id in self._banned_parents:
                 return  # blacklisted mid-sync (repeat corruption)
-            backoff = 0.0
             try:
                 status, body = self._fetch_parent_metadata(parent)
+                exc = None
+            except Exception as poll_exc:  # noqa: BLE001 — budgeted below
+                status, body, exc = -1, b"", poll_exc
+            wait = self._sync_poll_result(parent, state, status, body, exc)
+            if wait is None:
+                return
+            self._sync_stop.wait(wait)
+
+    def _sync_poll_result(self, parent: ParentInfo, state: "_SyncState",
+                          status: int, body: bytes,
+                          exc: "Exception | None") -> "float | None":
+        """Shared poll-outcome handler for BOTH sync engines (the thread
+        loop above and the event-loop :class:`_AsyncSyncer`): applies the
+        not-ready grace, the retry budget with jittered backoff, the
+        idle-adaptive pacing, availability/enqueue updates and the
+        giveup watchdog. Returns the wait before the next poll, or None
+        to retire the syncer."""
+        if exc is None:
+            # The WHOLE shape-dependent decode is budgeted: a parent
+            # answering 200 with a body that parses but isn't the
+            # metadata shape (a list, a piece entry missing "offset")
+            # must count against the retry budget and eventually hit
+            # the giveup bookkeeping below — not escape and kill the
+            # syncer with the parent's stale availability still
+            # registered.
+            try:
                 if status == 404:
-                    if time.monotonic() < not_ready_until:
+                    if time.monotonic() < state.not_ready_until:
                         self.recovery.tick("metadata_not_ready_polls")
-                        self._sync_stop.wait(self.opts.metadata_poll_interval)
-                        continue
+                        return self.opts.metadata_poll_interval
                     raise OSError(f"metadata 404 from {parent.addr}")
                 if status != 200:
                     raise OSError(
                         f"metadata status {status} from {parent.addr}")
                 meta = json.loads(body)
-                failures = 0
-                if meta.get("contentLength", -1) >= 0:
-                    self._learn_length(meta["contentLength"],
-                                       meta.get("totalPieces", -1))
-                pieces = meta.get("pieces", [])
-                self._update_availability(
-                    parent.peer_id, {p["num"] for p in pieces})
-                for p in pieces:
-                    self._enqueue_piece(parent, PieceMetadata(
-                        num=p["num"], md5=p.get("md5", ""),
-                        offset=p["offset"], start=p["start"],
-                        length=p["length"],
-                    ))
-                # Stay alive until the task completes: pieces that fail
-                # download are discarded from _enqueued and only a live
-                # syncer poll re-enqueues them.
-                if meta.get("done") and self._all_written():
-                    return
-                cap = self.opts.metadata_idle_poll_cap
-                if len(pieces) != seen_pieces or cap <= 0:
-                    seen_pieces = len(pieces)
-                    interval = self.opts.metadata_poll_interval
-                else:
-                    interval = min(max(interval * 2, 1e-3), cap)
-            except Exception as exc:
-                failures += 1
-                logger.debug("metadata sync %s failed (%d): %s",
-                             parent.addr, failures, exc)
-                if failures > self.opts.metadata_retry_limit:
-                    # Watchdog gives up on the parent
-                    # (peertask_piecetask_synchronizer.go:70 watchdog).
-                    self.recovery.tick("metadata_sync_giveups")
-                    self._drop_parent_availability(parent.peer_id)
-                    self._report_piece_failed(parent.peer_id, -1)
-                    return
-                # Budgeted retry with full jitter instead of hammering
-                # a flapping parent at the poll interval.
-                self.recovery.tick("metadata_retries")
-                backoff = full_jitter(failures - 1, self.opts.backoff_base,
-                                      self.opts.backoff_cap, self._rng)
-                interval = self.opts.metadata_poll_interval
-            self._sync_stop.wait(interval + backoff)
+                content_length = meta.get("contentLength", -1)
+                total_pieces = meta.get("totalPieces", -1)
+                done = bool(meta.get("done"))
+                parsed = [PieceMetadata(
+                    num=p["num"], md5=p.get("md5", ""),
+                    offset=p["offset"], start=p["start"],
+                    length=p["length"],
+                ) for p in meta.get("pieces", [])]
+            except Exception as parse_exc:  # noqa: BLE001 — budgeted
+                exc = parse_exc
+        if exc is None:
+            state.failures = 0
+            if content_length >= 0:
+                self._learn_length(content_length, total_pieces)
+            self._update_availability(
+                parent.peer_id, {pm.num for pm in parsed})
+            for pm in parsed:
+                self._enqueue_piece(parent, pm)
+            # Stay alive until the task completes: pieces that fail
+            # download are discarded from _enqueued and only a live
+            # syncer poll re-enqueues them.
+            if done and self._all_written():
+                return None
+            cap = self.opts.metadata_idle_poll_cap
+            if len(parsed) != state.seen_pieces or cap <= 0:
+                state.seen_pieces = len(parsed)
+                state.interval = self.opts.metadata_poll_interval
+            else:
+                state.interval = min(max(state.interval * 2, 1e-3), cap)
+            return state.interval
+        state.failures += 1
+        logger.debug("metadata sync %s failed (%d): %s",
+                     parent.addr, state.failures, exc)
+        if state.failures > self.opts.metadata_retry_limit:
+            # Watchdog gives up on the parent
+            # (peertask_piecetask_synchronizer.go:70 watchdog).
+            self.recovery.tick("metadata_sync_giveups")
+            self._drop_parent_availability(parent.peer_id)
+            # Async syncers run this handler on a loop thread — the
+            # whole-parent failure RPC goes through the ctl runner.
+            self._offload_control(
+                lambda p=parent.peer_id: self._report_piece_failed(p, -1))
+            return None
+        # Budgeted retry with full jitter instead of hammering a
+        # flapping parent at the poll interval.
+        self.recovery.tick("metadata_retries")
+        state.interval = self.opts.metadata_poll_interval
+        return state.interval + full_jitter(
+            state.failures - 1, self.opts.backoff_base,
+            self.opts.backoff_cap, self._rng)
 
     # -- swarm availability (rarest-first input) ---------------------------
 
@@ -932,16 +1258,161 @@ class PeerTaskConductor:
             # it is stranded until the task deadline.
             with self._written_lock:
                 self._enqueued.discard(piece.num)
+            return
+        if self.engine is not None:
+            self._async_pump()
 
     # -- piece download workers (downloadPieceWorker) ----------------------
 
     def _start_workers(self) -> None:
+        if self.engine is not None:
+            # Event-loop mode: no worker threads — the pump keeps up to
+            # piece_concurrency PieceFetchOps in flight on the engine.
+            self._async_pump()
+            return
         for i in range(self.opts.piece_concurrency):
             t = threading.Thread(
                 target=self._piece_worker, name=f"piece-worker-{i}", daemon=True
             )
             self._workers.append(t)
             t.start()
+
+    # -- event-loop piece pump (engine mode) -------------------------------
+
+    def _async_pump(self) -> None:
+        """Keep up to ``piece_concurrency`` PieceFetchOps in flight on
+        the engine — the event-loop replacement for the worker-thread
+        pool. Driven by enqueues (syncers) and completions (loop
+        threads); safe from any thread."""
+        if self.engine is None or self._done.is_set():
+            return
+        while True:
+            with self._async_lock:
+                if self._inflight_pieces >= self.opts.piece_concurrency:
+                    return
+                self._inflight_pieces += 1
+            req = None
+            closed = False
+            try:
+                req = self.dispatcher.get(timeout=0)
+            except DispatcherClosedError:
+                closed = True
+            if req is None:
+                with self._async_lock:
+                    self._inflight_pieces -= 1
+                # Lost-wakeup guard: an enqueue that raced the empty get
+                # above may have seen our transient slot at the cap and
+                # bailed without pumping. Its put() happens-before its
+                # cap check, so after releasing the slot any stranded
+                # piece is visible here — loop back for it.
+                if closed or not self.dispatcher.pending():
+                    return
+                continue
+            with self._written_lock:
+                done_already = req.piece.num in self._written
+            if done_already or (self.store is not None
+                                and self.store.has_piece(req.piece.num)):
+                with self._async_lock:
+                    self._inflight_pieces -= 1
+                continue
+            try:
+                self._async_submit_piece(req)
+            except RuntimeError:
+                # Engine stopped mid-shutdown: re-open the piece for a
+                # (never-coming) retry and stop pumping — the task is
+                # tearing down anyway.
+                with self._async_lock:
+                    self._inflight_pieces -= 1
+                with self._written_lock:
+                    self._enqueued.discard(req.piece.num)
+                return
+
+    def _async_submit_piece(self, req: DownloadPieceRequest) -> None:
+        from dragonfly2_tpu.client.download_async import PieceFetchOp
+
+        begin_wall = time.time()
+        holder = {}
+
+        def on_done(md5_hex, cost_ns, err, _req=req, _t0=begin_wall):
+            self._on_async_piece(_req, md5_hex, cost_ns, err, _t0,
+                                 holder.get("op"))
+
+        op = PieceFetchOp(
+            req,
+            open_fd=self.store.data_write_fd,
+            reserve=lambda n: self.shaper.reserve_n(self.task_id, n),
+            refund=lambda n: self.shaper.return_n(self.task_id, n),
+            callback=on_done,
+            timeout=self.downloader.timeout,
+            stats=self.stats,
+            chunk_hook=self.downloader.chunk_hook,
+        )
+        holder["op"] = op
+        with self._async_lock:
+            self._async_ops.add(op)
+        self.engine.submit(op)
+
+    def _on_async_piece(self, req: DownloadPieceRequest,
+                        md5_hex: "str | None", cost_ns: int,
+                        err: "DownloadPieceError | None",
+                        begin_wall: float, op) -> None:
+        """Completion of one event-loop piece fetch (loop thread) —
+        the async mirror of ``_fetch_one_piece``'s outcome handling."""
+        delay = 0.0
+        outcome = "stored"
+        try:
+            if err is None:
+                self.dispatcher.report(DownloadPieceResult(
+                    req.dst_peer_id, req.piece.num, fail=False,
+                    cost_ns=cost_ns))
+                self._record_fetched_piece(req, md5_hex, cost_ns)
+            elif self._done.is_set():
+                outcome = "cancelled"  # task over; no failure accounting
+            elif err.fatal:
+                outcome = "fatal"
+                self.recovery.tick("enospc_fail_fast")
+                self._fail(f"disk full: {err}")
+            elif err.not_ready and self._note_piece_not_ready(req):
+                outcome = "not_ready"
+            else:
+                outcome = "failed"
+                logger.debug("piece %d from %s failed: %s",
+                             req.piece.num, req.dst_peer_id, err)
+                self.dispatcher.report(DownloadPieceResult(
+                    req.dst_peer_id, req.piece.num, fail=True))
+                # The failure RPC (up to 2 sync attempts) must not run
+                # on this loop thread — a slow scheduler would stall
+                # every task multiplexed here.
+                self._offload_control(
+                    lambda p=req.dst_peer_id, n=req.piece.num:
+                    self._report_piece_failed(p, n))
+                delay = self._note_piece_failure(req.piece.num)
+        finally:
+            self._emit_piece_span(req, begin_wall, outcome)
+            with self._async_lock:
+                self._inflight_pieces -= 1
+                self._async_ops.discard(op)
+            if delay > 0:
+                try:
+                    self.engine.call_later(delay, self._async_pump)
+                except RuntimeError:
+                    pass
+            else:
+                self._async_pump()
+
+    def _emit_piece_span(self, req: DownloadPieceRequest,
+                         begin_wall: float, outcome: str) -> None:
+        """Retrospective ``piece.fetch`` span (loop threads multiplex
+        many tasks, so the threaded engine's context-manager span can't
+        wrap an async fetch)."""
+        tracer = tracing.default_tracer()
+        if not tracer.enabled:
+            return
+        tracer.emit("piece.fetch", start=begin_wall,
+                    duration_s=max(time.time() - begin_wall, 0.0),
+                    parent=self._trace_ctx, piece=req.piece.num,
+                    parent_id=req.dst_peer_id, nbytes=req.piece.length,
+                    outcome=outcome)
 
     def _piece_worker(self) -> None:
         # Fresh thread, fresh contextvar context: adopt the task trace
@@ -1122,11 +1593,14 @@ class PeerTaskConductor:
         self.recovery.tick("piece_not_ready_parks")
         return True
 
-    def _note_piece_failure(self, piece_num: int) -> None:
+    def _note_piece_failure(self, piece_num: int) -> float:
         """Count one failed attempt at a piece, re-open it for (other)
         syncers, and enforce the per-piece retry budget: an exhausted
         piece degrades the task to back-to-source instead of spinning on
-        the mesh until the task deadline."""
+        the mesh until the task deadline. Thread mode SLEEPS the jittered
+        backoff here (pacing the calling worker); event-loop mode gets
+        the delay returned instead and parks the pump on the engine's
+        timer wheel — a loop thread never sleeps a backoff."""
         now = time.monotonic()
         with self._written_lock:
             attempts = self._piece_attempts.get(piece_num, 0) + 1
@@ -1139,11 +1613,15 @@ class PeerTaskConductor:
             self.channel.decisions.put(NeedBackToSource(
                 f"piece {piece_num} exhausted its "
                 f"{self.opts.piece_retry_limit}-attempt retry budget"))
-            return
-        # Jittered backoff before this worker grabs more work: a dead
-        # parent no longer gets hammered in a tight requeue loop.
-        self._done.wait(full_jitter(attempts - 1, self.opts.backoff_base,
-                                    self.opts.backoff_cap, self._rng))
+            return 0.0
+        # Jittered backoff before more work is grabbed for the piece: a
+        # dead parent no longer gets hammered in a tight requeue loop.
+        delay = full_jitter(attempts - 1, self.opts.backoff_base,
+                            self.opts.backoff_cap, self._rng)
+        if self.engine is None:
+            self._done.wait(delay)
+            return 0.0
+        return delay
 
     def _on_piece_corrupt(self, req: DownloadPieceRequest, exc) -> None:
         """md5 mismatch at store time: steer the re-fetch to a DIFFERENT
@@ -1208,7 +1686,7 @@ class PeerTaskConductor:
             parent_id=req.dst_peer_id, offset=piece.offset,
             length=piece.length, digest=f"md5:{piece.md5}" if piece.md5 else "",
             cost_ns=cost_ns, traffic_type=TRAFFIC_REMOTE_PEER,
-        ), trace_link=(tracing.current_trace_context()
+        ), trace_link=((tracing.current_trace_context() or self._trace_ctx)
                        if tracing.default_tracer().enabled else None))
         self._check_finished()
 
@@ -1220,6 +1698,17 @@ class PeerTaskConductor:
             self.piece_sink(self.store, piece)
         except Exception:
             logger.exception("piece sink failed for piece %d", piece_num)
+
+    def _offload_control(self, fn) -> None:
+        """Run a blocking control-plane RPC off the calling thread when
+        that thread is an engine loop (completions and async sync polls
+        dispatch there); threads-engine callers are per-task workers
+        and pay inline, exactly as before."""
+        eng = self.engine
+        if eng is not None and getattr(eng, "running", False):
+            eng.offload(fn)
+        else:
+            fn()
 
     def _report_piece_failed(self, parent_id: str, piece_number: int) -> None:
         """Tell the scheduler a piece (or a whole parent, number=-1)
@@ -1311,6 +1800,10 @@ class PeerTaskConductor:
         self.channel.close()
         if self.native_fetcher is not None:
             self.native_fetcher.close()
+        with self._async_lock:
+            pending_ops = list(self._async_ops)
+        for op in pending_ops:
+            op.cancel()  # event-loop fetches still in flight
         for t in self._workers:
             t.join(timeout=2)
         for t in self._syncers.values():
@@ -1426,111 +1919,26 @@ class PeerTaskConductor:
 
         self._learn_length(length, -1)
         total = self.total_pieces
-        run_len = max(int(self.opts.coalesce_run), 1)
-        errors: List[str] = []
-        lock = threading.Lock()
-        cursor = [0]
-        # First error aborts the REMAINING work (workers stop claiming
-        # runs): a dead source fails in seconds instead of grinding
-        # through N doomed fetches before anyone looks at `errors`.
-        abort = threading.Event()
-        # Swarm-coordinated origin claims (fan-out dissemination): when
-        # the scheduler exposes the claim ledger AND this peer is
-        # registered, origin workers fetch only DISJOINT leased runs and
-        # the mesh (partial parents from the claim replies) delivers the
-        # rest. Any claim failure or mesh stall degrades ONE WAY to the
-        # local sequential claims below — liveness never depends on the
-        # scheduler or the mesh.
-        remote_claims = bool(
-            self._registered and self.opts.source_claims
-            and getattr(self.scheduler, "claim_source_run", None) is not None)
-        mode = {"local": not remote_claims}
+        claimer = _SourceClaimer(self, total,
+                                 max(int(self.opts.coalesce_run), 1))
+        if self._async_source_target() is not None:
+            # Event-loop driver: SourceRunOps stream granted runs on the
+            # daemon-wide engine; the caller thread (which the threaded
+            # driver spent join()ing its workers) orchestrates claims
+            # and retries -- zero back-source threads.
+            self._drive_source_async(claimer, length)
+        else:
+            self._drive_source_threads(claimer, client, length)
+        if claimer.errors and not self._source_complete():
+            raise RuntimeError("; ".join(claimer.errors[:3]))
+        self.store.mark_done()
+        return length, total
 
-        # Pieces some worker is currently fetching (kept through its
-        # whole retry loop): the re-sweep below must never double-claim
-        # a run another worker is mid-fetch on.
-        inflight: set[int] = set()
-
-        def local_claim() -> "tuple[int, int] | None":
-            """Next run of ≤run_len CONTIGUOUS missing pieces (pieces
-            already stored — e.g. partial p2p progress before the
-            back-to-source decision, or mesh deliveries during the
-            hybrid phase — break runs rather than being re-fetched)."""
-
-            def claimable(n: int) -> bool:
-                return n not in inflight and not self.store.has_piece(n)
-
-            with lock:
-                if abort.is_set():
-                    return None
-                while cursor[0] < total and not claimable(cursor[0]):
-                    cursor[0] += 1
-                if cursor[0] >= total:
-                    return None
-                start = cursor[0]
-                n = 0
-                while (n < run_len and start + n < total
-                       and claimable(start + n)):
-                    n += 1
-                cursor[0] = start + n
-                return start, n
-
-        def remote_claim() -> "tuple | None":
-            """One scheduler claim poll → ('run', first, count),
-            ('wait',), or None (origin work exhausted AND the file is
-            locally complete). Claim replies double as mesh discovery:
-            every reply's partial parents get a syncer."""
-            from dragonfly2_tpu.scheduler.service import SourceClaimRequest
-
-            try:
-                reply = self.scheduler.claim_source_run(SourceClaimRequest(
-                    peer_id=self.peer_id, task_id=self.task_id,
-                    total_pieces=total, run_len=run_len))
-                # Duck-typed scheduler stand-ins may accept the call
-                # and return garbage — a malformed reply degrades like
-                # a failed one.
-                parents = list(reply.parents)
-                first, count = int(reply.first), int(reply.count)
-            except Exception as exc:
-                logger.debug("source claim failed (%s); degrading to "
-                             "local claims", exc)
-                self.recovery.tick("source_claim_fallbacks")
-                # Keyed by failure shape so a fleet report can tell a
-                # saturated scheduler (DeadlineExceeded) from a legacy
-                # one (AttributeError) at a glance.
-                self.recovery.tick(
-                    f"source_claim_fallback_{type(exc).__name__}")
-                with lock:
-                    mode["local"] = True
-                return ("retry",)
-            for pid, addr in parents:
-                self._start_syncer(ParentInfo(pid, addr))
-            if first >= 0:
-                return ("run", first, count)
-            if self._source_complete():
-                return None
-            return ("wait",)
-
-        def claim() -> "tuple | None":
-            if abort.is_set():
-                return None
-            with lock:
-                local = mode["local"]
-            if not local:
-                return remote_claim()
-            granted = local_claim()
-            if granted is not None:
-                return ("run", granted[0], granted[1])
-            # Cursor exhausted. In pure-local mode that used to mean
-            # done — but mesh deliveries may still be in flight (the
-            # hybrid phase), and a mesh fetch that later FAILS re-opens
-            # a hole behind the cursor: re-sweep (skipping runs other
-            # workers hold in flight) until the file is complete.
-            if self._source_complete():
-                return None
-            with lock:
-                cursor[0] = 0
-            return ("wait",)
+    def _drive_source_threads(self, claimer: "_SourceClaimer", client,
+                              length: int) -> None:
+        """The historical thread-per-worker run driver (non-HTTP / TLS /
+        proxied sources, and conductors running without an engine)."""
+        total = claimer.total
 
         def fetch_run(first: int, count: int) -> "Exception | None":
             """Span-wrapped ``fetch_run_impl``: one ``source.fetch_run``
@@ -1541,7 +1949,7 @@ class PeerTaskConductor:
             if not tracer.enabled:
                 return fetch_run_impl(first, count)
             with tracer.span("source.fetch_run", first=first, count=count,
-                             claimed=not mode["local"]) as rec:
+                             claimed=not claimer.is_local()) as rec:
                 err = fetch_run_impl(first, count)
                 if err is not None:
                     rec["attrs"]["error"] = f"{type(err).__name__}: {err}"
@@ -1639,7 +2047,7 @@ class PeerTaskConductor:
             a DEAD source still fails in ~retry_limit runs per worker).
             Returns False when the worker must stop."""
             attempts = 0
-            while not abort.is_set():
+            while not claimer.abort.is_set():
                 err = fetch_run(first, count)
                 if err is None:
                     return True
@@ -1658,11 +2066,10 @@ class PeerTaskConductor:
                 if isinstance(err, DiskFullError):
                     self.recovery.tick("enospc_fail_fast")
                     attempts = None  # terminal — no retry can help
-                if attempts is None or attempts > self.opts.source_retry_limit:
-                    with lock:
-                        errors.append(
-                            f"pieces {first}-{first + count - 1}: {err}")
-                    abort.set()
+                if (attempts is None
+                        or attempts > self.opts.source_retry_limit):
+                    claimer.note_error(
+                        f"pieces {first}-{first + count - 1}: {err}")
                     return False
                 self.recovery.tick("source_run_retries")
                 logger.debug("source run %d-%d failed (attempt %d): %s",
@@ -1683,69 +2090,44 @@ class PeerTaskConductor:
             completes the file regardless of swarm health)."""
             tracing.adopt_trace_context(self._trace_ctx)
             while not self._done.is_set():
-                claimed = claim()
+                claimed = claimer.claim()
                 if claimed is None:
                     return
                 kind = claimed[0]
                 if kind == "retry":
                     continue  # mode flipped; re-claim immediately
                 if kind == "wait":
-                    if self._source_complete() or abort.is_set():
+                    if self._source_complete() or claimer.abort.is_set():
                         return
                     with self._sched_lock:
                         last_progress = self._last_progress_at
                     now = time.monotonic()
                     stalled = (now - last_progress
                                > self.opts.source_fallback_wait)
-                    with lock:
-                        if stalled and not mode["local"]:
-                            mode["local"] = True
-                            cursor[0] = 0
-                            self.recovery.tick("source_mesh_stall_fallbacks")
-                            logger.warning(
-                                "task %s: mesh stalled %.1fs; claiming "
-                                "remaining pieces from origin",
-                                self.task_id[:16],
-                                now - last_progress)
-                            continue
+                    if stalled and claimer.fallback_to_local():
+                        self.recovery.tick("source_mesh_stall_fallbacks")
+                        logger.warning(
+                            "task %s: mesh stalled %.1fs; claiming "
+                            "remaining pieces from origin",
+                            self.task_id[:16],
+                            now - last_progress)
+                        continue
                     if now > deadline:
-                        with lock:
-                            errors.append(
-                                "timed out waiting for leased pieces "
-                                "from the mesh")
-                        abort.set()
+                        claimer.note_error(
+                            "timed out waiting for leased pieces "
+                            "from the mesh")
                         return
                     self._done.wait(self.opts.claim_wait_interval)
                     continue
                 first, count = claimed[1], claimed[2]
-                # Clip the granted run to locally-MISSING subruns: a
-                # remote grant can race pieces landing here (mesh
-                # delivery, journal-resume replay still propagating) —
-                # re-downloading them would both waste origin bytes and
-                # re-fire piece sinks for bytes already on disk.
-                subruns = []
-                sub_first, sub_n = -1, 0
-                for num in range(first, first + count):
-                    if self.store.has_piece(num):
-                        if sub_n:
-                            subruns.append((sub_first, sub_n))
-                        sub_first, sub_n = -1, 0
-                        continue
-                    if sub_n == 0:
-                        sub_first = num
-                    sub_n += 1
-                if sub_n:
-                    subruns.append((sub_first, sub_n))
-                with lock:
-                    inflight.update(range(first, first + count))
+                subruns = claimer.clip(first, count)
+                claimer.hold(first, count)
                 try:
                     for sub_first, sub_n in subruns:
                         if not fetch_claimed(sub_first, sub_n):
                             return
                 finally:
-                    with lock:
-                        inflight.difference_update(
-                            range(first, first + count))
+                    claimer.release(first, count)
 
         threads = [
             threading.Thread(target=worker, daemon=True,
@@ -1756,10 +2138,255 @@ class PeerTaskConductor:
             t.start()
         for t in threads:
             t.join()
-        if errors and not self._source_complete():
-            raise RuntimeError("; ".join(errors[:3]))
-        self.store.mark_done()
-        return length, total
+
+    # -- event-loop back-to-source driver ----------------------------------
+
+    def _async_source_target(self) -> "tuple[str, str, str] | None":
+        """``(addr, path, Host header)`` when the origin is plain direct
+        HTTP the engine can speak nonblocking; None falls back to the
+        threaded driver (https/file/s3/… schemes, proxied or
+        credentialed URLs, redirect-dependent origins)."""
+        if self.engine is None or not getattr(self.engine, "running", False):
+            return None
+        import urllib.parse
+
+        parsed = urllib.parse.urlsplit(self.url)
+        if parsed.scheme != "http" or not parsed.hostname:
+            return None
+        try:
+            from dragonfly2_tpu.client.source import HTTPSourceClient
+
+            if HTTPSourceClient._needs_urllib(self.url):
+                return None
+        except Exception:  # noqa: BLE001 — resolver hiccups → safe path
+            return None
+        path = parsed.path or "/"
+        if parsed.query:
+            path += "?" + parsed.query
+        return (f"{parsed.hostname}:{parsed.port or 80}", path,
+                parsed.netloc)
+
+    def _drive_source_async(self, claimer: "_SourceClaimer",
+                            length: int) -> None:
+        """Claim orchestration for the event-loop driver. Runs on the
+        CALLER thread (the one the threaded driver spent join()ing its
+        workers): claims runs, keeps ≤ back_source_concurrency
+        SourceRunOps streaming on the engine, applies the per-run retry
+        budget with jittered backoff, the mesh-stall fallback and the
+        lease-wait deadline — claim semantics are the shared
+        :class:`_SourceClaimer`, so nothing diverges from the threaded
+        driver."""
+        from dragonfly2_tpu.client.storage import DiskFullError
+
+        total = claimer.total
+        concurrency = min(self.opts.back_source_concurrency, total) or 1
+        deadline = self._started_at + self.opts.timeout
+        results: "queue.Queue" = queue.Queue()
+        active = 0
+        # Retry backlog: [ready_at, first, count, attempts] units; a
+        # unit's pieces stay HELD in the claimer through its whole retry
+        # ladder (the threaded contract).
+        pending: List[list] = []
+
+        def submit_unit(unit: list) -> bool:
+            """Clip (pieces may have landed via the mesh since) and
+            submit one ranged-run op; False when nothing is left to
+            fetch (unit complete — hold released)."""
+            try:
+                submitted = self._submit_source_run_op(
+                    claimer, unit, length, results)
+            except RuntimeError:  # engine stopped (daemon shutdown)
+                claimer.release(unit[1], unit[2])
+                claimer.note_error("download engine stopped")
+                return False
+            if not submitted:
+                claimer.release(unit[1], unit[2])
+            return submitted
+
+        while not claimer.abort.is_set() and not self._done.is_set():
+            now = time.monotonic()
+            for unit in [u for u in pending if u[0] <= now]:
+                if active >= concurrency:
+                    break
+                pending.remove(unit)
+                if submit_unit(unit):
+                    active += 1
+            want_wait = False
+            while active < concurrency and not claimer.abort.is_set():
+                verdict = claimer.claim()
+                if verdict is None:
+                    break
+                if verdict[0] == "retry":
+                    continue  # mode flipped; re-claim immediately
+                if verdict[0] == "wait":
+                    want_wait = True
+                    break
+                first, count = verdict[1], verdict[2]
+                for sub_first, sub_n in claimer.clip(first, count):
+                    claimer.hold(sub_first, sub_n)
+                    unit = [0.0, sub_first, sub_n, 0]
+                    if active < concurrency:
+                        if submit_unit(unit):
+                            active += 1
+                    else:
+                        pending.append(unit)
+            if active == 0 and not pending:
+                if not want_wait:
+                    return  # claims exhausted; file locally complete
+                # Mesh-wait: other claimants hold the remaining leases
+                # and the mesh is delivering them — poll again after a
+                # beat; a mesh that stalls past source_fallback_wait
+                # degrades ONE WAY to local claims.
+                if self._source_complete():
+                    return
+                with self._sched_lock:
+                    last_progress = self._last_progress_at
+                now = time.monotonic()
+                if (now - last_progress > self.opts.source_fallback_wait
+                        and claimer.fallback_to_local()):
+                    self.recovery.tick("source_mesh_stall_fallbacks")
+                    logger.warning(
+                        "task %s: mesh stalled %.1fs; claiming remaining "
+                        "pieces from origin", self.task_id[:16],
+                        now - last_progress)
+                    continue
+                if now > deadline:
+                    claimer.note_error("timed out waiting for leased "
+                                       "pieces from the mesh")
+                    return
+                self._done.wait(self.opts.claim_wait_interval)
+                continue
+            # Drain one completion (bounded wait keeps pending retries
+            # and the mesh-stall checks live).
+            try:
+                unit, err = results.get(timeout=0.25)
+            except queue.Empty:
+                continue
+            active -= 1
+            first, count, attempts = unit[1], unit[2], unit[3]
+            if err is None or self._done.is_set():
+                claimer.release(first, count)
+                continue
+            attempts += 1
+            # Pieces still missing from the failed run opened their
+            # recovery window now (closed when the retry stores them —
+            # the recovery-latency ring).
+            now = time.monotonic()
+            with self._written_lock:
+                for num in range(first, first + count):
+                    if not self.store.has_piece(num):
+                        self._first_failure_at.setdefault(num, now)
+            if isinstance(err, DiskFullError):
+                self.recovery.tick("enospc_fail_fast")
+                claimer.release(first, count)
+                claimer.note_error(
+                    f"pieces {first}-{first + count - 1}: {err}")
+                return
+            if attempts > self.opts.source_retry_limit:
+                claimer.release(first, count)
+                claimer.note_error(
+                    f"pieces {first}-{first + count - 1}: {err}")
+                return
+            self.recovery.tick("source_run_retries")
+            logger.debug("source run %d-%d failed (attempt %d): %s",
+                         first, first + count - 1, attempts, err)
+            unit[0] = time.monotonic() + full_jitter(
+                attempts - 1, self.opts.backoff_base,
+                self.opts.backoff_cap, self._rng)
+            unit[3] = attempts
+            pending.append(unit)
+
+    def _submit_source_run_op(self, claimer: "_SourceClaimer", unit: list,
+                              length: int, results: "queue.Queue") -> bool:
+        """Build + submit one :class:`SourceRunOp` for a unit's still-
+        missing pieces. False = everything already landed (no op)."""
+        from dragonfly2_tpu.client.download_async import (
+            RunPiece,
+            SourceRunOp,
+        )
+
+        first, count = unit[1], unit[2]
+        pieces: List[RunPiece] = []
+        for num in range(first, first + count):
+            rng = piece_range(num, self.piece_size, length)
+            pieces.append(RunPiece(num, rng.start, rng.length,
+                                   skip=self.store.has_piece(num)))
+        # Trim landed edges so the ranged GET pays origin bytes only
+        # for the span that still contains missing pieces; interior
+        # skips (a rare mid-retry mesh race) are consumed and dropped.
+        while pieces and pieces[0].skip:
+            pieces.pop(0)
+        while pieces and pieces[-1].skip:
+            pieces.pop()
+        if not pieces:
+            return False
+        addr, path, host_header = self._async_source_target()
+        run_start = pieces[0].offset
+        run_len = pieces[-1].offset + pieces[-1].length - run_start
+        src_rng = (Range(self.url_range.start + run_start, run_len)
+                   if self.url_range is not None
+                   else Range(run_start, run_len))
+        begin_wall = time.time()
+        claimed = not claimer.is_local()
+
+        def on_done(completed: int, completed_bytes: int, err) -> None:
+            # Counters record what actually LANDED — a run that died
+            # mid-body must not claim its unwritten tail, and a GET that
+            # never produced a head still counts the request.
+            self.stats.source_run(completed, completed_bytes)
+            tracer = tracing.default_tracer()
+            if tracer.enabled:
+                attrs = dict(first=first, count=count, claimed=claimed)
+                if err is not None:
+                    attrs["error"] = f"{type(err).__name__}: {err}"
+                tracer.emit("source.fetch_run", start=begin_wall,
+                            duration_s=max(time.time() - begin_wall, 0.0),
+                            parent=self._trace_ctx, **attrs)
+            with self._async_lock:
+                self._async_ops.discard(op)
+            results.put((unit, err))
+
+        op = SourceRunOp(
+            self.task_id, addr, path, host_header=host_header,
+            src_range_header=src_rng.http_header(), url=self.url,
+            pieces=pieces, open_fd=self.store.data_write_fd,
+            reserve=lambda n: self.shaper.reserve_n(self.task_id, n),
+            refund=lambda n: self.shaper.return_n(self.task_id, n),
+            piece_cb=self._on_source_piece, done_cb=on_done,
+            extra_headers=self.request_header, stats=self.stats,
+        )
+        with self._async_lock:
+            self._async_ops.add(op)
+        self.engine.submit(op)
+        return True
+
+    def _on_source_piece(self, run_piece, md5_hex: str,
+                         cost_ns: int) -> None:
+        """One origin piece landed on the loop thread (bytes already
+        pwritten at the piece offset): record + report with the SAME
+        per-piece semantics as the threaded run fetcher (wire md5 as the
+        task's truth, journal cadence via record_piece, shaper demand
+        sample, batched finished report)."""
+        num, offset, nbytes = run_piece.num, run_piece.offset, \
+            run_piece.length
+        self.store.record_piece(
+            PieceMetadata(num=num, md5="", offset=offset, start=offset,
+                          length=nbytes),
+            nbytes, md5_hex, cost_ns)
+        with self._written_lock:
+            self._written.add(num)
+        self._touch_progress()
+        self._observe_piece_recovered(num)
+        self._notify_piece_sink(num)
+        self.shaper.record(self.task_id, nbytes)
+        if self.metrics:
+            self.metrics.download_traffic.labels(
+                type="back_to_source").inc(nbytes)
+        self.reporter.report(PieceFinished(
+            peer_id=self.peer_id, piece_number=num, parent_id="",
+            offset=offset, length=nbytes, digest=f"md5:{md5_hex}",
+            cost_ns=cost_ns, traffic_type=TRAFFIC_BACK_TO_SOURCE,
+        ))
 
     def _source_complete(self) -> bool:
         """Every piece of the (known-shape) task is on disk — origin

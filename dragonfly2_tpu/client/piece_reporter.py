@@ -84,6 +84,13 @@ class PieceReportBatcher:
         self._rng = random.Random()
         self._timer: Optional[threading.Timer] = None
         self._closed = False
+        # Optional executor for count-triggered flushes (fn -> None):
+        # the async download engine binds its dl-ctl runner here so the
+        # flush RPC (and its retry-ladder sleeps) never runs on an
+        # event-loop thread. None = flush inline on the reporting
+        # thread (the historical per-task-worker behavior).
+        self.flush_executor: Optional[Callable[[Callable[[], None]],
+                                               None]] = None
 
     # -- producer side -----------------------------------------------------
 
@@ -112,7 +119,10 @@ class PieceReportBatcher:
             # Drained under flush()'s deliver-lock-first discipline (a
             # concurrent flush may win the race and deliver it — fine,
             # someone delivers it exactly once).
-            self.flush()
+            if self.flush_executor is not None:
+                self.flush_executor(self.flush)
+            else:
+                self.flush()
         elif straggler:
             with self._deliver_lock:
                 self._deliver_locked(straggler)

@@ -198,10 +198,13 @@ class HTTPSourceClient(ResourceClient):
     MAX_REDIRECTS = 5
 
     def __init__(self, timeout: float = 30.0, pool_per_host: int = 4,
-                 stats=None):
+                 stats=None, pool_idle_ttl: float = 60.0,
+                 pool_max_total: int = 256):
         self.timeout = timeout
         self.pool = HTTPConnectionPool(per_host=pool_per_host,
-                                       timeout=timeout)
+                                       timeout=timeout,
+                                       idle_ttl=pool_idle_ttl,
+                                       max_total=pool_max_total)
         if stats is None:
             from dragonfly2_tpu.client.dataplane import STATS as stats
         self.stats = stats

@@ -43,6 +43,19 @@ class TrafficShaper:
         is per piece on every path, so demand sampling sees the same
         signal regardless of how many pieces share one request."""
 
+    def reserve_n(self, task_id: str, n: int) -> float:
+        """Nonblocking form of ``wait_n`` for the event-loop download
+        engine: deduct the tokens NOW and return the delay (seconds) the
+        caller should park on its timer wheel before transferring —
+        loops never sleep a rate limit. Same once-per-piece /
+        once-per-run granularity contract as ``wait_n``."""
+        return 0.0
+
+    def return_n(self, task_id: str, n: int) -> None:
+        """Refund tokens a caller reserved but provably never moved
+        (a stream that died mid-body refunds its unreceived tail) — the
+        upload engine's unsent-reservation refund, download side."""
+
 
 class PlainTrafficShaper(TrafficShaper):
     """All tasks share the global limiter (traffic_shaper.go plain mode)."""
@@ -68,6 +81,12 @@ class PlainTrafficShaper(TrafficShaper):
 
     def wait_n(self, task_id: str, n: int) -> None:
         self._limiter.wait_n(min(n, self._limiter.burst))
+
+    def reserve_n(self, task_id: str, n: int) -> float:
+        return self._limiter.reserve_n(min(n, self._limiter.burst))
+
+    def return_n(self, task_id: str, n: int) -> None:
+        self._limiter.return_n(min(n, self._limiter.burst))
 
 
 @dataclass
@@ -193,6 +212,21 @@ class SamplingTrafficShaper(TrafficShaper):
                 limiter = None
         if limiter is not None:
             limiter.wait_n(min(n, limiter.burst))
+
+    def reserve_n(self, task_id: str, n: int) -> float:
+        shard = self._shard(task_id)
+        with shard.lock:
+            entry = shard.tasks.get(task_id)
+            if entry is None:
+                return 0.0
+            entry.needed += n
+            limiter = entry.limiter
+        return limiter.reserve_n(min(n, limiter.burst))
+
+    def return_n(self, task_id: str, n: int) -> None:
+        entry = self._entry(task_id)
+        if entry is not None:
+            entry.limiter.return_n(min(n, entry.limiter.burst))
 
     def update_limits(self) -> None:
         """Recompute per-task rates from last-interval demand: tasks that

@@ -64,6 +64,9 @@ def build_daemon(args):
         upload_serve_backlog=args.serve_backlog,
         upload_max_connections=args.max_connections,
         upload_workers=args.upload_workers,
+        download_engine=args.dl_engine,
+        dl_workers=args.dl_workers,
+        dl_max_streams=args.dl_max_streams,
     ))
     daemon.start()
     return daemon
@@ -131,6 +134,23 @@ def main(argv=None) -> int:
                              "engine (0 = default; total serving threads "
                              "= workers + 1 acceptor, independent of "
                              "connection count)")
+    parser.add_argument("--dl-engine", default="async",
+                        choices=("async", "threads"),
+                        help="download engine: 'async' multiplexes every "
+                             "task's metadata syncs, piece fetches and "
+                             "source runs over a fixed pool of dl-loop "
+                             "event loops (download threads = a constant "
+                             "independent of concurrent task count); "
+                             "'threads' pins the historical "
+                             "thread-per-worker engine")
+    parser.add_argument("--dl-workers", type=int, default=0,
+                        help="event-loop worker threads for the async "
+                             "download engine (0 = default)")
+    parser.add_argument("--dl-max-streams", type=int, default=0,
+                        help="daemon-wide cap on concurrently streaming "
+                             "piece/source-run bodies in the async "
+                             "engine; excess streams queue FIFO "
+                             "(0 = default)")
     parser.add_argument("--persist-every-pieces", type=int, default=16,
                         help="journal task metadata after this many piece "
                              "landings (0 disables the count trigger); "

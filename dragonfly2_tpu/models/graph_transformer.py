@@ -55,6 +55,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from dragonfly2_tpu.parallel.mesh import ambient_mesh, shard_map_compat
+
 NEG_INF = -1e9
 # Neighbor-list pad sentinel: never inside [0, N) for any padded N, so a
 # pad slot is out of range of every key block and scatters nothing.
@@ -251,7 +253,7 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
     """
     from functools import partial
 
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     if mesh.empty or axis not in mesh.shape:
         # No ambient mesh (e.g. model.init outside jax.set_mesh, or a
         # single-process run): the ring degenerates to the local chunked
@@ -266,7 +268,8 @@ def ring_graph_attention(q, k, v, nbr, val, chunk, axis="data"):
     scale = 1.0 / np.sqrt(q.shape[-1])
     spec3, spec2 = P(axis, None, None), P(axis, None)
 
-    @partial(jax.shard_map, in_specs=(spec3, spec3, spec3, spec2, spec2),
+    @partial(shard_map_compat(), mesh=mesh,
+             in_specs=(spec3, spec3, spec3, spec2, spec2),
              out_specs=spec3)
     def run(ql, kl, vl, nbrl, vall):
         n_loc = ql.shape[0]
@@ -440,7 +443,7 @@ def _single_device_tpu() -> bool:
     """Is this trace a single-device TPU program? (Pallas kernels are
     per-device; a >1-device mesh keeps the XLA paths that explicit
     sharding partitions.)"""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     return ((mesh.empty or mesh.size == 1)
             and jax.devices()[0].platform == "tpu")
 

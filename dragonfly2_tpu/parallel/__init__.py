@@ -9,7 +9,10 @@ annotate, XLA lays out the collectives.
 
 from dragonfly2_tpu.parallel.mesh import (
     MeshContext,
+    ambient_mesh,
     data_parallel_mesh,
+    mesh_context,
+    shard_map_compat,
     supports_out_sharding,
 )
 from dragonfly2_tpu.parallel.moe import moe_apply
@@ -28,7 +31,8 @@ from dragonfly2_tpu.parallel.ring_attention import ring_attention
 from dragonfly2_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = ["MeshContext", "MultihostMeshContext", "agree",
-           "data_parallel_mesh", "init_multihost", "moe_apply",
+           "ambient_mesh", "data_parallel_mesh", "init_multihost",
+           "mesh_context", "moe_apply",
            "multihost_mesh", "pipeline_apply", "ring_attention",
-           "supports_out_sharding",
+           "shard_map_compat", "supports_out_sharding",
            "stack_stage_params", "sync", "ulysses_attention"]

@@ -79,6 +79,74 @@ def supports_out_sharding() -> bool:
     return _OUT_SHARDING_SUPPORTED
 
 
+_SHARD_MAP_FN = None
+
+
+def shard_map_compat():
+    """The ``shard_map`` entry point of this jax, probed once —
+    top-level ``jax.shard_map`` where it exists, else the
+    ``jax.experimental.shard_map`` original (same ``mesh``/``in_specs``/
+    ``out_specs`` keyword surface on both, so call sites are written
+    once against the newer name)."""
+    global _SHARD_MAP_FN
+    if _SHARD_MAP_FN is None:
+        fn = getattr(jax, "shard_map", None)
+        if fn is None:
+            from jax.experimental.shard_map import shard_map as fn
+        _SHARD_MAP_FN = fn
+    return _SHARD_MAP_FN
+
+
+def ambient_mesh():
+    """The ambient mesh of the current trace: the explicit-sharding
+    abstract mesh on newer jax, the ``with mesh:`` thread-resources
+    physical mesh on ≤0.4.x. Both expose ``empty``/``shape``/``size``,
+    so sharded kernels can gate their collective paths identically on
+    either tree."""
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        return get_abstract()
+    from jax._src import mesh as mesh_lib
+
+    return mesh_lib.thread_resources.env.physical_mesh
+
+
+def pvary_compat(x, axis):
+    """Mark ``x`` varying over ``axis`` inside a shard_map body —
+    ``jax.lax.pcast`` / ``jax.lax.pvary`` where this jax has them.
+    On ≤0.4.x neither exists and the value is returned unchanged;
+    callers disable the replication check instead (see
+    :func:`shard_map_unchecked_kwargs`), which is the only thing the
+    varying mark feeds."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis)
+    return x
+
+
+def shard_map_unchecked_kwargs() -> dict:
+    """Extra shard_map kwargs for bodies whose carries need the varying
+    mark: empty where :func:`pvary_compat` can mark them, else
+    ``check_rep=False`` for the ≤0.4.x experimental shard_map (whose
+    replication check would reject the unmarked per-device carries)."""
+    if hasattr(jax.lax, "pcast") or hasattr(jax.lax, "pvary"):
+        return {}
+    return {"check_rep": False}
+
+
+def mesh_context(mesh):
+    """``jax.set_mesh(mesh)`` where this jax has it (explicit-sharding
+    ambient mesh), else the classic ``Mesh`` context manager — which is
+    exactly what :func:`ambient_mesh` reads back on those trees."""
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
 def data_parallel_mesh(
     devices: Sequence[Any] | None = None, model_parallel: int = 1
 ) -> MeshContext:

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dragonfly2_tpu.parallel.mesh import shard_map_compat
+
 NEG_INF = -1e9
 
 
@@ -76,7 +78,7 @@ def ring_attention(
     qk = "bnhd,bmhd->bhnm" if batched else "nhd,mhd->hnm"
     pv = "bhnm,bmhd->bnhd" if batched else "hnm,mhd->nhd"
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(shard_map_compat(), mesh=mesh,
              in_specs=(seq_spec, seq_spec, seq_spec, valid_spec),
              out_specs=seq_spec)
     def run(ql, kl, vl, validl):

@@ -520,9 +520,32 @@ class SchedulerService:
                      for p in parents if p.id != req.peer_id],
         )
 
+    _RECEIVED_STATES = (PeerState.RECEIVED_EMPTY, PeerState.RECEIVED_TINY,
+                        PeerState.RECEIVED_SMALL, PeerState.RECEIVED_NORMAL)
+
+    def _heal_downloading_fsm(self, peer: Peer, parent_id: str) -> None:
+        """A piece report from a peer still in a Received* state means
+        its download-started RPC was lost (network fault / failover
+        replay gap): the peer is provably downloading, but Received* is
+        a bad-node state (evaluator_base.go:211-218), so until healed
+        the whole swarm refuses to use its pieces — a claimant told
+        "wait, the mesh will deliver" can then stall the full
+        source_fallback_wait on a mesh that refuses to serve it. Upsert
+        the observed truth into the FSM, same discipline as the replayed
+        back_to_source_started handler."""
+        if not peer.fsm.is_state(*self._RECEIVED_STATES):
+            return
+        event = (PeerEvent.DOWNLOAD if parent_id
+                 else PeerEvent.DOWNLOAD_BACK_TO_SOURCE)
+        if peer.fsm.can(event):
+            peer.fsm.fire(event)
+            if not parent_id:
+                peer.task.back_to_source_peers.add(peer.id)
+
     def download_piece_finished(self, report: PieceFinished) -> None:
         """(service_v2.go:1095 handleDownloadPieceFinishedRequest)"""
         peer = self._peer(report.peer_id)
+        self._heal_downloading_fsm(peer, report.parent_id)
         # Interned: the retained Piece records would otherwise pin one
         # fresh wire-decoded copy of the parent id / traffic type PER
         # PIECE — at swarm scale that is pure duplicate string memory.
@@ -581,6 +604,7 @@ class SchedulerService:
                                  report.peer_id)
             if peer is None:
                 continue
+            self._heal_downloading_fsm(peer, report.parent_id)
             # Same interning contract as the per-call form above.
             piece = Piece(
                 number=report.piece_number,
@@ -662,6 +686,13 @@ class SchedulerService:
         if peer.fsm.is_state(PeerState.SUCCEEDED):
             return  # duplicate terminal report (failover replay / race)
         peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        if peer.task.source_claims is not None:
+            # A finished claimant has no pending work: any lease it
+            # still holds covers a piece whose landing report was lost,
+            # and the next claimant must not idle out the lease TTL (or
+            # its own source_fallback_wait) for bytes nobody will
+            # deliver.
+            peer.task.source_claims.release(peer_id)
         if self.metrics:
             self.metrics.download_peer_finished.inc()
             self.metrics.download_peer_duration.observe(cost_seconds * 1e3)
@@ -686,6 +717,11 @@ class SchedulerService:
         if not peer.fsm.is_state(PeerState.SUCCEEDED):
             peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
         task = peer.task
+        if task.source_claims is not None:
+            # Same as download_peer_finished: a finished claimant's
+            # surviving leases cover lost landing reports — free them so
+            # the next claimant can grab those pieces immediately.
+            task.source_claims.release(peer_id)
         task.report_success(content_length, total_piece_count)
         if task.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
             task.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)

@@ -38,7 +38,11 @@ from dragonfly2_tpu.models.graph_transformer import (
     pad_graph_sparse,
     pad_multiple,
 )
-from dragonfly2_tpu.parallel import MeshContext, data_parallel_mesh
+from dragonfly2_tpu.parallel import (
+    MeshContext,
+    data_parallel_mesh,
+    mesh_context,
+)
 from dragonfly2_tpu.train.gnn_trainer import edge_split
 from dragonfly2_tpu.train.metrics import metrics_from_confusion, padded_chunks
 
@@ -277,7 +281,7 @@ def train_gat(
     stop = False
     # Explicit-sharding mode: the in-model reshards (K/V + embedding
     # all-gathers, block-bias scatter) need the ambient mesh during trace.
-    with jax.set_mesh(mesh.mesh):
+    with mesh_context(mesh.mesh):
         # Full-k groups plus one tail dispatch for the remainder — no
         # silently dropped steps when k ∤ steps_per_epoch (the tail is a
         # second, smaller scan program; compiled once).

@@ -1,0 +1,312 @@
+"""Async download engine (client/download_async) — tier-1 coverage.
+
+The load-bearing regression here is the THREAD CENSUS: a daemon driving
+32 concurrent tasks through the async engine must hold its download
+threads at ``dl_workers + 2`` — a constant — where the historical
+thread-per-worker engine grew linearly with task count (syncers + piece
+workers + back-source fetchers per task). The census helper under test
+is the same one the ``bench.py dataplane`` download-density rung bounds
+at 128 tasks.
+
+Also covered: the engine's daemon-wide stream-admission gate (FIFO past
+``max_streams``, queued-cancel skipped on release), and the idle-TTL
+reaper + global cap + ``data_plane`` gauges on both connection pools.
+"""
+
+import http.client
+import socket
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.dataplane import (
+    BlobRangeServer,
+    HTTPConnectionPool,
+    _FailRegisterScheduler,
+    _drive_task_fleet,
+    pool_gauges,
+)
+from dragonfly2_tpu.client.download_async import (
+    AsyncConnPool,
+    DownloadLoopEngine,
+    ThreadCensusSampler,
+    _LoopOp,
+    download_thread_census,
+)
+
+
+# ----------------------------------------------------------------------
+# Thread census: constant download threads under concurrent-task load
+# ----------------------------------------------------------------------
+
+
+def test_thread_census_constant_at_32_tasks(tmp_path):
+    """32 concurrent back-to-source tasks on one daemon: download
+    threads stay ≤ dl_workers + 2 at the busiest sampled instant
+    (counters-only, loopback, small blobs — the density rung's bound at
+    its cheapest scale)."""
+    import numpy as np
+
+    from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
+    from dragonfly2_tpu.client.peer_task import PeerTaskOptions
+
+    dl_workers = 2
+    blob = np.random.default_rng(7).bytes(256 << 10)
+    with BlobRangeServer(blob, backlog=64) as server:
+        daemon = Daemon(_FailRegisterScheduler(), DaemonConfig(
+            storage_root=str(tmp_path / "store"), keep_storage=False,
+            task_options=PeerTaskOptions(back_source_concurrency=2,
+                                         coalesce_run=8),
+            download_engine="async", dl_workers=dl_workers))
+        daemon.start()
+        try:
+            urls = [f"{server.url()}?census={i}" for i in range(32)]
+            with ThreadCensusSampler(interval=0.005) as census:
+                _ttlbs, failures, results = _drive_task_fleet(
+                    daemon, urls, timeout_s=60.0)
+        finally:
+            daemon.stop()
+    assert not failures
+    assert all(r is not None for r in results)
+    assert census.samples > 0
+    assert census.peak["total"] <= dl_workers + 2, census.peak
+    # The engine's loops dominate the census; the threaded families
+    # must be absent entirely on the async engine.
+    assert census.peak["piece-worker-"] == 0
+    assert census.peak["back-source-"] == 0
+
+
+def test_census_counts_only_download_families():
+    """Unrelated threads never count toward the download census."""
+    stop = threading.Event()
+    bystander = threading.Thread(target=stop.wait, name="bystander-1",
+                                 daemon=True)
+    bystander.start()
+    try:
+        census = download_thread_census()
+        total_before = census["total"]
+        poser = threading.Thread(target=stop.wait, name="dl-loop-99",
+                                 daemon=True)
+        poser.start()
+        try:
+            assert download_thread_census()["total"] == total_before + 1
+        finally:
+            stop.set()
+            poser.join()
+    finally:
+        stop.set()
+        bystander.join()
+
+
+# ----------------------------------------------------------------------
+# Stream admission: daemon-wide FIFO past max_streams
+# ----------------------------------------------------------------------
+
+
+class _HoldOp(_LoopOp):
+    """A gated op that parks until the test releases it."""
+
+    gated = True
+
+    def __init__(self, task_id):
+        super().__init__(task_id)
+        self.started = threading.Event()
+
+    def _begin(self):
+        self.started.set()
+
+    def release(self, err=None):
+        self.loop.call_soon(lambda: self._finish(err))
+
+
+@pytest.fixture()
+def engine():
+    eng = DownloadLoopEngine(workers=1, max_streams=2)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_admission_gate_fifo(engine):
+    ops = [_HoldOp(f"t{i}") for i in range(5)]
+    for op in ops:
+        engine.submit(op)
+    assert ops[0].started.wait(2) and ops[1].started.wait(2)
+    snap = engine.stream_admission()
+    assert snap["inflight"] == 2
+    assert snap["queued"] == 3
+    assert not ops[2].started.is_set()
+    # Finishing one admitted stream starts exactly the NEXT queued one.
+    ops[0].release()
+    assert ops[2].started.wait(2)
+    assert not ops[3].started.is_set()
+    for op in (ops[1], ops[2], ops[3], ops[4]):
+        if not op.started.is_set():
+            assert op.started.wait(2)
+        op.release()
+    for op in ops:
+        op.join(timeout=2)
+        assert not op.is_alive()
+    assert engine.stream_admission()["inflight"] == 0
+
+
+def test_admission_queued_cancel_skipped(engine):
+    ops = [_HoldOp(f"t{i}") for i in range(4)]
+    for op in ops:
+        engine.submit(op)
+    assert ops[0].started.wait(2) and ops[1].started.wait(2)
+    # Cancel a QUEUED op: it completes immediately without ever
+    # starting, and a later release skips straight past it.
+    ops[2].cancel()
+    ops[2].join(timeout=2)
+    assert not ops[2].is_alive()
+    assert not ops[2].started.is_set()
+    ops[0].release()
+    assert ops[3].started.wait(2)
+    for op in (ops[1], ops[3]):
+        op.release()
+        op.join(timeout=2)
+
+
+def test_admission_ungated_never_queues(engine):
+    holds = [_HoldOp(f"t{i}") for i in range(2)]
+    for op in holds:
+        engine.submit(op)
+    assert holds[0].started.wait(2) and holds[1].started.wait(2)
+
+    class _ControlOp(_HoldOp):
+        gated = False
+
+    control = _ControlOp("control")
+    engine.submit(control)
+    assert control.started.wait(2), "control op queued behind data"
+    for op in holds + [control]:
+        op.release()
+        op.join(timeout=2)
+
+
+def test_stop_drains_admission_queue():
+    eng = DownloadLoopEngine(workers=1, max_streams=1)
+    eng.start()
+    ops = [_HoldOp(f"t{i}") for i in range(3)]
+    for op in ops:
+        eng.submit(op)
+    assert ops[0].started.wait(2)
+    eng.stop()
+    for op in ops:
+        op.join(timeout=2)
+        assert not op.is_alive()
+
+
+# ----------------------------------------------------------------------
+# Connection pools: idle-TTL reaper, caps, gauges
+# ----------------------------------------------------------------------
+
+
+def _sock_pair():
+    a, b = socket.socketpair()
+    a.setblocking(False)
+    return a, b
+
+
+def test_async_pool_idle_ttl_reap():
+    pool = AsyncConnPool(per_host=4, idle_ttl=0.05)
+    keep = []
+    for i in range(3):
+        a, b = _sock_pair()
+        keep.append(b)
+        pool.give(f"10.0.0.{i}:80", a)
+    assert pool.snapshot()["sockets"] == 3
+    time.sleep(0.06)
+    # Cadence gate: a quarter-TTL must have passed — it has.
+    reaped = pool.reap()
+    snap = pool.snapshot()
+    assert reaped == 3
+    assert snap["sockets"] == 0
+    assert snap["keys"] == 0, "reaper must drop emptied _pool keys"
+    assert snap["reaped"] == 3
+    pool.close()
+    for b in keep:
+        b.close()
+
+
+def test_async_pool_global_cap_evicts():
+    pool = AsyncConnPool(per_host=8, idle_ttl=60.0, max_total=2)
+    keep = []
+    for i in range(3):
+        a, b = _sock_pair()
+        keep.append(b)
+        pool.give(f"10.0.1.{i}:80", a)
+    snap = pool.snapshot()
+    assert snap["sockets"] == 2
+    assert snap["evicted"] == 1
+    pool.close()
+    for b in keep:
+        b.close()
+
+
+def test_http_pool_idle_ttl_reap_and_keys():
+    pool = HTTPConnectionPool(per_host=4, idle_ttl=0.05)
+    key = ("http", "198.51.100.9", 80)
+    pool.checkin(key, http.client.HTTPConnection("198.51.100.9", 80))
+    assert pool.gauges() == {"keys": 1, "sockets": 1, "reaped": 0,
+                             "evicted": 0}
+    time.sleep(0.06)
+    assert pool.reap(force=True) == 1
+    gauges = pool.gauges()
+    assert gauges["sockets"] == 0
+    assert gauges["keys"] == 0
+    assert gauges["reaped"] == 1
+    pool.close()
+
+
+def test_http_pool_stale_checkout_counts_reaped():
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    port = listener.getsockname()[1]
+    pool = HTTPConnectionPool(per_host=4, idle_ttl=0.01)
+    key = ("http", "127.0.0.1", port)
+    pool.checkin(key, http.client.HTTPConnection("127.0.0.1", port))
+    time.sleep(0.02)
+    # Checkout refuses the past-TTL connection and dials fresh instead
+    # of spending the one stale-retry on a known-old socket.
+    conn, was_pooled = pool.checkout(key)
+    try:
+        assert not was_pooled
+        assert pool.gauges()["reaped"] == 1
+    finally:
+        conn.close()
+        pool.close()
+        listener.close()
+
+
+def test_http_pool_max_total_evicts_on_checkin():
+    pool = HTTPConnectionPool(per_host=8, idle_ttl=60.0, max_total=1)
+    pool.checkin(("http", "a", 80), http.client.HTTPConnection("a", 80))
+    pool.checkin(("http", "b", 80), http.client.HTTPConnection("b", 80))
+    gauges = pool.gauges()
+    assert gauges["sockets"] == 1
+    assert gauges["evicted"] == 1
+    pool.close()
+
+
+def test_pool_gauges_surface_in_data_plane_block():
+    """Every live pool aggregates into the data_plane /debug/vars block
+    (which the Prometheus bridge exports for free)."""
+    from dragonfly2_tpu.utils.debugmon import debug_vars
+
+    pool = HTTPConnectionPool(per_host=2, idle_ttl=60.0)
+    pool.checkin(("http", "gauge-host", 80),
+                 http.client.HTTPConnection("gauge-host", 80))
+    try:
+        agg = pool_gauges()
+        assert agg["pooled_connections"] >= 1
+        assert agg["pool_keys"] >= 1
+        block = debug_vars()["data_plane"]
+        for gauge in ("pool_keys", "pooled_connections", "pool_reaped",
+                      "pool_evicted"):
+            assert gauge in block
+    finally:
+        pool.close()

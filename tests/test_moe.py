@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dragonfly2_tpu.parallel.mesh import mesh_context
 from dragonfly2_tpu.parallel.moe import moe_apply
 from dragonfly2_tpu.parallel.pipeline import stack_stage_params
 
@@ -94,7 +95,7 @@ class TestMoE:
             return (moe_apply(expert_fn, p, x, g, mesh=mesh,
                               capacity_factor=8.0) ** 2).sum()
 
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             gp, gg = jax.jit(jax.grad(loss, argnums=(0, 1)))(params, gates)
         assert all(np.isfinite(np.asarray(l)).all()
                    for l in jax.tree.leaves(gp))

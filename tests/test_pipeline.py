@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from dragonfly2_tpu.parallel.mesh import mesh_context
 from dragonfly2_tpu.parallel.pipeline import (
     pipeline_apply,
     stack_stage_params,
@@ -83,7 +84,7 @@ class TestPipeline:
         def seq_loss(p):
             return ((sequential(p, x) - y) ** 2).mean()
 
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             g_pipe = jax.jit(jax.grad(pipe_loss))(params)
         g_seq = jax.grad(seq_loss)(params)
         for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
@@ -93,12 +94,12 @@ class TestPipeline:
     def test_param_memory_is_sharded(self, mesh):
         """Stage params sharded over the axis: each device holds 1/S of
         the parameter bytes — the reason pipelines exist."""
-        from jax.sharding import NamedSharding
+        from jax.sharding import NamedSharding, PartitionSpec
 
         d = 32
         params = make_params(8, d)
         sharded = jax.device_put(
-            params, NamedSharding(mesh, jax.P("stage")))
+            params, NamedSharding(mesh, PartitionSpec("stage")))
         total = sum(np.asarray(l).nbytes for l in jax.tree.leaves(params))
         per_dev = sum(l.addressable_shards[0].data.nbytes
                       for l in jax.tree.leaves(sharded))
