@@ -477,7 +477,16 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
        against ONE real daemon on the async download engine, download
        threads bounded at dl_workers+2 at every rung (the threaded
        engine grew with task count) and the 128-task aggregate MB/s ≥
-       a same-process thread-engine baseline.
+       a same-process thread-engine baseline,
+    5. the ISSUE-16 DOWNLOAD SPLICE rung — PieceFetchOp bodies landing
+       via the native socket→pwrite splice (zero-copy, no inline
+       digest), every piece span md5-verified post-window, bound ≥
+       SPLICE_BOUND_MB_S (1.5× the 536 MB/s native upload record),
+    6. the ISSUE-16 TLS rungs — upload loopback and the ≥256-stream
+       density rung repeated over nonblocking TLS (same serving engine,
+       same constant thread census), with the handshake/fallback
+       counters recorded; skipped explicitly when the openssl CLI
+       can't mint certs.
 
     A green run (all verdicts) persists to
     artifacts/bench_state/dataplane_run_<tag>.json — the record
@@ -578,8 +587,71 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
             for n, r in dl_density["rungs"].items()},
         dataplane_dl_density_verdict_pass=dl_density["verdict_pass"],
     )
-    verdict = bool(upload_pass and density["verdict_pass"]
-                   and dl_density["verdict_pass"])
+    base_pass = bool(upload_pass and density["verdict_pass"]
+                     and dl_density["verdict_pass"])
+    if left() < 8.0:
+        # Budget-starved splice/TLS rungs: explicit skip, partial
+        # verdict, nothing persists as a full green.
+        state.record(dataplane_splice_skipped=True,
+                     dataplane_tls_rungs_skipped=True,
+                     dataplane_verdict_pass=base_pass)
+        state.stage_done("dataplane")
+        return
+    from dragonfly2_tpu.client.dataplane import run_splice_loopback_bench
+
+    splice = run_splice_loopback_bench(
+        timeout_s=max(min(left() * 0.4, 45.0), 8.0))
+    if splice.get("skipped"):
+        state.record(dataplane_splice_skipped=True,
+                     dataplane_splice_skip_reason=splice["reason"],
+                     dataplane_verdict_pass=base_pass)
+        state.stage_done("dataplane")
+        return
+    state.record(
+        dataplane_splice_mb_per_s=splice["mb_per_s"],
+        dataplane_splice_bound_mb_per_s=splice["bound_mb_per_s"],
+        dataplane_splice_bytes=splice["splice_bytes"],
+        dataplane_splice_zero_copy_fraction=splice.get(
+            "zero_copy_fraction", 0.0),
+        dataplane_splice_verified_pieces=splice.get("verified_pieces", 0),
+        dataplane_splice_verdict_pass=splice["verdict_pass"],
+    )
+    if left() < 10.0:
+        state.record(dataplane_tls_rungs_skipped=True,
+                     dataplane_verdict_pass=bool(
+                         base_pass and splice["verdict_pass"]))
+        state.stage_done("dataplane")
+        return
+    tls_upload = run_upload_loopback_bench(
+        size_bytes=128 << 20, attempts=2, tls=True,
+        timeout_s=max(min(left() * 0.4, 40.0), 8.0))
+    if tls_upload.get("skipped"):
+        state.record(dataplane_tls_rungs_skipped=True,
+                     dataplane_tls_skip_reason=tls_upload["reason"],
+                     dataplane_verdict_pass=bool(
+                         base_pass and splice["verdict_pass"]))
+        state.stage_done("dataplane")
+        return
+    tls_density = run_density_rung(
+        tls=True, timeout_s=max(min(left() * 0.7, 60.0), 10.0))
+    tls_pass = bool(tls_upload["md5_ok"]
+                    and tls_upload["tls_handshakes"] > 0
+                    and tls_density.get("verdict_pass"))
+    state.record(
+        dataplane_tls_upload_mb_per_s=tls_upload["mb_per_s"],
+        dataplane_tls_upload_md5_ok=tls_upload["md5_ok"],
+        dataplane_tls_handshakes=tls_upload["tls_handshakes"],
+        dataplane_tls_fallbacks=tls_upload["tls_fallbacks"],
+        dataplane_tls_ktls_bytes=tls_upload["ktls_bytes"],
+        dataplane_tls_density_streams=tls_density.get("streams"),
+        dataplane_tls_density_mb_per_s=tls_density.get("mb_per_s"),
+        dataplane_tls_density_server_threads=tls_density.get(
+            "server_threads"),
+        dataplane_tls_density_verdict_pass=tls_density.get(
+            "verdict_pass"),
+        dataplane_tls_verdict_pass=tls_pass,
+    )
+    verdict = bool(base_pass and splice["verdict_pass"] and tls_pass)
     state.record(dataplane_verdict_pass=verdict)
     state.stage_done("dataplane")
     if verdict:
@@ -590,7 +662,10 @@ def stage_dataplane(state: BenchState, ctx: dict) -> None:
             {"ladder": {str(k): v for k, v in ladder.items()},
              "upload_loopback": upload,
              "density": density,
-             "download_density": dl_density})
+             "download_density": dl_density,
+             "download_splice": splice,
+             "tls_upload": tls_upload,
+             "tls_density": tls_density})
 
 
 @stage("scheduler", min_left=15.0)
@@ -763,8 +838,9 @@ def stage_scheduler(state: BenchState, ctx: dict) -> None:
 @stage("chaos", min_left=15.0)
 def stage_chaos(state: BenchState, ctx: dict) -> None:
     """Chaos — deterministic fault-injection ladder over the loopback
-    swarm (scheduler + two peers + origin, client/chaosbench.py), plus
-    the ISSUE-6 scheduler-kill rung (three scheduler replica PROCESSES,
+    swarm (scheduler + two peers + origin, client/chaosbench.py), the
+    same ladder repeated with every p2p leg over TLS plus mid-handshake
+    resets in the mix (ISSUE 16), plus the ISSUE-6 scheduler-kill rung (three scheduler replica PROCESSES,
     one hard-killed mid-swarm by the seeded ``scheduler.process`` site)
     and the ISSUE-8 daemon-kill rung (a daemon process SIGKILLed at
     ~50% of a download, restarted on the same storage root).
@@ -786,6 +862,31 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
 
     chaos = run_chaos_ladder(seed=0)
     top = chaos["ladder"][str(max(chaos["rates"]))]
+    tls_chaos = None
+    if left() <= 12.0:
+        state.record(chaos_tls_ladder_skipped=True)
+    else:
+        # The same ladder with every p2p leg over TLS and mid-handshake
+        # resets added to the fault mix (ISSUE 16) — skipped explicitly
+        # when the openssl CLI can't mint a throwaway CA.
+        tls_chaos = run_chaos_ladder(seed=0, tls=True)
+        if tls_chaos.get("skipped"):
+            state.record(chaos_tls_ladder_skipped=True,
+                         chaos_tls_skip_reason=tls_chaos["reason"])
+            tls_chaos = None
+        else:
+            tls_top = tls_chaos["ladder"][str(max(tls_chaos["rates"]))]
+            state.record(
+                chaos_tls_success_rate_at_max=tls_top["success_rate"],
+                chaos_tls_goodput_retention_at_max=tls_chaos[
+                    "goodput_retention_at_max"],
+                chaos_tls_recovery_events=tls_top["recovery_events"],
+                chaos_tls_handshake_faults=(tls_top.get("faults", {})
+                                            .get("tls.handshake")),
+                chaos_tls_all_rungs_full_success=tls_chaos[
+                    "all_rungs_full_success"],
+                chaos_tls_verdict_pass=tls_chaos["verdict_pass"],
+            )
     state.record(
         chaos_rates=chaos["rates"],
         chaos_success_rate_at_max=top["success_rate"],
@@ -848,6 +949,7 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
             chaos_daemon_kill_verdict_pass=daemon_kill["verdict_pass"],
         )
     verdict = bool(chaos["verdict_pass"]
+                   and (tls_chaos is None or tls_chaos["verdict_pass"])
                    and (kill is None or kill["verdict_pass"])
                    and (daemon_kill is None
                         or daemon_kill["verdict_pass"]))
@@ -859,6 +961,8 @@ def stage_chaos(state: BenchState, ctx: dict) -> None:
                 STATE_DIR,
                 f"chaos_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
             {"ladder": chaos,
+             "tls_ladder": (tls_chaos if tls_chaos is not None
+                            else {"skipped": True}),
              "scheduler_kill": (kill if kill is not None
                                 else {"skipped": True}),
              "daemon_kill": (daemon_kill if daemon_kill is not None
@@ -1481,9 +1585,11 @@ def check_regression_main(stage_name: str) -> None:
 
     - ``dataplane``: fresh upload-loopback rung vs the best recorded
       MB/s (docs/DATAPLANE.md fraction), PLUS a fresh download density
-      rung + async-engine loopback — fails on a download thread-census
-      breach at any rung, a density aggregate under 0.5× the best
-      record, or a single-task loopback under 0.9× the recorded MB/s.
+      rung + async-engine loopback + native splice rung — fails on a
+      download thread-census breach at any rung, a density aggregate
+      under 0.5× the best record, a single-task loopback under 0.7×
+      the recorded MB/s, or a splice loopback under 0.5× the recorded
+      splice MB/s.
     - ``chaos``: fresh fault ladder + daemon-kill rung vs the best
       recorded chaos run (docs/CHAOS.md) — any lost verdict or a
       goodput-retention collapse fails the gate.

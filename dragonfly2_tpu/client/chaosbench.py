@@ -24,6 +24,7 @@ retain one.
 from __future__ import annotations
 
 import hashlib
+import os
 import shutil
 import tempfile
 import time
@@ -114,9 +115,13 @@ class MultiBlobServer(ThreadedHTTPService):
         self.stop()
 
 
-def build_fault_plan(rate: float, seed: int) -> FaultPlan:
+def build_fault_plan(rate: float, seed: int,
+                     tls: bool = False) -> FaultPlan:
     """The ladder's fault mix at one rung: every RECOVERABLE kind on
-    every data/control site, probabilities scaled off the rung rate."""
+    every data/control site, probabilities scaled off the rung rate.
+    ``tls`` adds mid-HANDSHAKE resets on the peer leg — the connection
+    dies before the TLS session is up, the failure mode plain-TCP
+    ladders never exercise."""
     plan = FaultPlan(seed=seed)
     plan.add("piece.body", FaultKind.CORRUPT, probability=rate)
     plan.add("piece.body", FaultKind.RESET, probability=rate / 2)
@@ -124,6 +129,8 @@ def build_fault_plan(rate: float, seed: int) -> FaultPlan:
     plan.add("source.body", FaultKind.RESET, probability=rate / 2)
     plan.add("pool.connect", FaultKind.CONNECT_REFUSED, probability=rate)
     plan.add("scheduler.rpc", FaultKind.UNAVAILABLE, probability=rate)
+    if tls:
+        plan.add("tls.handshake", FaultKind.RESET, probability=rate)
     return plan
 
 
@@ -146,7 +153,7 @@ def _chaos_task_options():
 
 
 def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
-              tmp: str) -> dict:
+              tmp: str, tls_conf: "tuple | None" = None) -> dict:
     import os
 
     from dragonfly2_tpu.client.daemon import Daemon, DaemonConfig
@@ -177,6 +184,7 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
     # compiles the SAME "scheduler.rpc" site the gRPC adapters carry.
     scheduler = faultplan.RpcFaultProxy(service)
     options = _chaos_task_options()
+    cert, key, ca = tls_conf if tls_conf is not None else ("", "", "")
     daemons = [
         Daemon(scheduler, DaemonConfig(
             storage_root=os.path.join(tmp, name), hostname=name,
@@ -186,10 +194,15 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
             # ride the event-loop upload server, and the rung report
             # carries its serve-path split as evidence.
             dataplane_stats=dataplane,
+            # TLS ladder: every p2p leg handshakes — serving AND piece
+            # fetch — so mid-handshake/mid-stream resets hit real TLS
+            # state machines, not plaintext sockets.
+            upload_tls_cert=cert, upload_tls_key=key, peer_tls_ca=ca,
         ))
         for name in ("chaos-a", "chaos-b")
     ]
-    plan = build_fault_plan(rate, seed) if rate > 0 else None
+    plan = (build_fault_plan(rate, seed, tls=tls_conf is not None)
+            if rate > 0 else None)
     downloads = 0
     failures = []
     bytes_ok = 0
@@ -240,10 +253,12 @@ def _run_rung(rate: float, *, blobs: Dict[str, bytes], seed: int,
         "recovery_p50_ms": round(percentile(recoveries, 0.50) * 1e3, 1),
         "recovery_p99_ms": round(percentile(recoveries, 0.99) * 1e3, 1),
         "recovery_counters": recovery.snapshot(),
+        "tls": tls_conf is not None,
         "upload_engine": {
             k: v for k, v in dataplane.snapshot().items()
             if k.startswith(("upload_", "sendfile", "mmap_bytes",
-                             "buffered_bytes", "connections_open"))
+                             "buffered_bytes", "connections_open",
+                             "tls_", "ktls_"))
         },
     }
     if plan is not None:
@@ -975,6 +990,7 @@ def check_chaos_regression(
 def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
                      tasks: int = 3, size_bytes: int = 3 << 20,
                      piece_size: int = 256 << 10, seed: int = 0,
+                     tls: bool = False,
                      root: str | None = None) -> dict:
     """Run the ladder; returns per-rung results + the verdict.
 
@@ -983,6 +999,10 @@ def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
     test fixtures) so each task spans many pieces without multi-GB
     blobs — fault/recovery behavior is per-piece, so piece COUNT is
     what the ladder needs.
+
+    ``tls=True`` runs every p2p leg over TLS (throwaway openssl-CLI CA)
+    and adds mid-handshake resets to the fault mix; the result carries
+    ``{"skipped": True}`` when the CLI can't mint certs.
     """
     import numpy as np
 
@@ -993,6 +1013,20 @@ def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
         for i in range(tasks)
     }
     tmp = root or tempfile.mkdtemp(prefix="df2-chaos-")
+    tls_conf = None
+    if tls:
+        from dragonfly2_tpu.utils import tlsconf
+
+        if not tlsconf.openssl_available():
+            if root is None:
+                shutil.rmtree(tmp, ignore_errors=True)
+            return {"skipped": True,
+                    "reason": "openssl CLI unavailable for TLS certs"}
+        ca_cert, ca_key = tlsconf.mint_ca(os.path.join(tmp, "tls"),
+                                          "df2-chaos-ca")
+        cert, key = tlsconf.mint_leaf(os.path.join(tmp, "tls"),
+                                      "127.0.0.1", ca_cert, ca_key)
+        tls_conf = (cert, key, ca_cert)
     prev_piece_size = peer_task_mod.compute_piece_size
     peer_task_mod.compute_piece_size = lambda content_length: piece_size
     ladder: Dict[str, dict] = {}
@@ -1000,7 +1034,8 @@ def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
         for idx, rate in enumerate(rates):
             rung_tmp = tempfile.mkdtemp(prefix=f"rung{idx}-", dir=tmp)
             ladder[str(rate)] = _run_rung(
-                rate, blobs=blobs, seed=seed * 1000 + idx, tmp=rung_tmp)
+                rate, blobs=blobs, seed=seed * 1000 + idx, tmp=rung_tmp,
+                tls_conf=tls_conf)
     finally:
         peer_task_mod.compute_piece_size = prev_piece_size
         if root is None:
@@ -1014,6 +1049,7 @@ def run_chaos_ladder(rates: Sequence[float] = DEFAULT_RATES, *,
     return {
         "rates": list(rates),
         "ladder": ladder,
+        "tls": tls,
         "pieces_per_task": size_bytes // piece_size,
         "goodput_retention_at_max": retention,
         "goodput_retention_bound": GOODPUT_RETENTION_BOUND,

@@ -101,6 +101,16 @@ class DaemonConfig:
     # + source runs); past it, streams queue FIFO in the engine. 0 =
     # engine default (DEFAULT_DL_MAX_STREAMS).
     dl_max_streams: int = 0
+    # Data-plane TLS (utils/tlsconf): cert+key turn on TLS serving on
+    # the upload engine (kTLS-probed per connection; without offload the
+    # server falls down the ladder to mmap writes through the record
+    # layer). peer_tls_ca pins the CA the download engine verifies TLS
+    # parents against (fetches/syncs dial TLS only when set);
+    # source_tls_ca pins https origins (default: system trust).
+    upload_tls_cert: str = ""
+    upload_tls_key: str = ""
+    peer_tls_ca: str = ""
+    source_tls_ca: str = ""
 
 
 class Daemon:
@@ -127,12 +137,28 @@ class Daemon:
         # would otherwise re-announce the dark seed at a new owner) and
         # the restart re-announce backlog entry.
         self.storage.on_task_deleted = self._on_local_replica_deleted
+        upload_ssl = None
+        peer_tls = source_tls = None
+        if config.upload_tls_cert and config.upload_tls_key:
+            from dragonfly2_tpu.utils import tlsconf
+
+            upload_ssl = tlsconf.server_context(
+                config.upload_tls_cert, config.upload_tls_key)
+        if config.peer_tls_ca:
+            from dragonfly2_tpu.utils import tlsconf
+
+            peer_tls = tlsconf.client_context(cafile=config.peer_tls_ca)
+        if config.source_tls_ca:
+            from dragonfly2_tpu.utils import tlsconf
+
+            source_tls = tlsconf.client_context(cafile=config.source_tls_ca)
         self.upload = UploadServer(
             self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
             metrics=self.metrics,
             backlog=config.upload_serve_backlog,
             max_connections=config.upload_max_connections,
             workers=config.upload_workers,
+            ssl_context=upload_ssl,
             stats=config.dataplane_stats,
         )
         self.shaper: TrafficShaper = new_traffic_shaper(
@@ -145,7 +171,8 @@ class Daemon:
 
             self.dl_engine = DownloadLoopEngine(
                 workers=config.dl_workers, stats=config.dataplane_stats,
-                max_streams=config.dl_max_streams)
+                max_streams=config.dl_max_streams,
+                peer_tls_context=peer_tls, source_tls_context=source_tls)
         else:
             self.dl_engine = None
         self.host_id = idgen.host_id_v1(config.hostname, self.upload.port)

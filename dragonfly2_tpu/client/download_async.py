@@ -33,6 +33,23 @@ processes at most :data:`FAIR_BUDGET` body bytes before yielding the
 loop — a hot task with many ready sockets cannot monopolize a loop
 while a cold task's one socket starves.
 
+TLS and proxied exchanges ride the SAME loops — there is no thread
+fallback left. An op constructed with a ``tls`` context runs a
+nonblocking handshake state machine (SSLWant* → interest switching,
+``sock.pending`` drained before yielding — the upload engine's proven
+discipline) and an op with a ``tunnel`` target first speaks CONNECT to
+the proxy, then optionally handshakes through the tunnel. Pooled
+keep-alive sockets keep their TLS session (keyed separately from
+plaintext sockets), so a fleet pays one handshake per (daemon, peer).
+
+Plaintext piece/run bodies land through the native seam when it is
+available: :func:`dragonfly2_tpu.native.splice_recv_to_file` moves
+socket bytes to the data file at offset with PARTIAL progress on
+EAGAIN — zero-copy splice(2) through a loop-owned pipe when no inline
+digest is needed, a C recv→pwrite→MD5 loop otherwise — falling back
+per-connection to the Python recv path (TLS records, fault filters,
+missing toolchain).
+
 Faultplan parity with the threaded engine: fresh dials consult
 ``pool.connect`` (STALL parks on the timer wheel instead of sleeping
 the loop), parent bodies run through ``piece.body`` filters and origin
@@ -49,6 +66,7 @@ from __future__ import annotations
 
 import collections
 import errno
+import fcntl
 import hashlib
 import heapq
 import logging
@@ -57,10 +75,12 @@ import queue
 import select
 import selectors
 import socket
+import ssl
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from dragonfly2_tpu import native
 from dragonfly2_tpu.client.downloader import (
     DownloadPieceError,
     DownloadPieceRequest,
@@ -214,6 +234,25 @@ class AsyncConnPool:
                 with self._lock:
                     self.reaped += 1
                 continue
+            if isinstance(sock, ssl.SSLSocket):
+                # MSG_PEEK is meaningless through a TLS record layer
+                # (and rejected by SSLSocket.recv). A live idle TLS
+                # keep-alive has nothing decrypted and nothing readable,
+                # so a nonblocking recv(1) raising SSLWantRead is the
+                # healthy case; data/EOF/error all mean the framing is
+                # gone (a consumed stray byte can't be un-read, but a
+                # stray byte is a dead keep-alive anyway).
+                try:
+                    if sock.pending() > 0:
+                        raise OSError("stray decrypted bytes")
+                    sock.recv(1)
+                except (ssl.SSLWantReadError, ssl.SSLWantWriteError,
+                        BlockingIOError, InterruptedError):
+                    return sock
+                except OSError:
+                    pass
+                sock.close()
+                continue
             try:
                 peek = sock.recv(1, socket.MSG_PEEK)
             except (BlockingIOError, InterruptedError):
@@ -349,6 +388,22 @@ class _DlLoop(threading.Thread):
         #: Select rounds where >1 task had ready sockets and the loop
         #: interleaved them — the fairness scheduler's visible counter.
         self.fair_interleaves = 0
+        #: Loop-owned scratch pipe for zero-copy splice(2) body landing
+        #: (loop-thread-only, always drained empty between native
+        #: calls). (-1, -1) when pipes are unavailable — the native
+        #: seam then uses its C recv→pwrite loop instead.
+        try:
+            self.splice_pipe = os.pipe()
+            try:
+                # Widen the pipe to the fairness quantum so one splice
+                # round-trip moves a full budget (F_SETPIPE_SZ).
+                fcntl.fcntl(self.splice_pipe[1],
+                            getattr(fcntl, "F_SETPIPE_SZ", 1031),
+                            FAIR_BUDGET)
+            except OSError:
+                pass
+        except OSError:
+            self.splice_pipe = (-1, -1)
 
     # -- cross-thread API --------------------------------------------------
 
@@ -422,6 +477,12 @@ class _DlLoop(threading.Thread):
             self.selector.close()
             self._wake_r.close()
             self._wake_w.close()
+            for fd in self.splice_pipe:
+                if fd >= 0:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
 
     def _drain_inbox(self) -> None:
         while self.inbox:
@@ -493,8 +554,16 @@ class DownloadLoopEngine:
     def __init__(self, workers: int = 0, *, stats=None,
                  max_streams: int = 0,
                  pool_per_host: int = 4, pool_idle_ttl: float = 60.0,
-                 pool_max_total: int = 512):
+                 pool_max_total: int = 512,
+                 peer_tls_context: Optional[ssl.SSLContext] = None,
+                 source_tls_context: Optional[ssl.SSLContext] = None):
         self.worker_count = workers if workers > 0 else DEFAULT_DL_WORKERS
+        #: Client context for TLS parents/peers (piece fetch + metadata
+        #: sync). None → plaintext peers, the default mesh transport.
+        self.peer_tls_context = peer_tls_context
+        #: Client context for https origins; None → a default-verify
+        #: context is built lazily on first https source.
+        self.source_tls_context = source_tls_context
         self.max_streams = (max_streams if max_streams > 0
                             else DEFAULT_DL_MAX_STREAMS)
         if stats is None:
@@ -579,6 +648,15 @@ class DownloadLoopEngine:
     @property
     def running(self) -> bool:
         return bool(self._loops) and not self._stop.is_set()
+
+    def source_tls(self) -> ssl.SSLContext:
+        """Client context for https origins (lazily built with default
+        system trust when the operator did not pin a CA)."""
+        ctx = self.source_tls_context
+        if ctx is None:
+            ctx = ssl.create_default_context()
+            self.source_tls_context = ctx
+        return ctx
 
     def thread_count(self) -> int:
         return sum(1 for loop in self._loops if loop.is_alive())
@@ -758,6 +836,8 @@ class _LoopOp:
 
 _ST_IDLE = "idle"
 _ST_CONNECT = "connect"
+_ST_TUNNEL = "tunnel"    # CONNECT exchange with a forward proxy
+_ST_TLS = "tls"          # nonblocking client handshake in flight
 _ST_SEND = "send"
 _ST_HEAD = "head"
 _ST_BODY = "body"
@@ -813,7 +893,10 @@ class _HttpOp(_LoopOp):
     fair_budget = FAIR_BUDGET
 
     def __init__(self, task_id: str, addr: str, *, timeout: float = 30.0,
-                 stats=None):
+                 stats=None, tls: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None,
+                 tunnel: Optional[Tuple[str, int]] = None,
+                 tunnel_auth: Optional[str] = None):
         super().__init__(task_id)
         host, sep, port = addr.rpartition(":")
         if not sep or not port.isdigit():
@@ -823,6 +906,20 @@ class _HttpOp(_LoopOp):
         self._port = int(port)
         self.timeout = timeout
         self.stats = stats
+        #: TLS client context; None → plaintext exchange.
+        self.tls = tls
+        self._server_hostname = server_hostname or host
+        #: Forward proxy (host, port) to CONNECT through; None → direct.
+        self.tunnel = tunnel
+        self._tunnel_auth = tunnel_auth
+        #: Pool key: TLS sessions and tunneled sockets must never be
+        #: mixed with plaintext/direct sockets to the same address.
+        key = addr
+        if tls is not None:
+            key += "|tls"
+        if tunnel is not None:
+            key += f"|via={tunnel[0]}:{tunnel[1]}"
+        self.pool_key = key
         self.sock: Optional[socket.socket] = None
         self.state = _ST_IDLE
         self._interest = 0
@@ -832,6 +929,12 @@ class _HttpOp(_LoopOp):
         self._got_head = False
         self._out = b""
         self._out_off = 0
+        self._tun_out = b""
+        self._tun_out_off = 0
+        self._tun_buf = bytearray()
+        self._write_wants_read = False
+        self._read_wants_write = False
+        self._pump_scheduled = False
         self._head_buf = bytearray()
         self._resp_status = -1
         self._resp_headers: Dict[str, str] = {}
@@ -859,6 +962,16 @@ class _HttpOp(_LoopOp):
         Subclasses normally call ``_finish(None)`` here."""
         self._finish(None)
 
+    def _splice_sink(self) -> Optional[Tuple[int, int, int]]:
+        """(fd, file_offset, max_len) to land body bytes through the
+        native seam, or None to stream through ``_on_chunk``. Consulted
+        per dispatch iteration — eligibility is per-connection (TLS
+        records and fault filters need the Python path)."""
+        return None
+
+    def _on_spliced(self, nbytes: int) -> None:
+        """Bookkeeping for bytes the native seam landed directly."""
+
     # -- exchange ----------------------------------------------------------
 
     def _begin(self) -> None:
@@ -874,10 +987,12 @@ class _HttpOp(_LoopOp):
         self._out_off = 0
         self._arm_deadline()
         pool = self.engine.pool
-        sock = None if force_fresh else pool.take(self.addr)
+        sock = None if force_fresh else pool.take(self.pool_key)
         if sock is not None:
+            # Pooled sockets are already tunneled/handshaken (the pool
+            # key guarantees it) — go straight to the request.
             self._was_pooled = True
-            self._adopt_socket(sock, connected=True)
+            self._adopt_socket(sock, connected=True, established=True)
             return
         self._was_pooled = False
         plan = faultplan.ACTIVE
@@ -899,6 +1014,9 @@ class _HttpOp(_LoopOp):
     def _dial(self) -> None:
         if self._finished:
             return
+        dial_host, dial_port = ((self.tunnel[0], self.tunnel[1])
+                                if self.tunnel is not None
+                                else (self._host, self._port))
         try:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             sock.setblocking(False)
@@ -906,7 +1024,7 @@ class _HttpOp(_LoopOp):
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 pass
-            rc = sock.connect_ex((self._host, self._port))
+            rc = sock.connect_ex((dial_host, dial_port))
         except OSError as exc:
             self._finish(exc)
             return
@@ -916,16 +1034,164 @@ class _HttpOp(_LoopOp):
             return
         self._adopt_socket(sock, connected=(rc == 0))
 
-    def _adopt_socket(self, sock: socket.socket, connected: bool) -> None:
+    def _adopt_socket(self, sock: socket.socket, connected: bool,
+                      established: bool = False) -> None:
         self.sock = sock
         self._registered = False
-        if connected:
+        self._write_wants_read = False
+        self._read_wants_write = False
+        if established:
             self.state = _ST_SEND
             self._set_interest(selectors.EVENT_WRITE)
             self._try_send()
+        elif connected:
+            self._post_connect()
         else:
             self.state = _ST_CONNECT
             self._set_interest(selectors.EVENT_WRITE)
+
+    def _post_connect(self) -> None:
+        """TCP is up on a FRESH socket: tunnel first, then TLS, then the
+        request — each stage a nonblocking state machine on this loop."""
+        if self.tunnel is not None:
+            self._start_tunnel()
+        elif self.tls is not None:
+            self._start_tls()
+        else:
+            self.state = _ST_SEND
+            self._set_interest(selectors.EVENT_WRITE)
+            self._try_send()
+
+    # -- CONNECT tunnel ----------------------------------------------------
+
+    def _start_tunnel(self) -> None:
+        lines = [f"CONNECT {self._host}:{self._port} HTTP/1.1",
+                 f"Host: {self._host}:{self._port}"]
+        if self._tunnel_auth:
+            lines.append(f"Proxy-Authorization: {self._tunnel_auth}")
+        self._tun_out = ("\r\n".join(lines) + "\r\n\r\n").encode()
+        self._tun_out_off = 0
+        self._tun_buf = bytearray()
+        self.state = _ST_TUNNEL
+        self._set_interest(selectors.EVENT_WRITE)
+        self._tunnel_send()
+
+    def _tunnel_send(self) -> None:
+        try:
+            while self._tun_out_off < len(self._tun_out):
+                n = self.sock.send(
+                    memoryview(self._tun_out)[self._tun_out_off:])
+                self._tun_out_off += n
+                self._last_progress = time.monotonic()
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError as exc:
+            self._stream_fail(exc)
+            return
+        self._set_interest(selectors.EVENT_READ)
+
+    def _tunnel_recv(self) -> None:
+        view = self.loop.recv_view
+        while True:
+            try:
+                n = self.sock.recv_into(view[:RECV_CHUNK])
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError as exc:
+                self._stream_fail(exc)
+                return
+            if n == 0:
+                self._stream_fail(OSError(
+                    f"proxy {self.tunnel[0]}:{self.tunnel[1]}: closed "
+                    "during CONNECT"))
+                return
+            self._last_progress = time.monotonic()
+            self._tun_buf += view[:n]
+            idx = self._tun_buf.find(b"\r\n\r\n")
+            if idx >= 0:
+                break
+            if len(self._tun_buf) > MAX_HEAD_BYTES:
+                self._stream_fail(ValueError(
+                    "oversized CONNECT response head"))
+                return
+        try:
+            status, _hdrs = _parse_resp_head(bytes(self._tun_buf[:idx]))
+        except ValueError as exc:
+            self._stream_fail(exc)
+            return
+        if status < 200 or status >= 300:
+            self._stream_fail(OSError(
+                f"proxy {self.tunnel[0]}:{self.tunnel[1]}: CONNECT "
+                f"{self._host}:{self._port} → {status}"))
+            return
+        if len(self._tun_buf) > idx + 4:
+            # Bytes after the CONNECT reply belong to nobody — a proxy
+            # speaking early would desync the (possibly TLS) stream.
+            self._stream_fail(ValueError(
+                "proxy sent data before the tunnel was used"))
+            return
+        self._tun_buf = bytearray()
+        if self.stats is not None:
+            self.stats.connect_tunnel()
+        if self.tls is not None:
+            self._start_tls()
+        else:
+            self.state = _ST_SEND
+            self._set_interest(selectors.EVENT_WRITE)
+            self._try_send()
+
+    # -- nonblocking TLS handshake -----------------------------------------
+
+    def _start_tls(self) -> None:
+        plan = faultplan.ACTIVE
+        if plan is not None:
+            rule = plan.check("tls.handshake", context=self.addr)
+            if rule is not None:
+                # Mid-handshake fault: the peer is gone before the
+                # session is up. The op's normal stream-failure path
+                # (drop socket, fail → piece retry) must recover.
+                self._stream_fail(ConnectionResetError(
+                    104, "injected mid-handshake connection reset"))
+                return
+        sock = self.sock
+        if self._registered:
+            # wrap_socket returns a NEW object; the selector registration
+            # must move with it.
+            try:
+                self.loop.selector.unregister(sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            self._registered = False
+            self._interest = 0
+        try:
+            self.sock = self.tls.wrap_socket(
+                sock, server_side=False, do_handshake_on_connect=False,
+                server_hostname=self._server_hostname)
+        except (OSError, ssl.SSLError, ValueError) as exc:
+            self.sock = sock
+            self._stream_fail(exc)
+            return
+        self.state = _ST_TLS
+        self._continue_handshake()
+
+    def _continue_handshake(self) -> None:
+        try:
+            self.sock.do_handshake()
+        except ssl.SSLWantReadError:
+            self._set_interest(selectors.EVENT_READ)
+            return
+        except ssl.SSLWantWriteError:
+            self._set_interest(selectors.EVENT_WRITE)
+            return
+        except (OSError, ssl.SSLError) as exc:
+            self._stream_fail(exc)
+            return
+        self._last_progress = time.monotonic()
+        if self.stats is not None:
+            self.stats.tls_handshake(server=False)
+        self.state = _ST_SEND
+        self._set_interest(selectors.EVENT_WRITE)
+        self._try_send()
 
     def _set_interest(self, events: int) -> None:
         if self.sock is None:
@@ -946,6 +1212,12 @@ class _HttpOp(_LoopOp):
         except (KeyError, ValueError, OSError) as exc:
             self._stream_fail(exc)
 
+    def _native_md5(self):
+        """The op's digest context when it lives in the native seam
+        (then C accumulates spliced bytes into it); None → no inline
+        digest for spliced bytes."""
+        return None
+
     def _drop_socket(self, keep: bool) -> None:
         sock, self.sock = self.sock, None
         if sock is None:
@@ -956,8 +1228,12 @@ class _HttpOp(_LoopOp):
             except (KeyError, ValueError, OSError):
                 pass
             self._registered = False
+        if keep and isinstance(sock, ssl.SSLSocket) and sock.pending() > 0:
+            # Decrypted bytes beyond the response body: the keep-alive
+            # framing is desynced — never pool it.
+            keep = False
         if keep:
-            self.engine.pool.give(self.addr, sock)
+            self.engine.pool.give(self.pool_key, sock)
         else:
             sock.close()
 
@@ -994,14 +1270,35 @@ class _HttpOp(_LoopOp):
                 self._stream_fail(OSError(
                     err, f"connect to {self.addr}: {os.strerror(err)}"))
                 return
-            self.state = _ST_SEND
-            self._try_send()
+            self._post_connect()
             return
-        if self.state == _ST_SEND and mask & selectors.EVENT_WRITE:
-            self._try_send()
+        if self.state == _ST_TUNNEL:
+            if (mask & selectors.EVENT_WRITE
+                    and self._tun_out_off < len(self._tun_out)):
+                self._tunnel_send()
+            elif mask & selectors.EVENT_READ:
+                self._tunnel_recv()
             return
-        if self.state in (_ST_HEAD, _ST_BODY) and mask & selectors.EVENT_READ:
-            self._try_recv()
+        if self.state == _ST_TLS:
+            self._continue_handshake()
+            return
+        if self.state == _ST_SEND:
+            if self._write_wants_read and mask & selectors.EVENT_READ:
+                # Renegotiation: the record layer needed inbound bytes
+                # to make write progress (upload engine's discipline).
+                self._write_wants_read = False
+                self._set_interest(selectors.EVENT_WRITE)
+                self._try_send()
+            elif mask & selectors.EVENT_WRITE:
+                self._try_send()
+            return
+        if self.state in (_ST_HEAD, _ST_BODY):
+            if self._read_wants_write and mask & selectors.EVENT_WRITE:
+                self._read_wants_write = False
+                self._set_interest(selectors.EVENT_READ)
+                self._try_recv()
+            elif mask & selectors.EVENT_READ:
+                self._try_recv()
 
     def _try_send(self) -> None:
         try:
@@ -1009,18 +1306,62 @@ class _HttpOp(_LoopOp):
                 n = self.sock.send(memoryview(self._out)[self._out_off:])
                 self._out_off += n
                 self._last_progress = time.monotonic()
-        except (BlockingIOError, InterruptedError):
+        except ssl.SSLWantReadError:
+            # MUST precede the OSError clause — SSLWant* subclass it.
+            self._write_wants_read = True
+            self._set_interest(selectors.EVENT_READ)
+            return
+        except (ssl.SSLWantWriteError, BlockingIOError, InterruptedError):
             return
         except OSError as exc:
             self._stream_fail(exc)
             return
         self.state = _ST_HEAD
         self._set_interest(selectors.EVENT_READ)
+        if (isinstance(self.sock, ssl.SSLSocket)
+                and self.sock.pending() > 0):
+            # Decrypted bytes already sit in the record layer; the
+            # selector watches the RAW fd and would never fire for them.
+            self._schedule_pump()
 
     def _try_recv(self) -> None:
         budget = self.fair_budget
         view = self.loop.recv_view
         while budget > 0:
+            if self.state == _ST_BODY and self._body_remaining > 0:
+                sink = self._splice_sink()
+                if sink is not None:
+                    # Native seam: socket → file-at-offset entirely in
+                    # C, PARTIAL progress on EAGAIN. Digest (when the
+                    # sink carries one) accumulates in the op's shared
+                    # md5 context, so Python-fed head-surplus bytes and
+                    # C-landed bytes form one digest stream.
+                    fd, file_off, max_len = sink
+                    want = min(self._body_remaining, budget, max_len)
+                    try:
+                        res = native.splice_recv_to_file(
+                            self.sock.fileno(), fd, file_off, want,
+                            self._native_md5(), self.loop.splice_pipe)
+                    except (native.NativeIOError, OSError) as exc:
+                        self._stream_fail(exc)
+                        return
+                    if res.nbytes > 0:
+                        self._last_progress = time.monotonic()
+                        budget -= res.nbytes
+                        self._body_remaining -= res.nbytes
+                        if self.stats is not None:
+                            self.stats.splice(res.nbytes, res.zero_copy)
+                        self._on_spliced(res.nbytes)
+                        if self._body_remaining == 0:
+                            self._complete_exchange()
+                            return
+                    if res.eof:
+                        self._stream_fail(OSError(
+                            f"{self.addr}: connection closed mid-body"))
+                        return
+                    if res.nbytes < want:
+                        return  # EAGAIN — the selector re-fires
+                    continue
             if self.state == _ST_BODY and self._body_remaining >= 0:
                 # Body: one recv as large as remaining × budget allows —
                 # the kernel hands back whatever is buffered in a single
@@ -1034,6 +1375,14 @@ class _HttpOp(_LoopOp):
                 break
             try:
                 n = self.sock.recv_into(view[:want])
+            except ssl.SSLWantReadError:
+                # MUST precede OSError (SSLWant* subclass it): the
+                # record layer has no complete record yet.
+                return
+            except ssl.SSLWantWriteError:
+                self._read_wants_write = True
+                self._set_interest(selectors.EVENT_WRITE)
+                return
             except (BlockingIOError, InterruptedError):
                 return
             except OSError as exc:
@@ -1052,8 +1401,28 @@ class _HttpOp(_LoopOp):
             elif self.state == _ST_BODY:
                 if not self._feed_body(view[:n]):
                     return
-        # Budget exhausted with body left: yield the loop; the selector
-        # (level-triggered) re-fires while bytes remain buffered.
+        # Budget exhausted with body left: yield the loop. For plaintext
+        # the level-triggered selector re-fires while bytes remain
+        # kernel-buffered; decrypted-but-unread TLS bytes live in the
+        # record layer where the selector can't see them, so drain those
+        # via the loop's inbox (still AFTER other ready ops this round —
+        # fairness holds).
+        if (isinstance(self.sock, ssl.SSLSocket)
+                and self.sock.pending() > 0):
+            self._schedule_pump()
+
+    def _schedule_pump(self) -> None:
+        if self._pump_scheduled or self._finished:
+            return
+        self._pump_scheduled = True
+        self.loop.call_soon(self._pump_pending)
+
+    def _pump_pending(self) -> None:
+        self._pump_scheduled = False
+        if (self._finished or self.sock is None
+                or self.state not in (_ST_HEAD, _ST_BODY)):
+            return
+        self._try_recv()
 
     def _feed_head(self, data: bytes) -> bool:
         self._head_buf += data
@@ -1147,7 +1516,7 @@ class _HttpOp(_LoopOp):
             # Stale keep-alive: drop its pooled siblings too (same dead
             # server) so the retry really is a fresh connect.
             self._fresh_retried = True
-            self.engine.pool.flush(self.addr)
+            self.engine.pool.flush(self.pool_key)
             try:
                 self._start_exchange(force_fresh=True)
             except Exception as fresh_exc:  # noqa: BLE001
@@ -1180,10 +1549,16 @@ class BufferedGetOp(_HttpOp):
 
     def __init__(self, task_id: str, addr: str, path: str, *,
                  timeout: float = 5.0, stats=None,
+                 tls: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None,
+                 tunnel: Optional[Tuple[str, int]] = None,
+                 tunnel_auth: Optional[str] = None,
                  callback: Callable[[int, Dict[str, str],
                                      Optional[bytes],
                                      Optional[BaseException]], None]):
-        super().__init__(task_id, addr, timeout=timeout, stats=stats)
+        super().__init__(task_id, addr, timeout=timeout, stats=stats,
+                         tls=tls, server_hostname=server_hostname,
+                         tunnel=tunnel, tunnel_auth=tunnel_auth)
         self.path = path
         self.callback = callback
         self._body = bytearray()
@@ -1240,18 +1615,27 @@ class PieceFetchOp(_HttpOp):
                  callback: Callable[[Optional[str], int,
                                      Optional[DownloadPieceError]], None],
                  timeout: float = 30.0, stats=None,
-                 chunk_hook: Optional[Callable[[int], None]] = None):
+                 tls: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None,
+                 chunk_hook: Optional[Callable[[int], None]] = None,
+                 verify_body: bool = True):
         super().__init__(req.task_id, req.dst_addr, timeout=timeout,
-                         stats=stats)
+                         stats=stats, tls=tls,
+                         server_hostname=server_hostname)
         self.req = req
         self.open_fd = open_fd
         self.reserve = reserve
         self.refund = refund
         self.callback = callback
         self.chunk_hook = chunk_hook
+        #: False → no inline digest: the ZERO-COPY splice mode (bench
+        #: rungs that verify whole windows post-hoc via
+        #: ``native.md5_file_range``). The daemon's piece path always
+        #: verifies inline.
+        self.verify_body = verify_body
         self._fd = -1
         self._offset = req.piece.offset
-        self._md5 = hashlib.md5()
+        self._md5 = hashlib.md5() if verify_body else None
         self._received = 0
         self._reserved = 0
         self._filter = None
@@ -1303,7 +1687,27 @@ class PieceFetchOp(_HttpOp):
             self._finish(DownloadPieceError(
                 f"data file unavailable: {exc}"))
             return False
+        if self.verify_body and native.available():
+            # One digest context shared across the ctypes boundary:
+            # head-surplus bytes fed from Python and body bytes landed
+            # by the C splice loop accumulate into the SAME stream.
+            self._md5 = native.Md5()
         return True
+
+    def _splice_sink(self) -> Optional[Tuple[int, int, int]]:
+        if (self._fd < 0 or self._filter is not None
+                or self.chunk_hook is not None
+                or isinstance(self.sock, ssl.SSLSocket)
+                or not native.available()):
+            return None
+        return (self._fd, self._offset, self._body_remaining)
+
+    def _native_md5(self):
+        return self._md5 if isinstance(self._md5, native.Md5) else None
+
+    def _on_spliced(self, nbytes: int) -> None:
+        self._offset += nbytes
+        self._received += nbytes
 
     def _on_chunk(self, chunk: bytes) -> None:
         if self._filter is not None:
@@ -1313,7 +1717,8 @@ class PieceFetchOp(_HttpOp):
         if self.chunk_hook is not None:
             self.chunk_hook(len(chunk))
         os.pwrite(self._fd, chunk, self._offset)
-        self._md5.update(chunk)
+        if self._md5 is not None:
+            self._md5.update(chunk)
         self._offset += len(chunk)
         self._received += len(chunk)
 
@@ -1344,7 +1749,8 @@ class PieceFetchOp(_HttpOp):
         cost_ns = (time.monotonic_ns() - self._begin_ns
                    if self._begin_ns else 0)
         if err is None:
-            cb(self._md5.hexdigest(), cost_ns, None)
+            digest = "" if self._md5 is None else self._md5.hexdigest()
+            cb(digest, cost_ns, None)
             return
         if self._reserved and self._received < self._reserved:
             # Refund the unreceived fraction of the up-front charge so a
@@ -1398,8 +1804,14 @@ class SourceRunOp(_HttpOp):
                  done_cb: Callable[[int, int, Optional[BaseException]],
                                    None],
                  extra_headers: Optional[Dict[str, str]] = None,
-                 timeout: float = 30.0, stats=None):
-        super().__init__(task_id, addr, timeout=timeout, stats=stats)
+                 timeout: float = 30.0, stats=None,
+                 tls: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None,
+                 tunnel: Optional[Tuple[str, int]] = None,
+                 tunnel_auth: Optional[str] = None):
+        super().__init__(task_id, addr, timeout=timeout, stats=stats,
+                         tls=tls, server_hostname=server_hostname,
+                         tunnel=tunnel, tunnel_auth=tunnel_auth)
         self.path = path
         self.url = url
         self.host_header = host_header
@@ -1477,8 +1889,45 @@ class SourceRunOp(_HttpOp):
             self._drop_socket(keep=False)
             self._finish(exc)
             return False
+        if native.available():
+            self._cur_md5 = native.Md5()
         self._cur_begin_ns = time.monotonic_ns()
         return True
+
+    def _splice_sink(self) -> Optional[Tuple[int, int, int]]:
+        if (self._fd < 0 or self._filter is not None
+                or self._idx >= len(self.pieces)
+                or isinstance(self.sock, ssl.SSLSocket)
+                or not native.available()):
+            return None
+        piece = self.pieces[self._idx]
+        if piece.skip:
+            # Skip pieces (landed via the mesh since the claim) are
+            # consumed and DISCARDED — the Python path drains them.
+            return None
+        return (self._fd, piece.offset + self._cur_written,
+                piece.length - self._cur_written)
+
+    def _native_md5(self):
+        return (self._cur_md5
+                if isinstance(self._cur_md5, native.Md5) else None)
+
+    def _on_spliced(self, nbytes: int) -> None:
+        # The sink caps max_len at the current piece's remainder, so a
+        # spliced burst never crosses a piece boundary.
+        piece = self.pieces[self._idx]
+        self._cur_written += nbytes
+        self._received += nbytes
+        if self._cur_written == piece.length:
+            cost = time.monotonic_ns() - self._cur_begin_ns
+            self.piece_cb(piece, self._cur_md5.hexdigest(), cost)
+            self.completed += 1
+            self.completed_bytes += piece.length
+            self._idx += 1
+            self._cur_md5 = (native.Md5() if native.available()
+                             else hashlib.md5())
+            self._cur_written = 0
+            self._cur_begin_ns = time.monotonic_ns()
 
     def _on_chunk(self, chunk: bytes) -> None:
         if self._filter is not None:
@@ -1517,7 +1966,8 @@ class SourceRunOp(_HttpOp):
                     self.completed += 1
                     self.completed_bytes += piece.length
                 self._idx += 1
-                self._cur_md5 = hashlib.md5()
+                self._cur_md5 = (native.Md5() if native.available()
+                                 else hashlib.md5())
                 self._cur_written = 0
                 self._cur_begin_ns = time.monotonic_ns()
 

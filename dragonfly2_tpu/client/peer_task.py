@@ -410,6 +410,7 @@ class _AsyncSyncer:
                 c.task_id, self.parent.addr,
                 f"/metadata/{c.task_id}?peerId={self.parent.peer_id}",
                 timeout=c.opts.metadata_timeout, stats=c.stats,
+                tls=c.engine.peer_tls_context,
                 callback=self._on_poll))
         except RuntimeError:  # engine stopped (daemon shutdown)
             self._done.set()
@@ -1345,6 +1346,7 @@ class PeerTaskConductor:
             callback=on_done,
             timeout=self.downloader.timeout,
             stats=self.stats,
+            tls=self.engine.peer_tls_context,
             chunk_hook=self.downloader.chunk_hook,
         )
         holder["op"] = op
@@ -1936,8 +1938,10 @@ class PeerTaskConductor:
 
     def _drive_source_threads(self, claimer: "_SourceClaimer", client,
                               length: int) -> None:
-        """The historical thread-per-worker run driver (non-HTTP / TLS /
-        proxied sources, and conductors running without an engine)."""
+        """The historical thread-per-worker run driver — only non-HTTP
+        schemes (file/s3/…) and conductors running without an engine
+        land here; every http(s)/proxied/credentialed origin rides the
+        event loop."""
         total = claimer.total
 
         def fetch_run(first: int, count: int) -> "Exception | None":
@@ -2141,30 +2145,64 @@ class PeerTaskConductor:
 
     # -- event-loop back-to-source driver ----------------------------------
 
-    def _async_source_target(self) -> "tuple[str, str, str] | None":
-        """``(addr, path, Host header)`` when the origin is plain direct
-        HTTP the engine can speak nonblocking; None falls back to the
-        threaded driver (https/file/s3/… schemes, proxied or
-        credentialed URLs, redirect-dependent origins)."""
+    def _async_source_target(self) -> "dict | None":
+        """Engine-speakable origin descriptor — addr/path/Host plus the
+        TLS context, CONNECT tunnel and auth headers the SourceRunOp
+        needs — or None when the conductor must use the threaded driver
+        (no running engine, or a non-http(s) scheme: file/s3/…). Plain,
+        https, proxied and credentialed origins all ride the event loop
+        now; there is no per-task source thread left for HTTP."""
         if self.engine is None or not getattr(self.engine, "running", False):
             return None
+        import base64
         import urllib.parse
 
         parsed = urllib.parse.urlsplit(self.url)
-        if parsed.scheme != "http" or not parsed.hostname:
+        if parsed.scheme not in ("http", "https") or not parsed.hostname:
             return None
-        try:
-            from dragonfly2_tpu.client.source import HTTPSourceClient
-
-            if HTTPSourceClient._needs_urllib(self.url):
-                return None
-        except Exception:  # noqa: BLE001 — resolver hiccups → safe path
-            return None
+        host = parsed.hostname
+        port = parsed.port or (443 if parsed.scheme == "https" else 80)
         path = parsed.path or "/"
         if parsed.query:
             path += "?" + parsed.query
-        return (f"{parsed.hostname}:{parsed.port or 80}", path,
-                parsed.netloc)
+        headers: "dict[str, str]" = {}
+        if parsed.username:
+            # Userinfo rides as Basic auth; the dial target is the bare
+            # hostname (the legacy urllib path tried to resolve the
+            # userinfo-laden netloc and failed).
+            userinfo = urllib.parse.unquote(parsed.username)
+            if parsed.password is not None:
+                userinfo += ":" + urllib.parse.unquote(parsed.password)
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                userinfo.encode("latin-1")).decode("ascii")
+        from dragonfly2_tpu.client.source import HTTPSourceClient
+
+        try:
+            proxy = HTTPSourceClient._proxy_for(self.url)
+        except Exception:  # noqa: BLE001 — resolver hiccups → direct
+            proxy = None
+        addr = f"{host}:{port}"
+        host_header = parsed.netloc.rpartition("@")[2]
+        tunnel = tunnel_auth = None
+        if proxy is not None:
+            mode, phost, pport, pauth = proxy
+            if mode == "tunnel":
+                # https via proxy: CONNECT through the proxy, then TLS
+                # to the origin on the same socket.
+                tunnel, tunnel_auth = (phost, pport), pauth
+            else:
+                # plain http via proxy: absolute-URI request AT the
+                # proxy, exactly what the legacy urllib transport sent.
+                addr = f"{phost}:{pport}"
+                netloc = host if port == 80 else f"{host}:{port}"
+                path = f"http://{netloc}{path}"
+                if pauth:
+                    headers["Proxy-Authorization"] = pauth
+        tls = (self.engine.source_tls()
+               if parsed.scheme == "https" else None)
+        return {"addr": addr, "path": path, "host_header": host_header,
+                "tls": tls, "server_hostname": host, "tunnel": tunnel,
+                "tunnel_auth": tunnel_auth, "headers": headers}
 
     def _drive_source_async(self, claimer: "_SourceClaimer",
                             length: int) -> None:
@@ -2320,7 +2358,9 @@ class PeerTaskConductor:
             pieces.pop()
         if not pieces:
             return False
-        addr, path, host_header = self._async_source_target()
+        target = self._async_source_target()
+        addr, path = target["addr"], target["path"]
+        host_header = target["host_header"]
         run_start = pieces[0].offset
         run_len = pieces[-1].offset + pieces[-1].length - run_start
         src_rng = (Range(self.url_range.start + run_start, run_len)
@@ -2346,6 +2386,8 @@ class PeerTaskConductor:
                 self._async_ops.discard(op)
             results.put((unit, err))
 
+        extra = dict(self.request_header)
+        extra.update(target["headers"])
         op = SourceRunOp(
             self.task_id, addr, path, host_header=host_header,
             src_range_header=src_rng.http_header(), url=self.url,
@@ -2353,7 +2395,9 @@ class PeerTaskConductor:
             reserve=lambda n: self.shaper.reserve_n(self.task_id, n),
             refund=lambda n: self.shaper.return_n(self.task_id, n),
             piece_cb=self._on_source_piece, done_cb=on_done,
-            extra_headers=self.request_header, stats=self.stats,
+            extra_headers=extra, stats=self.stats,
+            tls=target["tls"], server_hostname=target["server_hostname"],
+            tunnel=target["tunnel"], tunnel_auth=target["tunnel_auth"],
         )
         with self._async_lock:
             self._async_ops.add(op)

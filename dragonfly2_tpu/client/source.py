@@ -10,15 +10,15 @@ file-server pod; our single-process harness uses either).
 
 from __future__ import annotations
 
+import base64
 import email.utils
 import http.client
 import os
 import threading
-import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
-from typing import BinaryIO, Dict, Optional
+from typing import BinaryIO, Dict, Optional, Tuple
 
 from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
 from dragonfly2_tpu.client.piece import Range
@@ -189,10 +189,14 @@ class HTTPSourceClient(ResourceClient):
 
     Requests ride a per-host keep-alive connection pool (the reference's
     pooled ``http.Client`` transport, source_client.go/httpprotocol) —
-    back-to-source piece runs stop paying a TCP handshake each. Content
-    length and range support come from a GET with ``Range: bytes=0-0``
-    (falling back to plain GET), matching the reference's probe
-    behavior; 206 ⇒ ranges supported.
+    back-to-source piece runs stop paying a TCP handshake each. Proxied
+    and credentialed URLs ride the SAME pool: plain http through a proxy
+    is an absolute-URI request at the proxy, https goes through a
+    CONNECT tunnel (both keyed by proxy identity so sockets never mix),
+    and URL userinfo becomes Basic auth — the legacy one-shot urllib
+    path is gone. Content length and range support come from a GET with
+    ``Range: bytes=0-0`` (falling back to plain GET), matching the
+    reference's probe behavior; 206 ⇒ ranges supported.
     """
 
     MAX_REDIRECTS = 5
@@ -213,43 +217,72 @@ class HTTPSourceClient(ResourceClient):
         self.pool.close()
 
     @staticmethod
-    def _needs_urllib(url: str) -> bool:
-        """Pooled connections dial the origin directly — URLs that need
-        the proxy env vars (http_proxy/https_proxy, minus no_proxy) or
-        carry userinfo credentials keep the legacy urllib path, which
-        honors both. One-shot (no keep-alive) there, exactly as before
-        pooling existed."""
+    def _proxy_for(url: str) -> Optional[Tuple[str, str, int, Optional[str]]]:
+        """``(mode, host, port, proxy_auth)`` for a URL the proxy env
+        vars (``http_proxy``/``https_proxy`` minus ``no_proxy``) route
+        through a proxy, else None — the exact selection semantics the
+        legacy urllib path had (:func:`urllib.request.getproxies` +
+        ``proxy_bypass``). ``mode`` is ``"absolute"`` for plain http
+        (absolute-URI request straight at the proxy, as urllib sent) and
+        ``"tunnel"`` for https (CONNECT, then TLS to the origin).
+        Proxy-URL userinfo becomes the Basic ``Proxy-Authorization``
+        value, again matching urllib."""
         parsed = urllib.parse.urlsplit(url)
-        if parsed.username:
-            return True
         proxies = urllib.request.getproxies()
-        if parsed.scheme not in proxies:
-            return False
+        proxy_url = proxies.get(parsed.scheme)
+        if not proxy_url:
+            return None
         try:
-            return not urllib.request.proxy_bypass(parsed.hostname or "")
-        except Exception:  # resolver hiccups in bypass lookups
-            return True
-
-    def _open_urllib(self, url: str, method: str,
-                     headers: Dict[str, str]):
-        req = urllib.request.Request(url, headers=headers, method=method)
-        try:
-            return urllib.request.urlopen(req, timeout=self.timeout)
-        except urllib.error.HTTPError as exc:
-            raise SourceError(f"{url}: HTTP {exc.code}") from exc
-        except urllib.error.URLError as exc:
-            raise SourceError(f"{url}: {exc.reason}") from exc
+            if urllib.request.proxy_bypass(parsed.hostname or ""):
+                return None
+        except Exception:  # resolver hiccups in bypass lookups: use proxy
+            pass
+        p = urllib.parse.urlsplit(proxy_url)
+        auth = None
+        if p.username:
+            userinfo = urllib.parse.unquote(p.username)
+            if p.password is not None:
+                userinfo += ":" + urllib.parse.unquote(p.password)
+            auth = "Basic " + base64.b64encode(
+                userinfo.encode("latin-1")).decode("ascii")
+        mode = "tunnel" if parsed.scheme == "https" else "absolute"
+        return (mode, p.hostname or "", p.port or 3128, auth)
 
     def _request(self, url: str, method: str,
                  headers: Dict[str, str]) -> _PooledBody:
         parsed = urllib.parse.urlsplit(url)
         if parsed.scheme not in ("http", "https"):
             raise SourceError(f"{url}: unsupported scheme for HTTP client")
-        key = (parsed.scheme, parsed.hostname or "",
-               parsed.port or (443 if parsed.scheme == "https" else 80))
+        host = parsed.hostname or ""
+        port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        headers = dict(headers)
+        if parsed.username and not any(
+                k.lower() == "authorization" for k in headers):
+            # Userinfo credentials ride as Basic auth while the dial
+            # target stays the bare hostname (urllib tried to RESOLVE
+            # ``user:pass@host`` and failed; this is the working form).
+            userinfo = urllib.parse.unquote(parsed.username)
+            if parsed.password is not None:
+                userinfo += ":" + urllib.parse.unquote(parsed.password)
+            headers["Authorization"] = "Basic " + base64.b64encode(
+                userinfo.encode("latin-1")).decode("ascii")
         path = parsed.path or "/"
         if parsed.query:
             path += "?" + parsed.query
+        proxy = self._proxy_for(url)
+        key: Tuple = (parsed.scheme, host, port)
+        if proxy is not None:
+            mode, phost, pport, pauth = proxy
+            key = key + ((mode, phost, pport, pauth),)
+            if mode == "absolute":
+                # Absolute-URI request-target (userinfo stripped);
+                # http.client derives the Host header from its netloc,
+                # so the origin-facing headers match the legacy path.
+                netloc = host if port == 80 else f"{host}:{port}"
+                path = f"{parsed.scheme}://{netloc}{path}"
+                if pauth and not any(k.lower() == "proxy-authorization"
+                                     for k in headers):
+                    headers["Proxy-Authorization"] = pauth
         try:
             conn, resp = self.pool.request(key, method, path, headers,
                                            stats=self.stats)
@@ -269,8 +302,6 @@ class HTTPSourceClient(ResourceClient):
             for key in [k for k in headers if k.lower() == "range"]:
                 del headers[key]
             headers["Range"] = request.rng.http_header()
-        if self._needs_urllib(request.url):
-            return self._open_urllib(request.url, method, headers)
         url = request.url
         for _hop in range(self.MAX_REDIRECTS + 1):
             resp = self._request(url, method, headers)

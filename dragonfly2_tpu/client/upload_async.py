@@ -17,10 +17,11 @@ docs/DATAPLANE.md):
 2. **pure-Python ``os.sendfile``** — the same zero-copy syscall without
    the toolchain dependency; returns partial counts and raises
    ``BlockingIOError`` on a full buffer, exactly what the loop needs.
-3. **mmap-backed chunked writes** — TLS connections (the record layer
-   must see the bytes) and platforms without ``sendfile``; the piece is
-   never materialized as a Python ``bytes``, only windowed through a
-   ``memoryview`` of the mapping.
+3. **mmap-backed chunked writes** — TLS connections without kernel TLS
+   offload (the record layer must see the bytes; with kTLS the
+   zero-copy rungs above stay live) and platforms without ``sendfile``;
+   the piece is never materialized as a Python ``bytes``, only windowed
+   through a ``memoryview`` of the mapping.
 4. **buffered** — ranges the span lookup can't resolve (clamped /
    out-of-extent reads on partial stores); the one remaining
    whole-``bytes`` path, counted separately so it is visible.
@@ -99,7 +100,7 @@ class _Conn:
     """One peer connection's full state machine."""
 
     __slots__ = (
-        "sock", "fd", "addr", "tls", "state", "interest", "inbuf",
+        "sock", "fd", "addr", "tls", "ktls", "state", "interest", "inbuf",
         "head", "head_off", "kind", "data", "data_off", "mm", "in_fd",
         "file_off", "remaining", "keep_alive", "resume_at", "count_piece",
         "reserved", "write_wants_read", "dispatching", "pump", "closed",
@@ -110,6 +111,7 @@ class _Conn:
         self.fd = sock.fileno()
         self.addr = addr
         self.tls = tls
+        self.ktls = False
         self.state = _HANDSHAKE if tls else _READ
         self.interest = selectors.EVENT_READ
         self.inbuf = bytearray()
@@ -449,6 +451,17 @@ class AsyncUploadServer:
         except (OSError, ssl.SSLError):
             self._close(worker, conn)
             return
+        self.stats.tls_handshake(server=True)
+        # Per-connection serve-path verdict, not per-deployment: a
+        # kernel-offloaded session keeps the zero-copy ladder (the
+        # kernel encrypts what sendfile moves); otherwise only writes
+        # through the SSL object are sound, and the reason is counted.
+        from dragonfly2_tpu.utils import tlsconf
+
+        usable, reason = tlsconf.ktls_probe(self.ssl_context)
+        conn.ktls = usable
+        if not usable:
+            self.stats.tls_fallback(reason)
         conn.state = _READ
         worker.set_interest(conn, selectors.EVENT_READ)
         if conn.sock.pending() > 0:
@@ -643,8 +656,10 @@ class AsyncUploadServer:
             self._respond_error(worker, conn, 416, detail)
 
     def _pick_span_kind(self, conn: _Conn) -> str:
-        if conn.tls:
-            return KIND_MMAP  # raw-fd writes would bypass the record layer
+        if conn.tls and not conn.ktls:
+            # Without kernel offload, raw-fd writes (native/sendfile)
+            # would bypass the record layer and corrupt the stream.
+            return KIND_MMAP
         mode = self.serve_path
         if mode == KIND_MMAP:
             return KIND_MMAP
@@ -818,7 +833,7 @@ class AsyncUploadServer:
             if self.metrics is not None:
                 self.metrics.upload_piece_count.inc()
                 self.metrics.upload_traffic.inc(served)
-            self.stats.upload_served(kind, served)
+            self.stats.upload_served(kind, served, tls=conn.tls)
         if not conn.keep_alive:
             self._close(worker, conn)
             return

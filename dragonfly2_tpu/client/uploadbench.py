@@ -31,9 +31,10 @@ import os
 import selectors
 import shutil
 import socket
+import ssl
 import tempfile
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from dragonfly2_tpu.client.piece import PieceMetadata
 from dragonfly2_tpu.client.storage import (
@@ -222,7 +223,10 @@ def _drive_streams(server, streams: List[_Stream],
                         st.out_buf = st.out_buf[n:]
                         if not st.out_buf:
                             sel.modify(st.sock, selectors.EVENT_READ, st)
-                except (BlockingIOError, InterruptedError):
+                except (BlockingIOError, InterruptedError,
+                        ssl.SSLWantReadError, ssl.SSLWantWriteError):
+                    # SSLWant* subclass OSError — they must stay benign
+                    # (retry next round), not stream-fatal.
                     pass
                 except OSError as exc:
                     _fail(st, str(exc))
@@ -230,11 +234,14 @@ def _drive_streams(server, streams: List[_Stream],
                 if not (mask & selectors.EVENT_READ):
                     continue
                 # Drain the socket while it has data: one select round
-                # per piece, not one per 256 KiB window.
+                # per piece, not one per 256 KiB window. Over TLS this
+                # also drains decrypted record-layer bytes the selector
+                # (watching the raw fd) cannot see.
                 while st.quota > 0:
                     try:
                         n = st.sock.recv_into(scratch)
-                    except (BlockingIOError, InterruptedError):
+                    except (BlockingIOError, InterruptedError,
+                            ssl.SSLWantReadError, ssl.SSLWantWriteError):
                         break
                     except OSError as exc:
                         _fail(st, str(exc))
@@ -265,11 +272,19 @@ def _drive_streams(server, streams: List[_Stream],
 
 
 def _connect_streams(port: int, count: int, pieces: List[PieceMetadata],
-                     quota: int, verify_every: int = 1) -> List[_Stream]:
+                     quota: int, verify_every: int = 1,
+                     tls_ctx: Optional[ssl.SSLContext] = None
+                     ) -> List[_Stream]:
     streams = []
     for i in range(count):
         sock = socket.create_connection(("127.0.0.1", port), timeout=30)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls_ctx is not None:
+            # Blocking handshake at connect, nonblocking thereafter: the
+            # SERVER's nonblocking handshake machine is the thing under
+            # test, and a sequential client handshake keeps the driver
+            # loop free of handshake states.
+            sock = tls_ctx.wrap_socket(sock, server_hostname="127.0.0.1")
         sock.setblocking(False)
         # Spread starting pieces so streams don't convoy on one span.
         order = pieces[i % len(pieces):] + pieces[:i % len(pieces)]
@@ -277,12 +292,27 @@ def _connect_streams(port: int, count: int, pieces: List[PieceMetadata],
     return streams
 
 
+def _tls_contexts(tmp: str) -> Optional[Tuple[ssl.SSLContext,
+                                              ssl.SSLContext]]:
+    """(server_ctx, client_ctx) from a throwaway CA minted with the
+    openssl CLI, or None when the CLI is unavailable (the TLS rungs
+    skip explicitly rather than fail)."""
+    from dragonfly2_tpu.utils import tlsconf
+
+    if not tlsconf.openssl_available():
+        return None
+    ca_cert, ca_key = tlsconf.mint_ca(tmp, "df2-bench-ca")
+    cert, key = tlsconf.mint_leaf(tmp, "127.0.0.1", ca_cert, ca_key)
+    return (tlsconf.server_context(cert, key),
+            tlsconf.client_context(cafile=ca_cert))
+
+
 def run_upload_loopback_bench(*, size_bytes: int = 256 << 20,
                               piece_size: int = 4 << 20, streams: int = 4,
                               passes: int = 1, serve_path: str = "sendfile",
                               root: Optional[str] = None,
                               seed: int = 0, verify_every: int = 4,
-                              attempts: int = 3,
+                              attempts: int = 3, tls: bool = False,
                               timeout_s: float = 60.0) -> Dict[str, object]:
     """Loopback serving throughput with the serve path pinned (default:
     pure-Python ``os.sendfile``, native OFF — the acceptance bound's
@@ -301,10 +331,18 @@ def run_upload_loopback_bench(*, size_bytes: int = 256 << 20,
     tmp = root or tempfile.mkdtemp(prefix="df2-upbench-")
     stats = DataPlaneStats()
     try:
+        server_ctx = client_ctx = None
+        if tls:
+            pair = _tls_contexts(os.path.join(tmp, "tls"))
+            if pair is None:
+                return {"skipped": True,
+                        "reason": "openssl CLI unavailable for TLS certs"}
+            server_ctx, client_ctx = pair
         mgr, pieces = build_seed_task(
             os.path.join(tmp, "seed"), size_bytes=size_bytes,
             piece_size=piece_size, seed=seed)
-        server = AsyncUploadServer(mgr, serve_path=serve_path, stats=stats)
+        server = AsyncUploadServer(mgr, serve_path=serve_path, stats=stats,
+                                   ssl_context=server_ctx)
         server.start()
         try:
             quota = (len(pieces) * passes + streams - 1) // streams
@@ -315,7 +353,8 @@ def run_upload_loopback_bench(*, size_bytes: int = 256 << 20,
                 if time.perf_counter() >= deadline:
                     break
                 conns = _connect_streams(server.port, streams, pieces,
-                                         quota, verify_every)
+                                         quota, verify_every,
+                                         tls_ctx=client_ctx)
                 begin = time.perf_counter()
                 out = _drive_streams(server, conns, deadline)
                 out["seconds"] = time.perf_counter() - begin
@@ -358,6 +397,10 @@ def run_upload_loopback_bench(*, size_bytes: int = 256 << 20,
             "sendfile_bytes": snap["sendfile_bytes"],
             "mmap_bytes": snap["mmap_bytes"],
             "buffered_bytes": snap["buffered_bytes"],
+            "tls": tls,
+            "tls_handshakes": snap["tls_handshakes"],
+            "ktls_bytes": snap["ktls_bytes"],
+            "tls_fallbacks": snap["tls_fallbacks"],
             "baseline_mb_per_s": UPLOAD_BASELINE_MB_S,
             "speedup_vs_baseline": round(
                 mb / max(seconds, 1e-9) / UPLOAD_BASELINE_MB_S, 2),
@@ -372,6 +415,7 @@ def run_density_rung(*, children: int = 32, streams_per_child: int = 8,
                      pieces_per_stream: int = 2, piece_size: int = 256 << 10,
                      task_pieces: int = 64, serve_path: str = "sendfile",
                      root: Optional[str] = None, seed: int = 0,
+                     tls: bool = False,
                      timeout_s: float = 90.0) -> Dict[str, object]:
     """The concurrency-density rung: ``children × streams_per_child``
     concurrent keep-alive piece streams against ONE seed daemon's
@@ -385,17 +429,25 @@ def run_density_rung(*, children: int = 32, streams_per_child: int = 8,
     tmp = root or tempfile.mkdtemp(prefix="df2-density-")
     stats = DataPlaneStats()
     try:
+        server_ctx = client_ctx = None
+        if tls:
+            pair = _tls_contexts(os.path.join(tmp, "tls"))
+            if pair is None:
+                return {"skipped": True,
+                        "reason": "openssl CLI unavailable for TLS certs"}
+            server_ctx, client_ctx = pair
         mgr, pieces = build_seed_task(
             os.path.join(tmp, "seed"),
             size_bytes=task_pieces * piece_size, piece_size=piece_size,
             seed=seed)
         server = AsyncUploadServer(
             mgr, serve_path=serve_path, stats=stats,
-            backlog=max(total_streams, 128))
+            backlog=max(total_streams, 128), ssl_context=server_ctx)
         server.start()
         try:
             conns = _connect_streams(server.port, total_streams, pieces,
-                                     pieces_per_stream)
+                                     pieces_per_stream,
+                                     tls_ctx=client_ctx)
             begin = time.perf_counter()
             out = _drive_streams(server, conns, begin + timeout_s)
             seconds = time.perf_counter() - begin
@@ -425,6 +477,8 @@ def run_density_rung(*, children: int = 32, streams_per_child: int = 8,
             "server_thread_bound": DENSITY_THREAD_BOUND,
             "threads_bounded": threads_bounded,
             "connections_peak": out["connections_peak"],
+            "tls": tls,
+            "tls_handshakes": stats.snapshot()["tls_handshakes"],
             "verdict_pass": bool(ok and threads_bounded
                                  and total_streams >= DENSITY_MIN_STREAMS),
         }

@@ -67,6 +67,10 @@ def build_daemon(args):
         download_engine=args.dl_engine,
         dl_workers=args.dl_workers,
         dl_max_streams=args.dl_max_streams,
+        upload_tls_cert=args.upload_tls_cert,
+        upload_tls_key=args.upload_tls_key,
+        peer_tls_ca=args.peer_tls_ca,
+        source_tls_ca=args.source_tls_ca,
     ))
     daemon.start()
     return daemon
@@ -146,6 +150,21 @@ def main(argv=None) -> int:
     parser.add_argument("--dl-workers", type=int, default=0,
                         help="event-loop worker threads for the async "
                              "download engine (0 = default)")
+    parser.add_argument("--upload-tls-cert", default="",
+                        help="PEM certificate enabling TLS on the upload "
+                             "(piece-serving) listener; kTLS offload is "
+                             "probed per connection and the serve ladder "
+                             "falls back to record-layer writes without it")
+    parser.add_argument("--upload-tls-key", default="",
+                        help="private key for --upload-tls-cert")
+    parser.add_argument("--peer-tls-ca", default="",
+                        help="CA bundle (PEM) for TLS to parent peers; "
+                             "set it and piece fetches + metadata syncs "
+                             "dial TLS on the same event loops (unset = "
+                             "plaintext mesh, the default)")
+    parser.add_argument("--source-tls-ca", default="",
+                        help="CA bundle pinned for https origins "
+                             "(unset = system trust)")
     parser.add_argument("--dl-max-streams", type=int, default=0,
                         help="daemon-wide cap on concurrently streaming "
                              "piece/source-run bodies in the async "

@@ -27,7 +27,7 @@ from typing import Optional, Tuple
 logger = logging.getLogger(__name__)
 
 _SOURCE = os.path.join(os.path.dirname(__file__), "pieceio.cpp")
-ABI_VERSION = 1
+ABI_VERSION = 2
 ERR_MALFORMED = -1000000
 
 _lock = threading.Lock()
@@ -98,6 +98,20 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.df2_md5_file_range.restype = ctypes.c_int64
     lib.df2_md5_file_range.argtypes = [
         ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p]
+    lib.df2_md5_ctx_size.restype = ctypes.c_int64
+    lib.df2_md5_ctx_size.argtypes = []
+    lib.df2_md5_ctx_init.restype = None
+    lib.df2_md5_ctx_init.argtypes = [ctypes.c_void_p]
+    lib.df2_md5_ctx_update.restype = None
+    lib.df2_md5_ctx_update.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.df2_md5_ctx_hex.restype = None
+    lib.df2_md5_ctx_hex.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.df2_splice_recv_to_file.restype = ctypes.c_int64
+    lib.df2_splice_recv_to_file.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
     return lib
 
 
@@ -182,3 +196,64 @@ def md5_file_range(fd: int, offset: int, count: int) -> Tuple[int, str]:
     if n < 0:
         raise NativeIOError(-n, os.strerror(int(-n)))
     return int(n), out.value.decode()
+
+
+class Md5:
+    """Resumable native MD5 with the hashlib surface the download ops
+    use (``update`` / ``hexdigest``). The context lives in a ctypes
+    buffer so :func:`splice_recv_to_file` can hand its address to C and
+    accumulate spliced bytes into the SAME digest stream as Python-fed
+    header-surplus bytes — one digest per piece, regardless of which
+    side of the ctypes boundary each burst landed on."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self) -> None:
+        lib = _get()
+        assert lib is not None, "call available() first"
+        self._buf = ctypes.create_string_buffer(int(lib.df2_md5_ctx_size()))
+        lib.df2_md5_ctx_init(ctypes.addressof(self._buf))
+
+    @property
+    def ctx_addr(self) -> int:
+        return ctypes.addressof(self._buf)
+
+    def update(self, data) -> None:
+        if data:
+            b = data if isinstance(data, bytes) else bytes(data)
+            _get().df2_md5_ctx_update(ctypes.addressof(self._buf), b, len(b))
+
+    def hexdigest(self) -> str:
+        out = ctypes.create_string_buffer(33)
+        _get().df2_md5_ctx_hex(ctypes.addressof(self._buf), out)
+        return out.value.decode()
+
+
+@dataclass(frozen=True)
+class SpliceResult:
+    nbytes: int
+    eof: bool
+    zero_copy: bool  # True when the bytes moved via splice(2), no copy
+
+
+def splice_recv_to_file(sock_fd: int, file_fd: int, offset: int, want: int,
+                        md5: Optional[Md5] = None,
+                        pipe: Tuple[int, int] = (-1, -1)) -> SpliceResult:
+    """Land up to ``want`` socket bytes at ``offset`` of ``file_fd`` with
+    PARTIAL progress on EAGAIN — the download-side mirror of
+    :func:`send_file_range`. With ``md5=None`` and a scratch ``pipe``
+    the bytes move zero-copy via splice(2); otherwise (inline digest
+    wanted, or no pipe) a recv→pwrite→MD5 loop runs entirely in C.
+    Raises :class:`NativeIOError` on IO failure."""
+    lib = _get()
+    assert lib is not None, "call available() first"
+    eof = ctypes.c_int32(0)
+    mode = ctypes.c_int32(0)
+    n = lib.df2_splice_recv_to_file(
+        sock_fd, file_fd, offset, want,
+        None if md5 is None else md5.ctx_addr, pipe[0], pipe[1],
+        ctypes.byref(eof), ctypes.byref(mode))
+    if n < 0:
+        raise NativeIOError(-n, os.strerror(int(-n)))
+    return SpliceResult(nbytes=int(n), eof=bool(eof.value),
+                        zero_copy=(mode.value == 1))

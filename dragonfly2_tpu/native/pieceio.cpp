@@ -12,6 +12,15 @@
 //                           persistent socket: send the GET, parse the
 //                           response header, then recv → pwrite → MD5
 //                           with zero Python in the loop.
+//   df2_splice_recv_to_file — fetch side for the NON-BLOCKING engine:
+//                           socket → file-at-offset with PARTIAL
+//                           progress on EAGAIN (the same contract that
+//                           fixed the upload side). Zero-copy splice(2)
+//                           through a caller-owned pipe when no inline
+//                           digest is requested, recv → pwrite → MD5
+//                           otherwise.
+//   df2_md5_ctx_*         — resumable MD5 state the splice calls can
+//                           accumulate into across EAGAIN boundaries.
 //   df2_md5_file_range    — digest of a stored span (verification).
 //
 // Exposed via ctypes (extern "C", plain ints/pointers) — no pybind11
@@ -27,6 +36,7 @@
 #include <cstring>
 #include <new>
 
+#include <fcntl.h>
 #include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/types.h>
@@ -465,7 +475,130 @@ int64_t df2_md5_file_range(int fd, int64_t offset, int64_t count,
   return done;
 }
 
+// --------------------------------------------------------------------------
+// Resumable MD5 context, exposed so the event-loop engine can hash a body
+// that arrives in EAGAIN-separated bursts (possibly mixing Python-fed
+// header-surplus bytes with C-spliced bytes) into ONE digest stream.
+// --------------------------------------------------------------------------
+
+int64_t df2_md5_ctx_size() { return (int64_t)sizeof(Md5Ctx); }
+
+void df2_md5_ctx_init(void *ctx) { md5_init((Md5Ctx *)ctx); }
+
+void df2_md5_ctx_update(void *ctx, const unsigned char *data, int64_t len) {
+  md5_update((Md5Ctx *)ctx, data, (size_t)len);
+}
+
+// Non-destructive finalize: digests a COPY so the caller can keep feeding
+// the context afterwards (hashlib semantics — per-piece digests inside a
+// running source stream peek at the state without consuming it).
+void df2_md5_ctx_hex(const void *ctx, char hex_out[33]) {
+  Md5Ctx copy = *(const Md5Ctx *)ctx;
+  md5_final(&copy, hex_out);
+}
+
+// Pull up to `want` body bytes from a (typically non-blocking) connected
+// socket and land them in `file_fd` at `file_offset`. The download-side
+// mirror of df2_send_file_range, with the same PARTIAL-progress contract:
+// EAGAIN returns the bytes landed so far (possibly 0) instead of -EAGAIN,
+// so the event loop resumes at file_offset+returned when the socket turns
+// readable and no byte is ever written twice or skipped.
+//
+// Two modes, picked per call:
+//   splice(2) zero-copy (mode_out=1): when `md5_ctx` is NULL and the
+//     caller supplies a pipe (pipe_rd/pipe_wr >= 0) — socket pages move
+//     kernel-side through the pipe to the file, no userspace copy. The
+//     pipe MUST be empty on entry; it is fully drained to the file before
+//     every return, so it is empty again on exit (even on EAGAIN).
+//   recv → pwrite (mode_out=2): when an inline digest is requested (bytes
+//     must transit userspace) or no pipe is given, or when the kernel
+//     refuses to splice this fd pair (per-connection fallback, not
+//     per-deployment).
+//
+// Returns bytes landed (>= 0), or -errno on hard failure (bytes already
+// in flight through the pipe are lost — the caller must treat the stream
+// as dead, same as any mid-body socket error). `eof_out` is set to 1 when
+// the peer half-closed (recv/splice returned 0).
+int64_t df2_splice_recv_to_file(int sock_fd, int file_fd, int64_t file_offset,
+                                int64_t want, void *md5_ctx, int pipe_rd,
+                                int pipe_wr, int32_t *eof_out,
+                                int32_t *mode_out) {
+  *eof_out = 0;
+  int64_t done = 0;
+  bool try_splice = (md5_ctx == nullptr && pipe_rd >= 0 && pipe_wr >= 0);
+  *mode_out = try_splice ? 1 : 2;
+  constexpr size_t kSpliceChunk = 1 << 20;
+
+  while (try_splice && done < want) {
+    size_t chunk = (size_t)(want - done) < kSpliceChunk
+                       ? (size_t)(want - done)
+                       : kSpliceChunk;
+    ssize_t n = splice(sock_fd, nullptr, pipe_wr, nullptr, chunk,
+                       SPLICE_F_MOVE | SPLICE_F_NONBLOCK);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return done;
+      if ((errno == EINVAL || errno == ENOSYS) && done == 0) {
+        // Kernel refuses this fd pair — fall through to the copy loop.
+        try_splice = false;
+        *mode_out = 2;
+        break;
+      }
+      return -errno;
+    }
+    if (n == 0) {
+      *eof_out = 1;
+      return done;
+    }
+    // Drain the pipe to the file completely before looking at the socket
+    // again: the pipe is loop-owned scratch and must be empty between
+    // calls, or a later EAGAIN would strand bytes outside the file.
+    ssize_t in_pipe = n;
+    off_t out_off = (off_t)(file_offset + done);
+    while (in_pipe > 0) {
+      ssize_t w = splice(pipe_rd, nullptr, file_fd, &out_off,
+                         (size_t)in_pipe, SPLICE_F_MOVE);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return -errno; // bytes stranded in the pipe — stream is dead
+      }
+      if (w == 0) return -EIO;
+      in_pipe -= w;
+      done += w;
+    }
+  }
+
+  if (*mode_out == 1 || done == want) return done;
+
+  unsigned char *buf = new (std::nothrow) unsigned char[kBufSize];
+  if (buf == nullptr) return done > 0 ? done : -ENOMEM;
+  while (done < want) {
+    size_t chunk = (size_t)(want - done) < kBufSize ? (size_t)(want - done)
+                                                    : kBufSize;
+    ssize_t n = recv(sock_fd, buf, chunk, 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      delete[] buf;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return done;
+      return -errno;
+    }
+    if (n == 0) {
+      *eof_out = 1;
+      break;
+    }
+    ssize_t w = pwrite_full(file_fd, buf, (size_t)n, file_offset + done);
+    if (w < 0) {
+      delete[] buf;
+      return w;
+    }
+    if (md5_ctx != nullptr) md5_update((Md5Ctx *)md5_ctx, buf, (size_t)n);
+    done += n;
+  }
+  delete[] buf;
+  return done;
+}
+
 // Version probe so Python can confirm it loaded the build it expects.
-int32_t df2_native_abi_version() { return 1; }
+int32_t df2_native_abi_version() { return 2; }
 
 } // extern "C"

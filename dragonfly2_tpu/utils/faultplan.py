@@ -43,6 +43,10 @@ site                    where it fires
                         (context = parent addr)
 ``source.body``         back-to-source response body in ``HTTPSourceClient``
                         (context = url)
+``tls.handshake``       client-side TLS handshake starts in the async
+                        download engine (context = peer addr) — a RESET
+                        rule drops the connection mid-handshake, before
+                        the session is established
 ``scheduler.rpc``       ``GrpcSchedulerClient`` sends + the in-process
                         :class:`RpcFaultProxy` (context = method name)
 ``storage.write``       ``TaskStorage.write_piece`` (context = task id)

@@ -251,7 +251,7 @@ def test_http_pool_idle_ttl_reap_and_keys():
     key = ("http", "198.51.100.9", 80)
     pool.checkin(key, http.client.HTTPConnection("198.51.100.9", 80))
     assert pool.gauges() == {"keys": 1, "sockets": 1, "reaped": 0,
-                             "evicted": 0}
+                             "evicted": 0, "tunnels": 0}
     time.sleep(0.06)
     assert pool.reap(force=True) == 1
     gauges = pool.gauges()

@@ -9,6 +9,8 @@ range and the task stores corrupt content mesh-wide.
 
 from __future__ import annotations
 
+import base64
+
 import pytest
 
 from dragonfly2_tpu.client.piece import Range
@@ -72,35 +74,45 @@ class TestHTTPSource:
             with pytest.raises(SourceError):
                 cli.download(Request(fs.url("f.bin"), rng=Range(10, 10)))
 
-    def test_proxied_and_credentialed_urls_keep_urllib_path(self, served,
-                                                            monkeypatch):
-        """The pooled transport dials origins directly; URLs that need
-        proxy env vars or carry userinfo must keep the legacy urllib
-        path (which honors both)."""
+    def test_proxied_and_credentialed_urls_ride_the_pool(self, served,
+                                                         monkeypatch):
+        """Proxy env vars and URL userinfo no longer divert to urllib:
+        ``_proxy_for`` resolves the same proxy selection urllib did
+        (getproxies + no_proxy bypass) and the pooled transport carries
+        the request itself."""
         import urllib.request
 
         fs, content = served
         cli = HTTPSourceClient()
-        calls = []
-        real_urlopen = urllib.request.urlopen
-
-        def spy(req, timeout=None):
-            calls.append(req.full_url)
-            return real_urlopen(req, timeout=timeout)
-
-        monkeypatch.setattr(urllib.request, "urlopen", spy)
-        # Credentialed URL → urllib (even with no proxy configured).
-        assert cli._needs_urllib("http://user:pw@127.0.0.1/x")
-        # Proxy env var → urllib, unless no_proxy bypasses the host.
-        monkeypatch.setenv("http_proxy", "http://proxy.invalid:3128")
+        # No proxy configured → direct dial.
+        monkeypatch.delenv("http_proxy", raising=False)
+        monkeypatch.delenv("no_proxy", raising=False)
+        assert cli._proxy_for(fs.url("blob.bin")) is None
+        # Proxy env var routes plain http as an absolute-URI request,
+        # with proxy-URL userinfo becoming Basic Proxy-Authorization.
+        monkeypatch.setenv("http_proxy", "http://pu:pp@proxy.invalid:3128")
         monkeypatch.setenv("no_proxy", "")
-        assert cli._needs_urllib(fs.url("blob.bin"))
+        mode, phost, pport, pauth = cli._proxy_for(fs.url("blob.bin"))
+        assert (mode, phost, pport) == ("absolute", "proxy.invalid", 3128)
+        assert pauth == "Basic " + base64.b64encode(b"pu:pp").decode("ascii")
+        # no_proxy bypass still wins, exactly like the urllib selector.
         monkeypatch.setenv("no_proxy", "127.0.0.1")
-        assert not cli._needs_urllib(fs.url("blob.bin"))
-        # And the bypassed direct fetch still works end to end without
-        # touching urllib.
+        assert cli._proxy_for(fs.url("blob.bin")) is None
+        # The bypassed fetch runs end to end on the pool, no urllib.
+        def boom(*a, **k):  # pragma: no cover - tripped only on regression
+            raise AssertionError("urlopen must not be used by the source "
+                                 "client")
+
+        monkeypatch.setattr(urllib.request, "urlopen", boom)
         resp = cli.download(Request(fs.url("blob.bin"), rng=Range(0, 10)))
         body = resp.body.read()
         resp.close()
         assert body == content[:10]
-        assert calls == []
+        # Credentialed URLs ride the pool too: userinfo becomes a Basic
+        # Authorization header while the dial target stays the bare host.
+        base = fs.url("blob.bin")
+        cred = base.replace("http://", "http://user:pw@", 1)
+        resp = cli.download(Request(cred, rng=Range(5, 5)))
+        body = resp.body.read()
+        resp.close()
+        assert body == content[5:10]

@@ -421,24 +421,28 @@ class TestFaultInjectionThroughEngine:
 
 class TestTLSServing:
     def test_tls_serves_via_mmap_never_raw_fd(self, tmp_path):
-        """A TLS listener must not sendfile past the record layer: spans
-        go through the mmap path, bodies still byte-exact."""
-        certs = pytest.importorskip("cryptography")  # noqa: F841
-        from dragonfly2_tpu.utils.certs import CertAuthority
+        """A TLS listener must not sendfile past the record layer
+        (unless the kernel takes the write side via kTLS — not the case
+        on this OpenSSL): spans go through the mmap path, bodies still
+        byte-exact, and the fallback reason is counted."""
+        from dragonfly2_tpu.utils import tlsconf
 
+        if not tlsconf.openssl_available():
+            pytest.skip("openssl CLI unavailable for TLS certs")
         content = os.urandom(300_000)
         mgr, pieces = seed_task(tmp_path / "store", content, 100_000)
-        ca = CertAuthority(str(tmp_path / "ca"))
-        server_ctx = ca.server_context("127.0.0.1")
+        ca_cert, ca_key = tlsconf.mint_ca(str(tmp_path / "ca"),
+                                          "df2-ut-ca")
+        cert, key = tlsconf.mint_leaf(str(tmp_path / "ca"), "127.0.0.1",
+                                      ca_cert, ca_key)
+        server_ctx = tlsconf.server_context(cert, key)
         stats = DataPlaneStats()
         server = AsyncUploadServer(mgr, ssl_context=server_ctx,
                                    stats=stats)
         server.start()
         try:
-            client_ctx = ssl.create_default_context()
+            client_ctx = tlsconf.client_context(cafile=ca_cert)
             client_ctx.check_hostname = False
-            client_ctx.load_verify_locations(
-                cadata=ca.ca_pem().decode())
             got = bytearray(len(content))
             raw = socket.create_connection(("127.0.0.1", server.port),
                                            timeout=10)
@@ -463,7 +467,12 @@ class TestTLSServing:
             assert bytes(got) == content
             assert settle(lambda: stats.snapshot()["mmap_bytes"]
                           == len(content))
-            assert stats.snapshot()["sendfile_bytes"] == 0
+            snap = stats.snapshot()
+            assert snap["sendfile_bytes"] == 0
+            assert snap["tls_handshakes"] == 1
+            # No kTLS on this stack: every TLS connection records why it
+            # fell off the zero-copy rung.
+            assert sum(snap["tls_fallbacks"].values()) >= 1
         finally:
             server.stop()
 
