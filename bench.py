@@ -1159,6 +1159,58 @@ def stage_obs(state: BenchState, ctx: dict) -> None:
             report)
 
 
+@stage("qos")
+def stage_qos(state: BenchState, ctx: dict) -> None:
+    """Multi-tenant QoS plane — the ISSUE-17 weighted-fair admission
+    stage (dragonfly2_tpu/client/qosbench.py): a throttled seed serves
+    interactive + bulk + background classed pulls CONCURRENTLY. The
+    mixed rung gates interactive per-task p99 within its documented
+    bound while bulk keeps ≥ 70% of its single-class saturation
+    throughput; the flooding-tenant chaos rung gates that a background
+    flood's 503 sheds land exclusively on the flooder and interactive
+    still holds its (looser) bound (docs/QOS.md). A green run persists
+    to artifacts/bench_state/qos_run_*.json; a budget-skipped stage
+    records an explicit skip artifact, never a silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.client.qosbench import run_qos_stage
+
+    # Budget gate inside the stage (the mlguard lesson): a registry
+    # min_left skip would record nothing.
+    if left() < 45.0 and not ctx.get("single_stage"):
+        state.record(qos_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"qos_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    report = run_qos_stage(seed=0)
+    mixed, flood = report["mixed"], report["flood"]
+    state.record(
+        qos_interactive_p99_s=mixed.get("interactive_p99_s"),
+        qos_interactive_p99_bound_s=mixed.get("interactive_p99_bound_s"),
+        qos_bulk_alone_mb_per_s=mixed.get("bulk_alone_mb_per_s"),
+        qos_bulk_mixed_mb_per_s=mixed.get("bulk_mixed_mb_per_s"),
+        qos_bulk_fraction=mixed.get("bulk_fraction"),
+        qos_upload_admitted_by_class=mixed.get(
+            "upload_admitted_by_class"),
+        qos_flood_interactive_p99_s=flood.get("interactive_p99_s"),
+        qos_flood_shed_by_class=flood.get("upload_shed_by_class"),
+        qos_flood_completed=flood.get("flood_completed"),
+        qos_failures=(mixed.get("failures", [])
+                      + flood.get("failures", []))[:5],
+        qos_verdict_pass=report["verdict_pass"],
+    )
+    state.stage_done("qos")
+    if report["verdict_pass"]:
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"qos_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            report)
+
+
 @stage("fanout", min_left=90.0)
 def stage_fanout(state: BenchState, ctx: dict) -> None:
     """Fleet-scale checkpoint fan-out — the ISSUE-9 dissemination
@@ -1611,7 +1663,11 @@ def check_regression_main(stage_name: str) -> None:
       bounds (disrupted task tail-captured end to end, analyzer blames
       the injected stall, every stats block scrapeable, tracing
       overhead ≤ 1.05× on announce p99 and loopback MB/s —
-      docs/OBSERVABILITY.md)."""
+      docs/OBSERVABILITY.md).
+    - ``qos``: a fresh mixed-workload + flooding-tenant stage must
+      hold its absolute bounds (interactive p99 within bound in both
+      rungs, bulk ≥ 70% of its alone throughput, sheds only on the
+      flooding class — docs/QOS.md)."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.dataplane import (
             check_download_regression,
@@ -1652,11 +1708,15 @@ def check_regression_main(stage_name: str) -> None:
         from dragonfly2_tpu.client.obsbench import check_obs_regression
 
         result = check_obs_regression(STATE_DIR)
+    elif stage_name == "qos":
+        from dragonfly2_tpu.client.qosbench import check_qos_regression
+
+        result = check_qos_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
             "(have: dataplane, chaos, fanout, scheduler, mlguard, "
-            "replay, obs)")
+            "replay, obs, qos)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

@@ -111,6 +111,27 @@ class DaemonConfig:
     upload_tls_key: str = ""
     peer_tls_ca: str = ""
     source_tls_ca: str = ""
+    # Multi-tenant QoS (client/qos.py, docs/QOS.md). qos_class_weights
+    # ("interactive=8,bulk=3,background=1") turns the policy ON: every
+    # admission gate (upload stream gate, download engine, shaper) goes
+    # class-aware weighted-fair. Empty = class-blind daemon, zero
+    # overhead on every gate (the faultplan ACTIVE-is-None discipline).
+    qos_class_weights: str = ""
+    # Per-class admission floors ("interactive=2"): slots bulk backlog
+    # can never occupy. sum(floors) < the gate capacity is the
+    # operator's contract.
+    qos_class_floors: str = ""
+    # Class unlabeled / unknown-labeled work lands on ("" = bulk).
+    qos_default_class: str = ""
+    # Per-class park-queue bound on the upload stream gate (overflow →
+    # 503 shed so a flooding tenant backs off).
+    qos_shed_limit: int = 512
+    # Upload stream gate capacity: concurrently SERVING piece bodies
+    # (0 = default 64 when a policy is on; gate off when class-blind).
+    upload_max_streams: int = 0
+    # Per-class slow-SLO overrides for the tail sampler
+    # ("interactive=2,bulk=30", seconds). Applies on top of trace_slo.
+    qos_class_slos: str = ""
 
 
 class Daemon:
@@ -152,17 +173,40 @@ class Daemon:
             from dragonfly2_tpu.utils import tlsconf
 
             source_tls = tlsconf.client_context(cafile=config.source_tls_ca)
+        from dragonfly2_tpu.client.qos import QosPolicy
+
+        self.qos_policy = QosPolicy.from_specs(
+            weights=config.qos_class_weights,
+            floors=config.qos_class_floors,
+            default_class=config.qos_default_class,
+            shed_limit=config.qos_shed_limit,
+        )
+        if config.qos_class_slos:
+            # Class-tagged slow SLOs: teach the process tail sampler
+            # that an interactive task is "slow" long before the
+            # fleet-wide bound (utils/tracing.TailSampler.slo_for).
+            from dragonfly2_tpu.client.qos import parse_class_map
+            from dragonfly2_tpu.utils import tracing as _tracing
+
+            sampler = getattr(_tracing.default_tracer(), "sampler", None)
+            if sampler is not None:
+                sampler.class_slos.update(parse_class_map(
+                    config.qos_class_slos, what="qos class SLO"))
         self.upload = UploadServer(
             self.storage, host=config.ip, rate_limit_bps=config.upload_rate_bps,
             metrics=self.metrics,
             backlog=config.upload_serve_backlog,
             max_connections=config.upload_max_connections,
+            max_streams=config.upload_max_streams,
+            qos_policy=self.qos_policy,
             workers=config.upload_workers,
             ssl_context=upload_ssl,
             stats=config.dataplane_stats,
         )
         self.shaper: TrafficShaper = new_traffic_shaper(
-            config.traffic_shaper_type, config.total_download_rate_bps
+            config.traffic_shaper_type, config.total_download_rate_bps,
+            class_weights=(self.qos_policy.weights
+                           if self.qos_policy is not None else None),
         )
         if config.download_engine == "async":
             from dragonfly2_tpu.client.download_async import (
@@ -172,6 +216,7 @@ class Daemon:
             self.dl_engine = DownloadLoopEngine(
                 workers=config.dl_workers, stats=config.dataplane_stats,
                 max_streams=config.dl_max_streams,
+                qos_policy=self.qos_policy,
                 peer_tls_context=peer_tls, source_tls_context=source_tls)
         else:
             self.dl_engine = None
@@ -365,7 +410,9 @@ class Daemon:
                       filtered_query_params=None,
                       piece_sink=None, url_range: str = "",
                       priority: int = 0,
-                      disable_back_source: bool = False) -> PeerTaskResult:
+                      disable_back_source: bool = False,
+                      traffic_class: str = "",
+                      tenant: str = "") -> PeerTaskResult:
         # dfget --range a-b (cmd/dfget/cmd/root.go:195): the ranged
         # window is its own task — the range participates in the task id
         # (idgen task_id.go range append), so distinct ranges never share
@@ -399,7 +446,9 @@ class Daemon:
             if self.config.host_type.is_seed
             else idgen.peer_id_v1(self.config.ip)
         ) + "-" + uuid.uuid4().hex[:8]
-        self.shaper.add_task(task_id)
+        if self.qos_policy is not None:
+            traffic_class = self.qos_policy.normalize(traffic_class)
+        self.shaper.add_task(task_id, traffic_class=traffic_class)
         self.metrics.download_task_count.inc()
         self.metrics.concurrent_tasks.inc()
         options = self.config.task_options
@@ -419,6 +468,8 @@ class Daemon:
                 recovery_stats=self.config.recovery_stats,
                 dataplane_stats=self.config.dataplane_stats,
                 engine=self.dl_engine,
+                traffic_class=traffic_class,
+                tenant=tenant,
             )
             with self._conductors_lock:
                 self._conductors[peer_id] = conductor
@@ -571,6 +622,14 @@ class SeedPeerDaemonClient:
                 + "-" + uuid.uuid4().hex[:8]
             )
             seed_range = getattr(task, "url_range", "") or ""
+            # Preheat/seed warm-up is scavenger traffic by definition:
+            # with a QoS policy on, it rides the background class so a
+            # fleet-wide preheat never contends with interactive pulls.
+            seed_class = ""
+            if daemon.qos_policy is not None:
+                from dragonfly2_tpu.client.qos import CLASS_BACKGROUND
+
+                seed_class = daemon.qos_policy.normalize(CLASS_BACKGROUND)
             conductor = PeerTaskConductor(
                 daemon.scheduler, daemon.storage,
                 host_id=daemon.host_id, task_id=task.id, peer_id=peer_id,
@@ -582,6 +641,7 @@ class SeedPeerDaemonClient:
                 recovery_stats=daemon.config.recovery_stats,
                 dataplane_stats=daemon.config.dataplane_stats,
                 engine=daemon.dl_engine,
+                traffic_class=seed_class,
             )
             # Seeds go straight to source (StartSeedTask → back-source);
             # register first so the peer exists in the scheduler's DAG.
@@ -593,6 +653,7 @@ class SeedPeerDaemonClient:
                     peer_id=peer_id, url=task.url,
                     request_header=dict(task.request_header),
                     url_range=seed_range,
+                    traffic_class=seed_class,
                 ),
                 channel=conductor.channel,
             )
@@ -605,7 +666,7 @@ class SeedPeerDaemonClient:
             # Register with the shaper like download_file does — otherwise
             # SamplingTrafficShaper.wait_n is a no-op for the unknown task
             # and seed warm-up traffic (preheat fan-out) runs unthrottled.
-            daemon.shaper.add_task(task.id)
+            daemon.shaper.add_task(task.id, traffic_class=seed_class)
             try:
                 result = conductor._run_back_to_source(report=True)
             finally:

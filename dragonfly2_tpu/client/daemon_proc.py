@@ -6,10 +6,14 @@ upload server + peer engine over ``BalancedSchedulerClient``), prints
 one ``DAEMON <host_id> <upload_addr>`` line on stdout, then serves a
 tiny line protocol on stdin:
 
-- ``DOWNLOAD <url>`` — start the download on a worker thread; every
-  verified piece landing prints ``PROGRESS <url> <cumulative_bytes>``
-  (the kill supervisor's mid-download trigger), and completion prints
-  ``RESULT <json>`` carrying success/md5/fresh-vs-resumed accounting.
+- ``DOWNLOAD <url> [class [tenant]]`` — start the download on a worker
+  thread; every verified piece landing prints
+  ``PROGRESS <url> <cumulative_bytes>`` (the kill supervisor's
+  mid-download trigger), and completion prints ``RESULT <json>``
+  carrying success/md5/fresh-vs-resumed accounting. The optional
+  trailing tokens tag the task with a QoS traffic class + tenant
+  (docs/QOS.md) — the qos bench's mixed-workload fleets issue classed
+  pulls through the same protocol the chaos plane uses.
 - ``STATS`` — prints ``STATS <json>`` of the process-wide recovery
   counters (reload verify/drop, orphan sweep, resume, re-announce).
 - ``EXIT`` — graceful ``daemon.stop()`` (persists every journal), then
@@ -87,6 +91,22 @@ def main(argv=None) -> int:
                         help="daemon-wide cap on concurrently streaming "
                              "piece/source-run bodies (0 = engine "
                              "default)")
+    # QoS plane (docs/QOS.md): blank weights = class-blind daemon, the
+    # zero-overhead default every existing rung keeps.
+    parser.add_argument("--qos-class-weights", default="",
+                        help="class=weight,... enabling weighted-fair "
+                             "admission (blank = class-blind)")
+    parser.add_argument("--qos-class-floors", default="",
+                        help="class=min_inservice,... reserved slots")
+    parser.add_argument("--qos-default-class", default="",
+                        help="class assigned to untagged work")
+    parser.add_argument("--qos-shed-limit", type=int, default=512,
+                        help="per-class parked-queue bound before 503 "
+                             "sheds")
+    parser.add_argument("--max-streams", type=int, default=0,
+                        help="upload-side concurrent response-stream cap "
+                             "(0 = QoS default when weights set, else "
+                             "uncapped)")
     parser.add_argument("--serve-rpc", action="store_true",
                         help="also serve the daemon gRPC surface "
                              "(ObtainSeeds for preheat triggers); the "
@@ -144,6 +164,11 @@ def main(argv=None) -> int:
         download_engine=args.dl_engine,
         dl_workers=args.dl_workers,
         dl_max_streams=args.dl_max_streams,
+        qos_class_weights=args.qos_class_weights,
+        qos_class_floors=args.qos_class_floors,
+        qos_default_class=args.qos_default_class,
+        qos_shed_limit=args.qos_shed_limit,
+        upload_max_streams=args.max_streams,
     ))
     daemon.start()
     rpc = None
@@ -168,7 +193,8 @@ def main(argv=None) -> int:
 
         start_metrics_server(args)
 
-    def run_download(url: str) -> None:
+    def run_download(url: str, traffic_class: str = "",
+                     tenant: str = "") -> None:
         fresh = {"bytes": 0, "pieces": 0}
 
         def sink(store, piece) -> None:
@@ -181,7 +207,9 @@ def main(argv=None) -> int:
                    "resumed_pieces": 0, "resumed_bytes": 0,
                    "content_length": -1}
         try:
-            result = daemon.download_file(url, piece_sink=sink)
+            result = daemon.download_file(url, piece_sink=sink,
+                                          traffic_class=traffic_class,
+                                          tenant=tenant)
             digest = hashlib.md5()
             if result.success:
                 for chunk in (result.storage.iter_content()
@@ -207,7 +235,13 @@ def main(argv=None) -> int:
             continue
         cmd, _, rest = line.partition(" ")
         if cmd == "DOWNLOAD" and rest:
-            threading.Thread(target=run_download, args=(rest,),
+            # "url [class [tenant]]" — bare url stays the class-blind
+            # chaos-plane form; URLs here never contain spaces.
+            parts = rest.split()
+            url = parts[0]
+            klass = parts[1] if len(parts) > 1 else ""
+            tenant = parts[2] if len(parts) > 2 else ""
+            threading.Thread(target=run_download, args=(url, klass, tenant),
                              name="proc-download", daemon=True).start()
         elif cmd == "STATS":
             from dragonfly2_tpu.client.dataplane import STATS as DP_STATS

@@ -69,6 +69,7 @@ import errno
 import fcntl
 import hashlib
 import heapq
+import math
 import logging
 import os
 import queue
@@ -509,12 +510,69 @@ class _DlLoop(threading.Thread):
         the per-dispatch FAIR_BUDGET bounds how much one socket consumes,
         and the rotating task order bounds how long one hot task (many
         ready sockets) can hold the loop before a cold task's socket is
-        served."""
+        served.  With a QoS policy active the grouping is class-major
+        DRR first (each class drains up to its integer weight per cycle),
+        then the same per-task rotation within the class."""
         if not ready:
             return
         if len(ready) == 1:
             self._safe_dispatch(*ready[0])
             return
+        policy = self.engine.qos_policy if self.engine is not None else None
+        if policy is not None:
+            by_class: "collections.OrderedDict[str, list]" = \
+                collections.OrderedDict()
+            for op, mask in ready:
+                by_class.setdefault(op.qos_class or policy.default_class,
+                                    []).append((op, mask))
+            if len(by_class) > 1:
+                self._dispatch_class_major(policy, by_class)
+                return
+        self._dispatch_task_fair(ready)
+
+    def _dispatch_class_major(self, policy, by_class) -> None:
+        """Deficit-round-robin over classes: per cycle, class *c* may
+        dispatch up to ceil(weight_c) of its ready sockets, rotating
+        over its tasks, so a bulk flood of ready connections cannot
+        monopolise the loop ahead of a lone interactive socket."""
+        self.fair_interleaves += 1
+        queues: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        quanta: Dict[str, int] = {}
+        for klass, items in by_class.items():
+            by_task: "collections.OrderedDict[str, list]" = \
+                collections.OrderedDict()
+            for op, mask in items:
+                by_task.setdefault(op.task_id, []).append((op, mask))
+            keys = list(by_task)
+            if len(keys) > 1:
+                off = self._rr % len(keys)
+                self._rr += 1
+                keys = keys[off:] + keys[:off]
+            flat: list = []
+            cursors = [by_task[k] for k in keys]
+            while cursors:
+                still = []
+                for queue in cursors:
+                    flat.append(queue.pop(0))
+                    if queue:
+                        still.append(queue)
+                cursors = still
+            queues[klass] = flat
+            quanta[klass] = max(1, int(math.ceil(policy.weight(klass))))
+        # Heaviest class first inside each cycle, then round the cycle
+        # until every queue is dry.
+        order = sorted(queues, key=lambda c: (-policy.weight(c), c))
+        while any(queues.values()):
+            for klass in order:
+                queue = queues[klass]
+                for _ in range(quanta[klass]):
+                    if not queue:
+                        break
+                    op, mask = queue.pop(0)
+                    self._safe_dispatch(op, mask)
+
+    def _dispatch_task_fair(self, ready: List[Tuple["_LoopOp", int]]) -> None:
         by_task: "collections.OrderedDict[str, list]" = \
             collections.OrderedDict()
         for op, mask in ready:
@@ -556,7 +614,8 @@ class DownloadLoopEngine:
                  pool_per_host: int = 4, pool_idle_ttl: float = 60.0,
                  pool_max_total: int = 512,
                  peer_tls_context: Optional[ssl.SSLContext] = None,
-                 source_tls_context: Optional[ssl.SSLContext] = None):
+                 source_tls_context: Optional[ssl.SSLContext] = None,
+                 qos_policy=None, qos_stats=None):
         self.worker_count = workers if workers > 0 else DEFAULT_DL_WORKERS
         #: Client context for TLS parents/peers (piece fetch + metadata
         #: sync). None → plaintext peers, the default mesh transport.
@@ -579,6 +638,30 @@ class DownloadLoopEngine:
         self._inflight_streams = 0
         self._waitq: collections.deque = collections.deque()
         self.admission_queued_peak = 0
+        # Multi-tenant QoS (client/qos.py, docs/QOS.md). Policy None =
+        # class-blind: admission keeps the single-FIFO path above and
+        # dispatch keeps the per-task WRR — the zero-overhead default.
+        # With a policy, gated ops park in per-class deques dequeued by
+        # smooth-WRR with per-class floors (class-major DRR), and the
+        # loop dispatcher interleaves class-major before per-task.
+        self.qos_policy = qos_policy
+        if qos_policy is not None:
+            from dragonfly2_tpu.client import qos as qos_mod
+
+            self._classq = qos_mod.ClassQueues(qos_policy)
+            self._inservice: Dict[str, int] = {}
+            self.qos_stats = (qos_stats if qos_stats is not None
+                              else qos_mod.QOS)
+        else:
+            self._classq = None
+            self._inservice = {}
+            self.qos_stats = qos_stats
+        # Queued-wait ring (park → admission): the number the admission
+        # gate actually bounds — queued_peak alone says how DEEP the
+        # queue got, not how LONG anyone waited in it.
+        from dragonfly2_tpu.client.qos import LatencyRing
+
+        self._admission_wait_ms = LatencyRing(2048)
         # Off-loop control-plane runner: blocking scheduler RPCs that
         # completions would otherwise issue ON a loop thread (piece-
         # failure reports, count-triggered report-batch flushes, syncer
@@ -629,6 +712,8 @@ class DownloadLoopEngine:
             loops, self._loops = self._loops, []
             queued = list(self._waitq)
             self._waitq.clear()
+            if self._classq is not None:
+                queued.extend(self._classq.drain())
             ctl, self._ctl_thread = self._ctl_thread, None
         if ctl is not None:
             # Drain-then-exit: queued control reports still deliver.
@@ -678,11 +763,34 @@ class DownloadLoopEngine:
             if not self._loops or self._stop.is_set():
                 raise RuntimeError("download engine not running")
             if op.gated:
-                if self._inflight_streams >= self.max_streams:
-                    self._waitq.append(op)
-                    self.admission_queued_peak = max(
-                        self.admission_queued_peak, len(self._waitq))
-                    return op
+                if self._classq is None:
+                    # Class-blind default: the historical single FIFO.
+                    if self._inflight_streams >= self.max_streams:
+                        op._parked_at = time.monotonic()
+                        self._waitq.append(op)
+                        self.admission_queued_peak = max(
+                            self.admission_queued_peak, len(self._waitq))
+                        return op
+                else:
+                    klass = self.qos_policy.normalize(op.qos_class)
+                    op.qos_class = klass
+                    # Park when the gate is full, when the class already
+                    # has a backlog (FIFO within a class — admitting
+                    # around it would reorder one tenant's streams), or
+                    # when free capacity is reserved for another class's
+                    # unmet floor.
+                    if (self._inflight_streams >= self.max_streams
+                            or self._classq.backlog(klass)
+                            or not self._classq.headroom(
+                                klass, self._inservice, self.max_streams)):
+                        op._parked_at = time.monotonic()
+                        self._classq.push(klass, op)
+                        self.qos_stats.admission("download", klass, "parked")
+                        self.admission_queued_peak = max(
+                            self.admission_queued_peak, len(self._classq))
+                        return op
+                    self._inservice[klass] = self._inservice.get(klass, 0) + 1
+                    self.qos_stats.admission("download", klass, "admitted")
                 self._inflight_streams += 1
                 op._admitted = True
             loop = min(self._loops, key=lambda l: len(l.ops))
@@ -699,17 +807,53 @@ class DownloadLoopEngine:
         with self._lock:
             op._admitted = False
             self._inflight_streams -= 1
-            while self._waitq:
-                cand = self._waitq.popleft()
-                if cand._finished:
-                    continue
-                nxt = cand
-                break
+            if self._classq is not None:
+                klass = op.qos_class
+                left = self._inservice.get(klass, 0) - 1
+                if left > 0:
+                    self._inservice[klass] = left
+                else:
+                    self._inservice.pop(klass, None)
+                # Class-major DRR dequeue: floor-deficit classes first,
+                # then the smooth-WRR rotation over classes with
+                # headroom (ClassQueues.pick).
+                while True:
+                    picked = self._classq.pick(self._inservice,
+                                               self.max_streams)
+                    if picked is None:
+                        break
+                    pk, cand = picked
+                    if cand._finished:
+                        continue
+                    nxt = cand
+                    self._inservice[pk] = self._inservice.get(pk, 0) + 1
+                    break
+            else:
+                while self._waitq:
+                    cand = self._waitq.popleft()
+                    if cand._finished:
+                        continue
+                    nxt = cand
+                    break
             if nxt is not None:
+                if nxt._parked_at:
+                    wait_ms = (time.monotonic() - nxt._parked_at) * 1e3
+                    self._admission_wait_ms.add(wait_ms)
+                    if self.qos_stats is not None:
+                        self.qos_stats.observe_wait(
+                            "download", nxt.qos_class, wait_ms)
+                        self.qos_stats.admission(
+                            "download", nxt.qos_class, "admitted")
                 if self._loops and not self._stop.is_set():
                     self._inflight_streams += 1
                     nxt._admitted = True
                     loop = min(self._loops, key=lambda l: len(l.ops))
+                elif self._classq is not None:
+                    left = self._inservice.get(nxt.qos_class, 0) - 1
+                    if left > 0:
+                        self._inservice[nxt.qos_class] = left
+                    else:
+                        self._inservice.pop(nxt.qos_class, None)
         if nxt is None:
             return
         if loop is None:
@@ -721,18 +865,34 @@ class DownloadLoopEngine:
         """Remove a still-queued op from the admission queue (True if it
         was there — the caller then completes it as cancelled)."""
         with self._lock:
+            if self._classq is not None:
+                return self._classq.remove(op.qos_class, op)
             try:
                 self._waitq.remove(op)
             except ValueError:
                 return False
         return True
 
-    def stream_admission(self) -> Dict[str, int]:
+    def stream_admission(self) -> Dict[str, object]:
         with self._lock:
-            return {"inflight": self._inflight_streams,
-                    "queued": len(self._waitq),
-                    "queued_peak": self.admission_queued_peak,
-                    "max_streams": self.max_streams}
+            queued = (len(self._classq) if self._classq is not None
+                      else len(self._waitq))
+            wait_p50, wait_p99 = self._admission_wait_ms.percentiles()
+            out: Dict[str, object] = {
+                "inflight": self._inflight_streams,
+                "queued": queued,
+                "queued_peak": self.admission_queued_peak,
+                "max_streams": self.max_streams,
+                # Park → admission latency of queued streams — the
+                # number the admission gate actually bounds.
+                "queued_wait_ms_p50": round(wait_p50, 3),
+                "queued_wait_ms_p99": round(wait_p99, 3),
+                "queued_waits": self._admission_wait_ms.count,
+            }
+            if self._classq is not None:
+                out["inflight_by_class"] = dict(self._inservice)
+                out["queued_by_class"] = self._classq.counts()
+            return out
 
     def call_later(self, delay: float, fn: Callable[[], None]) -> None:
         """Thread-safe delayed callable on one of the loops (round-robin)
@@ -767,6 +927,11 @@ class _LoopOp:
         self._done_evt = threading.Event()
         self._finished = False
         self._admitted = False
+        # Traffic class (client/qos.py): the conductor stamps gated ops
+        # so class-aware engines group admission and dispatch by class.
+        # "" = class-blind (the zero-overhead default).
+        self.qos_class = ""
+        self._parked_at = 0.0
 
     # -- thread-compatible surface ----------------------------------------
 
@@ -1633,6 +1798,9 @@ class PieceFetchOp(_HttpOp):
         #: ``native.md5_file_range``). The daemon's piece path always
         #: verifies inline.
         self.verify_body = verify_body
+        #: Stamped by the conductor when a traffic class is active so
+        #: the serving peer's upload gate can classify this stream.
+        self.qos_tenant = ""
         self._fd = -1
         self._offset = req.piece.offset
         self._md5 = hashlib.md5() if verify_body else None
@@ -1659,9 +1827,15 @@ class PieceFetchOp(_HttpOp):
     def _request_bytes(self) -> bytes:
         piece = self.req.piece
         path = piece_request_path(self.req.task_id, self.req.dst_peer_id)
+        extra = ""
+        if self.qos_class:
+            from dragonfly2_tpu.client import qos as qos_mod
+            extra = qos_mod.class_request_headers(self.qos_class,
+                                                  self.qos_tenant)
         return (f"GET {path} HTTP/1.1\r\n"
                 f"Host: {self.addr}\r\n"
                 f"Range: {piece.range.http_header()}\r\n"
+                f"{extra}"
                 f"Connection: keep-alive\r\n\r\n").encode()
 
     def _on_head(self) -> bool:

@@ -602,6 +602,8 @@ class PeerTaskConductor:
         dataplane_stats=None,
         recovery_stats=None,
         engine=None,
+        traffic_class: str = "",
+        tenant: str = "",
     ):
         self.scheduler = scheduler
         self.storage_manager = storage
@@ -617,6 +619,11 @@ class PeerTaskConductor:
         # (service.py register_peer: LEVEL1/2 reject, LEVEL3 self
         # back-source, others warm a seed).
         self.priority = priority
+        # QoS identity (client/qos.py): rides register_peer to the
+        # scheduler, tags piece GETs so parents classify this stream,
+        # and scopes the task-latency SLO. "" = class-blind.
+        self.traffic_class = traffic_class
+        self.tenant = tenant
         self.shaper = shaper or PlainTrafficShaper()
         self.opts = options or PeerTaskOptions()
         self.is_seed = is_seed
@@ -742,6 +749,20 @@ class PeerTaskConductor:
     # -- public entry ------------------------------------------------------
 
     def run(self) -> PeerTaskResult:
+        if not self.traffic_class:
+            return self._run_with_trace()
+        # Class-tagged task latency: the per-class p50/p99 the qos bench
+        # gates on and /metrics exports (df2_qos_task_ms_p99_<class>).
+        begin = time.monotonic()
+        try:
+            return self._run_with_trace()
+        finally:
+            from dragonfly2_tpu.client.qos import QOS
+
+            QOS.task_done(self.traffic_class,
+                          (time.monotonic() - begin) * 1e3)
+
+    def _run_with_trace(self) -> PeerTaskResult:
         # The conductor's task-level span (peertask_conductor.go:255
         # SpanRegisterTask): child rpc.client spans hang off it, so one
         # trace covers register → schedule → pieces → finish. At task
@@ -787,7 +808,8 @@ class PeerTaskConductor:
         if self._degraded_reason:
             return "degraded_to_source"
         sampler = getattr(tracer, "sampler", None)
-        if sampler is not None and elapsed > sampler.slow_slo_s:
+        if sampler is not None and elapsed > sampler.slo_for(
+                self.traffic_class):
             return "slow"
         return ""
 
@@ -801,6 +823,8 @@ class PeerTaskConductor:
                 url_range=(f"{self.url_range.start}-{self.url_range.end}"
                            if self.url_range else ""),
                 priority=self.priority,
+                traffic_class=self.traffic_class,
+                tenant=self.tenant,
             )
             try:
                 with tracing.default_tracer().span("peer_task.register",
@@ -1349,6 +1373,9 @@ class PeerTaskConductor:
             tls=self.engine.peer_tls_context,
             chunk_hook=self.downloader.chunk_hook,
         )
+        if self.traffic_class:
+            op.qos_class = self.traffic_class
+            op.qos_tenant = self.tenant
         holder["op"] = op
         with self._async_lock:
             self._async_ops.add(op)
@@ -2399,6 +2426,9 @@ class PeerTaskConductor:
             tls=target["tls"], server_hostname=target["server_hostname"],
             tunnel=target["tunnel"], tunnel_auth=target["tunnel_auth"],
         )
+        if self.traffic_class:
+            # Class the engine's admission/dispatch; no header to origin.
+            op.qos_class = self.traffic_class
         with self._async_lock:
             self._async_ops.add(op)
         self.engine.submit(op)

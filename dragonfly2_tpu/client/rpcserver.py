@@ -54,6 +54,10 @@ class DownloadRequest:
     # dfget --disable-back-source per-request override.
     priority: int = 0
     disable_back_source: bool = False
+    # QoS identity (docs/QOS.md): traffic class + tenant ride the daemon
+    # API into registration metadata; blank = class-blind.
+    traffic_class: str = ""
+    tenant: str = ""
 
 
 @message("dfdaemon.DownloadProgress")
@@ -219,6 +223,8 @@ class DaemonRpcService:
             url_range=request.url_range,
             priority=request.priority,
             disable_back_source=request.disable_back_source,
+            traffic_class=request.traffic_class,
+            tenant=request.tenant,
         )
         if not result.success:
             yield DownloadProgress(
@@ -376,6 +382,7 @@ class RemoteDaemonClient:
                  filtered_query_params=None, request_header=None,
                  url_range: str = "", priority: int = 0,
                  disable_back_source: bool = False,
+                 traffic_class: str = "", tenant: str = "",
                  timeout: float = 600.0) -> RemoteDownloadResult:
         stream = self._client.Download(DownloadRequest(
             url=url, tag=tag, application=application,
@@ -385,6 +392,8 @@ class RemoteDaemonClient:
             url_range=url_range,
             priority=priority,
             disable_back_source=disable_back_source,
+            traffic_class=traffic_class,
+            tenant=tenant,
         ), timeout=timeout)
         result = RemoteDownloadResult()
         out = open(output_path, "wb") if output_path else None

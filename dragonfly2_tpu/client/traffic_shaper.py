@@ -27,7 +27,13 @@ class TrafficShaper:
 
     def start(self) -> None: ...
     def stop(self) -> None: ...
-    def add_task(self, task_id: str, content_length: int = -1) -> None: ...
+
+    def add_task(self, task_id: str, content_length: int = -1,
+                 traffic_class: str = "") -> None:
+        """Register a task; ``traffic_class`` scopes its share under the
+        hierarchical (class-weighted) allocation when the shaper has
+        class weights configured, and is ignored otherwise."""
+
     def remove_task(self, task_id: str) -> None: ...
     def record(self, task_id: str, n: int) -> None:
         """Account ``n`` bytes downloaded for the task."""
@@ -70,7 +76,8 @@ class PlainTrafficShaper(TrafficShaper):
     def stop(self) -> None:
         pass
 
-    def add_task(self, task_id: str, content_length: int = -1) -> None:
+    def add_task(self, task_id: str, content_length: int = -1,
+                 traffic_class: str = "") -> None:
         pass
 
     def remove_task(self, task_id: str) -> None:
@@ -95,6 +102,7 @@ class _TaskEntry:
     used: int = 0           # bytes since last sample
     needed: int = 0         # bytes requested since last sample
     content_length: int = -1
+    traffic_class: str = ""  # QoS class scoping this task's share
     created_at: float = field(default_factory=time.time)
 
 
@@ -124,10 +132,19 @@ class SamplingTrafficShaper(TrafficShaper):
 
     def __init__(self, total_rate_bps: float, interval: float = 1.0,
                  clock: Callable[[], float] = time.monotonic,
-                 shards: int = 8):
+                 shards: int = 8, class_weights: Optional[Dict[str, float]]
+                 = None, qos_stats=None):
         self.total_rate = float(total_rate_bps)
         self.interval = interval
         self._clock = clock
+        #: Hierarchical mode (docs/QOS.md): class weight splits the link
+        #: first, demand-proportional shares within the class, and a
+        #: class's unused budget is redistributed to over-demand classes.
+        #: None = the historical flat demand-proportional allocation.
+        self.class_weights = dict(class_weights) if class_weights else None
+        if qos_stats is None and self.class_weights is not None:
+            from dragonfly2_tpu.client.qos import QOS as qos_stats
+        self.qos_stats = qos_stats
         self._shards: Tuple[_ShaperShard, ...] = tuple(
             _ShaperShard() for _ in range(max(shards, 1)))
         # Serializes task ADMISSION only (rare — once per task): two
@@ -175,7 +192,8 @@ class SamplingTrafficShaper(TrafficShaper):
         while not self._stop.wait(self.interval):
             self.update_limits()
 
-    def add_task(self, task_id: str, content_length: int = -1) -> None:
+    def add_task(self, task_id: str, content_length: int = -1,
+                 traffic_class: str = "") -> None:
         # A new task starts with an equal share of the total rate
         # (traffic_shaper.go AddTask: totalRateLimit / (nTasks+1)).
         # Lock order: admission → shard (shard locks stay leaves).
@@ -187,6 +205,7 @@ class SamplingTrafficShaper(TrafficShaper):
                 shard.tasks[task_id] = _TaskEntry(
                     limiter=Limiter(share, burst=int(share)),
                     content_length=content_length,
+                    traffic_class=traffic_class,
                 )
 
     def remove_task(self, task_id: str) -> None:
@@ -200,6 +219,11 @@ class SamplingTrafficShaper(TrafficShaper):
             entry = shard.tasks.get(task_id)
             if entry is not None:
                 entry.used += n
+                klass = entry.traffic_class
+            else:
+                klass = ""
+        if klass and self.qos_stats is not None:
+            self.qos_stats.shaper_grant(klass, n)
 
     def wait_n(self, task_id: str, n: int) -> None:
         shard = self._shard(task_id)
@@ -247,18 +271,68 @@ class SamplingTrafficShaper(TrafficShaper):
                     entry.needed = 0
         if not staged:
             return
+        if self.class_weights is not None:
+            self._update_limits_hierarchical(staged)
+            return
+        self._apply_shares(staged, self.total_rate)
+
+    def _apply_shares(self, staged: List[Tuple[_TaskEntry, int]],
+                      budget: float) -> None:
+        """Demand-proportional split of ``budget`` over ``staged`` with
+        the per-task one-piece/sec floor — the original flat allocation,
+        reused per class by the hierarchical path."""
         total_demand = sum(d for _, d in staged)
         for entry, demand in staged:
             if total_demand > 0:
-                share = self.total_rate * (demand / total_demand)
+                share = budget * (demand / total_demand)
             else:
-                share = self.total_rate / len(staged)
+                share = budget / len(staged)
             share = min(max(share, DEFAULT_PIECE_SIZE), self.total_rate)
             entry.limiter.set_rate(share, burst=int(share))
 
+    def _update_limits_hierarchical(
+            self, staged: List[Tuple[_TaskEntry, int]]) -> None:
+        """Class-weighted link split: each PRESENT class gets
+        ``total_rate * w_c / W``; a class that demands less than its
+        budget donates the surplus, redistributed to over-demand classes
+        proportional to their unmet demand (single water-fill pass).
+        Within a class the flat demand-proportional math applies
+        unchanged, so one bulk tenant can saturate only bulk's share."""
+        by_class: Dict[str, List[Tuple[_TaskEntry, int]]] = {}
+        for entry, demand in staged:
+            by_class.setdefault(entry.traffic_class, []).append(
+                (entry, demand))
+        weight_total = sum(
+            self.class_weights.get(c, 1.0) for c in by_class)
+        budget: Dict[str, float] = {}
+        demand_eff: Dict[str, float] = {}
+        for klass, items in by_class.items():
+            budget[klass] = (self.total_rate
+                             * self.class_weights.get(klass, 1.0)
+                             / weight_total)
+            # Effective demand never reads below the per-task floor the
+            # flat math guarantees — idle classes still donate the rest.
+            demand_eff[klass] = max(
+                float(sum(d for _, d in items)),
+                len(items) * float(DEFAULT_PIECE_SIZE))
+        alloc = {c: min(budget[c], demand_eff[c]) for c in by_class}
+        surplus = self.total_rate - sum(alloc.values())
+        unmet = {c: max(0.0, demand_eff[c] - budget[c]) for c in by_class}
+        unmet_total = sum(unmet.values())
+        if surplus > 0 and unmet_total > 0:
+            for klass in by_class:
+                alloc[klass] += surplus * unmet[klass] / unmet_total
+        for klass, items in by_class.items():
+            self._apply_shares(items, alloc[klass])
+            if self.qos_stats is not None and klass:
+                self.qos_stats.shaper_rate(klass, alloc[klass])
 
-def new_traffic_shaper(kind: str, total_rate_bps: float = INF) -> TrafficShaper:
+
+def new_traffic_shaper(kind: str, total_rate_bps: float = INF,
+                       class_weights: Optional[Dict[str, float]] = None,
+                       ) -> TrafficShaper:
     """(traffic_shaper.go:36-54 NewTrafficShaper)"""
     if kind == TYPE_SAMPLING and total_rate_bps != INF:
-        return SamplingTrafficShaper(total_rate_bps)
+        return SamplingTrafficShaper(total_rate_bps,
+                                     class_weights=class_weights)
     return PlainTrafficShaper(total_rate_bps)

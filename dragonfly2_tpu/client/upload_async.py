@@ -36,6 +36,19 @@ read-bytes path).
 Admission: ``max_connections`` bounds concurrently open connections
 (beyond it, new arrivals get a best-effort 503 and are closed) and
 ``backlog`` is handed to ``listen(2)``.
+
+Stream admission (QoS): ``max_streams`` bounds concurrently SERVING
+piece bodies — a request-time gate, distinct from the accept-time
+connection cap, because the traffic class is only known once the
+request head (``X-Df2-Class``) is parsed. Past the bound a piece
+request PARKS (the connection stays read-interested so a vanishing
+peer is detected) until a serving stream finishes; with a
+:class:`~dragonfly2_tpu.client.qos.QosPolicy` the parked queues are
+per-class and drained weighted-fair with per-class floors, and a class
+whose park queue exceeds the policy's shed limit gets a 503
+(``X-Df2-Shed``) so a flooding tenant backs off instead of growing an
+unbounded queue. Class-blind daemons keep a plain FIFO (or no gate at
+all when ``max_streams`` is 0 — the zero-overhead default).
 """
 
 from __future__ import annotations
@@ -54,6 +67,7 @@ import time
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
+from dragonfly2_tpu.client import qos as qos_mod
 from dragonfly2_tpu.client.piece import parse_http_range
 from dragonfly2_tpu.client.storage import StorageError, StorageManager
 from dragonfly2_tpu.utils.ratelimit import INF, Limiter
@@ -85,6 +99,12 @@ _HANDSHAKE = "handshake"
 _READ = "read"
 _WRITE = "write"
 _DELAY = "delay"
+_PARKED = "parked"  # stream-admission gate: waiting for a serving slot
+
+#: Stream cap applied when a QoS policy is configured without an
+#: explicit ``max_streams`` — admission must be finite for weighted-
+#: fair dequeue to mean anything.
+DEFAULT_QOS_MAX_STREAMS = 64
 
 # Body kinds (also the stats split).
 KIND_NATIVE = "native"
@@ -104,6 +124,7 @@ class _Conn:
         "head", "head_off", "kind", "data", "data_off", "mm", "in_fd",
         "file_off", "remaining", "keep_alive", "resume_at", "count_piece",
         "reserved", "write_wants_read", "dispatching", "pump", "closed",
+        "owner", "qos_class", "admitted_stream", "park_args", "park_at",
     )
 
     def __init__(self, sock, addr, tls: bool):
@@ -120,6 +141,11 @@ class _Conn:
         self.dispatching = False  # trampoline guard (see _try_dispatch)
         self.pump = False
         self.closed = False
+        self.owner = None             # the _Worker whose loop runs this conn
+        self.qos_class = ""           # from X-Df2-Class, per request
+        self.admitted_stream = False  # holds one max_streams slot
+        self.park_args = None         # (task_id, peer_id, rng) while parked
+        self.park_at = 0.0
         self._reset_response()
 
     def _reset_response(self) -> None:
@@ -152,13 +178,22 @@ class _Worker(threading.Thread):
         self.server = server
         self.selector = selectors.DefaultSelector()
         self.inbox: collections.deque = collections.deque()
+        self.calls: collections.deque = collections.deque()
         self.delayed: set = set()
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)
 
     def assign(self, conn: _Conn) -> None:
+        conn.owner = self
         self.inbox.append(conn)
+        self.wake()
+
+    def call(self, fn) -> None:
+        """Run ``fn()`` on this worker's loop — how another worker's
+        stream-slot release resumes a connection parked here (all conn
+        state is owned by exactly one loop)."""
+        self.calls.append(fn)
         self.wake()
 
     def wake(self) -> None:
@@ -193,12 +228,14 @@ class _Worker(threading.Thread):
                     self._dispatch(key.data, mask)
                 self._admit()
                 self._resume_delayed()
+                self._run_calls()
         finally:
             for key in list(self.selector.get_map().values()):
                 if key.data is not None:
                     srv._close(self, key.data)
             while self.inbox:  # assigned but never registered
                 srv._discard(self.inbox.popleft())
+            self.calls.clear()
             self.selector.close()
             self._wake_r.close()
             self._wake_w.close()
@@ -210,6 +247,14 @@ class _Worker(threading.Thread):
                 self.selector.register(conn.sock, selectors.EVENT_READ, conn)
             except (ValueError, OSError):
                 self.server._discard(conn)
+
+    def _run_calls(self) -> None:
+        while self.calls:
+            fn = self.calls.popleft()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one bad resume ≠ dead loop
+                logger.exception("upload-loop call failed")
 
     def _resume_delayed(self) -> None:
         if not self.delayed:
@@ -242,7 +287,7 @@ class _Worker(threading.Thread):
                     srv._continue_write(self, conn)
                 elif mask & selectors.EVENT_READ:
                     srv._on_readable(self, conn)
-            else:  # _READ or _DELAY: inbound data (or peer close)
+            else:  # _READ, _DELAY or _PARKED: inbound data (or peer close)
                 srv._on_readable(self, conn)
         except Exception:  # noqa: BLE001 — one bad conn must not kill the loop
             logger.debug("upload conn %s died", conn.addr, exc_info=True)
@@ -266,6 +311,7 @@ class AsyncUploadServer:
                  port: int = 0, rate_limit_bps: float = INF, metrics=None,
                  sendfile: bool = True, *, workers: int = 0,
                  backlog: int = 128, max_connections: int = 0,
+                 max_streams: int = 0, qos_policy=None, qos_stats=None,
                  serve_path: str = "auto", ssl_context=None, stats=None):
         self.storage = storage
         self.metrics = metrics
@@ -277,6 +323,22 @@ class AsyncUploadServer:
         if stats is None:
             from dragonfly2_tpu.client.dataplane import STATS as stats
         self.stats = stats
+        # -- stream-admission gate (request-time QoS) ----------------------
+        self.qos_policy = qos_policy
+        if qos_policy is not None and max_streams <= 0:
+            max_streams = DEFAULT_QOS_MAX_STREAMS
+        self.max_streams = max_streams
+        self.qos_stats = (qos_stats or qos_mod.QOS) if qos_policy is not None \
+            else qos_stats
+        self._adm_lock = threading.Lock()
+        self._streams = 0
+        self._streams_by_class: Dict[str, int] = {}
+        self._stream_parkq = (qos_mod.ClassQueues(
+            qos_policy, bound=qos_policy.shed_limit)
+            if qos_policy is not None else None)
+        self._stream_fifo: collections.deque = collections.deque()
+        self._stream_wait_ms = qos_mod.LatencyRing(2048)
+        self._stream_park_peak = 0
         self.worker_count = workers if workers > 0 else DEFAULT_WORKERS
         self.backlog = backlog
         self.max_connections = max_connections
@@ -415,6 +477,9 @@ class AsyncUploadServer:
         if conn.closed:
             return  # idempotent: a dispatch loop may close mid-pump
         conn.closed = True
+        if conn.park_args is not None:
+            self._abandon_parked(conn)
+        self._release_stream(conn)
         if conn.count_piece and conn.reserved:
             # Response died before completing (a completed one resets
             # these first): refund the UNSENT fraction of the up-front
@@ -577,10 +642,179 @@ class AsyncUploadServer:
         except ValueError as exc:
             self._respond_error(worker, conn, 400, str(exc))
             return
+        conn.qos_class = headers.get(qos_mod.CLASS_HEADER, "")
         self._serve_piece(worker, conn, task_id, peer_id, rng)
 
     def _serve_piece(self, worker: _Worker, conn: _Conn, task_id: str,
                      peer_id: str, rng) -> None:
+        if self.max_streams > 0 and not conn.admitted_stream:
+            if not self._admit_stream(worker, conn, (task_id, peer_id, rng)):
+                return  # parked (response deferred) or shed (503 sent)
+        self._serve_piece_body(worker, conn, task_id, peer_id, rng)
+
+    # -- stream admission (QoS gate) ---------------------------------------
+
+    def _admit_stream(self, worker: _Worker, conn: _Conn,
+                      args: tuple) -> bool:
+        """Claim a ``max_streams`` serving slot for this request, or park
+        the connection (read-interested, so peer close is seen) until a
+        slot frees, or shed with a 503 when the class's park queue is at
+        the policy bound. True = admitted, proceed to the body."""
+        policy = self.qos_policy
+        klass = policy.normalize(conn.qos_class) if policy is not None else ""
+        conn.qos_class = klass
+        qstats = self.qos_stats
+        with self._adm_lock:
+            if self._stream_headroom(klass):
+                self._stream_claim(klass)
+                conn.admitted_stream = True
+                if qstats is not None:
+                    qstats.admission("upload", klass, "admitted")
+                return True
+            # Stamp BEFORE the push: the instant the conn is queued,
+            # another worker's slot release may pick and resume it.
+            conn.park_args = args
+            conn.park_at = self._clock()
+            conn.state = _PARKED
+            if self._stream_parkq is not None:
+                parked = self._stream_parkq.push(klass, conn)
+            else:
+                parked = True
+                self._stream_fifo.append(conn)
+            if parked:
+                queued = (len(self._stream_parkq)
+                          if self._stream_parkq is not None
+                          else len(self._stream_fifo))
+                self._stream_park_peak = max(self._stream_park_peak, queued)
+        if not parked:
+            conn.park_args = None
+            conn.state = _READ
+            if qstats is not None:
+                qstats.admission("upload", klass, "shed")
+            conn.keep_alive = False
+            self._respond_bytes(worker, conn, 503, b"admission shed",
+                                ("X-Df2-Shed: 1",))
+            return False
+        if qstats is not None:
+            qstats.admission("upload", klass, "parked")
+        worker.set_interest(conn, selectors.EVENT_READ)
+        return False
+
+    def _stream_headroom(self, klass: str) -> bool:
+        """Caller holds ``_adm_lock``. FIFO order within a class is
+        preserved: a class with backlog never admits a fresh arrival
+        ahead of its parked queue."""
+        if self._streams >= self.max_streams:
+            return False
+        if self._stream_parkq is not None:
+            if self._stream_parkq.backlog(klass):
+                return False
+            return self._stream_parkq.headroom(
+                klass, self._streams_by_class, self.max_streams)
+        return not self._stream_fifo
+
+    def _stream_claim(self, klass: str) -> None:
+        self._streams += 1
+        if self._stream_parkq is not None:
+            self._streams_by_class[klass] = \
+                self._streams_by_class.get(klass, 0) + 1
+
+    def _release_stream(self, conn: _Conn) -> None:
+        """Give back a serving slot and hand it to the weighted-fair
+        pick over the parked queues (floor-deficit classes first). The
+        resumed connection is driven on ITS owning worker's loop."""
+        if not conn.admitted_stream:
+            return
+        conn.admitted_stream = False
+        nxt = None
+        with self._adm_lock:
+            self._streams -= 1
+            if self._stream_parkq is not None:
+                klass = conn.qos_class
+                left = self._streams_by_class.get(klass, 0) - 1
+                if left > 0:
+                    self._streams_by_class[klass] = left
+                else:
+                    self._streams_by_class.pop(klass, None)
+                picked = self._stream_parkq.pick(
+                    self._streams_by_class, self.max_streams)
+                if picked is not None:
+                    pk, nxt = picked
+                    self._stream_claim(pk)
+            elif self._stream_fifo and self._streams < self.max_streams:
+                nxt = self._stream_fifo.popleft()
+                self._stream_claim("")
+        if nxt is None:
+            return
+        nxt.admitted_stream = True
+        wait_ms = max(self._clock() - nxt.park_at, 0.0) * 1e3
+        self._stream_wait_ms.add(wait_ms)
+        if self.qos_stats is not None:
+            self.qos_stats.observe_wait("upload", nxt.qos_class, wait_ms)
+            self.qos_stats.admission("upload", nxt.qos_class, "admitted")
+        nxt.owner.call(lambda: self._resume_parked(nxt))
+
+    def _resume_parked(self, conn: _Conn) -> None:
+        """Owning-worker callback: a parked request won its slot."""
+        if conn.closed or conn.park_args is None:
+            self._release_stream(conn)  # slot granted to a dead conn
+            return
+        args = conn.park_args
+        conn.park_args = None
+        conn.state = _READ
+        try:
+            self._serve_piece_body(conn.owner, conn, *args)
+        except Exception:  # noqa: BLE001 — mirror _Worker._dispatch
+            logger.debug("upload conn %s died on resume", conn.addr,
+                         exc_info=True)
+            self._close(conn.owner, conn)
+
+    def _abandon_parked(self, conn: _Conn) -> None:
+        """A parked connection died before admission: withdraw it."""
+        if conn.park_args is None:
+            return
+        conn.park_args = None
+        with self._adm_lock:
+            if self._stream_parkq is not None:
+                removed = self._stream_parkq.remove(conn.qos_class, conn)
+            else:
+                try:
+                    self._stream_fifo.remove(conn)
+                    removed = True
+                except ValueError:
+                    removed = False
+        if removed and self.qos_stats is not None:
+            self.qos_stats.admission("upload", conn.qos_class, "abandoned")
+
+    def stream_admission(self) -> Dict[str, object]:
+        """The upload gate's admission snapshot (mirrors the download
+        engine's ``stream_admission`` shape)."""
+        with self._adm_lock:
+            inservice = self._streams
+            by_class = dict(self._streams_by_class)
+            queued = (len(self._stream_parkq)
+                      if self._stream_parkq is not None
+                      else len(self._stream_fifo))
+            queued_by_class = (self._stream_parkq.counts()
+                               if self._stream_parkq is not None else {})
+            peak = self._stream_park_peak
+        p50, p99 = self._stream_wait_ms.percentiles()
+        out: Dict[str, object] = {
+            "max_streams": self.max_streams,
+            "inservice": inservice,
+            "queued": queued,
+            "queued_peak": peak,
+            "queued_wait_ms_p50": round(p50, 3),
+            "queued_wait_ms_p99": round(p99, 3),
+            "queued_waits": self._stream_wait_ms.count,
+        }
+        if self.qos_policy is not None:
+            out["inservice_by_class"] = by_class
+            out["queued_by_class"] = queued_by_class
+        return out
+
+    def _serve_piece_body(self, worker: _Worker, conn: _Conn, task_id: str,
+                          peer_id: str, rng) -> None:
         span = None
         if self.serve_path != KIND_BUFFERED:
             try:
@@ -826,6 +1060,7 @@ class AsyncUploadServer:
         kind, served = conn.kind, conn.count_piece
         conn.count_piece = 0   # completed: the close path must not see a
         conn.reserved = 0.0    # live reservation to refund
+        self._release_stream(conn)  # slot back before the next admit
         self._release_body(conn)
         if served:
             # Count AFTER the last body byte was handed to the kernel —

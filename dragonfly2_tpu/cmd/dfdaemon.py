@@ -62,15 +62,21 @@ def build_daemon(args):
         probe_interval=args.probe_interval,
         announce_interval=args.announce_interval,
         upload_serve_backlog=args.serve_backlog,
-        upload_max_connections=args.max_connections,
+        upload_max_connections=args.max_connections or 0,
+        upload_max_streams=args.max_streams or 0,
         upload_workers=args.upload_workers,
         download_engine=args.dl_engine,
         dl_workers=args.dl_workers,
-        dl_max_streams=args.dl_max_streams,
+        dl_max_streams=args.dl_max_streams or 0,
         upload_tls_cert=args.upload_tls_cert,
         upload_tls_key=args.upload_tls_key,
         peer_tls_ca=args.peer_tls_ca,
         source_tls_ca=args.source_tls_ca,
+        qos_class_weights=args.qos_class_weights,
+        qos_class_floors=args.qos_class_floors,
+        qos_default_class=args.qos_default_class,
+        qos_shed_limit=args.qos_shed_limit,
+        qos_class_slos=args.qos_class_slos,
     ))
     daemon.start()
     return daemon
@@ -129,10 +135,36 @@ def main(argv=None) -> int:
                         choices=["plain", "sampling"])
     parser.add_argument("--serve-backlog", type=int, default=128,
                         help="upload listener listen(2) backlog")
-    parser.add_argument("--max-connections", type=int, default=0,
+    parser.add_argument("--max-connections", type=int, default=None,
                         help="admission cap on concurrently open upload "
-                             "connections (0 = unlimited; beyond the cap "
-                             "arrivals get a best-effort 503)")
+                             "connections (>= 1; beyond the cap arrivals "
+                             "get a best-effort 503; omit for unlimited)")
+    parser.add_argument("--max-streams", type=int, default=None,
+                        help="cap on concurrently SERVING upload piece "
+                             "bodies (>= 1) — the request-time QoS gate; "
+                             "excess requests park per class and drain "
+                             "weighted-fair (omit: gate off, or 64 when "
+                             "--qos-class-weights is set)")
+    parser.add_argument("--qos-class-weights", default="",
+                        help="'interactive=8,bulk=3,background=1' turns "
+                             "multi-tenant QoS ON: every admission gate "
+                             "(upload stream gate, download engine, "
+                             "traffic shaper) goes class-aware weighted-"
+                             "fair (docs/QOS.md); empty = class-blind")
+    parser.add_argument("--qos-class-floors", default="",
+                        help="per-class admission floors "
+                             "('interactive=2'): slots other classes' "
+                             "backlog can never occupy; sum(floors) must "
+                             "stay below the gate capacity")
+    parser.add_argument("--qos-default-class", default="",
+                        help="class unlabeled work lands on "
+                             "(default: bulk)")
+    parser.add_argument("--qos-shed-limit", type=int, default=512,
+                        help="per-class park-queue bound on the upload "
+                             "stream gate; overflow gets a 503 shed")
+    parser.add_argument("--qos-class-slos", default="",
+                        help="per-class slow-SLO seconds for the tail "
+                             "sampler ('interactive=2,bulk=30')")
     parser.add_argument("--upload-workers", type=int, default=0,
                         help="event-loop worker threads for the upload "
                              "engine (0 = default; total serving threads "
@@ -165,11 +197,11 @@ def main(argv=None) -> int:
     parser.add_argument("--source-tls-ca", default="",
                         help="CA bundle pinned for https origins "
                              "(unset = system trust)")
-    parser.add_argument("--dl-max-streams", type=int, default=0,
+    parser.add_argument("--dl-max-streams", type=int, default=None,
                         help="daemon-wide cap on concurrently streaming "
                              "piece/source-run bodies in the async "
-                             "engine; excess streams queue FIFO "
-                             "(0 = default)")
+                             "engine (>= 1); excess streams queue "
+                             "(omit for the engine default)")
     parser.add_argument("--persist-every-pieces", type=int, default=16,
                         help="journal task metadata after this many piece "
                              "landings (0 disables the count trigger); "
@@ -241,6 +273,28 @@ def main(argv=None) -> int:
                      "(the SNI listener terminates TLS with minted certs)")
     if not args.scheduler and not args.manager:
         parser.error("at least one of --scheduler / --manager is required")
+    # Admission caps must be >= 1 when given: an explicit 0 wedges the
+    # gate permanently (every arrival parks/rejects, no slot ever
+    # frees). "Unlimited"/"default" is expressed by OMITTING the flag.
+    for flag, value in (("--max-connections", args.max_connections),
+                        ("--max-streams", args.max_streams),
+                        ("--dl-max-streams", args.dl_max_streams)):
+        if value is not None and value < 1:
+            parser.error(f"{flag} must be >= 1 (an explicit 0 wedges "
+                         f"admission: every request waits for a slot "
+                         f"that can never free); omit the flag for the "
+                         f"default behavior")
+    if args.qos_shed_limit < 1:
+        parser.error("--qos-shed-limit must be >= 1")
+    from dragonfly2_tpu.client.qos import parse_class_map
+
+    for flag, spec in (("--qos-class-weights", args.qos_class_weights),
+                       ("--qos-class-floors", args.qos_class_floors),
+                       ("--qos-class-slos", args.qos_class_slos)):
+        try:
+            parse_class_map(spec, what=flag)
+        except ValueError as exc:
+            parser.error(str(exc))
 
     dynconfig = None
     cli_targets = list(args.scheduler or [])

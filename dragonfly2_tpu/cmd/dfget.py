@@ -75,6 +75,14 @@ def main(argv=None) -> int:
     parser.add_argument("--timeout", type=float, default=0.0,
                         help="seconds for the whole download; 0 (default) "
                              "= no deadline (root.go --timeout)")
+    parser.add_argument("--traffic-class", default="",
+                        help="QoS traffic class for this task "
+                             "(interactive/bulk/background, docs/QOS.md); "
+                             "rides registration metadata to the scheduler "
+                             "and every classed admission gate; blank = "
+                             "class-blind")
+    parser.add_argument("--tenant", default="",
+                        help="tenant id tagged alongside --traffic-class")
     parser.add_argument("--priority", type=int, default=0,
                         help="scheduler priority ladder value 0-6 "
                              "(root.go -P: LEVEL1 forbidden, LEVEL2 "
@@ -174,6 +182,8 @@ def main(argv=None) -> int:
             url_range=args.url_range,
             priority=args.priority,
             disable_back_source=args.disable_back_source,
+            traffic_class=args.traffic_class,
+            tenant=args.tenant,
         )
     except Exception as exc:  # noqa: BLE001 — mirror _daemon_download:
         # the --original-offset temp window must not leak in the output
@@ -353,6 +363,8 @@ def _recursive_download(args, headers) -> int:
                         filtered_query_params=filtered,
                         priority=args.priority,
                         disable_back_source=args.disable_back_source,
+                        traffic_class=args.traffic_class,
+                        tenant=args.tenant,
                         timeout=(args.timeout if args.timeout > 0
                                  else 7 * 86400))
                 except Exception as exc:  # noqa: BLE001 — per-entry
@@ -382,7 +394,9 @@ def _recursive_download(args, headers) -> int:
                     application=args.application,
                     filtered_query_params=filtered,
                     priority=args.priority,
-                    disable_back_source=args.disable_back_source)
+                    disable_back_source=args.disable_back_source,
+                    traffic_class=args.traffic_class,
+                    tenant=args.tenant)
                 if not result.success:
                     failures += 1
                     print(f"{child}: {result.error}", file=sys.stderr)
@@ -413,6 +427,8 @@ def _daemon_download(args, headers):
             url_range=args.url_range,
             priority=args.priority,
             disable_back_source=args.disable_back_source,
+            traffic_class=args.traffic_class,
+            tenant=args.tenant,
             timeout=args.timeout if args.timeout > 0 else 7 * 86400,
         )
     except Exception as exc:  # noqa: BLE001 — daemon down is a soft error

@@ -99,6 +99,12 @@ class ControlPlaneStats:
         self.gc_ticks = 0
         self.gc_budget_overruns = 0
         self.gc_reclaimed = 0
+        # Per-traffic-class control-plane counters (docs/QOS.md): ticked
+        # only for class-tagged peers, so class-blind fleets export
+        # empty dicts at zero cost.
+        self.announces_by_class: Dict[str, int] = {}
+        self.schedules_by_class: Dict[str, int] = {}
+        self.decisions_by_class: Dict[str, int] = {}
         self._schedule_ms = _Ring(4096)
         self._filter_ms = _Ring(2048)
         self._evaluate_ms = _Ring(2048)
@@ -106,12 +112,25 @@ class ControlPlaneStats:
 
     # -- ticks -------------------------------------------------------------
 
-    def observe_schedule(self, ms: float, *, decided: bool) -> None:
+    def observe_schedule(self, ms: float, *, decided: bool,
+                         traffic_class: str = "") -> None:
         with self._lock:
             self.schedules += 1
             if decided:
                 self.decisions += 1
             self._schedule_ms.add(ms)
+            if traffic_class:
+                self.schedules_by_class[traffic_class] = \
+                    self.schedules_by_class.get(traffic_class, 0) + 1
+                if decided:
+                    self.decisions_by_class[traffic_class] = \
+                        self.decisions_by_class.get(traffic_class, 0) + 1
+
+    def observe_announce_class(self, traffic_class: str) -> None:
+        """One class-tagged register_peer (class-blind peers don't tick)."""
+        with self._lock:
+            self.announces_by_class[traffic_class] = \
+                self.announces_by_class.get(traffic_class, 0) + 1
 
     def observe_back_to_source(self) -> None:
         with self._lock:
@@ -234,6 +253,9 @@ class ControlPlaneStats:
                 "gc_reclaimed": self.gc_reclaimed,
                 "gc_pause_ms_p50": round(gc_p50, 4),
                 "gc_pause_ms_p99": round(gc_p99, 4),
+                "announces_by_class": dict(self.announces_by_class),
+                "schedules_by_class": dict(self.schedules_by_class),
+                "decisions_by_class": dict(self.decisions_by_class),
             }
 
 

@@ -108,7 +108,8 @@ class Peer:
     # read with getattr(..., None) so leaving it unset is fine.
     __slots__ = (
         "id", "task", "host", "tag", "application", "priority",
-        "range_header", "finished_pieces", "pieces", "_piece_costs",
+        "range_header", "traffic_class", "tenant", "finished_pieces",
+        "pieces", "_piece_costs",
         "cost", "block_parents", "need_back_to_source", "schedule_count",
         "piece_updated_at", "created_at", "updated_at", "_lock", "fsm",
         "announce_channel",
@@ -116,7 +117,8 @@ class Peer:
 
     def __init__(self, id: str, task: Task, host: Host, *,
                  tag: str = "", application: str = "", priority: int = 0,
-                 range_header: str = "",
+                 range_header: str = "", traffic_class: str = "",
+                 tenant: str = "",
                  piece_cost_window: int = DEFAULT_PIECE_COST_WINDOW):
         self.id = id
         self.task = task
@@ -125,6 +127,10 @@ class Peer:
         self.application = application
         self.priority = priority
         self.range_header = range_header
+        # QoS identity carried by register_peer ('' = class-blind):
+        # class-aware candidate ordering + per-class scheduler counters.
+        self.traffic_class = traffic_class
+        self.tenant = tenant
         self.finished_pieces: set[int] = set()
         self.pieces: Dict[int, Piece] = {}
         # Lazily materialized on the first appended cost; window size is

@@ -213,6 +213,8 @@ class WireRegisterPeer:
     need_back_to_source: bool = False
     url_range: str = ""
     reestablish: bool = False  # failover re-home, not a fresh register
+    traffic_class: str = ""    # QoS class ("" = class-blind)
+    tenant: str = ""
 
 
 @message("scheduler.WirePeerEvent")
@@ -606,6 +608,8 @@ class SchedulerRpcService:
                         need_back_to_source=req.need_back_to_source,
                         url_range=req.url_range,
                         reestablish=req.reestablish,
+                        traffic_class=req.traffic_class,
+                        tenant=req.tenant,
                     ),
                     channel=channel,
                 )
@@ -902,6 +906,8 @@ class GrpcSchedulerClient:
             need_back_to_source=req.need_back_to_source,
             url_range=req.url_range,
             reestablish=req.reestablish,
+            traffic_class=req.traffic_class,
+            tenant=req.tenant,
         ))
         reader = threading.Thread(
             target=self._read_loop, args=(session, channel),

@@ -212,6 +212,14 @@ class Scheduling:
         t2 = perf_counter()
         self.stats.observe_evaluate((t2 - t1) * 1e3)
         delivered = list(ranked[: self.config.candidate_parent_limit])
+        if getattr(peer, "traffic_class", "") == "interactive" \
+                and len(delivered) > 1:
+            # Interactive pulls steer to the least-loaded delivered
+            # parents (stable sort — evaluator rank breaks ties), so a
+            # latency-bound stream avoids queuing at a parent already
+            # fanning out to a bulk swarm. Other classes keep the pure
+            # evaluator order.
+            delivered.sort(key=lambda c: len(c.children()))
         if counts is not None:
             tracer.emit("sched.evaluate", start=time.time() - (t2 - t1),
                         duration_s=t2 - t1, peer_id=peer.id,

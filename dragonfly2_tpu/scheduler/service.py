@@ -77,6 +77,11 @@ class RegisterPeerRequest:
     # register retry — both land in the idempotent-upsert branch, but
     # only the failover is an SLO breach worth tail-keeping the trace.
     reestablish: bool = False
+    # QoS identity (docs/QOS.md): traffic class + optional tenant id,
+    # "" = class-blind. Stored on the Peer for class-aware candidate
+    # ordering, per-class scheduler counters and class-tagged SLOs.
+    traffic_class: str = ""
+    tenant: str = ""
 
 
 @dataclass
@@ -279,6 +284,8 @@ class SchedulerService:
         # one canonical copy, not a per-registration wire decode.
         tag = sys.intern(req.tag)
         application = sys.intern(req.application)
+        traffic_class = sys.intern(req.traffic_class)
+        tenant = sys.intern(req.tenant)
         task = self.resource.task_manager.load_or_store(
             Task(req.task_id, url=req.url, tag=tag,
                  application=application,
@@ -289,8 +296,11 @@ class SchedulerService:
         )
         peer = self.resource.peer_manager.load_or_store(
             Peer(req.peer_id, task, host, tag=tag,
-                 application=application, priority=req.priority)
+                 application=application, priority=req.priority,
+                 traffic_class=traffic_class, tenant=tenant)
         )
+        if traffic_class:
+            self.stats.observe_announce_class(traffic_class)
         peer.need_back_to_source = req.need_back_to_source
         if channel is not None:
             peer.announce_channel = channel
@@ -673,7 +683,9 @@ class SchedulerService:
                 peer, set(peer.block_parents))
         finally:
             elapsed = time.perf_counter() - start
-            self.stats.observe_schedule(elapsed * 1e3, decided=bool(decided))
+            self.stats.observe_schedule(
+                elapsed * 1e3, decided=bool(decided),
+                traffic_class=getattr(peer, "traffic_class", ""))
             if span_attrs is not None:
                 span_attrs["decided"] = bool(decided)
             if self.metrics:
@@ -682,7 +694,8 @@ class SchedulerService:
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
-        self._tail_verdict(cost_seconds)
+        self._tail_verdict(cost_seconds,
+                           getattr(peer, "traffic_class", ""))
         if peer.fsm.is_state(PeerState.SUCCEEDED):
             return  # duplicate terminal report (failover replay / race)
         peer.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
@@ -707,7 +720,8 @@ class SchedulerService:
     ) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
-        self._tail_verdict(cost_seconds)
+        self._tail_verdict(cost_seconds,
+                           getattr(peer, "traffic_class", ""))
         # Idempotent on an already-Succeeded peer: the hybrid fan-out
         # path can complete via the MESH a beat before the
         # NeedBackToSource decision is consumed (the conductor then
@@ -769,14 +783,17 @@ class SchedulerService:
         self._record_replay_outcome(peer)
 
     @staticmethod
-    def _tail_verdict(cost_seconds: float) -> None:
+    def _tail_verdict(cost_seconds: float, traffic_class: str = "") -> None:
         """Scheduler-side tail-sampling verdict at a successful task
         end: a task slower than the tracer's SLO keeps its trace HERE
         too (the daemon promotes its own half with the same shared
-        trace id; both sides decide locally from the same number)."""
+        trace id; both sides decide locally from the same number). The
+        SLO is class-tagged: an interactive task past ITS bound is slow
+        even when far under the fleet-wide one."""
         tracer = tracing.default_tracer()
         sampler = getattr(tracer, "sampler", None)
-        if (sampler is not None and cost_seconds > sampler.slow_slo_s):
+        if (sampler is not None
+                and cost_seconds > sampler.slo_for(traffic_class)):
             tracing.promote_current_trace("slow")
 
     def leave_peer(self, peer_id: str) -> None:

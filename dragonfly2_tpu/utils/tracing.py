@@ -102,11 +102,15 @@ class TailSampler:
 
     def __init__(self, head_fraction: float = 0.05, max_traces: int = 512,
                  max_spans_per_trace: int = 512, slow_slo_s: float = 30.0,
-                 stats=None):
+                 class_slos=None, stats=None):
         self.head_fraction = max(0.0, min(1.0, head_fraction))
         self.max_traces = max(1, int(max_traces))
         self.max_spans_per_trace = max(1, int(max_spans_per_trace))
         self.slow_slo_s = slow_slo_s
+        #: Per-traffic-class SLO overrides (seconds): an interactive
+        #: task blown past ITS bound is tail-promoted even when it is
+        #: nowhere near the process-wide slow_slo_s.
+        self.class_slos = dict(class_slos or {})
         if stats is None:
             from dragonfly2_tpu.utils.obsstats import OBS as stats
         self.stats = stats
@@ -125,6 +129,11 @@ class TailSampler:
         # its churn would evict the genuine in-flight buffers.
         self._expected: "collections.OrderedDict[str, bool]" = \
             collections.OrderedDict()
+
+    def slo_for(self, traffic_class: str) -> float:
+        """The slow-verdict SLO for one traffic class ('' / unknown →
+        the process-wide ``slow_slo_s``)."""
+        return self.class_slos.get(traffic_class, self.slow_slo_s)
 
     # -- head sampling -----------------------------------------------------
 

@@ -1,0 +1,700 @@
+"""Multi-tenant QoS plane (docs/QOS.md).
+
+Tier-1 units: class-map parsing, policy normalization, the smooth-WRR
+``ClassQueues`` arbitration (weight ratios, floors, bounds), the
+download engine's class-aware admission + class-major DRR dispatcher
+(including the satellite heterogeneous-piece starvation regressions),
+the upload stream gate's park/priority/shed behavior, hierarchical
+shaper shares, per-class scheduler counters and class SLO lookup, CLI
+validation of the admission caps, and the /debug/vars "qos" block.
+
+The live mixed-swarm rung is ``slow + qos`` (the bench.py qos stage
+shape at reduced scale).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.client.qos import (
+    QOS,
+    ClassQueues,
+    LatencyRing,
+    QosPolicy,
+    QosStats,
+    class_request_headers,
+    parse_class_map,
+)
+
+TASK_ID = "cd" * 20
+
+
+# ----------------------------------------------------------------------
+# Parsing + policy
+# ----------------------------------------------------------------------
+
+
+class TestParseAndPolicy:
+    def test_parse_class_map(self):
+        assert parse_class_map("interactive=8,bulk=3", what="w") == {
+            "interactive": 8.0, "bulk": 3.0}
+        assert parse_class_map("", what="w") == {}
+        assert parse_class_map(" a = 1 , b = 2 ", what="w") == {
+            "a": 1.0, "b": 2.0}
+
+    @pytest.mark.parametrize("spec", ["interactive", "a=x", "a=0",
+                                      "a=-1", "=3"])
+    def test_parse_rejects_malformed(self, spec):
+        with pytest.raises(ValueError):
+            parse_class_map(spec, what="test spec")
+
+    def test_blank_weights_mean_no_policy(self):
+        assert QosPolicy.from_specs("", "", "", 512) is None
+
+    def test_normalize_and_defaults(self):
+        policy = QosPolicy.from_specs("interactive=8,bulk=3,background=1",
+                                      "interactive=2", "", 512)
+        assert policy is not None
+        assert policy.normalize("interactive") == "interactive"
+        assert policy.normalize("") == policy.default_class
+        assert policy.normalize("no-such-class") == policy.default_class
+        assert policy.weight("interactive") == 8.0
+        assert policy.floor("interactive") == 2
+        assert policy.floor("bulk") == 0
+
+    def test_class_request_headers(self):
+        assert class_request_headers("") == ""
+        assert class_request_headers("bulk") == "X-Df2-Class: bulk\r\n"
+        assert class_request_headers("bulk", "acme") == (
+            "X-Df2-Class: bulk\r\nX-Df2-Tenant: acme\r\n")
+
+
+# ----------------------------------------------------------------------
+# ClassQueues arbitration
+# ----------------------------------------------------------------------
+
+
+def _policy(weights="interactive=6,bulk=3,background=1", floors=""):
+    return QosPolicy.from_specs(weights, floors, "", 512)
+
+
+class TestClassQueues:
+    def test_weighted_dequeue_ratio(self):
+        """Continuous backlog in every class → dequeues approach the
+        weight ratio (smooth WRR)."""
+        q = ClassQueues(_policy())
+        for i in range(100):
+            for klass in ("interactive", "bulk", "background"):
+                q.push(klass, f"{klass}-{i}")
+        picked = {"interactive": 0, "bulk": 0, "background": 0}
+        inservice: dict = {}
+        for _ in range(100):
+            klass, _item = q.pick(inservice, capacity=10**9)
+            picked[klass] += 1
+        assert picked["interactive"] == 60
+        assert picked["bulk"] == 30
+        assert picked["background"] == 10
+
+    def test_floor_deficit_outranks_weights(self):
+        """A class below its floor drains first even at weight 1."""
+        q = ClassQueues(_policy("interactive=100,background=1",
+                                floors="background=2"))
+        q.push("interactive", "i0")
+        q.push("background", "b0")
+        klass, item = q.pick({"background": 0}, capacity=4)
+        assert (klass, item) == ("background", "b0")
+
+    def test_bound_sheds_per_class(self):
+        q = ClassQueues(_policy(), bound=2)
+        assert q.push("bulk", "a") and q.push("bulk", "b")
+        assert not q.push("bulk", "c")  # bulk at bound
+        assert q.push("interactive", "i")  # other classes unaffected
+        assert q.counts() == {"bulk": 2, "interactive": 1}
+
+    def test_remove_withdraws_parked(self):
+        q = ClassQueues(_policy())
+        q.push("bulk", "a")
+        assert q.remove("bulk", "a")
+        assert not q.remove("bulk", "a")
+        assert len(q) == 0
+
+    def test_headroom_honors_other_floors(self):
+        """The last free slot is reserved for a floor-deficit class."""
+        p = _policy("interactive=6,bulk=3", floors="interactive=1")
+        q = ClassQueues(p)
+        # capacity 2, one bulk in service, interactive floor unmet:
+        # the remaining slot belongs to interactive.
+        assert not q.headroom("bulk", {"bulk": 1}, capacity=2)
+        assert q.headroom("interactive", {"bulk": 1}, capacity=2)
+        # Floor met → bulk may take the slot.
+        assert q.headroom("bulk", {"bulk": 0, "interactive": 1},
+                          capacity=2)
+
+    def test_latency_ring_percentiles(self):
+        ring = LatencyRing(maxlen=64)
+        for v in range(1, 101):
+            ring.add(float(v))
+        p50, p99 = ring.percentiles()
+        assert ring.count == 100
+        assert 60 <= p50 <= 80  # last 64 samples: 37..100
+        assert p99 >= 99.0
+
+
+class TestQosStats:
+    def test_admission_and_wait_counters(self):
+        stats = QosStats()
+        stats.admission("upload", "bulk", "admitted")
+        stats.admission("upload", "bulk", "shed")
+        stats.admission("upload", "", "parked")  # blank → "default"
+        stats.observe_wait("upload", "bulk", 12.0)
+        stats.task_done("bulk", 340.0)
+        snap = stats.snapshot()
+        assert snap["upload"]["admitted"] == {"bulk": 1}
+        assert snap["upload"]["shed"] == {"bulk": 1}
+        assert snap["upload"]["parked"] == {"default": 1}
+        assert snap["upload"]["queued_waits"] == 1
+        assert snap["upload"]["wait_ms_p99_by_class"]["bulk"] == 12.0
+        assert snap["task_ms_p99"]["bulk"] == 340.0
+
+    def test_process_block_registered(self):
+        from dragonfly2_tpu.utils.debugmon import registered_debug_vars
+
+        assert "qos" in registered_debug_vars()
+        snap = QOS.snapshot()
+        # Scalar keys always present (the Prometheus bridge contract).
+        for side in ("upload", "download"):
+            assert "queued_wait_ms_p99" in snap[side]
+
+
+# ----------------------------------------------------------------------
+# Download engine: class-aware admission + class-major dispatch
+# ----------------------------------------------------------------------
+
+
+from dragonfly2_tpu.client.download_async import (  # noqa: E402
+    DownloadLoopEngine,
+    _DlLoop,
+    _LoopOp,
+)
+
+
+class _HoldOp(_LoopOp):
+    """A gated op that parks until the test releases it."""
+
+    gated = True
+
+    def __init__(self, task_id, qos_class=""):
+        super().__init__(task_id)
+        self.qos_class = qos_class
+        self.started = threading.Event()
+
+    def _begin(self):
+        self.started.set()
+
+    def release(self, err=None):
+        self.loop.call_soon(lambda: self._finish(err))
+
+
+def _drain(ops, timeout=2.0):
+    for op in ops:
+        if not op.started.wait(timeout):
+            return False
+        op.release()
+    for op in ops:
+        op.join(timeout=timeout)
+    return True
+
+
+class TestEngineClassAdmission:
+    def test_interactive_skips_bulk_backlog(self):
+        """With every slot bulk-held and a deep bulk backlog, the next
+        freed slot goes to the lone interactive op, not bulk's queue."""
+        policy = _policy("interactive=6,bulk=1")
+        eng = DownloadLoopEngine(workers=1, max_streams=2,
+                                 qos_policy=policy, qos_stats=QosStats())
+        eng.start()
+        try:
+            running = [_HoldOp(f"b{i}", "bulk") for i in range(2)]
+            for op in running:
+                eng.submit(op)
+            assert all(op.started.wait(2) for op in running)
+            backlog = [_HoldOp(f"bq{i}", "bulk") for i in range(4)]
+            inter = _HoldOp("hot", "interactive")
+            for op in backlog:
+                eng.submit(op)
+            eng.submit(inter)
+            snap = eng.stream_admission()
+            assert snap["queued_by_class"] == {"bulk": 4, "interactive": 1}
+            running[0].release()
+            assert inter.started.wait(2)  # weighted pick, not FIFO
+            assert not backlog[0].started.is_set()
+            inter.release()
+            running[1].release()
+            assert _drain(backlog)
+        finally:
+            eng.stop()
+
+    def test_class_blind_engine_keeps_fifo(self):
+        """No policy → the original single-FIFO admission order."""
+        eng = DownloadLoopEngine(workers=1, max_streams=1)
+        eng.start()
+        try:
+            first = _HoldOp("a", "bulk")
+            eng.submit(first)
+            assert first.started.wait(2)
+            queued = [_HoldOp("b", "bulk"), _HoldOp("c", "interactive")]
+            for op in queued:
+                eng.submit(op)
+            first.release()
+            assert queued[0].started.wait(2)  # strict arrival order
+            assert not queued[1].started.is_set()
+            queued[0].release()
+            assert queued[1].started.wait(2)
+            queued[1].release()
+            for op in [first] + queued:
+                op.join(timeout=2)
+        finally:
+            eng.stop()
+
+    def test_queued_wait_ring_reports_percentiles(self):
+        """Satellite: park→admission wait p50/p99 in stream_admission."""
+        eng = DownloadLoopEngine(workers=1, max_streams=1)
+        eng.start()
+        try:
+            first = _HoldOp("a")
+            eng.submit(first)
+            assert first.started.wait(2)
+            second = _HoldOp("b")
+            eng.submit(second)
+            time.sleep(0.05)
+            first.release()
+            assert second.started.wait(2)
+            second.release()
+            for op in (first, second):
+                op.join(timeout=2)
+            snap = eng.stream_admission()
+            assert snap["queued_waits"] >= 1
+            assert snap["queued_wait_ms_p99"] >= 40.0
+        finally:
+            eng.stop()
+
+
+class _FakeOp:
+    def __init__(self, task_id, qos_class=""):
+        self.task_id = task_id
+        self.qos_class = qos_class
+
+
+def _loop(policy=None):
+    import types
+
+    loop = _DlLoop(types.SimpleNamespace(qos_policy=policy), 0)
+    order = []
+    loop._safe_dispatch = lambda op, mask: order.append(op)
+    return loop, order
+
+
+def _close_loop(loop):
+    loop.selector.close()
+    loop._wake_r.close()
+    loop._wake_w.close()
+    for fd in loop.splice_pipe:
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+class TestDispatchFairness:
+    def test_hot_task_cannot_starve_small_task(self):
+        """Satellite: a task with MANY ready sockets (large pieces keep
+        them continuously readable) must interleave with a one-socket
+        task — the small task is served within the first round, not
+        after the hot task's whole batch."""
+        loop, order = _loop()
+        try:
+            hot = [_FakeOp("hog") for _ in range(8)]
+            cold = _FakeOp("small")
+            ready = [(op, 1) for op in hot] + [(cold, 1)]
+            loop._dispatch_fair(ready)
+            assert order.index(cold) <= 1  # round-robin, not tail
+            assert len(order) == 9
+        finally:
+            _close_loop(loop)
+
+    def test_rotation_is_seeded_not_sticky(self):
+        """Across dispatch rounds the first-served task rotates, so no
+        task owns the 'first byte of every round' advantage."""
+        loop, order = _loop()
+        try:
+            firsts = set()
+            for _ in range(4):
+                order.clear()
+                ready = [(_FakeOp(t), 1) for t in ("a", "b", "c")]
+                loop._dispatch_fair(ready)
+                firsts.add(order[0].task_id)
+            assert len(firsts) >= 2
+        finally:
+            _close_loop(loop)
+
+    def test_class_major_drr_bounds_bulk_per_cycle(self):
+        """DRR counterpart: with a policy, a bulk flood of ready
+        sockets drains at most ceil(weight) per cycle while the lone
+        interactive socket is served in the FIRST cycle."""
+        policy = _policy("interactive=6,bulk=2")
+        loop, order = _loop(policy)
+        try:
+            bulk = [_FakeOp(f"b{i}", "bulk") for i in range(10)]
+            inter = _FakeOp("ui", "interactive")
+            loop._dispatch_fair([(op, 1) for op in bulk] + [(inter, 1)])
+            assert len(order) == 11
+            # Interactive (weight 6) leads the cycle; bulk gets at most
+            # its quantum (2) before interactive is served.
+            assert order.index(inter) <= 2
+        finally:
+            _close_loop(loop)
+
+    def test_single_class_falls_back_to_task_fair(self):
+        policy = _policy("interactive=6,bulk=2")
+        loop, order = _loop(policy)
+        try:
+            ops = [_FakeOp(f"t{i}", "bulk") for i in range(3)]
+            loop._dispatch_fair([(op, 1) for op in ops])
+            assert len(order) == 3
+        finally:
+            _close_loop(loop)
+
+
+# ----------------------------------------------------------------------
+# Upload stream gate
+# ----------------------------------------------------------------------
+
+
+from dragonfly2_tpu.client.piece import PieceMetadata  # noqa: E402
+from dragonfly2_tpu.client.storage import (  # noqa: E402
+    StorageManager,
+    StorageOptions,
+    WritePieceRequest,
+)
+from dragonfly2_tpu.client.upload_async import AsyncUploadServer  # noqa: E402
+
+
+def _seed_task(root, content: bytes, piece_size: int):
+    mgr = StorageManager(StorageOptions(root=str(root), keep_storage=False))
+    store = mgr.register_task(TASK_ID, "seed-peer")
+    pieces = []
+    for num in range(0, (len(content) + piece_size - 1) // piece_size):
+        chunk = content[num * piece_size:(num + 1) * piece_size]
+        p = PieceMetadata(
+            num=num, md5=hashlib.md5(chunk).hexdigest(),
+            offset=num * piece_size, start=num * piece_size,
+            length=len(chunk))
+        store.write_piece(WritePieceRequest(TASK_ID, "seed-peer", p),
+                          io.BytesIO(chunk))
+        pieces.append(p)
+    store.update(content_length=len(content), total_pieces=len(pieces))
+    store.mark_done()
+    return mgr, pieces
+
+
+def _piece_get(port, piece, klass=""):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    extra = f"X-Df2-Class: {klass}\r\n" if klass else ""
+    s.sendall(
+        f"GET /download/{TASK_ID[:3]}/{TASK_ID}?peerId=seed-peer "
+        f"HTTP/1.1\r\nHost: t\r\nRange: {piece.range.http_header()}\r\n"
+        f"{extra}\r\n".encode())
+    return s
+
+
+def _settle(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestUploadStreamGate:
+    def test_park_weighted_resume_and_shed(self, tmp_path):
+        """One slow in-service stream; parked bulk + interactive; bulk
+        past the class bound sheds with 503 X-Df2-Shed; on release the
+        interactive stream resumes FIRST despite arriving later."""
+        # 16 MiB body against a client that never reads: loopback
+        # socket buffers fill, the server blocks in _WRITE, and the
+        # stream slot stays held until that client goes away — a
+        # deterministic park/shed window with no rate-limit timing.
+        content = bytes(16 << 20)
+        mgr, pieces = _seed_task(tmp_path, content, 16 << 20)
+        policy = QosPolicy.from_specs("interactive=8,bulk=1", "", "", 2)
+        stats = QosStats()
+        server = AsyncUploadServer(mgr, max_streams=1, qos_policy=policy,
+                                   qos_stats=stats)
+        server.start()
+        socks = []
+        try:
+            p = pieces[0]
+            first = _piece_get(server.port, p, "bulk")
+            socks.append(first)
+            assert _settle(lambda: server.stream_admission()
+                           ["inservice"] == 1)
+            parked_bulk = _piece_get(server.port, p, "bulk")
+            late_inter = _piece_get(server.port, p, "interactive")
+            socks += [parked_bulk, late_inter]
+            assert _settle(lambda: server.stream_admission()
+                           ["queued"] == 2)
+            adm = server.stream_admission()
+            assert adm["queued_by_class"] == {"bulk": 1, "interactive": 1}
+
+            # Fill bulk's park bound (2), then one more bulk sheds.
+            socks.append(_piece_get(server.port, p, "bulk"))
+            assert _settle(lambda: server.stream_admission()
+                           ["queued"] == 3)
+            shed_sock = _piece_get(server.port, p, "bulk")
+            socks.append(shed_sock)
+            shed_sock.settimeout(5)
+            data = shed_sock.recv(4096)
+            assert b"503" in data and b"X-Df2-Shed: 1" in data
+            assert stats.snapshot()["upload"]["shed"] == {"bulk": 1}
+
+            # Vanishing in-service client frees the slot; the weighted
+            # pick admits interactive ahead of the earlier bulk.
+            first.close()
+            late_inter.settimeout(5)
+            assert b"HTTP/1.1 2" in late_inter.recv(4096)
+            snap = stats.snapshot()["upload"]
+            assert snap["admitted"].get("interactive") == 1
+            assert snap["queued_waits"] >= 1
+            adm = server.stream_admission()
+            assert adm["queued_by_class"].get("interactive") is None
+            assert adm["queued_wait_ms_p99"] >= 0.0
+        finally:
+            for s in socks:
+                s.close()
+            server.stop()
+
+    def test_class_blind_server_never_parks(self, tmp_path):
+        """No policy and no max_streams → the gate is inert (the
+        zero-overhead default path)."""
+        content = os.urandom(8192)
+        mgr, pieces = _seed_task(tmp_path, content, 8192)
+        server = AsyncUploadServer(mgr)
+        server.start()
+        try:
+            assert server.max_streams == 0
+            s = _piece_get(server.port, pieces[0])
+            s.settimeout(5)
+            assert b"HTTP/1.1 2" in s.recv(4096)
+            s.close()
+            adm = server.stream_admission()
+            assert adm["queued_peak"] == 0
+            assert "queued_by_class" not in adm
+        finally:
+            server.stop()
+
+
+# ----------------------------------------------------------------------
+# Hierarchical shaper
+# ----------------------------------------------------------------------
+
+
+class TestHierarchicalShaper:
+    def test_class_weighted_shares(self):
+        from dragonfly2_tpu.client.traffic_shaper import (
+            SamplingTrafficShaper,
+        )
+
+        total = 80 * 1024 * 1024
+        shaper = SamplingTrafficShaper(
+            total_rate_bps=total,
+            class_weights={"interactive": 3.0, "bulk": 1.0},
+            qos_stats=QosStats())
+        shaper.add_task("ui", traffic_class="interactive")
+        shaper.add_task("ckpt", traffic_class="bulk")
+        for task in ("ui", "ckpt"):
+            # Each class demands MORE than its weighted budget, so the
+            # water-fill hands out exactly the 3:1 budgets (a class
+            # under its budget would donate surplus — weighted max-min).
+            shaper.record(task, 2 * total)
+        time.sleep(0.01)
+        shaper.update_limits()
+        ui = shaper._entry("ui").limiter.rate
+        ckpt = shaper._entry("ckpt").limiter.rate
+        assert ui / ckpt == pytest.approx(3.0, rel=0.05)
+        assert ui + ckpt <= total * 1.001
+
+    def test_idle_class_bandwidth_redistributed(self):
+        from dragonfly2_tpu.client.traffic_shaper import (
+            SamplingTrafficShaper,
+        )
+
+        total = 40 * 1024 * 1024
+        shaper = SamplingTrafficShaper(
+            total_rate_bps=total,
+            class_weights={"interactive": 3.0, "bulk": 1.0})
+        shaper.add_task("ui", traffic_class="interactive")
+        shaper.add_task("ckpt", traffic_class="bulk")
+        shaper.record("ckpt", 60 * 1024 * 1024)  # bulk wants it all
+        time.sleep(0.01)
+        shaper.update_limits()
+        # Interactive is idle: bulk's allocation must exceed its 25%
+        # weight share — the surplus flowed to the demanding class.
+        assert shaper._entry("ckpt").limiter.rate > total * 0.5
+
+    def test_class_blind_shaper_unchanged(self):
+        from dragonfly2_tpu.client.traffic_shaper import (
+            SamplingTrafficShaper,
+        )
+
+        shaper = SamplingTrafficShaper(total_rate_bps=10_000_000)
+        assert shaper.class_weights is None
+        shaper.add_task("a")
+        shaper.record("a", 8_000_000)
+        time.sleep(0.01)
+        shaper.update_limits()
+        assert shaper._entry("a").limiter.rate > 0
+
+
+# ----------------------------------------------------------------------
+# Scheduler-side: class on the wire, per-class counters, class SLOs
+# ----------------------------------------------------------------------
+
+
+class TestSchedulerClassPlumbing:
+    def _service(self):
+        from dragonfly2_tpu.scheduler.controlstats import ControlPlaneStats
+        from dragonfly2_tpu.scheduler.evaluator.base import BaseEvaluator
+        from dragonfly2_tpu.scheduler.resource.resource import Resource
+        from dragonfly2_tpu.scheduler.scheduling.core import (
+            Scheduling,
+            SchedulingConfig,
+        )
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+
+        stats = ControlPlaneStats()
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(BaseEvaluator(), SchedulingConfig()),
+            stats=stats)
+        return service, stats
+
+    def test_register_carries_class_and_ticks_counters(self):
+        from dragonfly2_tpu.scheduler.resource.host import Host
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        service, stats = self._service()
+        service.announce_host(Host(id="h1", hostname="h", ip="1.2.3.4",
+                                   port=80, download_port=81))
+        service.register_peer(RegisterPeerRequest(
+            host_id="h1", task_id="t1", peer_id="p1", url="http://o/x",
+            traffic_class="interactive", tenant="acme"))
+        peer = service.resource.peer_manager.load("p1")
+        assert peer.traffic_class == "interactive"
+        assert peer.tenant == "acme"
+        snap = stats.snapshot()
+        assert snap["announces_by_class"] == {"interactive": 1}
+
+    def test_class_blind_register_ticks_nothing(self):
+        from dragonfly2_tpu.scheduler.resource.host import Host
+        from dragonfly2_tpu.scheduler.service import RegisterPeerRequest
+
+        service, stats = self._service()
+        service.announce_host(Host(id="h1", hostname="h", ip="1.2.3.4",
+                                   port=80, download_port=81))
+        service.register_peer(RegisterPeerRequest(
+            host_id="h1", task_id="t1", peer_id="p1", url="http://o/x"))
+        assert service.resource.peer_manager.load("p1").traffic_class == ""
+        assert stats.snapshot()["announces_by_class"] == {}
+
+    def test_wire_register_carries_class(self):
+        from dragonfly2_tpu.scheduler.rpcserver import WireRegisterPeer
+
+        wire = WireRegisterPeer(host_id="h", task_id="t", peer_id="p",
+                                url="u", traffic_class="bulk",
+                                tenant="acme")
+        assert wire.traffic_class == "bulk"
+        assert wire.tenant == "acme"
+
+    def test_tail_sampler_class_slos(self):
+        from dragonfly2_tpu.utils.tracing import TailSampler
+
+        sampler = TailSampler(slow_slo_s=10.0,
+                              class_slos={"interactive": 0.5})
+        assert sampler.slo_for("interactive") == 0.5
+        assert sampler.slo_for("bulk") == 10.0
+        assert sampler.slo_for("") == 10.0
+
+
+# ----------------------------------------------------------------------
+# CLI validation (satellite: an explicit 0 wedges admission)
+# ----------------------------------------------------------------------
+
+
+class TestCliValidation:
+    @pytest.mark.parametrize("flag", ["--max-connections", "--max-streams",
+                                      "--dl-max-streams"])
+    def test_zero_admission_cap_rejected(self, flag, capsys):
+        from dragonfly2_tpu.cmd.dfdaemon import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--scheduler", "127.0.0.1:1", flag, "0"])
+        assert exc.value.code == 2
+        assert "must be >= 1" in capsys.readouterr().err
+
+    def test_negative_cap_rejected(self, capsys):
+        from dragonfly2_tpu.cmd.dfdaemon import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--scheduler", "127.0.0.1:1", "--dl-max-streams", "-3"])
+        assert exc.value.code == 2
+
+    def test_malformed_qos_spec_rejected(self, capsys):
+        from dragonfly2_tpu.cmd.dfdaemon import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--scheduler", "127.0.0.1:1",
+                  "--qos-class-weights", "interactive=zero"])
+        assert exc.value.code == 2
+
+    def test_zero_shed_limit_rejected(self, capsys):
+        from dragonfly2_tpu.cmd.dfdaemon import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--scheduler", "127.0.0.1:1", "--qos-shed-limit", "0"])
+        assert exc.value.code == 2
+
+
+# ----------------------------------------------------------------------
+# Live mixed-workload swarm (the bench.py qos stage at reduced scale)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.qos
+class TestLiveMixedSwarm:
+    def test_mixed_rung_holds_bounds(self):
+        from dragonfly2_tpu.client.qosbench import run_qos_mixed_rung
+
+        out = run_qos_mixed_rung(bulk_bytes=8 << 20,
+                                 background_bytes=2 << 20,
+                                 interactive_pulls=4)
+        assert out["verdict_pass"], out["failures"]
+        assert out["upload_admitted_by_class"].get("interactive")
+
+    def test_flood_rung_sheds_only_flooder(self):
+        from dragonfly2_tpu.client.qosbench import run_qos_flood_rung
+
+        out = run_qos_flood_rung(flood_tasks=6, flood_bytes=2 << 20,
+                                 interactive_pulls=4)
+        assert out["verdict_pass"], out["failures"]
+        assert out["upload_shed_by_class"].get("background")
+        assert not out["upload_shed_by_class"].get("interactive")
