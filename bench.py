@@ -1268,6 +1268,74 @@ def stage_fanout(state: BenchState, ctx: dict) -> None:
             ladder)
 
 
+@stage("geo")
+def stage_geo(state: BenchState, ctx: dict) -> None:
+    """Geo-hierarchical multi-site swarm — the ISSUE-18 WAN-aware
+    routing ladder (client/geobench.py): three emulated sites of
+    ``--cluster-id``-labeled daemon processes joined by seeded WAN
+    link emulation (utils/geoplan.py), pulling a sharded checkpoint
+    through scheduler-elected bridge peers. Gates (docs/GEO.md): cold
+    WAN amplification ≤ 1 + #clusters at the largest rung with at
+    least one bridge elected; cross-site preheat leaves the swarm
+    phase WAN- and origin-quiet; the site-partition chaos rung's
+    surviving sites finish 100% and the victim resumes crash-safe
+    within the documented bound after heal. A green run persists to
+    artifacts/bench_state/geo_run_*.json; a budget-skipped stage
+    records an explicit skip artifact + ``geo_skipped``, never a
+    silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.client.geobench import run_geo_ladder
+
+    # Budget gate inside the stage (the mlguard lesson): a registry
+    # min_left skip would record nothing.
+    if left() < 120.0 and not ctx.get("single_stage"):
+        state.record(geo_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"geo_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    ladder = run_geo_ladder(seed=0, time_left=left)
+    rungs = ladder["ladder"]
+    largest = str(max(ladder["rungs"]))
+    top = rungs.get(largest, {})
+    partition = ladder.get("partition") or {}
+    state.record(
+        geo_sites=ladder["sites"],
+        geo_rungs=ladder["rungs"],
+        geo_checkpoint_mb=ladder["checkpoint_bytes"] >> 20,
+        geo_skipped_rungs=ladder["skipped_rungs"],
+        geo_wan_amplification=ladder.get("cold_wan_amplification_at_max"),
+        geo_wan_amplification_bound=ladder["wan_amplification_bound"],
+        geo_cold_ttlb_s=top.get("ttlb_s"),
+        geo_site_ttlb_s=top.get("site_ttlb_s"),
+        geo_bridge_grants=top.get("bridge_grants"),
+        geo_bridge_denials=top.get("bridge_denials"),
+        geo_origin_amplification=top.get("origin_amplification"),
+        geo_preheat_wan_fraction=ladder.get("preheat_wan_fraction"),
+        geo_preheat_origin_fraction=ladder.get(
+            "preheat_origin_fraction"),
+        geo_partition_survivor_success=partition.get(
+            "survivor_success_rate"),
+        geo_partition_resume_seconds=partition.get(
+            "victim_resume_seconds"),
+        geo_partition_resume_bound_s=partition.get("resume_bound_s"),
+        geo_failures=(top.get("failures", [])
+                      + partition.get("failures", []))[:5],
+    )
+    if "verdict_pass" in ladder:
+        state.record(geo_verdict_pass=ladder["verdict_pass"])
+    state.stage_done("geo")
+    if ladder.get("verdict_pass"):
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"geo_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            ladder)
+
+
 def run_stages(state: BenchState, platform: str, budget: float,
                only: str | None = None,
                stage_opts: dict | None = None) -> None:
@@ -1667,7 +1735,11 @@ def check_regression_main(stage_name: str) -> None:
     - ``qos``: a fresh mixed-workload + flooding-tenant stage must
       hold its absolute bounds (interactive p99 within bound in both
       rungs, bulk ≥ 70% of its alone throughput, sheds only on the
-      flooding class — docs/QOS.md)."""
+      flooding class — docs/QOS.md).
+    - ``geo``: fresh multi-site ladder vs the best recorded geo run
+      (docs/GEO.md) — a lost verdict (including the site-partition
+      rung) or a 2× TTLB / WAN-amplification collapse fails the
+      gate."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.dataplane import (
             check_download_regression,
@@ -1712,11 +1784,15 @@ def check_regression_main(stage_name: str) -> None:
         from dragonfly2_tpu.client.qosbench import check_qos_regression
 
         result = check_qos_regression(STATE_DIR)
+    elif stage_name == "geo":
+        from dragonfly2_tpu.client.geobench import check_geo_regression
+
+        result = check_geo_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
             "(have: dataplane, chaos, fanout, scheduler, mlguard, "
-            "replay, obs, qos)")
+            "replay, obs, qos, geo)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

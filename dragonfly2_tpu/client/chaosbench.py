@@ -627,6 +627,7 @@ class DaemonProc:
         self.progress_at: Dict[str, float] = {}
         self.results: "queue_mod.Queue" = queue_mod.Queue()
         self.stats_q: "queue_mod.Queue" = queue_mod.Queue()
+        self.geo_q: "queue_mod.Queue" = queue_mod.Queue()
         self._ready: "queue_mod.Queue" = queue_mod.Queue()
         threading.Thread(target=self._read_loop, name=f"proc-read-{hostname}",
                          daemon=True).start()
@@ -667,6 +668,8 @@ class DaemonProc:
                 self.results.put(json_mod.loads(rest))
             elif kind == "STATS":
                 self.stats_q.put(json_mod.loads(rest))
+            elif kind in ("GEO-OK", "GEO-ERR"):
+                self.geo_q.put((kind == "GEO-OK", rest))
             elif not announced:
                 announced = True
                 self._ready.put(line)  # startup failure text
@@ -691,6 +694,18 @@ class DaemonProc:
     def stats(self, timeout: float = 10.0) -> dict:
         self._send("STATS")
         return self.stats_q.get(timeout=timeout)
+
+    def geo_install(self, plan_dict: dict, timeout: float = 10.0) -> None:
+        """Install/replace the child's WAN link-emulation plan
+        (docs/GEO.md) — sent post-spawn because the fleet's ephemeral
+        addresses are only known from the DAEMON lines; re-sending with
+        partitioned links is the geo bench's partition trigger."""
+        import json as json_mod
+
+        self._send("GEO " + json_mod.dumps(plan_dict))
+        ok, err = self.geo_q.get(timeout=timeout)
+        if not ok:
+            raise RuntimeError(f"geo plan install failed: {err}")
 
     def kill(self) -> None:
         self.proc.kill()

@@ -50,6 +50,11 @@ class DaemonConfig:
     host_type: HostType = HostType.NORMAL
     idc: str = ""
     location: str = ""
+    # Geo cluster identity (docs/GEO.md): "" = cluster-blind (the
+    # default keeps single-site fleets byte-identical); set, it rides
+    # announce/register onto Host/Peer so the scheduler can steer
+    # intra-cluster and elect WAN bridges.
+    cluster_id: str = ""
     upload_rate_bps: float = INF
     total_download_rate_bps: float = INF
     traffic_shaper_type: str = "plain"
@@ -391,6 +396,7 @@ class Daemon:
             port=self.upload.port,
             download_port=self.upload.port,
             type=self.config.host_type,
+            cluster_id=self.config.cluster_id,
             cpu=telemetry.collect_cpu(),
             memory=telemetry.collect_memory(),
             disk=telemetry.collect_disk(self.config.storage_root),

@@ -107,6 +107,9 @@ def main(argv=None) -> int:
                         help="upload-side concurrent response-stream cap "
                              "(0 = QoS default when weights set, else "
                              "uncapped)")
+    parser.add_argument("--cluster-id", default=None,
+                        help="geo cluster this daemon belongs to "
+                             "(docs/GEO.md; omit for cluster-blind)")
     parser.add_argument("--serve-rpc", action="store_true",
                         help="also serve the daemon gRPC surface "
                              "(ObtainSeeds for preheat triggers); the "
@@ -119,6 +122,16 @@ def main(argv=None) -> int:
 
     add_observability_flags(parser)
     args = parser.parse_args(argv)
+
+    if args.cluster_id is not None:
+        from dragonfly2_tpu.cmd.common import init_observability_identity
+        from dragonfly2_tpu.utils.geoplan import validate_cluster_id
+
+        try:
+            validate_cluster_id(args.cluster_id, flag="--cluster-id")
+        except ValueError as exc:
+            parser.error(str(exc))
+        init_observability_identity(args.cluster_id)
 
     if args.trace_dir or args.otlp_endpoint:
         from dragonfly2_tpu.cmd.common import init_tracing
@@ -152,11 +165,13 @@ def main(argv=None) -> int:
         options.back_source_concurrency = args.piece_concurrency
     if args.fallback_wait > 0:
         options.source_fallback_wait = args.fallback_wait
-    scheduler = BalancedSchedulerClient(list(args.scheduler))
+    scheduler = BalancedSchedulerClient(list(args.scheduler),
+                                        cluster_id=args.cluster_id or "")
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=args.storage_root,
         hostname=args.hostname,
         host_type=HostType.from_name(args.type),
+        cluster_id=args.cluster_id or "",
         keep_storage=True,
         total_download_rate_bps=args.download_rate or INF,
         persist_every_pieces=args.persist_every,
@@ -243,14 +258,34 @@ def main(argv=None) -> int:
             tenant = parts[2] if len(parts) > 2 else ""
             threading.Thread(target=run_download, args=(url, klass, tenant),
                              name="proc-download", daemon=True).start()
+        elif cmd == "GEO" and rest:
+            # Install (or replace) the WAN link-emulation plan for THIS
+            # process (docs/GEO.md). Sent post-spawn because the bench
+            # only learns the fleet's ephemeral addresses from the
+            # DAEMON lines; a re-send with partitioned links is the
+            # partition chaos trigger. GEO {} uninstalls.
+            from dragonfly2_tpu.utils import geoplan
+
+            try:
+                spec = json.loads(rest)
+                if spec:
+                    geoplan.install(geoplan.GeoPlan.from_dict(spec))
+                else:
+                    geoplan.uninstall()
+                emit("GEO-OK")
+            except (ValueError, KeyError, TypeError) as exc:
+                emit(f"GEO-ERR {type(exc).__name__}: {exc}")
         elif cmd == "STATS":
             from dragonfly2_tpu.client.dataplane import STATS as DP_STATS
+            from dragonfly2_tpu.utils import geoplan
 
             snap = dict(RECOVERY.snapshot())
             # Nested so the flat recovery keys the kill rung reads stay
             # exactly as they were; the fan-out rungs sum these across
             # the fleet for the P2P-share metric.
             snap["data_plane"] = DP_STATS.snapshot()
+            if geoplan.ACTIVE is not None:
+                snap["geo"] = geoplan.ACTIVE.snapshot()
             emit(f"STATS {json.dumps(snap)}")
         elif cmd == "EXIT":
             break

@@ -45,7 +45,7 @@ import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils import faultplan, geoplan
 from dragonfly2_tpu.utils.debugmon import register_debug_var
 
 
@@ -374,6 +374,21 @@ class HTTPConnectionPool:
             if rule is not None:
                 faultplan.raise_connect(rule, "pool.connect",
                                         f"{host}:{port}")
+        geo = geoplan.ACTIVE
+        if geo is not None:
+            # WAN emulation (docs/GEO.md): same discipline as faultplan
+            # above — only fresh dials pay the link; pooled sockets are
+            # already established. A partitioned link refuses like a
+            # dropped route; otherwise the dial blocks for the emulated
+            # RTT (this pool is the threaded engine — sleeping here is
+            # the thread-per-worker model's native parking).
+            refused, delay = geo.dial(f"{host}:{port}")
+            if refused:
+                raise ConnectionRefusedError(
+                    111, f"geo partition: {host}:{port} unreachable "
+                    "across clusters")
+            if delay > 0:
+                time.sleep(delay)
         cls = (http.client.HTTPSConnection if scheme == "https"
                else http.client.HTTPConnection)
         kwargs = {"timeout": self.timeout}

@@ -87,7 +87,7 @@ from dragonfly2_tpu.client.downloader import (
     DownloadPieceRequest,
     piece_request_path,
 )
-from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils import faultplan, geoplan
 
 logger = logging.getLogger(__name__)
 
@@ -1174,6 +1174,21 @@ class _HttpOp(_LoopOp):
                         111, f"injected connect-refused at pool.connect "
                              f"({self.addr})"))
                     return
+        geo = geoplan.ACTIVE
+        if geo is not None:
+            # WAN emulation (docs/GEO.md): fresh dials across a
+            # partitioned link refuse; otherwise the emulated RTT parks
+            # the dial on the timer wheel, faultplan-STALL style — the
+            # loop thread never sleeps.
+            refused, delay = geo.dial(self.addr)
+            if refused:
+                self._finish(ConnectionRefusedError(
+                    111, f"geo partition: {self.addr} unreachable "
+                    "across clusters"))
+                return
+            if delay > 0:
+                self.loop.call_later(delay, self._dial)
+                return
         self._dial()
 
     def _dial(self) -> None:
@@ -1490,9 +1505,24 @@ class _HttpOp(_LoopOp):
             self._schedule_pump()
 
     def _try_recv(self) -> None:
+        geo = geoplan.ACTIVE
+        if geo is not None and geo.refuse(self.addr):
+            # WAN emulation (docs/GEO.md): a partition severing this
+            # link mid-stream resets like a dropped route.
+            self._stream_fail(ConnectionResetError(
+                104, f"geo partition: {self.addr} stream reset"))
+            return
         budget = self.fair_budget
         view = self.loop.recv_view
         while budget > 0:
+            if geo is not None:
+                # Outstanding bandwidth debt on this link: park the op
+                # on the timer wheel (socket off the selector) instead
+                # of sleeping the shared loop thread.
+                delay = geo.pace(self.addr, 0)
+                if delay > 0:
+                    self._geo_pause(delay)
+                    return
             if self.state == _ST_BODY and self._body_remaining > 0:
                 sink = self._splice_sink()
                 if sink is not None:
@@ -1516,6 +1546,8 @@ class _HttpOp(_LoopOp):
                         self._body_remaining -= res.nbytes
                         if self.stats is not None:
                             self.stats.splice(res.nbytes, res.zero_copy)
+                        if geo is not None:
+                            geo.pace(self.addr, res.nbytes)
                         self._on_spliced(res.nbytes)
                         if self._body_remaining == 0:
                             self._complete_exchange()
@@ -1560,6 +1592,10 @@ class _HttpOp(_LoopOp):
                 return
             self._last_progress = time.monotonic()
             budget -= n
+            if geo is not None:
+                # Accumulate the link's bandwidth debt; the query at
+                # the top of the loop parks once it goes positive.
+                geo.pace(self.addr, n)
             if self.state == _ST_HEAD:
                 if not self._feed_head(bytes(view[:n])):
                     return
@@ -1575,6 +1611,26 @@ class _HttpOp(_LoopOp):
         if (isinstance(self.sock, ssl.SSLSocket)
                 and self.sock.pending() > 0):
             self._schedule_pump()
+
+    def _geo_pause(self, delay: float) -> None:
+        """Park this op for an emulated-WAN bandwidth debt: the socket
+        comes off the selector (kernel buffering backpressures the
+        sender, like a real slow link) and a timer re-arms the read."""
+        if self._registered and self.sock is not None:
+            try:
+                self.loop.selector.unregister(self.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            self._registered = False
+            self._interest = 0
+        self.loop.call_later(delay, self._geo_resume)
+
+    def _geo_resume(self) -> None:
+        if (self._finished or self.sock is None
+                or self.state not in (_ST_HEAD, _ST_BODY)):
+            return
+        self._set_interest(selectors.EVENT_READ)
+        self._try_recv()
 
     def _schedule_pump(self) -> None:
         if self._pump_scheduled or self._finished:

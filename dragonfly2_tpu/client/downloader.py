@@ -27,7 +27,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from dragonfly2_tpu import native
 from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
 from dragonfly2_tpu.client.piece import PieceMetadata
-from dragonfly2_tpu.utils import faultplan
+from dragonfly2_tpu.utils import faultplan, geoplan
 
 MAX_SCORE_NS = 0                     # best (lower is better)
 MIN_SCORE_NS = 60 * 1_000_000_000    # failure penalty pole
@@ -356,16 +356,30 @@ class PieceDownloader:
         flt = (faultplan.body_filter(
                    plan.check("piece.body", context=req.dst_addr))
                if plan is not None else None)
+        geo = geoplan.ACTIVE
         digest = hashlib.md5()
         offset = piece.offset
         remaining = piece.length
         try:
             while remaining > 0:
+                if geo is not None:
+                    # WAN emulation (docs/GEO.md): a mid-stream
+                    # partition resets like a dropped route; otherwise
+                    # pay the link's bandwidth debt for bytes already
+                    # read (thread engine parks by sleeping).
+                    if geo.refuse(req.dst_addr):
+                        raise ConnectionResetError(
+                            104, f"geo partition: {req.dst_addr} "
+                            "stream reset")
                 chunk = resp.read(min(self.chunk_size, remaining))
                 if flt is not None:
                     chunk = flt(chunk)
                 if not chunk:
                     break
+                if geo is not None and len(chunk):
+                    pause = geo.pace(req.dst_addr, len(chunk))
+                    if pause > 0:
+                        time.sleep(pause)
                 if self.chunk_hook is not None:
                     self.chunk_hook(len(chunk))
                 os.pwrite(file_fd, chunk, offset)
@@ -398,10 +412,18 @@ class PieceDownloader:
         flt = (faultplan.body_filter(
                    plan.check("piece.body", context=req.dst_addr))
                if plan is not None else None)
+        geo = geoplan.ACTIVE
         try:
+            if geo is not None and geo.refuse(req.dst_addr):
+                raise ConnectionResetError(
+                    104, f"geo partition: {req.dst_addr} stream reset")
             data = resp.read(piece.length)
             if flt is not None:
                 data = flt(data)
+            if geo is not None and data:
+                pause = geo.pace(req.dst_addr, len(data))
+                if pause > 0:
+                    time.sleep(pause)
         except (OSError, http.client.HTTPException) as exc:
             conn.close()
             raise DownloadPieceError(
@@ -468,6 +490,15 @@ class NativePieceFetcher:
             rule = plan.check("pool.connect", context=addr)
             if rule is not None:
                 faultplan.raise_connect(rule, "pool.connect", addr)
+        geo = geoplan.ACTIVE
+        if geo is not None:
+            refused, delay = geo.dial(addr)
+            if refused:
+                raise ConnectionRefusedError(
+                    111, f"geo partition: {addr} unreachable across "
+                    "clusters")
+            if delay > 0:
+                time.sleep(delay)
         sock = socket.create_connection((host, int(port)),
                                         timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -568,6 +599,14 @@ class NativePieceFetcher:
                 self._checkin(req.dst_addr, sock)
             else:
                 sock.close()
+            geo = geoplan.ACTIVE
+            if geo is not None:
+                # The C body loop can't be paced per-chunk; settle the
+                # link's bandwidth debt for the whole piece afterwards —
+                # the aggregate debt clock still bounds WAN throughput.
+                pause = geo.pace(req.dst_addr, piece.length)
+                if pause > 0:
+                    time.sleep(pause)
             self.stats.parent_request(piece.length)
             return res.md5_hex
         raise DownloadPieceError(

@@ -62,6 +62,7 @@ from dragonfly2_tpu.scheduler.service import (
     RegisterPeerResponse,
 )
 from dragonfly2_tpu.utils import digest as digestutil
+from dragonfly2_tpu.utils import geoplan
 from dragonfly2_tpu.utils import tracing
 from dragonfly2_tpu.utils.backoff import full_jitter
 from dragonfly2_tpu.utils.hosttypes import HostType
@@ -1459,9 +1460,15 @@ class PeerTaskConductor:
                     continue
             tracer = tracing.default_tracer()
             if tracer.enabled:
-                with tracer.span("piece.fetch", piece=req.piece.num,
-                                 parent_id=req.dst_peer_id,
-                                 nbytes=req.piece.length) as rec:
+                span_kw = {"piece": req.piece.num,
+                           "parent_id": req.dst_peer_id,
+                           "nbytes": req.piece.length}
+                geo = geoplan.ACTIVE
+                if geo is not None and geo.is_wan(req.dst_addr):
+                    # Cross-cluster fetch: tag the span so trace analysis
+                    # can separate WAN hops from intra-site traffic.
+                    span_kw["cross_cluster"] = True
+                with tracer.span("piece.fetch", **span_kw) as rec:
                     if not self._fetch_one_piece(req, rec.get("attrs")):
                         return
             elif not self._fetch_one_piece(req, None):

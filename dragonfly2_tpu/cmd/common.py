@@ -95,7 +95,18 @@ TAIL_CAPABLE_SERVICES = frozenset((
 ))
 
 
-def init_tracing(args, service_name: str) -> None:
+def init_observability_identity(cluster_id: str) -> None:
+    """Stamp this process's geo cluster onto the observability plane
+    (docs/GEO.md): /debug/vars grows a ``cluster`` key and every
+    Prometheus metric a ``cluster`` label. No-op for "" — cluster-blind
+    processes keep byte-identical output."""
+    if cluster_id:
+        from dragonfly2_tpu.utils import debugmon
+
+        debugmon.set_cluster_id(cluster_id)
+
+
+def init_tracing(args, service_name: str, cluster_id: str = "") -> None:
     """Install the process-wide tracer when --trace-dir or
     --otlp-endpoint was given (the reference's jaeger bootstrap,
     cmd/dependency/dependency.go:263-295), with tail-based sampling on
@@ -115,10 +126,19 @@ def init_tracing(args, service_name: str) -> None:
                 head_fraction=fraction,
                 max_traces=getattr(args, "trace_tail_buffer", 512),
                 slow_slo_s=getattr(args, "trace_slo_s", 30.0))
+        # Geo cluster tag: explicit cluster_id argument, else the
+        # daemon CLIs' string --cluster-id. The isinstance guard is
+        # load-bearing — the scheduler CLI's --cluster-id is the
+        # manager's INTEGER scheduler-cluster id (it passes its
+        # --geo-cluster explicitly instead).
+        arg_cluster = getattr(args, "cluster_id", None)
+        if not isinstance(arg_cluster, str):
+            arg_cluster = ""
         set_default_tracer(Tracer(
             service_name, out_dir=args.trace_dir,
             otlp_endpoint=getattr(args, "otlp_endpoint", ""),
-            sampler=sampler))
+            sampler=sampler,
+            cluster=cluster_id or arg_cluster))
 
 
 def parse_with_config(parser: argparse.ArgumentParser, argv=None):

@@ -11,6 +11,7 @@ import argparse
 import sys
 
 from dragonfly2_tpu.cmd.common import (
+    init_observability_identity,
     init_tracing,
     install_shutdown_handlers,
     parse_with_config,
@@ -45,7 +46,8 @@ def build_daemon(args):
         tls = ClientTLS(ca_path=args.scheduler_tls_ca,
                         cert_path=args.tls_cert, key_path=args.tls_key,
                         server_name_override=args.scheduler_tls_server_name)
-    scheduler = BalancedSchedulerClient(args.scheduler, tls=tls)
+    scheduler = BalancedSchedulerClient(args.scheduler, tls=tls,
+                                        cluster_id=args.cluster_id or "")
     daemon = Daemon(scheduler, DaemonConfig(
         storage_root=args.storage_dir,
         ip=args.ip,
@@ -53,6 +55,7 @@ def build_daemon(args):
         host_type=HostType.from_name(args.type),
         idc=args.idc,
         location=args.location,
+        cluster_id=args.cluster_id or "",
         total_download_rate_bps=args.download_rate or INF,
         upload_rate_bps=args.upload_rate or INF,
         traffic_shaper_type=args.traffic_shaper,
@@ -122,6 +125,12 @@ def main(argv=None) -> int:
                         help="normal|super|strong|weak (seed roles)")
     parser.add_argument("--idc", default="")
     parser.add_argument("--location", default="")
+    parser.add_argument("--cluster-id", default=None,
+                        help="geo cluster this daemon belongs to "
+                             "(docs/GEO.md): rides announce/register so "
+                             "the scheduler steers piece traffic intra-"
+                             "cluster and elects per-cluster WAN bridges; "
+                             "omit for a cluster-blind daemon")
     parser.add_argument("--download-rate", type=float, default=0,
                         help="bytes/sec total download limit (0 = unlimited)")
     parser.add_argument("--upload-rate", type=float, default=0)
@@ -267,6 +276,14 @@ def main(argv=None) -> int:
     # daemon.stop() → storage.persist_all().
     shutdown = install_shutdown_handlers()
     init_logging(args.verbose, args.log_dir, service="dfdaemon")
+    if args.cluster_id is not None:
+        from dragonfly2_tpu.utils.geoplan import validate_cluster_id
+
+        try:
+            validate_cluster_id(args.cluster_id, flag="--cluster-id")
+        except ValueError as exc:
+            parser.error(str(exc))
+        init_observability_identity(args.cluster_id)
     init_tracing(args, "dfdaemon")
     if args.sni_port >= 0 and not args.proxy_hijack_https:
         parser.error("--sni-port requires --proxy-hijack-https "

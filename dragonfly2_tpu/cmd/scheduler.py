@@ -293,6 +293,12 @@ def main(argv=None) -> int:
     parser.add_argument("--cluster-id", type=int, default=0,
                         help="scheduler cluster id at the manager "
                              "(0 = manager default cluster)")
+    parser.add_argument("--geo-cluster", default=None,
+                        help="geo cluster (site) this scheduler runs in "
+                             "(docs/GEO.md) — a STRING site identity, "
+                             "distinct from the manager's integer "
+                             "--cluster-id; tags /debug/vars, /metrics "
+                             "and traces; omit for cluster-blind")
     parser.add_argument("--job-poll-interval", type=float, default=1.0,
                         help="seconds between job-plane lease polls")
     parser.add_argument("--replica-peer", default=None, action="append",
@@ -319,7 +325,16 @@ def main(argv=None) -> int:
     if bool(args.tls_cert) != bool(args.tls_key):
         parser.error("--tls-cert and --tls-key must be given together")
     init_logging(args.verbose, args.log_dir, service="scheduler")
-    init_tracing(args, "scheduler")
+    if args.geo_cluster is not None:
+        from dragonfly2_tpu.cmd.common import init_observability_identity
+        from dragonfly2_tpu.utils.geoplan import validate_cluster_id
+
+        try:
+            validate_cluster_id(args.geo_cluster, flag="--geo-cluster")
+        except ValueError as exc:
+            parser.error(str(exc))
+        init_observability_identity(args.geo_cluster)
+    init_tracing(args, "scheduler", cluster_id=args.geo_cluster or "")
 
     service, server = build_scheduler(args)
     print(f"scheduler serving on {server.target}", flush=True)

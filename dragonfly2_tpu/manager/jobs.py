@@ -42,6 +42,9 @@ class PreheatRequest:
     tag: str = ""
     filtered_query_params: List[str] = field(default_factory=list)
     headers: Dict[str, str] = field(default_factory=dict)
+    # Geo cluster whose bridge seed should warm (docs/GEO.md); "" keeps
+    # the classic single-site preheat against the default seed peer.
+    cluster: str = ""
 
 
 @dataclass
@@ -286,18 +289,27 @@ class PreheatService:
 
     def preheat_urls(self, urls: List[str], *, tag: str = "",
                      headers: Dict[str, str] | None = None,
-                     scheduler_ids: List[int] | None = None) -> List[GroupStatus]:
+                     scheduler_ids: List[int] | None = None,
+                     clusters: List[str] | None = None) -> List[GroupStatus]:
+        """``clusters`` turns one preheat into a cross-site warm-up
+        (docs/GEO.md): each URL posts one job per target cluster, and
+        the scheduler-side worker routes each to that cluster's
+        registered bridge seed — one WAN transfer per remote site,
+        after which intra-cluster dissemination is local. None/[] keeps
+        the classic single-site job shape."""
         queues = self._target_queues(scheduler_ids)
         groups = []
         for url in urls:
-            groups.append(self.bus.post_group(
-                queues,
-                lambda url=url: Job(
-                    id=uuid.uuid4().hex, type="preheat",
-                    payload=PreheatRequest(url=url, tag=tag,
-                                           headers=dict(headers or {})),
-                ),
-            ))
+            for cluster in (clusters or [""]):
+                groups.append(self.bus.post_group(
+                    queues,
+                    lambda url=url, cluster=cluster: Job(
+                        id=uuid.uuid4().hex, type="preheat",
+                        payload=PreheatRequest(url=url, tag=tag,
+                                               headers=dict(headers or {}),
+                                               cluster=cluster),
+                    ),
+                ))
         return groups
 
     def preheat_image(self, image_url: str, *, tag: str = "",
@@ -354,7 +366,8 @@ class SchedulerJobWorker:
             self.service.preheat(
                 req.url, tag=req.tag,
                 filtered_query_params=req.filtered_query_params,
-                request_header=req.headers)
+                request_header=req.headers,
+                cluster=getattr(req, "cluster", ""))
             return None
         if job.type == "sync_peers":
             return self._sync_peers()

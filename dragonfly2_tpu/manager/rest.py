@@ -481,7 +481,11 @@ class RestApi:
                 groups = self.preheat.preheat_urls(
                     [preheat_args["url"]],
                     headers=preheat_args.get("headers"),
-                    scheduler_ids=body.get("scheduler_ids"))
+                    scheduler_ids=body.get("scheduler_ids"),
+                    # Cross-site warm-up (docs/GEO.md): one job per
+                    # listed geo cluster, each routed to that site's
+                    # bridge seed.
+                    clusters=preheat_args.get("clusters"))
             for g in groups:
                 self._groups[g.group_id] = g
             return {"ids": [g.group_id for g in groups]}

@@ -80,6 +80,12 @@ class ControlPlaneStats:
         self.task_reannounces = 0
         self.source_claims = 0
         self.source_claims_granted = 0
+        # Geo bridge election (docs/GEO.md): cross-cluster candidate
+        # asks resolved per filter pass — grants (the asking peer is /
+        # became its cluster's WAN bridge) vs denials (steered back to
+        # same-cluster parents). Zero for cluster-blind fleets.
+        self.bridge_grants = 0
+        self.bridge_denials = 0
         self.bad_node_fast = 0
         self.bad_node_slow = 0
         # Learned-cost seam (docs/REPLAY.md): is_bad_node verdicts served
@@ -166,6 +172,14 @@ class ControlPlaneStats:
             if granted:
                 self.source_claims_granted += 1
 
+    def observe_bridge(self, *, granted: bool) -> None:
+        """One cross-cluster bridge-election verdict (docs/GEO.md)."""
+        with self._lock:
+            if granted:
+                self.bridge_grants += 1
+            else:
+                self.bridge_denials += 1
+
     def observe_bad_node(self, *, fast: bool) -> None:
         # Lock-free: this fires once per CANDIDATE inside the filter hot
         # loop — taking the shared stats lock there would re-introduce
@@ -238,6 +252,8 @@ class ControlPlaneStats:
                 "task_reannounces": self.task_reannounces,
                 "source_claims": self.source_claims,
                 "source_claims_granted": self.source_claims_granted,
+                "bridge_grants": self.bridge_grants,
+                "bridge_denials": self.bridge_denials,
                 "bad_node_fast": self.bad_node_fast,
                 "bad_node_slow": self.bad_node_slow,
                 "bad_node_learned": self.bad_node_learned,

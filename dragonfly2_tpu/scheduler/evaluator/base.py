@@ -68,6 +68,17 @@ class PeerLike(Protocol):
     def piece_costs(self) -> Sequence[float]: ...
 
 
+def _locality_idc(host) -> str:
+    """Effective IDC for the affinity term: hosts that carry a geo
+    cluster expose ``locality_idc`` (idc, else a ``cluster:<id>``
+    synthetic — docs/GEO.md), so multi-site fleets get intra-cluster
+    affinity through the EXISTING ``idc_match`` column and the trained
+    models' 11-wide rows stay valid. Duck-typed hosts without the
+    property (and every cluster-blind host) fall back to ``idc`` —
+    byte-identical to the pre-geo feature row."""
+    return getattr(host, "locality_idc", None) or host.idc
+
+
 def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> np.ndarray:
     """Extract the canonical feature vector for one (parent, child) pair."""
     host = parent.host
@@ -88,8 +99,8 @@ def pair_features(parent: PeerLike, child: PeerLike, total_piece_count: int) -> 
         concurrent_upload_limit=host.concurrent_upload_limit,
         is_seed=is_seed,
         seed_ready=is_seed and state in (PEER_STATE_RECEIVED_NORMAL, PEER_STATE_RUNNING),
-        parent_idc=host.idc,
-        child_idc=child.host.idc,
+        parent_idc=_locality_idc(host),
+        child_idc=_locality_idc(child.host),
         parent_location=host.location,
         child_location=child.host.location,
     )
@@ -132,7 +143,7 @@ def build_feature_matrix(
     m = out[:n]
     child_finished = child.finished_piece_count()
     child_host = child.host
-    child_idc = child_host.idc
+    child_idc = _locality_idc(child_host)
     child_location = child_host.location
     for i, parent in enumerate(parents):
         host = parent.host
@@ -148,7 +159,7 @@ def build_feature_matrix(
         row[_I_IS_SEED] = 1.0 if is_seed else 0.0
         row[_I_SEED_READY] = (
             1.0 if is_seed and parent.state() in _SEED_READY_STATES else 0.0)
-        row[_I_IDC] = scoring.idc_match(host.idc, child_idc)
+        row[_I_IDC] = scoring.idc_match(_locality_idc(host), child_idc)
         row[_I_LOCATION] = scoring.location_matches(
             host.location, child_location)
     return m

@@ -35,7 +35,8 @@ def handle_scheduler_job(service, scheduler_id: int, job_type: str,
         service.preheat(
             payload["url"], tag=payload.get("tag", ""),
             filtered_query_params=payload.get("filtered_query_params", []),
-            request_header=payload.get("headers", {}))
+            request_header=payload.get("headers", {}),
+            cluster=payload.get("cluster", ""))
         return None
     if job_type == "sync_peers":
         return {"scheduler_id": scheduler_id,

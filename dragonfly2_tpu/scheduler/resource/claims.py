@@ -156,3 +156,88 @@ class SourceClaims:
                 "granted_runs": self._granted_runs,
                 "expired_leases": self._expired_leases,
             }
+
+
+class BridgeClaims:
+    """Per-(task, cluster) WAN-bridge election (docs/GEO.md).
+
+    In a geo-hierarchical swarm only a small set of *bridge peers* per
+    cluster may fetch pieces across the WAN; everyone else is steered to
+    same-cluster parents. Election is claim-style, exactly like
+    :class:`SourceClaims` leases: the first peer in a cluster that
+    *needs* a cross-cluster parent acquires the cluster's bridge lease
+    on demand, renews it by continuing to ask, and forfeits it after
+    ``lease_ttl`` of silence (a dead bridge must not strand its cluster
+    behind the WAN). Terminal peer handlers release explicitly, so a
+    finished bridge hands the role over immediately.
+
+    ``max_bridges`` bounds concurrent WAN pullers per cluster — the knob
+    that trades re-convergence speed against the amplification bound
+    (every extra bridge is an extra potential WAN copy of a piece).
+    """
+
+    def __init__(self, *, max_bridges: int = 1,
+                 lease_ttl: float = DEFAULT_LEASE_TTL):
+        self.max_bridges = max(1, int(max_bridges))
+        self.lease_ttl = lease_ttl
+        # cluster → {peer_id → lease expiry}
+        self._bridges: Dict[str, Dict[str, float]] = {}
+        self._elections = 0
+        self._renewals = 0
+        self._denials = 0
+        self._expired = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, cluster: str, peer_id: str,
+                now: Optional[float] = None) -> bool:
+        """True iff ``peer_id`` is (now) a bridge for ``cluster`` —
+        granted when it already holds a lease (renewal) or a slot is
+        free/expired; denied otherwise. Called from the candidate
+        filter, so it must stay O(bridges-per-cluster)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            held = self._bridges.setdefault(cluster, {})
+            expiry = held.get(peer_id)
+            if expiry is not None:
+                held[peer_id] = now + self.lease_ttl
+                self._renewals += 1
+                return True
+            stale = [p for p, exp in held.items() if exp < now]
+            for p in stale:
+                del held[p]
+            self._expired += len(stale)
+            if len(held) < self.max_bridges:
+                held[peer_id] = now + self.lease_ttl
+                self._elections += 1
+                return True
+            self._denials += 1
+            return False
+
+    def is_bridge(self, cluster: str, peer_id: str,
+                  now: Optional[float] = None) -> bool:
+        """Lease probe without election or renewal."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            expiry = self._bridges.get(cluster, {}).get(peer_id)
+            return expiry is not None and expiry >= now
+
+    def release(self, peer_id: str) -> int:
+        """Drop every bridge lease ``peer_id`` holds (terminal peer);
+        returns how many clusters lost their bridge."""
+        with self._lock:
+            freed = 0
+            for held in self._bridges.values():
+                if held.pop(peer_id, None) is not None:
+                    freed += 1
+            return freed
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "clusters": {c: len(h) for c, h in self._bridges.items()
+                             if h},
+                "elections": self._elections,
+                "renewals": self._renewals,
+                "denials": self._denials,
+                "expired": self._expired,
+            }

@@ -38,6 +38,10 @@ class Host:
     platform_version: str = ""
     kernel_version: str = ""
     scheduler_cluster_id: int = 0
+    # Geo cluster identity ('' = cluster-blind, docs/GEO.md): announced
+    # by the daemon, inherited by its peers, and the key the bridge
+    # election + same-cluster candidate steering group by.
+    cluster_id: str = ""
     concurrent_upload_limit: int = 0
     concurrent_upload_count: int = 0
     upload_count: int = 0
@@ -75,6 +79,17 @@ class Host:
     @property
     def location(self) -> str:
         return self.network.location
+
+    @property
+    def locality_idc(self) -> str:
+        """Effective IDC for the evaluator's affinity term: the
+        operator-announced idc when set, else a synthetic one derived
+        from the geo cluster — so multi-site fleets get intra-cluster
+        scoring affinity without a 12th feature column (the trained
+        models' 11-wide rows stay valid), and cluster-blind hosts score
+        byte-for-byte as before."""
+        return self.network.idc or (
+            "cluster:" + self.cluster_id if self.cluster_id else "")
 
     def free_upload_count(self) -> int:
         return self.concurrent_upload_limit - self.concurrent_upload_count

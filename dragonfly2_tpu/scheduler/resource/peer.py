@@ -108,7 +108,8 @@ class Peer:
     # read with getattr(..., None) so leaving it unset is fine.
     __slots__ = (
         "id", "task", "host", "tag", "application", "priority",
-        "range_header", "traffic_class", "tenant", "finished_pieces",
+        "range_header", "traffic_class", "tenant", "cluster_id",
+        "finished_pieces",
         "pieces", "_piece_costs",
         "cost", "block_parents", "need_back_to_source", "schedule_count",
         "piece_updated_at", "created_at", "updated_at", "_lock", "fsm",
@@ -118,7 +119,7 @@ class Peer:
     def __init__(self, id: str, task: Task, host: Host, *,
                  tag: str = "", application: str = "", priority: int = 0,
                  range_header: str = "", traffic_class: str = "",
-                 tenant: str = "",
+                 tenant: str = "", cluster_id: str = "",
                  piece_cost_window: int = DEFAULT_PIECE_COST_WINDOW):
         self.id = id
         self.task = task
@@ -131,6 +132,9 @@ class Peer:
         # class-aware candidate ordering + per-class scheduler counters.
         self.traffic_class = traffic_class
         self.tenant = tenant
+        # Geo cluster (docs/GEO.md): defaults to the host's announced
+        # cluster so register_peer payloads need not repeat it.
+        self.cluster_id = cluster_id or getattr(host, "cluster_id", "")
         self.finished_pieces: set[int] = set()
         self.pieces: Dict[int, Piece] = {}
         # Lazily materialized on the first appended cost; window size is

@@ -97,8 +97,8 @@ class Task:
         "filtered_query_params", "request_header", "piece_length",
         "url_range", "content_length", "total_piece_count", "direct_piece",
         "back_to_source_limit", "back_to_source_peers", "peer_failed_count",
-        "pieces", "source_claims", "dag", "created_at", "updated_at",
-        "_lock", "fsm",
+        "pieces", "source_claims", "bridge_claims", "dag", "created_at",
+        "updated_at", "_lock", "fsm",
     )
 
     def __init__(
@@ -137,6 +137,10 @@ class Task:
         # present only once a back-to-source peer asked for disjoint
         # origin claims — the piece-report hot path guards on None.
         self.source_claims = None
+        # Lazily-created WAN bridge election (resource/claims.py
+        # BridgeClaims): present only once a cluster-tagged peer wanted
+        # a cross-cluster parent — cluster-blind swarms never pay it.
+        self.bridge_claims = None
         self.dag: dag_mod.DAG = dag_mod.DAG()
         now = time.time()
         self.created_at = now
@@ -176,6 +180,16 @@ class Task:
             if self.source_claims is None:
                 self.source_claims = SourceClaims(total_pieces, seed=self.id)
             return self.source_claims
+
+    def ensure_bridge_claims(self, max_bridges: int = 1):
+        """Lazily create the per-cluster WAN bridge election (first
+        cross-cluster candidate ask wins the shape, docs/GEO.md)."""
+        from dragonfly2_tpu.scheduler.resource.claims import BridgeClaims
+
+        with self._lock:
+            if self.bridge_claims is None:
+                self.bridge_claims = BridgeClaims(max_bridges=max_bridges)
+            return self.bridge_claims
 
     def mark_piece_landed(self, number: int) -> None:
         """Feed the claim map from the piece-report path: ANY replica of

@@ -68,6 +68,7 @@ class AnnounceHostRequest:
     type: str = "normal"
     idc: str = ""
     location: str = ""
+    cluster_id: str = ""  # geo cluster ("" = cluster-blind, docs/GEO.md)
     concurrent_upload_limit: int = 0
     telemetry: dict = field(default_factory=dict)
 
@@ -79,6 +80,7 @@ class AnnounceHostRequest:
             id=host.id, hostname=host.hostname, ip=host.ip, port=host.port,
             download_port=host.download_port, type=host.type.type_name,
             idc=host.network.idc, location=host.network.location,
+            cluster_id=getattr(host, "cluster_id", ""),
             concurrent_upload_limit=host.concurrent_upload_limit,
             # psutil snapshot + platform identity (announcer.go:45-158) —
             # the MLP's machine features must survive the wire.
@@ -118,6 +120,7 @@ class AnnounceHostRequest:
             id=self.id, hostname=self.hostname, ip=self.ip, port=self.port,
             download_port=self.download_port,
             type=HostType.from_name(self.type),
+            cluster_id=self.cluster_id,
             concurrent_upload_limit=self.concurrent_upload_limit,
             network=network,
             cpu=records.CPU(**cpu_kw),
@@ -215,6 +218,7 @@ class WireRegisterPeer:
     reestablish: bool = False  # failover re-home, not a fresh register
     traffic_class: str = ""    # QoS class ("" = class-blind)
     tenant: str = ""
+    cluster_id: str = ""       # geo cluster ("" = cluster-blind)
 
 
 @message("scheduler.WirePeerEvent")
@@ -610,6 +614,7 @@ class SchedulerRpcService:
                         reestablish=req.reestablish,
                         traffic_class=req.traffic_class,
                         tenant=req.tenant,
+                        cluster_id=req.cluster_id,
                     ),
                     channel=channel,
                 )
@@ -1205,7 +1210,8 @@ class BalancedSchedulerClient:
     HANDOFF_DRAIN_JOIN_S = 10.0
 
     def __init__(self, targets, client_factory=None, tls=None,
-                 health_probe=None, recovery=None):
+                 health_probe=None, recovery=None, cluster_id="",
+                 target_clusters=None):
         from dragonfly2_tpu.client.recovery import RECOVERY
         from dragonfly2_tpu.rpc.client import HashRing
 
@@ -1213,6 +1219,14 @@ class BalancedSchedulerClient:
             (lambda t: GrpcSchedulerClient(t, tls=tls)) if tls is not None
             else GrpcSchedulerClient)
         self.ring = HashRing(targets)
+        # Geo awareness (docs/GEO.md): when the daemon knows its own
+        # cluster AND the per-target cluster map, the ring walk prefers
+        # same-cluster replicas — crossing the WAN to a remote-site
+        # scheduler only after every local one is down or draining.
+        # Either empty → cluster-blind: the walk below is byte-identical
+        # to the pre-geo ordering.
+        self._cluster_id = cluster_id or ""
+        self._target_clusters: Dict[str, str] = dict(target_clusters or {})
         self._clients: Dict[str, GrpcSchedulerClient] = {}
         self._peer_owner: Dict[str, GrpcSchedulerClient] = {}
         # peer_id → replayable session state (failover + handoff input).
@@ -1315,9 +1329,25 @@ class BalancedSchedulerClient:
         """Ring order with NOT_SERVING targets moved to the back. Lazy:
         each target is probed only when the walk reaches it, so a
         first-target success never pays for probing the rest of the
-        fleet (cold-cache probes cost up to 1 s each)."""
-        drained = []
+        fleet (cold-cache probes cost up to 1 s each).
+
+        With a geo cluster configured, targets KNOWN to sit in a remote
+        cluster are deferred behind every local serving target (but
+        still ahead of drained ones): scheduler RPCs stay on-site until
+        the local replicas are gone. Targets absent from the cluster map
+        are treated as local — an unlabeled fleet keeps the plain
+        health-aware order."""
+        remote, drained = [], []
         for target in self.ring.walk(key):
+            if (self._cluster_id and self._target_clusters.get(
+                    target, self._cluster_id) != self._cluster_id):
+                remote.append(target)
+                continue
+            if self._serving(target):
+                yield target
+            else:
+                drained.append(target)
+        for target in remote:
             if self._serving(target):
                 yield target
             else:

@@ -283,6 +283,13 @@ class Scheduling:
         dag = task.dag
         peer_id = peer.id
         peer_host_id = peer.host.id
+        # Geo steering (docs/GEO.md): a cluster-tagged child may only
+        # take CROSS-cluster parents while it holds its cluster's WAN
+        # bridge lease; election is on demand and resolved at most once
+        # per filter pass (the tri-state also renews a held lease).
+        # Cluster-blind peers ('' either side) skip all of this.
+        peer_cluster = getattr(peer, "cluster_id", "")
+        bridge_ok: "bool | None" = None
         can_add_peer_edge = task.can_add_peer_edge
         is_bad_node = self.evaluator.is_bad_node
         out = []
@@ -298,6 +305,15 @@ class Scheduling:
             # downloads between two local tasks).
             if candidate.host.id == peer_host_id:
                 continue
+            if peer_cluster:
+                cand_cluster = getattr(candidate, "cluster_id", "")
+                if cand_cluster and cand_cluster != peer_cluster:
+                    if bridge_ok is None:
+                        bridge_ok = task.ensure_bridge_claims().acquire(
+                            peer_cluster, peer_id)
+                        self.stats.observe_bridge(granted=bridge_ok)
+                    if not bridge_ok:
+                        continue
             if is_bad_node(candidate):
                 if counts is not None:
                     counts["bad_node"] += 1

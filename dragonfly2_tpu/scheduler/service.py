@@ -82,6 +82,9 @@ class RegisterPeerRequest:
     # ordering, per-class scheduler counters and class-tagged SLOs.
     traffic_class: str = ""
     tenant: str = ""
+    # Geo cluster identity (docs/GEO.md): "" defers to the announced
+    # host's cluster, so daemons need not repeat it per registration.
+    cluster_id: str = ""
 
 
 @dataclass
@@ -186,6 +189,11 @@ class SchedulerService:
         # SeedPeerClient protocol: trigger_task(task, url_meta) — implemented
         # by the daemon's seeder binding (resource/seed_peer.go:101).
         self.seed_peer_client = seed_peer_client
+        # Geo federation (docs/GEO.md): per-cluster seed clients for
+        # cross-site preheat — a manager job targeting cluster X warms
+        # X's seed/bridge daemon, not whichever seed happens to be the
+        # default. Empty for single-site deployments.
+        self._cluster_seed_clients: Dict[str, object] = {}
         # SchedulerMetrics (scheduler/metrics.py) or None — instrumentation
         # is optional so unit tests and embedded uses stay dependency-free.
         self.metrics = metrics
@@ -208,7 +216,7 @@ class SchedulerService:
         for attr in ("ip", "port", "download_port", "cpu", "memory",
                      "network", "disk", "build", "concurrent_upload_limit",
                      "os", "platform", "platform_family", "platform_version",
-                     "kernel_version"):
+                     "kernel_version", "cluster_id"):
             setattr(existing, attr, getattr(host, attr))
         existing.touch()
 
@@ -286,6 +294,7 @@ class SchedulerService:
         application = sys.intern(req.application)
         traffic_class = sys.intern(req.traffic_class)
         tenant = sys.intern(req.tenant)
+        cluster_id = sys.intern(req.cluster_id)
         task = self.resource.task_manager.load_or_store(
             Task(req.task_id, url=req.url, tag=tag,
                  application=application,
@@ -297,7 +306,8 @@ class SchedulerService:
         peer = self.resource.peer_manager.load_or_store(
             Peer(req.peer_id, task, host, tag=tag,
                  application=application, priority=req.priority,
-                 traffic_class=traffic_class, tenant=tenant)
+                 traffic_class=traffic_class, tenant=tenant,
+                 cluster_id=cluster_id)
         )
         if traffic_class:
             self.stats.observe_announce_class(traffic_class)
@@ -434,16 +444,32 @@ class SchedulerService:
         except Exception:
             logger.exception("seed peer trigger failed for task %s", task.id)
 
+    def register_seed_client(self, cluster_id: str, client) -> None:
+        """Bind a seed-peer client to a geo cluster (docs/GEO.md) so
+        cluster-targeted preheats warm THAT site's bridge. The default
+        ``seed_peer_client`` keeps serving untargeted preheats."""
+        self._cluster_seed_clients[cluster_id] = client
+
     def preheat(self, url: str, *, tag: str = "",
                 filtered_query_params: Optional[List[str]] = None,
-                request_header: Optional[Dict[str, str]] = None) -> str:
+                request_header: Optional[Dict[str, str]] = None,
+                cluster: str = "") -> str:
         """Warm a URL onto the seed peers, synchronously — the scheduler
         half of the manager's preheat job (scheduler/job/job.go:152-222:
         resolve task id, TriggerTask on the seed, job status from the
-        outcome). Returns the task id."""
+        outcome). ``cluster`` routes to that cluster's registered seed
+        client (cross-site preheat); "" keeps the default seed. Returns
+        the task id."""
         from dragonfly2_tpu.utils import idgen
 
-        if self.seed_peer_client is None:
+        seed_client = self.seed_peer_client
+        if cluster:
+            seed_client = self._cluster_seed_clients.get(cluster)
+            if seed_client is None:
+                raise ServiceError(
+                    FAILED_PRECONDITION,
+                    f"no seed client registered for cluster {cluster!r}")
+        if seed_client is None:
             raise ServiceError(FAILED_PRECONDITION, "no seed peer client")
         task_id = idgen.task_id_v1(
             url, tag=tag,
@@ -454,9 +480,13 @@ class SchedulerService:
                  filtered_query_params=list(filtered_query_params or []),
                  request_header=dict(request_header or {}))
         )
-        if task.fsm.is_state(TaskState.SUCCEEDED):
-            return task_id  # already warm
-        ok = self.seed_peer_client.trigger_task(task)
+        if not cluster and task.fsm.is_state(TaskState.SUCCEEDED):
+            # Untargeted preheat: any warm replica satisfies it. A
+            # cluster-targeted preheat must still trigger — the task
+            # being warm at ANOTHER site is exactly the situation the
+            # cross-site warm-up exists for.
+            return task_id
+        ok = seed_client.trigger_task(task)
         if ok is False:
             raise ServiceError(INTERNAL, f"seed trigger failed for {url}")
         return task_id
@@ -691,6 +721,15 @@ class SchedulerService:
             if self.metrics:
                 self.metrics.schedule_duration.observe(elapsed)
 
+    @staticmethod
+    def _release_bridge(task: Task, peer_id: str) -> None:
+        """Terminal peers hand their WAN bridge role over immediately
+        (docs/GEO.md) — same discipline as the source-claim release: a
+        finished/failed/left bridge must not make its cluster idle out
+        the lease TTL before another peer may cross the WAN."""
+        if task.bridge_claims is not None:
+            task.bridge_claims.release(peer_id)
+
     def download_peer_finished(self, peer_id: str, cost_seconds: float = 0.0) -> None:
         peer = self._peer(peer_id)
         peer.cost = cost_seconds
@@ -706,6 +745,7 @@ class SchedulerService:
             # its own source_fallback_wait) for bytes nobody will
             # deliver.
             peer.task.source_claims.release(peer_id)
+        self._release_bridge(peer.task, peer_id)
         if self.metrics:
             self.metrics.download_peer_finished.inc()
             self.metrics.download_peer_duration.observe(cost_seconds * 1e3)
@@ -736,6 +776,7 @@ class SchedulerService:
             # surviving leases cover lost landing reports — free them so
             # the next claimant can grab those pieces immediately.
             task.source_claims.release(peer_id)
+        self._release_bridge(task, peer_id)
         task.report_success(content_length, total_piece_count)
         if task.fsm.can(TaskEvent.DOWNLOAD_SUCCEEDED):
             task.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
@@ -753,6 +794,7 @@ class SchedulerService:
         peer.fsm.fire(PeerEvent.DOWNLOAD_FAILED)
         if peer.task.source_claims is not None:
             peer.task.source_claims.release(peer_id)
+        self._release_bridge(peer.task, peer_id)
         peer.task.peer_failed_count += 1
         if self.metrics:
             self.metrics.download_peer_failure.inc()
@@ -772,6 +814,7 @@ class SchedulerService:
             # out the TTL — surviving claimants pick the pieces up on
             # their next claim poll.
             task.source_claims.release(peer_id)
+        self._release_bridge(task, peer_id)
         if task.fsm.can(TaskEvent.DOWNLOAD_FAILED):
             task.fsm.fire(TaskEvent.DOWNLOAD_FAILED)
         # Unverified metadata dies with the failed back-source attempt
@@ -800,6 +843,7 @@ class SchedulerService:
         peer = self._peer(peer_id)
         if peer.task.source_claims is not None:
             peer.task.source_claims.release(peer_id)
+        self._release_bridge(peer.task, peer_id)
         peer.leave()
         self._record_replay_outcome(peer)
         peer.task.delete_peer_in_edges(peer.id)

@@ -36,6 +36,21 @@ from dragonfly2_tpu.utils.httpserver import ThreadedHTTPService
 
 _START_TIME = time.time()
 
+# Geo cluster identity of this process ("" = cluster-blind). Set once at
+# service startup (cmd/common.init_observability_identity); read by
+# process_vars and the Prometheus bridge so every exported block carries
+# which site it came from (docs/GEO.md).
+_CLUSTER_ID = ""
+
+
+def set_cluster_id(cluster_id: str) -> None:
+    global _CLUSTER_ID
+    _CLUSTER_ID = cluster_id or ""
+
+
+def cluster_id() -> str:
+    return _CLUSTER_ID
+
 
 def thread_dump() -> str:
     """All live threads with their current stacks (the goroutine dump)."""
@@ -115,6 +130,10 @@ def process_vars(full: bool = False) -> dict:
         "gc_counts": gc.get_count(),
         "python": sys.version.split()[0],
     }
+    if _CLUSTER_ID:
+        # Only cluster-labeled processes grow the key: cluster-blind
+        # /debug/vars output stays byte-identical.
+        out["cluster"] = _CLUSTER_ID
     if full:
         # len(gc.get_objects()) is an O(live heap) stop-the-world scan —
         # hundreds of ms on a 100k-peer scheduler, per poll. Opt-in via

@@ -88,12 +88,20 @@ class DebugVarsCollector:
         label_names: Dict[str, List[str]] = {}
         blocks = {"process": debugmon.process_vars}
         blocks.update(debugmon.registered_debug_vars())
+        # Geo cluster label (docs/GEO.md): a cluster-labeled process
+        # stamps every exported metric, so one federated Prometheus
+        # scraping multiple sites can tell the series apart. Resolved
+        # per scrape; cluster-blind processes emit no extra label and
+        # their exposition text stays byte-identical.
+        cluster = debugmon.cluster_id()
         for block, fn in blocks.items():
             try:
                 value = fn()
             except Exception:  # noqa: BLE001 — mirror debug_vars()
                 continue
             for parts, labels, leaf in flatten_block(value, (block,)):
+                if cluster:
+                    labels = {**labels, "cluster": cluster}
                 name = _metric_name(*parts)
                 names = sorted(labels)
                 fam = families.get(name)

@@ -240,8 +240,12 @@ class Tracer:
     def __init__(self, service: str, out_dir: str = "",
                  max_bytes: int = 32 * 1024 * 1024, backups: int = 2,
                  otlp_endpoint: str = "", sampler: TailSampler | None = None,
-                 stats=None):
+                 stats=None, cluster: str = ""):
         self.service = service
+        #: Geo cluster of the emitting process (docs/GEO.md); when set,
+        #: every record carries a ``cluster`` field so multi-site trace
+        #: stores can tell which side of the WAN a span ran on.
+        self.cluster = cluster
         self.enabled = bool(out_dir) or bool(otlp_endpoint)
         self.sampler = sampler
         self._lock = threading.Lock()
@@ -283,6 +287,8 @@ class Tracer:
             "attrs": attrs,
             "status": "ok",
         }
+        if self.cluster:
+            record["cluster"] = self.cluster
         if links:
             # OTel span links: e.g. a report batch pointing at the piece
             # spans whose reports it carries.
@@ -323,6 +329,8 @@ class Tracer:
             "status": status,
             "duration_ms": round(duration_s * 1e3, 3),
         }
+        if self.cluster:
+            record["cluster"] = self.cluster
         self._sink(record)
 
     # -- tail-sampling surface --------------------------------------------

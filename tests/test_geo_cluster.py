@@ -1,0 +1,358 @@
+"""Geo cluster identity end to end (docs/GEO.md).
+
+Covers the control-plane half of ISSUE 18: cluster_id over the announce
+wire and onto Host/Peer, per-(task, cluster) WAN bridge election and its
+candidate-filter steering, locality scoring through the existing
+idc_match feature slot, the scheduler client's local-first ring walk,
+cluster-targeted preheat routing, and the cluster tag on the
+observability plane. The recurring invariant: a cluster-BLIND
+configuration must behave byte-for-byte as before the geo work landed.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dragonfly2_tpu.scheduler.controlstats import ControlPlaneStats
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.evaluator import scoring
+from dragonfly2_tpu.scheduler.evaluator.base import (
+    build_feature_matrix,
+    pair_features,
+)
+from dragonfly2_tpu.scheduler.resource import (
+    Host,
+    Peer,
+    PeerEvent,
+    Task,
+    TaskEvent,
+)
+from dragonfly2_tpu.scheduler.resource.claims import BridgeClaims
+from dragonfly2_tpu.scheduler.resource.resource import Resource
+from dragonfly2_tpu.scheduler.rpcserver import (
+    AnnounceHostRequest,
+    BalancedSchedulerClient,
+)
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import (
+    FAILED_PRECONDITION,
+    SchedulerService,
+    ServiceError,
+)
+from dragonfly2_tpu.utils.hosttypes import HostType
+
+_I_IDC = scoring.FEATURE_NAMES.index("idc_match")
+
+
+def make_peer(peer_id, task, host, *, running=False, cluster_id=""):
+    p = Peer(peer_id, task, host, cluster_id=cluster_id)
+    p.fsm.fire(PeerEvent.REGISTER_NORMAL)
+    if running:
+        p.fsm.fire(PeerEvent.DOWNLOAD)
+    else:
+        p.fsm.fire(PeerEvent.DOWNLOAD_SUCCEEDED)
+        p.finished_pieces |= set(range(64))
+    task.store_peer(p)
+    return p
+
+
+def make_geo_task(parent_clusters=("site-b", "site-b"),
+                  child_cluster="site-a"):
+    """One cluster-tagged running child + succeeded parents, one per
+    entry in ``parent_clusters`` (test_scheduling.make_cluster with geo
+    identity on every host)."""
+    task = Task("task-1", "https://e.com/f")
+    task.total_piece_count = 64
+    task.content_length = 64 << 22
+    parents = []
+    for i, cluster in enumerate(parent_clusters):
+        host = Host(id=f"host-p{i}", ip=f"10.0.1.{i}", cluster_id=cluster)
+        parents.append(make_peer(f"parent-{i}", task, host))
+    child = make_peer("child", task,
+                      Host(id="host-c", ip="10.0.2.1",
+                           cluster_id=child_cluster), running=True)
+    return task, parents, child
+
+
+def steering():
+    stats = ControlPlaneStats()
+    return Scheduling(BaseEvaluator(),
+                      SchedulingConfig(retry_interval=0.0),
+                      stats=stats), stats
+
+
+class TestClusterIdentityWire:
+    def test_announce_round_trip(self):
+        host = Host(id="h1", ip="10.0.0.1", cluster_id="site-a")
+        req = AnnounceHostRequest.from_host(host)
+        assert req.cluster_id == "site-a"
+        assert req.to_host().cluster_id == "site-a"
+
+    def test_cluster_blind_round_trip(self):
+        req = AnnounceHostRequest.from_host(Host(id="h1", ip="10.0.0.1"))
+        assert req.cluster_id == ""
+        assert req.to_host().cluster_id == ""
+
+    def test_reannounce_refreshes_cluster(self):
+        service = SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(BaseEvaluator(), SchedulingConfig()))
+        service.announce_host(Host(id="h1", ip="10.0.0.1"))
+        service.announce_host(Host(id="h1", ip="10.0.0.1",
+                                   cluster_id="site-a"))
+        assert service.resource.host_manager.load("h1").cluster_id == "site-a"
+
+    def test_peer_inherits_host_cluster(self):
+        task = Task("t", "https://e.com/f")
+        host = Host(id="h1", ip="10.0.0.1", cluster_id="site-a")
+        assert Peer("p1", task, host).cluster_id == "site-a"
+        # Explicit registration identity wins over the host's.
+        assert Peer("p2", task, host,
+                    cluster_id="site-b").cluster_id == "site-b"
+
+
+class TestBridgeClaims:
+    def test_election_then_renewal(self):
+        claims = BridgeClaims()
+        assert claims.acquire("site-a", "p1", now=0.0)
+        assert claims.acquire("site-a", "p1", now=1.0)  # renewal
+        snap = claims.snapshot()
+        assert snap["elections"] == 1 and snap["renewals"] == 1
+        assert snap["clusters"] == {"site-a": 1}
+
+    def test_slot_full_denies_second_peer(self):
+        claims = BridgeClaims()
+        assert claims.acquire("site-a", "p1", now=0.0)
+        assert not claims.acquire("site-a", "p2", now=1.0)
+        assert claims.snapshot()["denials"] == 1
+        # ...but another cluster's slot is independent.
+        assert claims.acquire("site-b", "p2", now=1.0)
+
+    def test_lease_expires(self):
+        claims = BridgeClaims(lease_ttl=45.0)
+        assert claims.acquire("site-a", "p1", now=0.0)
+        assert claims.acquire("site-a", "p2", now=50.0)  # p1 silent > ttl
+        snap = claims.snapshot()
+        assert snap["expired"] == 1 and snap["elections"] == 2
+        assert not claims.is_bridge("site-a", "p1", now=50.0)
+        assert claims.is_bridge("site-a", "p2", now=50.0)
+
+    def test_release_hands_over_immediately(self):
+        claims = BridgeClaims()
+        assert claims.acquire("site-a", "p1", now=0.0)
+        assert claims.release("p1") == 1
+        assert claims.acquire("site-a", "p2", now=0.1)
+        assert claims.release("unknown") == 0
+
+    def test_is_bridge_is_a_pure_probe(self):
+        claims = BridgeClaims()
+        assert not claims.is_bridge("site-a", "p1", now=0.0)
+        assert claims.snapshot()["elections"] == 0
+
+    def test_max_bridges_bounds_concurrent_wan_pullers(self):
+        claims = BridgeClaims(max_bridges=2)
+        assert claims.acquire("site-a", "p1", now=0.0)
+        assert claims.acquire("site-a", "p2", now=0.0)
+        assert not claims.acquire("site-a", "p3", now=0.0)
+
+
+class TestBridgeSteering:
+    """_filter_candidate_parents: cross-cluster parents only for the
+    cluster's elected bridge peer."""
+
+    def test_first_child_elected_bridge_sees_wan_parents(self):
+        task, parents, child = make_geo_task()
+        sched, stats = steering()
+        got = sched.find_candidate_parents(child, set())
+        assert {p.id for p in got} == {p.id for p in parents}
+        assert stats.snapshot()["bridge_grants"] == 1
+        assert task.bridge_claims.is_bridge("site-a", child.id)
+
+    def test_non_bridge_child_loses_wan_parents(self):
+        task, _, bridge = make_geo_task()
+        sched, stats = steering()
+        assert sched.find_candidate_parents(bridge, set())
+        other = make_peer("child-2", task,
+                          Host(id="host-c2", ip="10.0.2.2",
+                               cluster_id="site-a"), running=True)
+        assert sched.find_candidate_parents(other, set()) == []
+        assert stats.snapshot()["bridge_denials"] >= 1
+
+    def test_same_cluster_parents_unaffected_by_denial(self):
+        task, parents, bridge = make_geo_task(
+            parent_clusters=("site-b", "site-a"))
+        sched, _ = steering()
+        assert sched.find_candidate_parents(bridge, set())
+        other = make_peer("child-2", task,
+                          Host(id="host-c2", ip="10.0.2.2",
+                               cluster_id="site-a"), running=True)
+        got = sched.find_candidate_parents(other, set())
+        # The WAN parent is steered away; the local one still serves.
+        assert [p.id for p in got] == [parents[1].id]
+
+    def test_untagged_parent_never_triggers_election(self):
+        task, parents, child = make_geo_task(parent_clusters=("", ""))
+        sched, stats = steering()
+        got = sched.find_candidate_parents(child, set())
+        assert {p.id for p in got} == {p.id for p in parents}
+        assert task.bridge_claims is None
+        assert stats.snapshot()["bridge_grants"] == 0
+
+    def test_cluster_blind_swarm_never_pays(self):
+        task, parents, child = make_geo_task(
+            parent_clusters=("", ""), child_cluster="")
+        sched, stats = steering()
+        got = sched.find_candidate_parents(child, set())
+        assert {p.id for p in got} == {p.id for p in parents}
+        assert task.bridge_claims is None
+        snap = stats.snapshot()
+        assert snap["bridge_grants"] == 0 and snap["bridge_denials"] == 0
+
+
+class TestLocalityScoring:
+    def test_locality_idc_property(self):
+        assert Host(id="h", ip="1.2.3.4",
+                    cluster_id="x").locality_idc == "cluster:x"
+        assert Host(id="h", ip="1.2.3.4").locality_idc == ""
+        tagged = Host(id="h", ip="1.2.3.4", cluster_id="x")
+        tagged.network.idc = "dc9"
+        assert tagged.locality_idc == "dc9"  # operator idc wins
+
+    def _pair(self, parent_cluster, child_cluster):
+        task, parents, child = make_geo_task(
+            parent_clusters=(parent_cluster,), child_cluster=child_cluster)
+        return pair_features(parents[0], child, 64)
+
+    def test_same_cluster_scores_idc_match(self):
+        assert self._pair("site-a", "site-a")[_I_IDC] == 1.0
+        assert self._pair("site-b", "site-a")[_I_IDC] == 0.0
+        assert self._pair("", "")[_I_IDC] == 0.0   # blind: as before
+        assert self._pair("site-a", "")[_I_IDC] == 0.0
+
+    def test_matrix_matches_pair_features_for_tagged_hosts(self):
+        import numpy as np
+
+        task, parents, child = make_geo_task(
+            parent_clusters=("site-a", "site-b"))
+        rows = build_feature_matrix(parents, child, 64)
+        stacked = np.stack([pair_features(p, child, 64) for p in parents])
+        assert np.array_equal(rows, stacked)
+
+
+class TestBalancedClientLocalFirstWalk:
+    def _client(self, **kw):
+        return BalancedSchedulerClient(
+            ["t1", "t2", "t3"], client_factory=lambda t: None,
+            health_probe=kw.pop("health_probe",
+                                lambda target: "SERVING"), **kw)
+
+    def test_remote_cluster_targets_deferred(self):
+        cli = self._client(cluster_id="site-a",
+                           target_clusters={"t2": "site-b"})
+        walk = list(cli._walk_healthy("key"))
+        assert sorted(walk) == ["t1", "t2", "t3"]
+        assert walk[-1] == "t2"   # known-remote goes last...
+
+    def test_remote_still_beats_drained_local(self):
+        from dragonfly2_tpu.rpc.health import NOT_SERVING
+
+        cli = self._client(
+            cluster_id="site-a", target_clusters={"t2": "site-b"},
+            health_probe=lambda t: NOT_SERVING if t == "t1" else "SERVING")
+        walk = list(cli._walk_healthy("key"))
+        assert walk == ["t3", "t2", "t1"]  # local, then WAN, then drained
+
+    def test_cluster_blind_walk_is_plain_ring_order(self):
+        cli = self._client()
+        assert list(cli._walk_healthy("key")) == \
+            list(cli.ring.walk("key"))
+
+    def test_unlabeled_targets_treated_as_local(self):
+        cli = self._client(cluster_id="site-a")  # no target map at all
+        assert list(cli._walk_healthy("key")) == \
+            list(cli.ring.walk("key"))
+
+
+class _FakeSeedClient:
+    def __init__(self):
+        self.triggered = []
+
+    def trigger_task(self, task, url_meta=None):
+        self.triggered.append(task.id)
+        return True
+
+
+class TestClusterPreheat:
+    def _service(self):
+        return SchedulerService(
+            resource=Resource(),
+            scheduling=Scheduling(BaseEvaluator(), SchedulingConfig()),
+            seed_peer_client=_FakeSeedClient())
+
+    def test_unregistered_cluster_is_a_precondition_failure(self):
+        service = self._service()
+        with pytest.raises(ServiceError) as err:
+            service.preheat("https://e.com/f", cluster="site-b")
+        assert err.value.code == FAILED_PRECONDITION
+        assert "site-b" in str(err.value)
+
+    def test_routes_to_registered_cluster_seed(self):
+        service = self._service()
+        remote = _FakeSeedClient()
+        service.register_seed_client("site-b", remote)
+        task_id = service.preheat("https://e.com/f", cluster="site-b")
+        assert remote.triggered == [task_id]
+        assert service.seed_peer_client.triggered == []  # default idle
+
+    def test_targeted_preheat_bypasses_succeeded_short_circuit(self):
+        service = self._service()
+        remote = _FakeSeedClient()
+        service.register_seed_client("site-b", remote)
+        task_id = service.preheat("https://e.com/f")
+        task = service.resource.task_manager.load(task_id)
+        task.fsm.fire(TaskEvent.DOWNLOAD)
+        task.fsm.fire(TaskEvent.DOWNLOAD_SUCCEEDED)
+        # Untargeted: any warm replica satisfies it → no second trigger.
+        service.preheat("https://e.com/f")
+        assert len(service.seed_peer_client.triggered) == 1
+        # Cluster-targeted: warm at ANOTHER site is exactly the case
+        # cross-site preheat exists for → must still trigger.
+        service.preheat("https://e.com/f", cluster="site-b")
+        assert remote.triggered == [task_id]
+
+
+class TestObservabilityCluster:
+    def test_debug_vars_gain_cluster_key_only_when_set(self):
+        from dragonfly2_tpu.utils import debugmon
+
+        try:
+            debugmon.set_cluster_id("site-a")
+            assert debugmon.process_vars()["cluster"] == "site-a"
+        finally:
+            debugmon.set_cluster_id("")
+        assert "cluster" not in debugmon.process_vars()
+
+    def test_tracer_records_carry_cluster(self, tmp_path):
+        from dragonfly2_tpu.utils.tracing import Tracer
+
+        t = Tracer("svc", out_dir=str(tmp_path), cluster="site-a")
+        with t.span("piece.fetch", cross_cluster=True):
+            pass
+        t.emit("schedule.wait", start=0.0, duration_s=0.1)
+        records = [json.loads(line) for line in
+                   (tmp_path / "trace-svc.jsonl").read_text().splitlines()]
+        assert len(records) == 2
+        assert all(r["cluster"] == "site-a" for r in records)
+        assert records[0]["attrs"]["cross_cluster"] is True
+
+    def test_cluster_blind_tracer_records_unchanged(self, tmp_path):
+        from dragonfly2_tpu.utils.tracing import Tracer
+
+        t = Tracer("svc", out_dir=str(tmp_path))
+        with t.span("piece.fetch"):
+            pass
+        record = json.loads(
+            (tmp_path / "trace-svc.jsonl").read_text().splitlines()[0])
+        assert "cluster" not in record

@@ -1,0 +1,254 @@
+"""Deterministic WAN link-emulation plane (utils/geoplan.py).
+
+The tier-1 contract (same discipline as test_faultplan.py): a seeded
+GeoPlan produces a BIT-IDENTICAL shaping history for a fixed drive
+sequence, per-link jitter streams differ across seeds, the aggregate
+bandwidth debt clock shares a link between concurrent streams, a
+partition refuses dials AND resets in-flight streams until healed, and
+the whole thing costs nothing when no plan is installed (or when the
+destination is unshaped) — the ACTIVE-is-None A/B.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from dragonfly2_tpu.utils import geoplan
+from dragonfly2_tpu.utils.geoplan import (
+    GeoPlan,
+    LinkSpec,
+    validate_cluster_id,
+)
+
+A = "127.0.0.1:1001"  # site-a
+B = "127.0.0.1:2001"  # site-b
+C = "127.0.0.1:3001"  # site-c
+
+
+@pytest.fixture(autouse=True)
+def no_active_plan():
+    yield
+    geoplan.uninstall()
+
+
+def build(seed=1234, clock=None, **link_kw):
+    """A site-a plan with shaped links to site-b/site-c."""
+    kw = dict(latency_s=0.01, jitter_s=0.005, bandwidth_bps=1000.0)
+    kw.update(link_kw)
+    links = {("site-a", "site-b"): LinkSpec(**kw),
+             ("site-a", "site-c"): LinkSpec(**kw)}
+    plan_kw = {"seed": seed}
+    if clock is not None:
+        plan_kw["clock"] = clock
+    return GeoPlan("site-a",
+                   clusters={"site-a": [A], "site-b": [B], "site-c": [C]},
+                   links=links, **plan_kw)
+
+
+def drive(plan, clock):
+    """Fixed dial/pace/refuse sequence with a deterministic clock."""
+    for i in range(20):
+        plan.dial(B)
+        plan.pace(B, 512)
+        plan.dial(C)
+        plan.pace(C, 256)
+        clock[0] += 0.05
+    plan.partition("site-b")
+    plan.refuse(B)
+    plan.dial(B)
+    plan.heal("site-b")
+    plan.dial(B)
+    return list(plan.history)
+
+
+class TestValidateClusterId:
+    @pytest.mark.parametrize("good", ["site-a", "eu.west-1", "A1",
+                                      "rack:7", "x" * 64])
+    def test_accepts(self, good):
+        assert validate_cluster_id(good) == good
+
+    @pytest.mark.parametrize("bad", ["", "   ", "site a", " site-a",
+                                     "site-a ", "a\tb", "-lead",
+                                     ".lead", "x" * 65, None, 7])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError) as err:
+            validate_cluster_id(bad)
+        assert "--cluster-id" in str(err.value)
+
+    def test_error_names_the_flag(self):
+        with pytest.raises(ValueError) as err:
+            validate_cluster_id("", flag="--geo-cluster")
+        assert "--geo-cluster" in str(err.value)
+
+
+class TestDeterminism:
+    def test_bit_identical_history_across_runs(self):
+        c1, c2 = [0.0], [0.0]
+        h1 = drive(build(clock=lambda: c1[0]), c1)
+        h2 = drive(build(clock=lambda: c2[0]), c2)
+        assert h1, "shaped drive must record history"
+        assert h1 == h2
+
+    def test_different_seed_different_history(self):
+        c1, c2 = [0.0], [0.0]
+        h1 = drive(build(seed=1, clock=lambda: c1[0]), c1)
+        h2 = drive(build(seed=2, clock=lambda: c2[0]), c2)
+        assert h1 != h2  # per-link jitter streams are seeded
+
+    def test_links_do_not_perturb_each_other(self):
+        """site-b's decision stream is identical whether or not the
+        site-c link is exercised in between — each link owns its RNG."""
+        c1 = [0.0]
+        interleaved = [h for h in drive(build(clock=lambda: c1[0]), c1)
+                       if "site-b" in h[1]]
+        c2 = [0.0]
+        plan = build(clock=lambda: c2[0])
+        for i in range(20):
+            plan.dial(B)
+            plan.pace(B, 512)
+            c2[0] += 0.05
+        plan.partition("site-b")
+        plan.refuse(B)
+        plan.dial(B)
+        plan.heal("site-b")
+        plan.dial(B)
+        solo = [h for h in plan.history if "site-b" in h[1]]
+        assert interleaved == solo
+
+
+class TestShaping:
+    def test_unknown_and_same_cluster_addrs_are_unshaped(self):
+        plan = build()
+        for addr in ("10.9.9.9:80", A):  # origin-like + same-cluster
+            assert plan.dial(addr) == (False, 0.0)
+            assert plan.pace(addr, 4096) == 0.0
+            assert plan.refuse(addr) is False
+        assert plan.history == []           # nothing recorded
+        assert plan.snapshot()["wan_bytes"] == 0
+
+    def test_is_wan_predicate(self):
+        plan = build()
+        assert plan.is_wan(B) and plan.is_wan(C)
+        assert not plan.is_wan(A)
+        assert not plan.is_wan("10.9.9.9:80")  # unknown ≠ WAN
+
+    def test_assign_late_binds_addresses(self):
+        plan = build()
+        plan.assign("127.0.0.1:4001", "site-b")
+        assert plan.cluster_of("127.0.0.1:4001") == "site-b"
+        assert plan.is_wan("127.0.0.1:4001")
+
+    def test_unspecified_cross_cluster_link_is_counted(self):
+        plan = GeoPlan("site-a", clusters={"site-a": [A], "site-b": [B]})
+        refused, delay = plan.dial(B)
+        assert refused is False and delay == 0.0  # unshaped...
+        snap = plan.snapshot()
+        assert snap["wan_dials"] == 1             # ...but counted
+        assert "site-a->site-b" in snap["links"]
+
+    def test_dial_delay_within_latency_plus_jitter(self):
+        plan = build()
+        for _ in range(50):
+            refused, delay = plan.dial(B)
+            assert refused is False
+            assert 0.01 <= delay <= 0.015 + 1e-9
+
+    def test_pace_debt_clock_shares_the_link(self):
+        clock = [0.0]
+        plan = build(jitter_s=0.0, clock=lambda: clock[0])
+        assert plan.pace(B, 1000) == pytest.approx(1.0)   # 1000 B @ 1 kB/s
+        assert plan.pace(B, 1000) == pytest.approx(2.0)   # debt accumulates
+        assert plan.pace(B, 0) == pytest.approx(2.0)      # query only
+        clock[0] = 10.0
+        assert plan.pace(B, 0) == 0.0                     # debt paid
+        assert plan.pace(B, 500) == pytest.approx(0.5)    # fresh debt
+        assert plan.snapshot()["wan_bytes"] == 2500
+
+    def test_pace_unshaped_bandwidth_still_counts(self):
+        plan = build(bandwidth_bps=0.0, jitter_s=0.0)
+        assert plan.pace(B, 4096) == 0.0
+        assert plan.snapshot()["wan_bytes"] == 4096
+
+    def test_partition_refuses_and_resets_until_heal(self):
+        plan = build()
+        plan.partition("site-b")
+        assert plan.dial(B) == (True, 0.0)
+        assert plan.refuse(B) is True
+        assert plan.dial(C)[0] is False     # other site untouched
+        assert plan.refuse(C) is False
+        plan.heal("site-b")
+        assert plan.dial(B)[0] is False
+        snap = plan.snapshot()
+        assert snap["wan_refused"] == 1 and snap["wan_resets"] == 1
+
+    def test_partition_pair_only(self):
+        links = {("site-a", "site-b"): LinkSpec(),
+                 ("site-a", "site-c"): LinkSpec()}
+        plan = GeoPlan("site-a", clusters={"site-a": [A], "site-b": [B],
+                                           "site-c": [C]}, links=links)
+        plan.partition("site-a", "site-b")
+        assert plan.dial(B)[0] is True
+        assert plan.dial(C)[0] is False
+
+
+class TestWireForm:
+    def test_round_trip(self):
+        plan = build()
+        plan.links[("site-a", "site-b")].partitioned = True
+        data = plan.to_dict()
+        clone = GeoPlan.from_dict(data)
+        assert clone.cluster == "site-a"
+        assert clone.seed == 1234
+        assert clone.cluster_of(B) == "site-b"
+        assert clone.links[("site-a", "site-b")].partitioned is True
+        assert clone.links[("site-a", "site-c")].bandwidth_bps == 1000.0
+        assert clone.to_dict() == data
+
+    def test_from_dict_rejects_garbage(self):
+        with pytest.raises((KeyError, TypeError)):
+            GeoPlan.from_dict({"links": {"a|b": {"nope": 1}}})
+
+
+class TestActivePlan:
+    def test_no_plan_installed_is_inert(self):
+        assert geoplan.ACTIVE is None
+
+    def test_install_uninstall(self):
+        plan = geoplan.install(build())
+        assert geoplan.ACTIVE is plan
+        geoplan.uninstall()
+        assert geoplan.ACTIVE is None
+
+    def test_pool_checkout_ab(self):
+        """The REAL dial hook (dataplane pool): no plan → plain connect;
+        partitioned plan → ConnectionRefusedError; uninstall restores
+        the exact pre-geo path. This is the zero-overhead A/B — the
+        cluster-blind configuration never enters the geo code."""
+        import socket
+
+        from dragonfly2_tpu.client.dataplane import HTTPConnectionPool
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(4)
+        port = listener.getsockname()[1]
+        addr = f"127.0.0.1:{port}"
+        pool = HTTPConnectionPool(timeout=5.0)
+        key = ("http", "127.0.0.1", port)
+        try:
+            conn, pooled = pool.checkout(key)     # ACTIVE is None
+            assert not pooled
+            conn.close()
+            geoplan.install(GeoPlan(
+                "site-a",
+                clusters={"site-a": ["127.0.0.1:1"], "site-b": [addr]},
+                links={("site-a", "site-b"):
+                       LinkSpec(partitioned=True)}))
+            with pytest.raises(ConnectionRefusedError):
+                pool.checkout(key)
+            geoplan.uninstall()
+            conn, _ = pool.checkout(key)
+            conn.close()
+        finally:
+            pool.close()
+            listener.close()
