@@ -1049,10 +1049,20 @@ def stage_replay(state: BenchState, ctx: dict) -> None:
     (docs/REPLAY.md). A green run persists to
     artifacts/bench_state/replay_run_*.json — the record `bench.py
     replay --check-regression` reads; budget-starved runs record an
-    explicit skip artifact, never a silent pass."""
+    explicit skip artifact, never a silent pass.
+
+    The stage then climbs the vectorized replay throughput ladder
+    (run_replay_throughput_ladder): synthetic columnar corpora at the
+    10k/100k rungs, sequential vs whole-corpus vectorized vs sharded
+    scoring — bit-identical digests required at every rung and the
+    vectorized path ≥ 20× sequential on the 100k rung. A green ladder
+    persists to replay_ladder_run_*.json (the throughput record
+    --check-regression compares against); the same budget-skip
+    artifact rule applies."""
     left = ctx["left"]
 
-    from dragonfly2_tpu.scheduler.replaybench import run_replay_ab
+    from dragonfly2_tpu.scheduler.replaybench import (
+        run_replay_ab, run_replay_throughput_ladder)
 
     # Budget gate inside the stage (the mlguard lesson): a registry
     # min_left skip would record nothing.
@@ -1090,13 +1100,50 @@ def stage_replay(state: BenchState, ctx: dict) -> None:
         replay_error=report.get("error"),
         replay_verdict_pass=report.get("verdict_pass"),
     )
-    state.stage_done("replay")
     if report.get("verdict_pass"):
         _persist_json(
             os.path.join(
                 STATE_DIR,
                 f"replay_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
             report)
+
+    # Throughput ladder — same budget-skip discipline as the A/B: a
+    # starved run leaves an explicit skip artifact, never nothing.
+    if left() < 60.0 and not ctx.get("single_stage"):
+        state.record(replay_ladder_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"replay_ladder_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        state.stage_done("replay")
+        return
+    ladder = run_replay_throughput_ladder()
+    bound_rung = next(
+        (r for r in ladder.get("rungs", ())
+         if r.get("decisions") == ladder.get("bound_rung")), {})
+    state.record(
+        replay_ladder_rungs=[r.get("decisions")
+                             for r in ladder.get("rungs", ())],
+        replay_ladder_digests_equal=all(
+            r.get("digests_equal") for r in ladder.get("rungs", ())),
+        replay_ladder_seq_decisions_per_s=bound_rung.get(
+            "seq_decisions_per_s"),
+        replay_ladder_vec_decisions_per_s=bound_rung.get(
+            "vec_decisions_per_s"),
+        replay_ladder_speedup=bound_rung.get("speedup"),
+        replay_ladder_sharded_speedup=bound_rung.get("sharded_speedup"),
+        replay_ladder_bound=ladder.get("bound"),
+        replay_ladder_error=ladder.get("error"),
+        replay_ladder_verdict_pass=ladder.get("verdict_pass"),
+    )
+    state.stage_done("replay")
+    if ladder.get("verdict_pass"):
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"replay_ladder_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            ladder)
 
 
 @stage("obs")
@@ -1726,7 +1773,10 @@ def check_regression_main(stage_name: str) -> None:
     - ``replay``: a fresh record→gate→A/B pass must hold its absolute
       bounds (bit-identical determinism, both models gate-promoted,
       ML/learned-cost regret within the documented delta of the rule
-      baseline, recorder overhead ≤ 5% — docs/REPLAY.md).
+      baseline, recorder overhead ≤ 5% — docs/REPLAY.md), PLUS a
+      fresh vectorized throughput-ladder rung with bit-identical
+      digests and vectorized decisions/sec ≥ 0.33× the best persisted
+      replay_ladder_run record.
     - ``obs``: a fresh observability stage must hold its absolute
       bounds (disrupted task tail-captured end to end, analyzer blames
       the injected stall, every stats block scrapeable, tracing

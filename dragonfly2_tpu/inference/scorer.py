@@ -227,6 +227,41 @@ class ParentScorer:
             return np.zeros(0, np.float32)
         return self.score_async(features).materialize()
 
+    def score_corpus(self, features: np.ndarray,
+                     chunk: int = 4096) -> np.ndarray:
+        """Corpus-scale scoring: [n, FEATURE_DIM] rows of ANY n, chunked
+        through one fixed zero-padded jit shape (the same pow2-bucket
+        zero-pad discipline as the staging pool, sized for offline
+        batches instead of announce batches).
+
+        Per-row outputs are BIT-IDENTICAL to :meth:`score` on any
+        sub-batch containing the row — the jit forward is row-stable on
+        this backend (row i never depends on batch shape or the zero
+        rows padding it), which is what lets the vectorized replay
+        engine keep the sequential harness's run digest. Owns its own
+        buffer (no staging-pool interaction), so concurrent shard
+        workers can call it freely.
+        """
+        feats = np.ascontiguousarray(features, dtype=np.float32)
+        n = len(feats)
+        if n == 0:
+            return np.zeros(0, np.float32)
+        b = 8
+        while b < min(chunk, n):
+            b *= 2
+        buf = np.zeros((b, FEATURE_DIM), np.float32)
+        out = np.empty(n, np.float32)
+        dirty = 0
+        for start in range(0, n, b):
+            m = min(b, n - start)
+            if dirty > m:
+                buf[m:dirty] = 0
+            buf[:m] = feats[start:start + m]
+            dirty = m
+            out[start:start + m] = np.asarray(
+                self._forward(self._params, buf))[:m]
+        return out
+
     def benchmark(self, batch: int = 16, iters: int = 200) -> dict:
         """Measure steady-state scoring latency; returns percentiles in ms."""
         rng = np.random.default_rng(0)
@@ -498,6 +533,13 @@ class CostScorer:
 
     def score(self, features: np.ndarray) -> np.ndarray:
         return -self._scorer.score(features)
+
+    def score_corpus(self, features: np.ndarray,
+                     chunk: int = 4096) -> np.ndarray:
+        """Corpus-scale :meth:`score`: the same negation over the
+        underlying scorer's row-stable chunked forward — bit-identical
+        per row to ``score`` on any sub-batch."""
+        return -self._scorer.score_corpus(features, chunk=chunk)
 
     def close(self) -> None:
         close = getattr(self._scorer, "close", None)

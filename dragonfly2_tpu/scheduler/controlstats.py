@@ -102,6 +102,9 @@ class ControlPlaneStats:
         self.replay_finalized = 0
         self.replay_evicted = 0
         self.replay_truncated = 0
+        # Batched sink appends: one per capture-thread drain, so
+        # finalized / appends_batched is the realized IO amortization.
+        self.replay_appends_batched = 0
         self.gc_ticks = 0
         self.gc_budget_overruns = 0
         self.gc_reclaimed = 0
@@ -206,7 +209,8 @@ class ControlPlaneStats:
 
     def observe_replay(self, *, decision: bool = False,
                        finalized: bool = False, evicted: bool = False,
-                       truncated: bool = False) -> None:
+                       truncated: bool = False,
+                       appended_batch: bool = False) -> None:
         # Lock-free and EXACT: the recorder's single capture thread is
         # the only writer of these counters, and taking the shared
         # stats lock here would let capture stall announce threads
@@ -219,6 +223,8 @@ class ControlPlaneStats:
             self.replay_evicted += 1
         if truncated:
             self.replay_truncated += 1
+        if appended_batch:
+            self.replay_appends_batched += 1
 
     def observe_gc(self, ms: float, *, overran: bool, reclaimed: int) -> None:
         with self._lock:
@@ -264,6 +270,7 @@ class ControlPlaneStats:
                 "replay_finalized": self.replay_finalized,
                 "replay_evicted": self.replay_evicted,
                 "replay_truncated": self.replay_truncated,
+                "replay_appends_batched": self.replay_appends_batched,
                 "gc_ticks": self.gc_ticks,
                 "gc_budget_overruns": self.gc_budget_overruns,
                 "gc_reclaimed": self.gc_reclaimed,

@@ -357,6 +357,7 @@ def run_swarm_bench(
         "replay_decisions": snap["replay_decisions"],
         "replay_finalized": snap["replay_finalized"],
         "replay_evicted": snap["replay_evicted"],
+        "replay_appends_batched": snap["replay_appends_batched"],
         "errors": errors,
     }
     if return_latencies:

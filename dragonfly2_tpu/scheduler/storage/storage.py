@@ -20,7 +20,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Iterator, List, Type
+from typing import Iterator, List, Sequence, Type
 
 from dragonfly2_tpu.schema import Download, NetworkTopology, ReplayDecision
 from dragonfly2_tpu.schema.io import (
@@ -93,6 +93,19 @@ class _RotatingDataset:
         list append."""
         with self._lock:
             self._buffer.append(record)
+            flush_needed = len(self._buffer) >= self.config.buffer_size
+        if flush_needed:
+            self.flush()
+
+    def create_batch(self, records) -> None:
+        """Buffered append of MANY records under ONE lock acquisition —
+        the replay recorder's per-drain sink (one IO call per capture
+        wakeup, not per event). Same flush discipline as create()."""
+        records = list(records)
+        if not records:
+            return
+        with self._lock:
+            self._buffer.extend(records)
             flush_needed = len(self._buffer) >= self.config.buffer_size
         if flush_needed:
             self.flush()
@@ -234,6 +247,9 @@ class Storage:
 
     def create_replay(self, record: ReplayDecision) -> None:
         self.replay.create(record)
+
+    def create_replay_batch(self, records: Sequence[ReplayDecision]) -> None:
+        self.replay.create_batch(records)
 
     def list_download(self) -> List[Download]:
         return list(self.download.records())

@@ -91,7 +91,23 @@ def cost_examples_from_corpus(
     """(X [n, FEATURE_DIM] float32, y [n] seconds) from replay decision
     events: one example per candidate that realized at least one piece
     cost by outcome time. Decision-time features, outcome-time label —
-    exactly the prediction the evaluator seam needs."""
+    exactly the prediction the evaluator seam needs.
+
+    Accepts either a sequence of ``ReplayDecision`` events or a columnar
+    corpus (``scheduler.replaystore.ColumnarCorpus``); the columnar path
+    builds both arrays with three whole-corpus mask ops over the mmap'd
+    columns — no per-row parse, no per-candidate Python loop — and
+    yields the SAME example rows in the SAME order (row-major over
+    [decision, candidate] is exactly the sequential nesting)."""
+    features = getattr(events, "features", None)
+    if features is not None and getattr(events, "valid", None) is not None:
+        mask = (events.valid
+                & (events.realized_n >= 1)
+                & (events.realized_cost >= 0))
+        X = np.ascontiguousarray(features[mask], dtype=np.float32)
+        y = events.realized_cost[mask].astype(np.float32)
+        return X, y
+
     from dragonfly2_tpu.scheduler.replay import _row_array
 
     rows: List[np.ndarray] = []

@@ -51,6 +51,35 @@ class ClusterDataset:
     y: np.ndarray  # [n] MB/s
 
 
+def cluster_datasets_from_corpora(
+    corpora, piece_mb: float = 4.0,
+) -> List[ClusterDataset]:
+    """Per-replica federated inputs straight off replay corpora — each
+    cluster's recorded decisions become its local (features, MB/s)
+    examples with no per-row CSV parse when the corpus is columnar
+    (``scheduler.replaystore.ColumnarCorpus``: three whole-corpus mask
+    ops over the mmap'd columns).
+
+    ``corpora``: mapping ``scheduler_id -> corpus`` or a sequence of
+    ``(scheduler_id, corpus)`` pairs; clusters with zero realized
+    examples are dropped (an all-empty input returns ``[]``, which
+    ``train_federated_mlp`` rejects loudly)."""
+    from dragonfly2_tpu.train.mlp_trainer import (
+        bandwidth_examples_from_corpus,
+    )
+
+    pairs = corpora.items() if hasattr(corpora, "items") else corpora
+    datasets = []
+    for scheduler_id, corpus in pairs:
+        X, y = bandwidth_examples_from_corpus(corpus, piece_mb=piece_mb)
+        if len(X):
+            datasets.append(ClusterDataset(int(scheduler_id), X, y))
+        else:
+            logger.info("cluster %s: no realized replay examples; skipped",
+                        scheduler_id)
+    return datasets
+
+
 @dataclass(frozen=True)
 class FederatedConfig:
     local: MLPTrainConfig = MLPTrainConfig()

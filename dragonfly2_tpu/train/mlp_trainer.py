@@ -212,3 +212,21 @@ def train_mlp(
         samples_per_sec=budget.samples_per_sec(batch_size),
         history=history,
     )
+
+
+def bandwidth_examples_from_corpus(
+    corpus, piece_mb: float = 4.0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(X [n, FEATURE_DIM] float32, y [n] MB/s) from a replay corpus —
+    the bandwidth predictor's view of the SAME realized evidence the
+    cost model trains on: each candidate's realized per-piece cost
+    (seconds for a ``piece_mb``-sized piece) inverted into achieved
+    bandwidth. Accepts a ``ColumnarCorpus`` (whole-corpus mask ops over
+    the mmap'd columns, no per-row parse) or a ReplayDecision sequence;
+    costs are floored at 0.1 ms so a clock-resolution cost cannot mint
+    an absurd bandwidth label."""
+    from dragonfly2_tpu.train.cost_trainer import cost_examples_from_corpus
+
+    X, cost_s = cost_examples_from_corpus(corpus)
+    y = (piece_mb / np.maximum(cost_s, 1e-4)).astype(np.float32)
+    return X, y

@@ -594,6 +594,240 @@ class TestTrainerCostJob:
 
 
 # ---------------------------------------------------------------------------
+# Vectorized replay engine: bit-identity against the sequential harness
+# ---------------------------------------------------------------------------
+
+
+class TestVectorizedReplay:
+    def test_recorded_corpus_bit_identical(self, recorded):
+        """The ragged real-world case: a recorded swarm corpus replays
+        bit-identically through sequential, whole-corpus vectorized and
+        sharded fan-out paths — digest, decision sequence AND full
+        tie-break order."""
+        events = rp.corpus_from_events(recorded["ring"])
+        cc = rp.as_columnar(events)
+        seq = rp.replay_decisions(events, BaseEvaluator(), seed=0)
+        vec = rp.replay_decisions_vectorized(cc, seed=0)
+        sh = rp.replay_decisions_vectorized(cc, seed=0, shards=3)
+        assert seq.digest == vec.digest == sh.digest
+        assert seq.decisions == vec.decisions == sh.decisions
+        assert seq.full_order == vec.full_order == sh.full_order
+        assert sh.shards == 3 and len(sh.shard_stats) == 3
+        assert sum(s["decisions"] for s in sh.shard_stats) == cc.n
+
+    def test_bucket_parity_k1_and_kmax(self, recorded):
+        """Padded-bucket edges: every decision truncated to ONE candidate
+        (maximum padding) and every decision widened to
+        MAX_REPLAY_CANDIDATES via feature-tied clones (zero padding) both
+        stay bit-identical to the sequential replay."""
+        from dragonfly2_tpu.scheduler.replaystore import bucket_candidates
+
+        events = [e for e in recorded["ring"] if e.candidates]
+        k1 = [dataclasses.replace(e, candidates=list(e.candidates[:1]))
+              for e in events]
+        kmax = []
+        for e in events:
+            clones = [dataclasses.replace(
+                e.candidates[0], id=f"{e.candidates[0].id}~dup{j}", rank=-1)
+                for j in range(MAX_REPLAY_CANDIDATES - len(e.candidates))]
+            kmax.append(dataclasses.replace(
+                e, candidates=list(e.candidates) + clones))
+        for variant, want_k in ((k1, bucket_candidates(1)),
+                                (kmax, bucket_candidates(
+                                    MAX_REPLAY_CANDIDATES))):
+            cc = rp.as_columnar(variant)
+            assert cc.k == want_k
+            seq = rp.replay_decisions(variant, BaseEvaluator())
+            vec = rp.replay_decisions_vectorized(cc)
+            assert seq.digest == vec.digest
+            assert seq.full_order == vec.full_order
+
+    def test_ties_resolved_in_candidate_order(self):
+        """Score ties must break by original candidate position in BOTH
+        engines (the sequential harness's stable argsort): tie every
+        candidate's features within each decision and check the replayed
+        order IS the slot order."""
+        from dragonfly2_tpu.scheduler.replaybench import synth_replay_corpus
+        from dragonfly2_tpu.scheduler.replaystore import ColumnarCorpus
+
+        cc = synth_replay_corpus(300, seed=7)
+        tied = np.ascontiguousarray(
+            np.broadcast_to(cc.features[:, :1, :], cc.features.shape)
+            * cc.valid[..., None], dtype=np.float32)
+        cols = cc.columns()
+        cols["features"] = tied
+        cc2 = ColumnarCorpus(cols)
+        seq = rp.replay_decisions(cc2.decisions(), BaseEvaluator())
+        vec = rp.replay_decisions_vectorized(cc2)
+        assert seq.digest == vec.digest
+        assert seq.full_order == vec.full_order
+        for i in range(cc2.n):
+            nc = int(cc2.n_candidates[i])
+            order = vec.full_order.get(int(cc2.seq[i]))
+            if nc and order is not None:
+                assert order == tuple(cc2.cand_id[i, :nc].tolist())
+
+    def test_score_run_vectorized_matches_sequential(self, recorded):
+        events = rp.corpus_from_events(recorded["ring"])
+        cc = rp.as_columnar(events)
+        evaluator = BaseEvaluator()
+        run = rp.replay_decisions(events, evaluator, name="rule")
+        seq_scored = rp.score_run(events, run, evaluator=evaluator)
+        vec_scored = rp.score_run_vectorized(
+            cc, run, bad_node_verdicts=rp.rule_bad_node_verdicts(cc))
+        assert set(seq_scored) == set(vec_scored)
+        for key, value in seq_scored.items():
+            assert vec_scored[key] == value, key
+
+    def test_bad_node_labels_batch_matches_per_event(self, recorded):
+        events = rp.corpus_from_events(recorded["ring"])
+        cc = rp.as_columnar(events)
+        labels, has_label = rp.bad_node_labels_batch(cc)
+        for i, event in enumerate(events):
+            want = rp.bad_node_labels(event)
+            by_id = {str(cc.cand_id[i, j]): (bool(labels[i, j]),
+                                             bool(has_label[i, j]))
+                     for j in range(int(cc.n_candidates[i]))}
+            for cand_id, is_bad in want.items():
+                assert by_id[cand_id] == (is_bad, True)
+            assert sum(1 for lab, has in by_id.values() if has) == len(want)
+
+    def test_ml_and_cost_evaluators_vectorized_parity(self, cost_model):
+        from dragonfly2_tpu.inference.scorer import (
+            LearnedCostEvaluator,
+            MLEvaluator,
+            ParentScorer,
+        )
+
+        result = cost_model["result"]
+        scorer = ParentScorer(result.model, result.params,
+                              result.normalizer, result.target_norm)
+        corpus = cost_model["corpus"]
+        cc = rp.as_columnar(corpus)
+        for name, make in (
+                ("ml", lambda: MLEvaluator(scorer)),
+                ("cost", lambda: LearnedCostEvaluator(_cost_scorer(result)))):
+            e_seq, e_vec = make(), make()
+            seq = rp.replay_decisions(corpus, e_seq, name=name)
+            vec = rp.replay_decisions_vectorized(cc, e_vec, name=name)
+            assert seq.digest == vec.digest, name
+            assert seq.full_order == vec.full_order, name
+            assert e_vec.scored_count == e_seq.scored_count > 0, name
+
+    def test_unsupported_evaluator_rejected(self, recorded):
+        cc = rp.as_columnar(rp.corpus_from_events(recorded["ring"][:3]))
+
+        class _Weird:
+            def evaluate_parents(self, parents, child, total):
+                return parents
+
+        with pytest.raises(TypeError):
+            rp.replay_decisions_vectorized(cc, _Weird())
+
+    def test_trainers_consume_columnar_corpus_bit_equal(self, cost_model):
+        from dragonfly2_tpu.train.cost_trainer import (
+            cost_examples_from_corpus,
+        )
+        from dragonfly2_tpu.train.federated import (
+            cluster_datasets_from_corpora,
+        )
+        from dragonfly2_tpu.train.mlp_trainer import (
+            bandwidth_examples_from_corpus,
+        )
+        from dragonfly2_tpu.scheduler.replaystore import ColumnarCorpus
+
+        corpus = cost_model["corpus"]
+        cc = rp.as_columnar(corpus)
+        X_seq, y_seq = cost_examples_from_corpus(corpus)
+        X_col, y_col = cost_examples_from_corpus(cc)
+        assert np.array_equal(X_seq, X_col)
+        assert np.array_equal(y_seq, y_col)
+        X_bw, y_bw = bandwidth_examples_from_corpus(cc)
+        assert np.array_equal(X_bw, X_col)
+        assert (y_bw > 0).all()
+        datasets = cluster_datasets_from_corpora(
+            {3: cc, 9: ColumnarCorpus.from_events([])})
+        assert [d.scheduler_id for d in datasets] == [3]
+        assert np.array_equal(datasets[0].X, X_bw)
+        assert cluster_datasets_from_corpora({}) == []
+
+
+class TestRecorderBatching:
+    def test_commit_is_one_sink_call_per_drain(self):
+        calls = []
+
+        class _Sink:
+            def create_replay_batch(self, records):
+                calls.append(list(records))
+
+        stats = ControlPlaneStats()
+        rec = ReplayRecorder(_Sink(), stats=stats)
+        staged = [("ready", ReplayDecision(seq=i, verdict="back_to_source"))
+                  for i in range(12)]
+        rec._commit(staged)
+        assert len(calls) == 1 and len(calls[0]) == 12
+        assert stats.snapshot()["replay_appends_batched"] == 1
+        assert len(rec.events()) == 12
+        rec._commit([])
+        assert len(calls) == 1, "empty drains must not touch the sink"
+        rec.close()
+
+    def test_rung_reports_batched_appends(self, recorded):
+        rung = recorded["rung"]
+        assert 0 < rung["replay_appends_batched"] <= rung["replay_finalized"]
+        assert "replay_appends_batched" in ControlPlaneStats().snapshot()
+
+
+class TestThroughputLadder:
+    def test_rung_report_keys_complete_from_birth(self):
+        """Every consumer-read key must exist even on a rung that errors
+        before measuring (the bench stage and the regression check index
+        into these unconditionally)."""
+        from dragonfly2_tpu.scheduler.replaybench import _ladder_rung_report
+
+        report = _ladder_rung_report(10)
+        assert {"decisions", "corpus_k", "seq_elapsed_s",
+                "seq_decisions_per_s", "vec_elapsed_s",
+                "vec_decisions_per_s", "sharded_elapsed_s",
+                "sharded_decisions_per_s", "speedup", "sharded_speedup",
+                "digests_equal", "digest", "error"} <= set(report)
+        assert report["decisions"] == 10
+        assert report["error"] is None and report["digests_equal"] is None
+
+    def test_synth_corpus_is_structurally_valid(self, tmp_path):
+        from dragonfly2_tpu.scheduler.replaybench import synth_replay_corpus
+        from dragonfly2_tpu.scheduler.replaystore import (
+            check_corpus,
+            write_columns,
+        )
+
+        cc = synth_replay_corpus(500, seed=5)
+        path = str(tmp_path / "synth.npc")
+        write_columns(path, cc.columns())
+        report = check_corpus(path)
+        assert report["ok"], report["errors"]
+        assert report["back_to_source"] > 0
+
+    def test_small_ladder_smoke(self):
+        """Tier-1 counters-only smoke: a tiny rung through the full
+        ladder machinery — digests must match; the 20x bound is the slow
+        battery's business."""
+        from dragonfly2_tpu.scheduler.replaybench import (
+            run_replay_throughput_ladder,
+        )
+
+        report = run_replay_throughput_ladder(rungs=(400,), bound=0.0)
+        assert report["error"] is None
+        assert report["verdict_pass"] is True, report
+        rung = report["rungs"][0]
+        assert rung["error"] is None
+        assert rung["digests_equal"] is True
+        assert rung["decisions"] == 400
+        assert rung["vec_decisions_per_s"] > 0
+        assert rung["sharded_decisions_per_s"] > 0
+
+
+# ---------------------------------------------------------------------------
 # Helpers
 # ---------------------------------------------------------------------------
 
@@ -639,3 +873,52 @@ class TestReplayStageE2E:
 
         guard = run_recorder_overhead_guard()
         assert guard["within_bound"], guard
+
+
+@pytest.mark.slow
+@pytest.mark.replay
+class TestThroughputLadderE2E:
+    def test_full_ladder_green(self):
+        """The documented bound: vectorized >= 20x sequential on the
+        100k rung, bit-identical digests on every rung."""
+        from dragonfly2_tpu.scheduler.replaybench import (
+            LADDER_RUNGS,
+            VECTORIZED_SPEEDUP_BOUND,
+            run_replay_throughput_ladder,
+        )
+
+        report = run_replay_throughput_ladder()
+        assert report["verdict_pass"] is True, report
+        assert [r["decisions"] for r in report["rungs"]] == list(LADDER_RUNGS)
+        assert all(r["digests_equal"] for r in report["rungs"])
+        top = report["rungs"][-1]
+        assert top["speedup"] >= VECTORIZED_SPEEDUP_BOUND, top
+
+    def test_check_regression_fails_on_synthetic_throughput_collapse(
+            self, tmp_path):
+        """Acceptance case: seed the state dir with a fabricated best
+        ladder record claiming absurd throughput — the fresh re-measure
+        cannot hold 0.33x of it, so the gate must go red."""
+        import json as _json
+
+        from dragonfly2_tpu.scheduler.replaybench import (
+            check_replay_regression,
+        )
+
+        fake = {
+            "rungs": [{"decisions": 10_000, "corpus_k": 16,
+                       "vec_decisions_per_s": 1e12, "speedup": 1e9,
+                       "digests_equal": True, "error": None}],
+            "bound": 20.0, "bound_rung": 10_000, "shards": 2,
+            "verdict_pass": True, "error": None,
+        }
+        with open(tmp_path / "replay_ladder_run_20990101_000000.json",
+                  "w") as f:
+            _json.dump(fake, f)
+        result = check_replay_regression(str(tmp_path))
+        assert result["ladder_throughput_ok"] is False
+        assert result["passed"] is False
+        assert result["best_recorded_ladder"]["rungs"] == fake["rungs"]
+        # The fresh rung itself stayed healthy — only the relative
+        # throughput floor failed.
+        assert result["ladder_digests_ok"] is True

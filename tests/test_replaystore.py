@@ -1,0 +1,281 @@
+"""Columnar replay store: pack/write/mmap-open round trips, padded-bucket
+invariants, corruption detection, segment rotation, the df2-replay CLI,
+and the proof that the columnar read path never touches the CSV parser.
+
+Everything here runs on synthetic corpora (milliseconds) — the recorded
+swarm corpus battery lives in test_replay.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.schema import (
+    MAX_REPLAY_CANDIDATES,
+    ReplayCandidate,
+    ReplayDecision,
+    ReplayFeatureRow,
+)
+from dragonfly2_tpu.scheduler import replay as rp
+from dragonfly2_tpu.scheduler.evaluator import BaseEvaluator
+from dragonfly2_tpu.scheduler.replaybench import synth_replay_corpus
+from dragonfly2_tpu.scheduler.replaystore import (
+    ALL_COLUMNS,
+    ColumnarCorpus,
+    ReplayStoreError,
+    ReplayStoreWriter,
+    bucket_candidates,
+    check_corpus,
+    concat_corpora,
+    open_corpus,
+    open_dir,
+    pack_columns,
+    write_columns,
+)
+
+
+def _decision(seq: int, n_cands: int, *, verdict: str = "parents",
+              total: int = 8) -> ReplayDecision:
+    cands = [
+        ReplayCandidate(
+            id=f"c{seq}-{j}", rank=j if j < 4 else -1,
+            features=ReplayFeatureRow(
+                parent_finished_pieces=float(j + 1), child_finished_pieces=2.0,
+                total_pieces=float(total), upload_count=float(j)),
+            cost_n=3, cost_last=0.02 + j * 0.001, cost_prior_mean=0.02,
+            cost_prior_pstd=0.001, realized_n=2 + j,
+            realized_cost=0.02 + j * 0.002)
+        for j in range(n_cands)
+    ]
+    return ReplayDecision(
+        seq=seq, task_id="t", peer_id=f"p{seq}", total_piece_count=total,
+        verdict=verdict, chosen=cands[0].id if cands else "",
+        outcome="Succeeded" if cands else "", outcome_cost=0.1,
+        decided_at=seq * 1000, finalized_at=seq * 1000 + 500,
+        candidates=cands)
+
+
+class TestPack:
+    def test_bucket_candidates_doubles_from_eight(self):
+        assert bucket_candidates(0) == 8
+        assert bucket_candidates(1) == 8
+        assert bucket_candidates(8) == 8
+        assert bucket_candidates(9) == 16
+        assert bucket_candidates(MAX_REPLAY_CANDIDATES) >= \
+            MAX_REPLAY_CANDIDATES
+
+    def test_pack_event_roundtrip_value_equal(self):
+        events = [_decision(i, (i % 5) + 1) for i in range(20)]
+        events.append(_decision(20, 0, verdict="back_to_source"))
+        cc = ColumnarCorpus.from_events(events)
+        assert cc.n == 21
+        assert cc.k == bucket_candidates(5)
+        back = cc.to_events()
+        assert len(back) == len(events)
+        for a, b in zip(events, back):
+            # Features survive as float32 (the wire/staging dtype).
+            assert b == dataclasses.replace(
+                a, candidates=[dataclasses.replace(
+                    c, features=ReplayFeatureRow(*np.asarray(
+                        dataclasses.astuple(c.features),
+                        np.float32).tolist()))
+                    for c in a.candidates])
+
+    def test_padding_is_clean(self):
+        cc = ColumnarCorpus.from_events(
+            [_decision(i, (i % 3) + 1) for i in range(9)])
+        pad = ~cc.valid
+        assert np.abs(cc.features[pad]).sum() == 0
+        assert (cc.rank[pad] == -1).all()
+        assert (cc.cand_id[pad] == "").all()
+        assert (cc.realized_cost[pad] == -1.0).all()
+        assert (cc.realized_n[pad] == 0).all()
+
+    def test_empty_corpus(self):
+        cc = ColumnarCorpus.from_events([])
+        assert cc.n == 0 and len(cc) == 0
+        assert set(cc.columns()) == set(ALL_COLUMNS)
+        seq = rp.replay_decisions([], BaseEvaluator())
+        vec = rp.replay_decisions_vectorized(cc)
+        assert seq.digest == vec.digest
+        assert vec.decisions == []
+
+
+class TestFileFormat:
+    @pytest.fixture()
+    def packed(self, tmp_path):
+        cc = synth_replay_corpus(200, seed=11)
+        path = str(tmp_path / "corpus.npc")
+        write_columns(path, cc.columns())
+        return cc, path
+
+    def test_mmap_open_is_value_identical(self, packed):
+        cc, path = packed
+        back = open_corpus(path)
+        assert back._mmap is not None, "open_corpus must mmap, not read()"
+        for name in ALL_COLUMNS:
+            assert np.array_equal(getattr(back, name), getattr(cc, name)), \
+                name
+        report = check_corpus(path)
+        assert report["ok"], report["errors"]
+        assert report["decisions"] == cc.n
+
+    def test_slices_share_the_backing_mmap(self, packed):
+        _, path = packed
+        back = open_corpus(path)
+        view = back.slice(10, 50)
+        assert view.n == 40
+        assert view.features.base is not None
+        assert np.array_equal(view.seq, back.seq[10:50])
+
+    def test_truncation_detected_at_every_layer(self, packed, tmp_path):
+        _, path = packed
+        data = open(path, "rb").read()
+        # Torn tail, torn footer, torn data region — all must read as
+        # corrupt, never as a silently shorter corpus.
+        for cut in (4, 40, len(data) // 2, len(data) - 4):
+            trunc = str(tmp_path / f"cut{cut}.npc")
+            with open(trunc, "wb") as f:
+                f.write(data[:len(data) - cut])
+            with pytest.raises((ReplayStoreError, OSError)):
+                open_corpus(trunc)
+            report = check_corpus(trunc)
+            assert not report["ok"] and report["errors"]
+
+    def test_bad_magic_detected(self, packed, tmp_path):
+        _, path = packed
+        data = bytearray(open(path, "rb").read())
+        data[:4] = b"XXXX"
+        bad = str(tmp_path / "magic.npc")
+        open(bad, "wb").write(bytes(data))
+        with pytest.raises(ReplayStoreError):
+            open_corpus(bad)
+
+    def test_check_flags_invariant_breaks(self, packed, tmp_path):
+        cc, _ = packed
+        cols = cc.columns()
+        cols["features"] = cols["features"].copy()
+        cols["features"][~cols["valid"]] = 7.0  # dirty padding
+        bad = str(tmp_path / "dirty.npc")
+        write_columns(bad, cols)
+        report = check_corpus(bad)
+        assert not report["ok"]
+        assert any("padded" in e for e in report["errors"])
+
+
+class TestConcatAndWriter:
+    def test_concat_repads_to_widest_bucket(self):
+        a = ColumnarCorpus.from_events(
+            [_decision(i, 1) for i in range(4)])          # k == 8
+        b = ColumnarCorpus.from_events(
+            [_decision(10 + i, 12) for i in range(3)])    # k == 16
+        merged = concat_corpora([a, b])
+        assert merged.k == max(a.k, b.k)
+        assert merged.n == 7
+        assert merged.seq.tolist() == sorted(merged.seq.tolist())
+        assert (merged.cand_id[~merged.valid] == "").all()
+        assert (merged.realized_cost[~merged.valid] == -1.0).all()
+
+    def test_writer_rotates_and_prunes_segments(self, tmp_path):
+        w = ReplayStoreWriter(str(tmp_path), segment_decisions=8,
+                              max_segments=3)
+        events = [_decision(i, 3) for i in range(40)]
+        for e in events:
+            w.append(e)
+        w.flush()
+        segments = w.segments()
+        assert 1 <= len(segments) <= 3
+        for s in segments:
+            assert check_corpus(s)["ok"]
+        merged = open_dir(str(tmp_path))
+        # Oldest segments were pruned; the survivors are the tail.
+        assert merged.n == sum(check_corpus(s)["decisions"]
+                               for s in segments)
+        assert merged.seq.tolist() == \
+            sorted(merged.seq.tolist())
+
+
+class TestNoCsvParser:
+    def test_columnar_read_path_never_touches_csv(self, tmp_path,
+                                                  monkeypatch):
+        """The mmap booby-trap: poison the CSV parser, then pack, open
+        and REPLAY a columnar file — nothing may hit read_csv_records."""
+        from dragonfly2_tpu.schema import io as schema_io
+
+        cc = synth_replay_corpus(300, seed=3)
+        path = str(tmp_path / "corpus.npc")
+        write_columns(path, cc.columns())
+
+        def boom(*a, **k):
+            raise AssertionError("columnar path fell back to CSV parsing")
+
+        monkeypatch.setattr(schema_io, "read_csv_records", boom)
+        loaded = rp.columnar_from_files([path])
+        run = rp.replay_decisions_vectorized(loaded, shards=2)
+        assert run.decisions
+        assert check_corpus(path)["ok"]
+
+
+class TestReplayTool:
+    def _record_csv_corpus(self, tmp_path):
+        from dragonfly2_tpu.schema.io import CsvRecordWriter
+
+        path = str(tmp_path / "replay.csv")
+        with CsvRecordWriter(ReplayDecision, path) as w:
+            for i in range(25):
+                w.write(_decision(i, (i % 4) + 1))
+        return path
+
+    def test_pack_check_stat_roundtrip(self, tmp_path, capsys):
+        from dragonfly2_tpu.cmd.replaytool import main
+
+        csv_path = self._record_csv_corpus(tmp_path)
+        out = str(tmp_path / "corpus.npc")
+        assert main(["pack", csv_path, "-o", out]) == 0
+        stats = json.loads(capsys.readouterr().out)
+        assert stats["decisions"] == 25
+        assert stats["check"]["ok"] is True
+        assert main(["check", out]) == 0
+        capsys.readouterr()
+        assert main(["stat", out, "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)[0]
+        assert report["decisions"] == 25
+        assert report["bytes"] == os.path.getsize(out)
+        # The packed corpus replays bit-identically to the CSV original.
+        seq = rp.replay_decisions(
+            rp.corpus_from_files([csv_path]), BaseEvaluator())
+        vec = rp.replay_decisions_vectorized(rp.columnar_from_files([out]))
+        assert seq.digest == vec.digest
+
+    def test_check_exits_nonzero_on_corruption(self, tmp_path, capsys):
+        from dragonfly2_tpu.cmd.replaytool import main
+
+        csv_path = self._record_csv_corpus(tmp_path)
+        out = str(tmp_path / "corpus.npc")
+        assert main(["pack", csv_path, "-o", out]) == 0
+        data = open(out, "rb").read()
+        trunc = str(tmp_path / "trunc.npc")
+        open(trunc, "wb").write(data[:len(data) - 32])
+        assert main(["check", trunc]) == 1
+        assert main(["stat", trunc]) == 1
+        # A mixed list still fails overall (no masking by the good file).
+        assert main(["check", out, trunc]) == 1
+
+    def test_pack_refuses_empty_source_dir(self, tmp_path):
+        from dragonfly2_tpu.cmd.replaytool import main
+
+        empty = tmp_path / "no-csvs"
+        empty.mkdir()
+        with pytest.raises(SystemExit, match="no replay"):
+            main(["pack", str(empty), "-o", str(tmp_path / "o.npc")])
+
+    def test_pack_missing_file_exits_nonzero(self, tmp_path):
+        from dragonfly2_tpu.cmd.replaytool import main
+
+        assert main(["pack", str(tmp_path / "nope.csv"), "-o",
+                     str(tmp_path / "o.npc")]) == 1
