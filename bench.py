@@ -1383,6 +1383,77 @@ def stage_geo(state: BenchState, ctx: dict) -> None:
             ladder)
 
 
+@stage("federated")
+def stage_federated(state: BenchState, ctx: dict) -> None:
+    """Byzantine-robust federated rounds — the ISSUE-20 stage
+    (dragonfly2_tpu/train/fedbench.py): heterogeneous synthetic cluster
+    corpora train a global bandwidth model through screened federated
+    rounds (trainer/federation.py coordinator: norm/holdout/nonfinite
+    admission screens, K-of-N quorum, durable round journal). Gates
+    (docs/FEDERATED.md): the CLEAN rung's gate-promoted global must
+    match-or-beat the best solo cluster model's replay-A/B regret on
+    the mixed eval corpus, bit-deterministically; the POISONED rung's
+    label-flipped/scaled cluster and NaN-params cluster must BOTH be
+    screened every round, the persistent liar escalated to registry
+    quarantine, and poisoned-fleet regret held within 1.2x clean; the
+    COORDINATOR-KILL rung SIGKILLs a subprocess coordinator mid-round
+    and must resume from the journal, committing the SAME round without
+    retraining journaled clusters. A green run persists to
+    artifacts/bench_state/federated_run_*.json; a budget-skipped stage
+    records an explicit skip artifact + ``federated_skipped``, never a
+    silent pass."""
+    left = ctx["left"]
+
+    from dragonfly2_tpu.train.fedbench import run_federated_bench
+
+    # Budget gate inside the stage (the mlguard lesson): a registry
+    # min_left skip would record nothing.
+    if left() < 180.0 and not ctx.get("single_stage"):
+        state.record(federated_skipped=True)
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"federated_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            {"skipped": True, "reason": "stage budget exhausted"})
+        return
+    # The kill rung costs two subprocess cold starts (~60s); drop it
+    # when the budget is tight rather than losing the whole stage.
+    report = run_federated_bench(seed=0,
+                                 include_kill=bool(
+                                     left() >= 300.0
+                                     or ctx.get("single_stage")))
+    clean, poisoned, kill = (report["clean"], report["poisoned"],
+                             report["kill"])
+    state.record(
+        federated_rounds=len(clean.get("rounds", [])),
+        federated_gate_state=clean.get("gate_state"),
+        federated_regret_s=clean.get("federated_regret"),
+        federated_best_solo_regret_s=clean.get("best_solo_regret"),
+        federated_deterministic=clean.get("deterministic"),
+        federated_clean_ok=clean.get("ok"),
+        federated_screened_reasons=poisoned.get("screened_reasons"),
+        federated_screens_ok=poisoned.get("screens_ok"),
+        federated_escalated=poisoned.get("escalated"),
+        federated_quarantined_version=poisoned.get("quarantined_version"),
+        federated_poisoned_regret_s=poisoned.get("regret"),
+        federated_within_poison_bound=poisoned.get("within_poison_bound"),
+        federated_poisoned_ok=poisoned.get("ok"),
+        federated_kill_ran=kill.get("ran"),
+        federated_kill_resumed=kill.get("resumed"),
+        federated_kill_no_retrain=kill.get("no_retrain"),
+        federated_kill_ok=kill.get("ok"),
+        federated_error=report.get("error"),
+        federated_verdict_pass=report.get("verdict_pass"),
+    )
+    state.stage_done("federated")
+    if report.get("verdict_pass"):
+        _persist_json(
+            os.path.join(
+                STATE_DIR,
+                f"federated_run_{time.strftime('%Y%m%d_%H%M%S')}.json"),
+            report)
+
+
 def run_stages(state: BenchState, platform: str, budget: float,
                only: str | None = None,
                stage_opts: dict | None = None) -> None:
@@ -1789,7 +1860,14 @@ def check_regression_main(stage_name: str) -> None:
     - ``geo``: fresh multi-site ladder vs the best recorded geo run
       (docs/GEO.md) — a lost verdict (including the site-partition
       rung) or a 2× TTLB / WAN-amplification collapse fails the
-      gate."""
+      gate.
+    - ``federated``: a fresh clean + poisoned federated pass (kill
+      rung skipped — subprocess cold starts don't belong in a quick
+      gate) must hold its absolute bounds (screens catch both the
+      flipped/scaled and NaN clusters, gate-promoted global
+      matches-or-beats the best solo regret, poisoned regret within
+      1.2× clean — docs/FEDERATED.md); the best record rides along
+      for trend reading."""
     if stage_name == "dataplane":
         from dragonfly2_tpu.client.dataplane import (
             check_download_regression,
@@ -1838,11 +1916,17 @@ def check_regression_main(stage_name: str) -> None:
         from dragonfly2_tpu.client.geobench import check_geo_regression
 
         result = check_geo_regression(STATE_DIR)
+    elif stage_name == "federated":
+        from dragonfly2_tpu.train.fedbench import (
+            check_federated_regression,
+        )
+
+        result = check_federated_regression(STATE_DIR)
     else:
         raise SystemExit(
             f"no regression gate for stage {stage_name!r} "
             "(have: dataplane, chaos, fanout, scheduler, mlguard, "
-            "replay, obs, qos, geo)")
+            "replay, obs, qos, geo, federated)")
     print(json.dumps(result), flush=True)
     sys.exit(0 if result["passed"] else 1)
 

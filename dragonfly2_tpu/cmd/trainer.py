@@ -45,6 +45,22 @@ def main(argv=None) -> int:
                         help="run train-step loops under "
                              "jax.profiler.trace; XPlane dumps land here "
                              "(inspect with tensorboard/xprof)")
+    parser.add_argument("--federated-quorum", type=int, default=0,
+                        help="K-of-N quorum for federated rounds driven "
+                             "from the training cycle (0 = federation "
+                             "off). Endpoints come from this trainer's "
+                             "replay segments grouped by scheduler id; "
+                             "each cycle commits one screened round "
+                             "through the journal in "
+                             "<data-dir>/federation")
+    parser.add_argument("--round-deadline", type=float, default=60.0,
+                        help="federated straggler deadline per round, "
+                             "seconds: a slow or dead cluster delays "
+                             "nothing past it")
+    parser.add_argument("--aggregator", default="fedavg",
+                        choices=("fedavg", "trimmed_mean"),
+                        help="federated aggregator (trimmed_mean is the "
+                             "Byzantine-robust coordinate-wise trim)")
     add_multihost_flags(parser)
     add_common_flags(parser)
     args = parse_with_config(parser, argv)
@@ -91,6 +107,47 @@ def main(argv=None) -> int:
         metrics=metrics)
     server = serve([(TRAINER_SPEC, service)], host=args.host, port=args.port)
     print(f"trainer serving on {server.target}", flush=True)
+    if args.federated_quorum > 0:
+        import os
+
+        from dragonfly2_tpu.trainer.federation import (
+            FederationConfig,
+            FederationCoordinator,
+            endpoints_from_storage,
+        )
+        from dragonfly2_tpu.train.federated import FederatedConfig
+
+        fed_config = FederationConfig(
+            fed=FederatedConfig(aggregator=args.aggregator),
+            quorum=args.federated_quorum,
+            round_deadline_s=args.round_deadline)
+
+        # Endpoints follow the streamed datasets: (re)build from replay
+        # segments at each cycle so clusters that announce later join
+        # the next round.
+        class _LazyFederation:
+            def __init__(self):
+                self._coordinator = None
+
+            def run_round(self):
+                endpoints = endpoints_from_storage(
+                    storage, service._host_identities,
+                    fed_config.fed.local)
+                if len(endpoints) < args.federated_quorum:
+                    raise RuntimeError(
+                        f"{len(endpoints)} federated endpoints < quorum "
+                        f"{args.federated_quorum}; waiting for replay "
+                        f"segments")
+                self._coordinator = FederationCoordinator(
+                    endpoints,
+                    os.path.join(args.data_dir, "federation"),
+                    fed_config, manager=registry)
+                return self._coordinator.run_round()
+
+        service.attach_federation(_LazyFederation())
+        print(f"federation enabled: quorum={args.federated_quorum} "
+              f"deadline={args.round_deadline:g}s "
+              f"aggregator={args.aggregator}", flush=True)
     if args.train_interval > 0:
         service.start_cycle_driver(args.train_interval)
         print(f"interval cycle driver running every "

@@ -60,6 +60,33 @@ def guard_reason(scores, features=None) -> Optional[str]:
     return None
 
 
+def params_guard_reason(params) -> Optional[str]:
+    """Why a parameter tree must NOT enter an aggregate, or ``None``.
+
+    The :func:`guard_reason` discipline applied to weights instead of
+    scores: a single NaN/Inf float leaf poisons every prediction the
+    model will ever make (and, averaged, every model it is averaged
+    into), so the federated admission screen and the score-batch guard
+    share one definition of "nonfinite". Non-float leaves (index
+    tables) are ignored, mirroring :func:`poison_params`, which leaves
+    them loadable on purpose.
+    """
+    stack = [params]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+            continue
+        if isinstance(node, (list, tuple)):
+            stack.extend(node)
+            continue
+        arr = np.asarray(node)
+        if np.issubdtype(arr.dtype, np.floating) \
+                and not bool(np.isfinite(arr).all()):
+            return "nonfinite"
+    return None
+
+
 def poison_params(params, mode: str):
     """Return a structurally identical params tree with poisoned leaves.
 

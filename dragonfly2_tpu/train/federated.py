@@ -15,6 +15,21 @@ Normalization: FedAvg of raw parameters is only meaningful under one shared
 feature/target normalization, so round 0 fits a GLOBAL normalizer from
 per-cluster moments (exact pooled mean/variance, no raw data pooling — the
 federated constraint) and every local trainer reuses it.
+
+Robustness (ISSUE 20): plain FedAvg happily averages in a poisoned
+update, so every per-cluster update now passes an admission screen
+before it touches the aggregate — finite leaves
+(:func:`~dragonfly2_tpu.inference.modelguard.params_guard_reason`, the
+shared guard discipline), an update-norm bound relative to the round
+median (norm-scaling attacks), and a pooled-holdout regression screen
+(a cluster whose local model scores the shared holdout far worse than
+its peers is lying about its data). Coordinate-wise trimmed mean is
+available as a robust aggregator behind ``FederatedConfig.aggregator``
+(FedAvg stays the default for clean fleets). A cluster screened N
+consecutive rounds escalates to registry quarantine through the PR-11
+gate path (:func:`escalate_screened_clusters`). All screening is pure
+numpy over seeded inputs: same corpora + seed ⇒ bit-identical global
+params.
 """
 
 from __future__ import annotations
@@ -84,6 +99,48 @@ def cluster_datasets_from_corpora(
 class FederatedConfig:
     local: MLPTrainConfig = MLPTrainConfig()
     rounds: int = 3
+    #: "fedavg" (sample-weighted mean) or "trimmed_mean" (coordinate-wise
+    #: trimmed mean — robust to a minority of arbitrary updates). With
+    #: fewer than 3 admitted updates trimming is meaningless and the
+    #: aggregator falls back to FedAvg.
+    aggregator: str = "fedavg"
+    #: Fraction trimmed from EACH end per coordinate under trimmed_mean.
+    trim_fraction: float = 0.2
+    #: Screen an update whose L2 distance from the current global params
+    #: exceeds this multiple of the round-median distance (needs >= 3
+    #: finite updates for the median to out-vote one attacker). 0 disables.
+    screen_norm_factor: float = 4.0
+    #: Screen an update whose local model's pooled-holdout MSE (in the
+    #: normalized log-target space training optimizes — scale-calibrated,
+    #: so the bound means the same thing on every corpus) exceeds this
+    #: multiple of the median of its PEERS' MSEs. 0 disables.
+    screen_holdout_factor: float = 3.0
+    #: A cluster screened this many CONSECUTIVE rounds escalates to
+    #: registry quarantine (admission resets the strike count). 0 disables.
+    screen_quarantine_rounds: int = 3
+    #: Clusters with fewer local examples contribute to the pooled
+    #: holdout only (or are dropped with a warning when the caller
+    #: supplied the holdout) — never an empty local fit.
+    min_cluster_examples: int = 8
+
+
+@dataclass
+class ClusterUpdate:
+    """One cluster's round contribution, as seen by the screens."""
+
+    scheduler_id: int
+    params: dict
+    n_samples: int
+
+
+@dataclass
+class ScreenReport:
+    """Outcome of one round's admission screen."""
+
+    admitted: List[ClusterUpdate]
+    screened: Dict[int, str]  # scheduler_id -> reason
+    norms: Dict[int, float]  # update L2 norms (finite updates only)
+    holdout_mse: Dict[int, float]  # per-update holdout MSE (if screened on)
 
 
 @dataclass
@@ -97,6 +154,36 @@ class FederatedResult:
     # Lineage: per round, {scheduler_id: n_samples} that contributed.
     lineage: List[Dict[int, int]] = field(default_factory=list)
     per_cluster: Dict[int, MLPTrainResult] = field(default_factory=dict)
+    # Per round, {scheduler_id: reason} for updates the screen rejected.
+    screened: List[Dict[int, str]] = field(default_factory=list)
+    updates_screened: int = 0
+    # Clusters screened screen_quarantine_rounds consecutive rounds.
+    escalated: List[int] = field(default_factory=list)
+
+
+def column_moments(x: np.ndarray) -> Tuple[int, np.ndarray, np.ndarray]:
+    """(n, Σx, Σx²) for one cluster's columns — the only thing a cluster
+    ships for normalizer pooling. Both sums accumulate in float64: on
+    multi-million-row float32 corpora a float32 Σx loses low-order mass
+    and the pooled mean drifts from a centrally fitted one."""
+    x64 = x.astype(np.float64)
+    return len(x), x64.sum(axis=0), (x64**2).sum(axis=0)
+
+
+def normalizer_from_moments(
+    moments: Sequence[Tuple[int, np.ndarray, np.ndarray]],
+) -> Normalizer:
+    """Exact pooled mean/std from per-cluster (n, Σx, Σx²) moments."""
+    n = sum(m[0] for m in moments)
+    s1 = np.sum([np.asarray(m[1], np.float64) for m in moments], axis=0)
+    s2 = np.sum([np.asarray(m[2], np.float64) for m in moments], axis=0)
+    mean = s1 / n
+    var = np.maximum(s2 / n - mean**2, 0.0)
+    # Same epsilon convention as Normalizer.fit (+1e-6, mlp.py:40) so a
+    # pooled normalizer is bit-comparable with a centrally fitted one.
+    std = np.sqrt(var) + 1e-6
+    return Normalizer(mean=mean.astype(np.float32),
+                      std=std.astype(np.float32))
 
 
 def pooled_normalizers(
@@ -104,22 +191,9 @@ def pooled_normalizers(
 ) -> Tuple[Normalizer, Normalizer]:
     """Exact pooled mean/std from per-cluster moments — each cluster ships
     (n, Σx, Σx²), never raw rows."""
-
-    def pool(columns: List[np.ndarray]) -> Normalizer:
-        n = sum(len(c) for c in columns)
-        s1 = np.sum([c.sum(axis=0) for c in columns], axis=0)
-        s2 = np.sum([(c.astype(np.float64) ** 2).sum(axis=0) for c in columns],
-                    axis=0)
-        mean = s1 / n
-        var = np.maximum(s2 / n - mean**2, 0.0)
-        # Same epsilon convention as Normalizer.fit (+1e-6, mlp.py:40) so a
-        # pooled normalizer is bit-comparable with a centrally fitted one.
-        std = np.sqrt(var) + 1e-6
-        return Normalizer(mean=mean.astype(np.float32),
-                          std=std.astype(np.float32))
-
-    feat = pool([d.X for d in datasets])
-    target = pool([np.log1p(d.y)[:, None] for d in datasets])
+    feat = normalizer_from_moments([column_moments(d.X) for d in datasets])
+    target = normalizer_from_moments(
+        [column_moments(np.log1p(d.y)[:, None]) for d in datasets])
     return feat, target
 
 
@@ -132,6 +206,179 @@ def fedavg(param_trees: Sequence, weights: Sequence[float]):
         return sum(w * leaf for w, leaf in zip(norm, leaves))
 
     return jax.tree.map(avg, *param_trees)
+
+
+def trimmed_mean(param_trees: Sequence, trim_fraction: float = 0.2):
+    """Coordinate-wise trimmed mean: per parameter coordinate, drop the k
+    largest and k smallest values across updates and average the rest.
+    Robust to up to k arbitrary updates per coordinate (Yin et al. 2018)
+    — a poisoned value that slips the screens lands in the trimmed tails
+    instead of the average. Pure sorted-numpy: bit-deterministic."""
+    m = len(param_trees)
+    if m == 0:
+        raise ValueError("no parameter trees")
+    k = min(int(m * trim_fraction), (m - 1) // 2)
+
+    def agg(*leaves):
+        stacked = np.sort(
+            np.stack([np.asarray(leaf) for leaf in leaves], axis=0), axis=0)
+        kept = stacked[k:m - k]
+        return kept.mean(axis=0, dtype=np.float64).astype(stacked.dtype)
+
+    return jax.tree.map(agg, *param_trees)
+
+
+def aggregate_updates(updates: Sequence[ClusterUpdate], aggregator: str,
+                      trim_fraction: float = 0.2):
+    """Dispatch on the ``FederatedConfig.aggregator`` knob. Trimmed mean
+    needs >= 3 updates for the trim to out-vote an attacker; below that
+    it degrades to FedAvg (logged)."""
+    if aggregator not in ("fedavg", "trimmed_mean"):
+        raise ValueError(f"unknown aggregator {aggregator!r}")
+    trees = [u.params for u in updates]
+    if aggregator == "trimmed_mean":
+        if len(trees) >= 3:
+            return trimmed_mean(trees, trim_fraction)
+        logger.warning("trimmed_mean with %d updates degrades to fedavg",
+                       len(trees))
+    return fedavg(trees, [u.n_samples for u in updates])
+
+
+def update_norm(params, global_params) -> float:
+    """L2 distance between an update and the current global params, in
+    float64 (the norm screen must not overflow on a scaled attack)."""
+    diffs = jax.tree.map(
+        lambda a, b: np.asarray(a, np.float64) - np.asarray(b, np.float64),
+        params, global_params)
+    return float(np.sqrt(sum(float((d**2).sum())
+                             for d in jax.tree.leaves(diffs))))
+
+
+def init_global_params(hidden: Sequence[int], feature_dim: int, seed: int):
+    """The shared round-0 starting point. Same construction as
+    ``train_mlp``'s own init (model.init under jax.random.key(seed)), so
+    pre-initializing changes nothing for clean fleets — but it makes
+    "update = local − global" well-defined in EVERY round, including the
+    first, which the norm screen needs."""
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models.mlp import MLPBandwidthPredictor
+
+    model = MLPBandwidthPredictor(hidden=tuple(hidden))
+    params = model.init(jax.random.key(seed),
+                        jnp.zeros((1, feature_dim), jnp.float32))
+    return model, jax.device_get(params)
+
+
+def screen_updates(
+    updates: Sequence[ClusterUpdate],
+    global_params,
+    *,
+    config: FederatedConfig,
+    model=None,
+    normalizer: Normalizer | None = None,
+    target_norm: Normalizer | None = None,
+    holdout=None,  # (X, y) or sequence of per-cluster (X, y) slices
+) -> ScreenReport:
+    """The admission screen every update passes before aggregation.
+
+    Three screens, in escalating cost order:
+
+    1. ``nonfinite`` — any NaN/Inf float leaf
+       (:func:`~dragonfly2_tpu.inference.modelguard.params_guard_reason`,
+       the shared guard discipline: one definition of "poisoned" across
+       serving and training).
+    2. ``norm_bound`` — update L2 norm (distance from the current global
+       params) above ``screen_norm_factor`` × the round-median norm.
+       Catches norm-scaling attacks; needs >= 3 finite updates so one
+       attacker cannot own the median.
+    3. ``holdout_regression`` — the update's model scores the holdout
+       with MSE above ``screen_holdout_factor`` × the round-median MSE.
+       With >= 3 survivors the median spans ALL survivor scores (an
+       honest majority owns it, and each honestly-heterogeneous
+       cluster's own score keeps the reference from collapsing onto the
+       easy bands); with exactly 2 the all-median is the midpoint and
+       can never flag either side, so each update is judged against its
+       peer's score instead. Measured in the
+       NORMALIZED log-target space training optimizes: raw-MB/s MSE is
+       dominated by the heavy bandwidth tail and by honest cross-band
+       extrapolation error, which would drown the lying cluster's
+       signal; z-space is where a model trained on flipped/scaled
+       labels stands apart from honestly-heterogeneous peers.
+
+    ``holdout`` is either one pooled ``(X, y)`` pair or a sequence of
+    per-cluster ``(X, y)`` slices. With slices, an update's score is
+    the MEDIAN of its per-slice MSEs — clusters volunteer their own
+    holdout rows, so a lying cluster's slice carries poisoned labels
+    that would reward its own model and punish honest ones in a pooled
+    mean; the per-slice median discards any minority of poisoned
+    slices. Both holdout forms assume a majority-honest round (the
+    medians must land on honest values).
+
+    Pure numpy over the given inputs — bit-deterministic.
+    """
+    from dragonfly2_tpu.inference.modelguard import params_guard_reason
+
+    screened: Dict[int, str] = {}
+    norms: Dict[int, float] = {}
+    holdout_mse: Dict[int, float] = {}
+
+    finite = []
+    for u in updates:
+        reason = params_guard_reason(u.params)
+        if reason is not None:
+            screened[u.scheduler_id] = reason
+        else:
+            finite.append(u)
+
+    survivors = finite
+    if config.screen_norm_factor > 0 and len(finite) >= 3:
+        for u in finite:
+            norms[u.scheduler_id] = update_norm(u.params, global_params)
+        median = float(np.median(list(norms.values())))
+        bound = config.screen_norm_factor * median
+        survivors = []
+        for u in finite:
+            if median > 0 and norms[u.scheduler_id] > bound:
+                screened[u.scheduler_id] = "norm_bound"
+            else:
+                survivors.append(u)
+
+    if holdout is not None and isinstance(holdout, tuple):
+        holdout = [holdout]
+    slices = [s for s in (holdout or []) if len(s[0])]
+    if (config.screen_holdout_factor > 0 and slices
+            and model is not None and len(survivors) >= 2):
+        z_slices = []
+        for hold_X, hold_y in slices:
+            x_norm = normalizer(hold_X)
+            z_true = ((np.log1p(hold_y) - target_norm.mean[0])
+                      / target_norm.std[0])
+            z_slices.append((x_norm, z_true))
+        for u in survivors:
+            per_slice = []
+            for x_norm, z_true in z_slices:
+                z_pred = np.asarray(model.apply(u.params, x_norm))
+                per_slice.append(float(((z_pred - z_true) ** 2).mean()))
+            holdout_mse[u.scheduler_id] = float(np.median(per_slice))
+        admitted = []
+        all_scores = [holdout_mse[u.scheduler_id] for u in survivors]
+        for u in survivors:
+            if len(survivors) >= 3:
+                reference = float(np.median(all_scores))
+            else:
+                reference = float(np.median(
+                    [holdout_mse[v.scheduler_id] for v in survivors
+                     if v.scheduler_id != u.scheduler_id]))
+            mse = holdout_mse[u.scheduler_id]
+            if mse > config.screen_holdout_factor * reference + 1e-12:
+                screened[u.scheduler_id] = "holdout_regression"
+            else:
+                admitted.append(u)
+        survivors = admitted
+
+    return ScreenReport(admitted=list(survivors), screened=screened,
+                        norms=norms, holdout_mse=holdout_mse)
 
 
 def train_federated_mlp(
@@ -151,32 +398,64 @@ def train_federated_mlp(
         raise ValueError("no cluster datasets")
     mesh = mesh or data_parallel_mesh()
 
+    # A cluster below min_cluster_examples cannot sustain a local fit
+    # (a 1-example cluster used to get n_hold=1 and an EMPTY training
+    # set handed to train_mlp). Small clusters contribute their rows to
+    # the pooled holdout only; when the caller supplied the holdout they
+    # are dropped with a warning — never an empty local fit.
+    min_n = max(int(config.min_cluster_examples), 2)
+    small = [ds for ds in datasets if len(ds.X) < min_n]
+    datasets = [ds for ds in datasets if len(ds.X) >= min_n]
+    if small:
+        logger.warning(
+            "clusters %s below min_cluster_examples=%d: %s",
+            [ds.scheduler_id for ds in small], min_n,
+            "holdout-only" if eval_set is None else "dropped")
+    if not datasets:
+        raise ValueError(
+            f"no cluster has >= {min_n} examples; nothing to train")
+
     # Honest global metrics: without a caller-provided eval set, hold out a
     # per-cluster fraction BEFORE any training. Evaluating the aggregate on
     # its own training rows would publish optimistically-biased registry
     # metrics next to the per-cluster models' held-out ones.
     if eval_set is None:
-        holdout_X, holdout_y, trimmed = [], [], []
+        holdout_X = [ds.X for ds in small]
+        holdout_y = [ds.y for ds in small]
+        trimmed = []
         fraction = max(config.local.eval_fraction, 0.05)
         for ds in datasets:
             rng = np.random.default_rng((config.local.seed, ds.scheduler_id))
             perm = rng.permutation(len(ds.X))
-            n_hold = max(int(len(ds.X) * fraction), 1)
+            # Cap the holdout so the training remainder never drops below
+            # half of min_cluster_examples rows.
+            n_hold = min(max(int(len(ds.X) * fraction), 1),
+                         len(ds.X) - min_n // 2)
             hold, keep = perm[:n_hold], perm[n_hold:]
             holdout_X.append(ds.X[hold])
             holdout_y.append(ds.y[hold])
             trimmed.append(ClusterDataset(ds.scheduler_id,
                                           ds.X[keep], ds.y[keep]))
         datasets = trimmed
+        # The screen sees the holdout as per-cluster slices (median over
+        # slices defuses poisoned holdout rows); the final eval pools.
+        screen_holdout = list(zip(holdout_X, holdout_y))
         eval_set = (np.concatenate(holdout_X), np.concatenate(holdout_y))
+    else:
+        screen_holdout = eval_set
 
     normalizer, target_norm = pooled_normalizers(datasets)
+    model, global_params = init_global_params(
+        config.local.hidden, datasets[0].X.shape[1], config.local.seed)
 
-    global_params = None
     lineage: List[Dict[int, int]] = []
+    screened_rounds: List[Dict[int, str]] = []
+    strikes: Dict[int, int] = {}
+    escalated: List[int] = []
+    updates_screened = 0
     per_cluster: Dict[int, MLPTrainResult] = {}
     for round_idx in range(config.rounds):
-        trees, weights, contributed = [], [], {}
+        updates = []
         for ds in datasets:
             result = train_mlp(
                 ds.X, ds.y, config.local, mesh,
@@ -184,19 +463,44 @@ def train_federated_mlp(
                 normalizer=normalizer, target_norm=target_norm,
             )
             per_cluster[ds.scheduler_id] = result
-            trees.append(result.params)
-            weights.append(len(ds.X))
-            contributed[ds.scheduler_id] = len(ds.X)
-        global_params = fedavg(trees, weights)
-        lineage.append(contributed)
-        logger.info("federated round %d: averaged %d clusters",
-                    round_idx, len(trees))
+            updates.append(ClusterUpdate(
+                ds.scheduler_id, jax.device_get(result.params), len(ds.X)))
+        report = screen_updates(
+            updates, global_params, config=config, model=model,
+            normalizer=normalizer, target_norm=target_norm,
+            holdout=screen_holdout)
+        for u in updates:
+            if u.scheduler_id in report.screened:
+                strikes[u.scheduler_id] = strikes.get(u.scheduler_id, 0) + 1
+                if (config.screen_quarantine_rounds > 0
+                        and strikes[u.scheduler_id]
+                        >= config.screen_quarantine_rounds
+                        and u.scheduler_id not in escalated):
+                    escalated.append(u.scheduler_id)
+            else:
+                strikes[u.scheduler_id] = 0
+        updates_screened += len(report.screened)
+        screened_rounds.append(dict(report.screened))
+        if report.admitted:
+            global_params = aggregate_updates(
+                report.admitted, config.aggregator, config.trim_fraction)
+            lineage.append({u.scheduler_id: u.n_samples
+                            for u in report.admitted})
+        else:
+            # Every update screened: the aggregate must not move. Keeping
+            # the previous global params is the safe no-op.
+            lineage.append({})
+            logger.warning("federated round %d: ALL %d updates screened "
+                           "(%s); global params unchanged",
+                           round_idx, len(updates), report.screened)
+        logger.info("federated round %d: aggregated %d clusters, "
+                    "screened %d", round_idx, len(report.admitted),
+                    len(report.screened))
 
     # Global eval of the aggregated model on held-out data.
     eval_X, eval_y = eval_set
     from dragonfly2_tpu.models.mlp import predict_bandwidth
 
-    model = per_cluster[datasets[0].scheduler_id].model
     pred = np.asarray(predict_bandwidth(
         model, global_params, normalizer, target_norm, eval_X))
     err = pred - eval_y
@@ -209,6 +513,9 @@ def train_federated_mlp(
         mae=float(np.abs(err).mean()),
         lineage=lineage,
         per_cluster=per_cluster,
+        screened=screened_rounds,
+        updates_screened=updates_screened,
+        escalated=escalated,
     )
 
 
@@ -219,10 +526,15 @@ def train_federated_mlp(
 
 def register_federated_model(manager, result: FederatedResult,
                              model_id: str = "df2-mlp-global",
-                             hostname: str = "manager") -> None:
-    """Register the aggregate under GLOBAL_SCHEDULER_ID with lineage in the
-    evaluation payload; per-cluster models keep their own registry rows and
-    single-active invariants."""
+                             hostname: str = "manager",
+                             traces=None):
+    """Register the aggregate under GLOBAL_SCHEDULER_ID with lineage (both
+    admitted contributions and screened-update reasons) in the evaluation
+    payload; per-cluster models keep their own registry rows and
+    single-active invariants. ``traces`` (feature batches) flow to the
+    PR-11 validation gate: the aggregate lands as a CANDIDATE and only
+    activates if the gate passes — a poisoned aggregate that slips the
+    screens still cannot activate. Returns the registry row."""
     import math
     import shutil
     import tempfile
@@ -236,6 +548,10 @@ def register_federated_model(manager, result: FederatedResult,
     lineage = [
         {str(sid): n for sid, n in round_contrib.items()}
         for round_contrib in result.lineage
+    ]
+    screened = [
+        {str(sid): reason for sid, reason in round_screened.items()}
+        for round_screened in result.screened
     ]
     # NaN is not valid JSON to strict parsers; omit undefined metrics.
     evaluation = {
@@ -253,22 +569,60 @@ def register_federated_model(manager, result: FederatedResult,
                 config={
                     "hidden": list(result.config.local.hidden),
                     "federated_rounds": result.config.rounds,
+                    "aggregator": result.config.aggregator,
                     "lineage": lineage,
+                    "screened": screened,
+                    "updates_screened": result.updates_screened,
+                    "escalated": list(result.escalated),
                 },
             ),
         )
-        manager.create_model(
+        return manager.create_model(
             model_id=model_id, model_type="mlp", host_id="federated",
             ip="", hostname=hostname,
             evaluation={
                 **evaluation,
                 "clusters": len(result.lineage[-1] if result.lineage else {}),
+                "updates_screened": result.updates_screened,
             },
             artifact_dir=tmp,
             scheduler_id=GLOBAL_SCHEDULER_ID,
+            traces=traces,
         )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def escalate_screened_clusters(manager, scheduler_ids: Sequence[int],
+                               model_type: str = "mlp",
+                               reason: str = "federated-screen") -> Dict[
+                                   int, Optional[str]]:
+    """Registry consequence for a persistently lying cluster: its ACTIVE
+    per-cluster model is quarantined through the PR-11 gate path
+    (``ManagerService.quarantine_version`` — terminal state, previous
+    version restored), so the cluster's own serving plane falls back
+    while its updates stay out of the aggregate. Returns
+    {scheduler_id: quarantined version or None when the cluster had no
+    active model to quarantine}."""
+    quarantined: Dict[int, Optional[int]] = {}
+    for sid in scheduler_ids:
+        row = manager.get_active_model(model_type, scheduler_id=sid)
+        if row is None:
+            logger.warning("escalation: cluster %d has no active %s model",
+                           sid, model_type)
+            quarantined[sid] = None
+            continue
+        # Returns the RESTORED predecessor (None when the cluster had no
+        # earlier good version) — the quarantine itself is unconditional.
+        restored = manager.quarantine_version(
+            model_type, row.version, scheduler_id=sid,
+            reason=f"{reason}: screened {sid}")
+        quarantined[sid] = str(row.version)
+        logger.warning("escalation: cluster %d %s v%s quarantined (%s)%s",
+                       sid, model_type, row.version, reason,
+                       f"; restored v{restored.version}"
+                       if restored is not None else "")
+    return quarantined
 
 
 def aggregate_cluster_models(manager, hidden: Sequence[int],

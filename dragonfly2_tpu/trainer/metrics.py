@@ -32,6 +32,16 @@ class TrainerMetrics:
             "Interval-driver cycles skipped for a host (no new "
             "segments since the last cycle).",
             namespace=ns, subsystem=sub, registry=self.registry)
+        self.federated_rounds = Counter(
+            "federated_rounds_total",
+            "Federated rounds committed by the attached "
+            "FederationCoordinator.",
+            namespace=ns, subsystem=sub, registry=self.registry)
+        self.federated_updates_screened = Counter(
+            "federated_updates_screened_total",
+            "Per-cluster updates rejected by the federated admission "
+            "screen (nonfinite / norm_bound / holdout_regression).",
+            namespace=ns, subsystem=sub, registry=self.registry)
         self.training_duration = Histogram(
             "training_duration_seconds", "One training job's duration.",
             labelnames=("model",),

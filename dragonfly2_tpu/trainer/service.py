@@ -109,6 +109,15 @@ class TrainerService:
         self._host_identities: dict = {}
         self._cycle_stop = threading.Event()
         self._cycle_thread: Optional[threading.Thread] = None
+        self._federation = None  # FederationCoordinator, when attached
+
+    def attach_federation(self, coordinator) -> None:
+        """Attach a ``trainer.federation.FederationCoordinator``: every
+        training cycle then also drives one quorum-committed federated
+        round (screened aggregation + durable journal) after the
+        per-host jobs. Quorum failures are logged, counted, and retried
+        on the next cycle — the journal keeps partial rounds."""
+        self._federation = coordinator
 
     def Train(self, request_iterator, context) -> TrainResponse:
         first: Optional[TrainRequest] = None
@@ -248,7 +257,20 @@ class TrainerService:
                 skipped.append(host_id)
                 if self.metrics:
                     self.metrics.train_cycle_skips.inc()
-        return {"trained": trained, "skipped": skipped}
+        cycle = {"trained": trained, "skipped": skipped}
+        if self._federation is not None:
+            try:
+                report = self._federation.run_round()
+                cycle["federated"] = report.to_dict()
+                if self.metrics:
+                    self.metrics.federated_rounds.inc()
+                    if report.screened:
+                        self.metrics.federated_updates_screened.inc(
+                            len(report.screened))
+            except Exception as exc:  # noqa: BLE001 — cycle must not die
+                logger.warning("federated round failed: %s", exc)
+                cycle["federated"] = {"error": str(exc)}
+        return cycle
 
     def start_cycle_driver(self, interval_s: float) -> None:
         """Retrain on a timer whenever new dataset segments arrived —

@@ -1,4 +1,7 @@
-"""Federated multi-cluster training tests (config #4)."""
+"""Federated multi-cluster training tests (config #4) + the ISSUE-20
+Byzantine-robust round machinery: admission screens, robust
+aggregators, the pooled-normalizer float64 discipline, and the
+crash-safe coordinator (quorum, stragglers, journal resume)."""
 
 from __future__ import annotations
 
@@ -12,11 +15,17 @@ from dragonfly2_tpu.parallel import data_parallel_mesh
 from dragonfly2_tpu.train.federated import (
     GLOBAL_SCHEDULER_ID,
     ClusterDataset,
+    ClusterUpdate,
     FederatedConfig,
+    aggregate_updates,
+    column_moments,
+    escalate_screened_clusters,
     fedavg,
     pooled_normalizers,
     register_federated_model,
+    screen_updates,
     train_federated_mlp,
+    trimmed_mean,
 )
 from dragonfly2_tpu.train.mlp_trainer import MLPTrainConfig
 
@@ -47,6 +56,190 @@ class TestFedMath:
         exact = Normalizer.fit(all_X)
         np.testing.assert_allclose(feat.mean, exact.mean, rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(feat.std, exact.std, rtol=1e-3, atol=1e-3)
+
+    def test_pooled_normalizer_million_row_float64_sums(self):
+        """Satellite regression (ISSUE 20): on a million float32 rows
+        with a large common offset, a float32 running sum loses
+        low-order mass and the pooled std collapses toward the epsilon
+        floor. Both moment sums must accumulate in float64, keeping the
+        pooled normalizer tight against a centrally fitted one."""
+        rng = np.random.default_rng(0)
+        X = (rng.normal(size=(1_000_000, 3)) * 0.5 + 4096.0).astype(
+            np.float32)
+        y = np.abs(rng.normal(size=1_000_000)).astype(np.float32) + 1.0
+        half = len(X) // 2
+        datasets = [ClusterDataset(1, X[:half], y[:half]),
+                    ClusterDataset(2, X[half:], y[half:])]
+        feat, target = pooled_normalizers(datasets)
+        exact = Normalizer.fit(X)
+        np.testing.assert_allclose(feat.mean, exact.mean, rtol=1e-6)
+        np.testing.assert_allclose(feat.std, exact.std, rtol=1e-3)
+        # The float32-accumulation failure mode this guards against:
+        n, s1, s2 = column_moments(X)
+        bad_s2 = (X**2).sum(axis=0, dtype=np.float32).astype(np.float64)
+        bad_var = bad_s2 / n - (s1 / n) ** 2
+        assert not np.allclose(np.sqrt(np.maximum(bad_var, 0.0)),
+                               exact.std - 1e-6, rtol=1e-3)
+
+    def test_trimmed_mean_drops_tails(self):
+        trees = [{"w": np.full((2,), float(v), np.float32)}
+                 for v in (0.0, 1.0, 2.0, 3.0, 100.0)]
+        out = trimmed_mean(trees, trim_fraction=0.2)  # k=1: drop 0 and 100
+        np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+
+    def test_trimmed_mean_outvotes_one_attacker(self):
+        honest = [{"w": np.array([1.0, -1.0], np.float32)} for _ in range(4)]
+        attacker = {"w": np.array([1e9, -1e9], np.float32)}
+        out = trimmed_mean(honest + [attacker], trim_fraction=0.2)
+        np.testing.assert_allclose(np.asarray(out["w"]), [1.0, -1.0])
+
+    def test_aggregate_updates_dispatch(self):
+        u = [ClusterUpdate(i, {"w": np.full((2,), float(i), np.float32)}, 10)
+             for i in (1, 2)]
+        # 2 updates degrade trimmed_mean to (here unweighted) fedavg.
+        out = aggregate_updates(u, "trimmed_mean")
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.5)
+        with pytest.raises(ValueError):
+            aggregate_updates(u, "krum")
+
+
+class _LinModel:
+    """Stand-in for the flax MLP in screen units: ``apply`` is a linear
+    map in the normalized feature/target z-space the screen scores in."""
+
+    def apply(self, params, x):
+        return np.asarray(x) @ np.asarray(params["w"])
+
+
+def _identity_norms(dim=1):
+    eye = Normalizer(mean=np.zeros(dim, np.float32),
+                     std=np.ones(dim, np.float32))
+    tgt = Normalizer(mean=np.zeros(1, np.float32),
+                     std=np.ones(1, np.float32))
+    return eye, tgt
+
+
+def _slice_for(w, n=64, seed=0):
+    """A holdout slice a ``_LinModel`` with weights ``w`` fits exactly:
+    z_true = x @ w, so y = expm1(x @ w)."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 1)).astype(np.float32)
+    y = np.expm1(X @ np.asarray(w)).astype(np.float32)
+    return X, y
+
+
+class TestScreens:
+    def _cfg(self, **kw):
+        base = dict(local=TINY, screen_norm_factor=4.0,
+                    screen_holdout_factor=3.0)
+        base.update(kw)
+        return FederatedConfig(**base)
+
+    def test_nonfinite_screened(self):
+        updates = [
+            ClusterUpdate(1, {"w": np.zeros(2, np.float32)}, 10),
+            ClusterUpdate(2, {"w": np.array([1.0, np.nan], np.float32)}, 10),
+        ]
+        report = screen_updates(updates, {"w": np.zeros(2, np.float32)},
+                                config=self._cfg())
+        assert report.screened == {2: "nonfinite"}
+        assert [u.scheduler_id for u in report.admitted] == [1]
+
+    def test_norm_bound_needs_three_finite(self):
+        gp = {"w": np.zeros(2, np.float32)}
+        big = ClusterUpdate(2, {"w": np.full(2, 1e6, np.float32)}, 10)
+        small = ClusterUpdate(1, {"w": np.full(2, 0.1, np.float32)}, 10)
+        report = screen_updates([small, big], gp, config=self._cfg())
+        assert report.screened == {}  # two finite: median unsafe, no screen
+        third = ClusterUpdate(3, {"w": np.full(2, 0.2, np.float32)}, 10)
+        report = screen_updates([small, big, third], gp, config=self._cfg())
+        assert report.screened == {2: "norm_bound"}
+        assert sorted(u.scheduler_id for u in report.admitted) == [1, 3]
+
+    def test_holdout_slice_median_defuses_poisoned_slice(self):
+        """The lying cluster volunteers a holdout slice with its own
+        poisoned labels. A pooled-mean score would reward the liar on
+        its slice and punish honest models there; the per-slice MEDIAN
+        ignores the minority poisoned slice and the liar alone fails
+        the regression screen."""
+        model = _LinModel()
+        normalizer, target_norm = _identity_norms()
+        honest_w = np.array([[1.0]], np.float32)
+        liar_w = np.array([[-1.0]], np.float32)
+        updates = [
+            ClusterUpdate(1, {"w": honest_w}, 40),
+            ClusterUpdate(2, {"w": honest_w * 1.01}, 40),
+            ClusterUpdate(3, {"w": honest_w * 0.99}, 40),
+            ClusterUpdate(4, {"w": liar_w}, 40),
+        ]
+        slices = [_slice_for(honest_w, seed=s) for s in (1, 2, 3)]
+        slices.append(_slice_for(liar_w, seed=4))  # poisoned labels
+        report = screen_updates(
+            updates, {"w": np.zeros_like(honest_w)},
+            config=self._cfg(screen_norm_factor=0.0), model=model,
+            normalizer=normalizer, target_norm=target_norm, holdout=slices)
+        assert report.screened == {4: "holdout_regression"}
+        assert sorted(u.scheduler_id for u in report.admitted) == [1, 2, 3]
+        assert report.holdout_mse[4] > 3.0 * report.holdout_mse[1]
+
+    def test_holdout_two_survivors_judges_against_peer(self):
+        model = _LinModel()
+        normalizer, target_norm = _identity_norms()
+        honest_w = np.array([[1.0]], np.float32)
+        liar_w = np.array([[-1.0]], np.float32)
+        updates = [ClusterUpdate(1, {"w": honest_w}, 40),
+                   ClusterUpdate(2, {"w": liar_w}, 40)]
+        report = screen_updates(
+            updates, {"w": np.zeros_like(honest_w)},
+            config=self._cfg(screen_norm_factor=0.0), model=model,
+            normalizer=normalizer, target_norm=target_norm,
+            holdout=[_slice_for(honest_w, seed=1)])
+        assert report.screened == {2: "holdout_regression"}
+
+    def test_screens_disabled(self):
+        gp = {"w": np.zeros(2, np.float32)}
+        updates = [
+            ClusterUpdate(1, {"w": np.full(2, 0.1, np.float32)}, 10),
+            ClusterUpdate(2, {"w": np.full(2, 1e6, np.float32)}, 10),
+            ClusterUpdate(3, {"w": np.full(2, 0.2, np.float32)}, 10),
+        ]
+        report = screen_updates(
+            updates, gp,
+            config=self._cfg(screen_norm_factor=0.0,
+                             screen_holdout_factor=0.0))
+        assert report.screened == {}
+        assert len(report.admitted) == 3
+
+
+class TestEscalation:
+    def test_escalates_active_model_to_quarantine(self, tmp_path):
+        import tempfile
+
+        from dragonfly2_tpu.train.checkpoint import (
+            ModelMetadata,
+            mlp_tree,
+            save_model,
+        )
+        from dragonfly2_tpu.train.mlp_trainer import train_mlp
+
+        manager = ManagerService(
+            Database(), FilesystemObjectStore(str(tmp_path / "obj")))
+        ds = make_datasets(1, 300)[0]
+        result = train_mlp(ds.X, ds.y, TINY, data_parallel_mesh())
+        d = tempfile.mkdtemp(dir=tmp_path)
+        save_model(
+            d, mlp_tree(result.params, result.normalizer,
+                        result.target_norm),
+            ModelMetadata(model_id="m7", model_type="mlp",
+                          evaluation={"mae": result.mae},
+                          config={"hidden": list(TINY.hidden)}))
+        manager.create_model("m7", "mlp", "h", "1.1.1.1", "hn",
+                             {"mae": result.mae}, d, scheduler_id=7)
+        assert manager.get_active_model("mlp", scheduler_id=7) is not None
+        out = escalate_screened_clusters(manager, [7, 8])
+        assert out[7] is not None
+        assert out[8] is None  # nothing registered for cluster 8
+        assert manager.get_active_model("mlp", scheduler_id=7) is None
 
 
 @pytest.mark.slow  # multi-cluster training rounds (~20 s of MLP fits)
@@ -107,6 +300,274 @@ class TestFederatedTraining:
     def test_empty_datasets_rejected(self):
         with pytest.raises(ValueError):
             train_federated_mlp([], FederatedConfig(local=TINY))
+
+
+class TestDegenerateClusters:
+    """Satellite fix (ISSUE 20): a 1-example cluster used to carve a
+    1-row holdout and hand train_mlp an EMPTY training set."""
+
+    def test_single_example_cluster_is_holdout_only(self):
+        datasets = make_datasets(1, 400)
+        tiny_cluster = ClusterDataset(9, datasets[0].X[:1],
+                                      datasets[0].y[:1])
+        result = train_federated_mlp(
+            [datasets[0], tiny_cluster],
+            FederatedConfig(local=TINY, rounds=1), data_parallel_mesh())
+        # The degenerate cluster never fits locally; its row feeds the
+        # pooled holdout instead.
+        assert set(result.lineage[0]) == {1}
+        assert 9 not in result.per_cluster
+        assert np.isfinite(result.mae)
+
+    def test_single_example_cluster_dropped_with_caller_eval_set(self):
+        datasets = make_datasets(1, 400)
+        tiny_cluster = ClusterDataset(9, datasets[0].X[:1],
+                                      datasets[0].y[:1])
+        eval_X, eval_y = datasets[0].X[:50], datasets[0].y[:50]
+        result = train_federated_mlp(
+            [datasets[0], tiny_cluster],
+            FederatedConfig(local=TINY, rounds=1), data_parallel_mesh(),
+            eval_set=(eval_X, eval_y))
+        assert set(result.lineage[0]) == {1}
+
+    def test_all_degenerate_rejected(self):
+        ds = make_datasets(1, 40)[0]
+        with pytest.raises(ValueError):
+            train_federated_mlp(
+                [ClusterDataset(1, ds.X[:1], ds.y[:1])],
+                FederatedConfig(local=TINY, rounds=1))
+
+
+class StubEndpoint:
+    """Coordinator-protocol endpoint with no jax training: each round
+    returns the global params shifted by a per-cluster constant (or NaN
+    poison), so quorum/straggler/journal behavior tests run in
+    milliseconds."""
+
+    def __init__(self, scheduler_id: int, *, fail_always: bool = False,
+                 fail_times: int = 0, poison_nan: bool = False):
+        self.scheduler_id = scheduler_id
+        self.fail_always = fail_always
+        self.fail_times = fail_times
+        self.poison_nan = poison_nan
+        self.train_calls = 0
+        rng = np.random.default_rng(scheduler_id)
+        self._X = rng.normal(size=(40, 3)).astype(np.float32)
+        self._y = (np.abs(rng.normal(size=40)) + 1.0).astype(np.float32)
+
+    def moments(self):
+        return (column_moments(self._X),
+                column_moments(np.log1p(self._y)[:, None]))
+
+    def holdout(self):
+        return (np.empty((0, 3), np.float32), np.empty((0,), np.float32))
+
+    def train_round(self, round_idx, global_params, normalizer, target_norm):
+        self.train_calls += 1
+        if self.fail_always:
+            raise RuntimeError(f"cluster {self.scheduler_id} down")
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError(f"cluster {self.scheduler_id} flaky")
+        import jax
+
+        shift = (np.nan if self.poison_nan
+                 else 0.01 * self.scheduler_id)
+        params = jax.tree.map(
+            lambda leaf: np.asarray(leaf, np.float32) + shift,
+            global_params)
+        return ClusterUpdate(self.scheduler_id, params, len(self._X))
+
+
+def _fed_config(**kw):
+    from dragonfly2_tpu.trainer.federation import FederationConfig
+
+    fed = kw.pop("fed", FederatedConfig(
+        local=MLPTrainConfig(hidden=(4,), epochs=1, batch_size=32,
+                             eval_fraction=0.2)))
+    base = dict(fed=fed, quorum=2, round_deadline_s=10.0,
+                retry_limit=1, retry_base_s=0.001, retry_cap_s=0.002)
+    base.update(kw)
+    return FederationConfig(**base)
+
+
+class TestFederationCoordinator:
+    def test_pack_unpack_roundtrip(self):
+        from dragonfly2_tpu.trainer.federation import (
+            pack_params,
+            unpack_params,
+        )
+
+        tree = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                          "b": np.array([1.5, -2.0], np.float64)},
+                "out": {"w": np.zeros((3, 1), np.float32)}}
+        restored = unpack_params(pack_params(tree))
+        assert set(restored) == {"layer", "out"}
+        np.testing.assert_array_equal(restored["layer"]["w"],
+                                      tree["layer"]["w"])
+        np.testing.assert_array_equal(restored["layer"]["b"],
+                                      tree["layer"]["b"])
+        assert restored["layer"]["b"].dtype == np.float64
+        bare = np.arange(4, dtype=np.float32)
+        np.testing.assert_array_equal(unpack_params(pack_params(bare)), bare)
+
+    def test_quorum_outside_range_rejected(self, tmp_path):
+        from dragonfly2_tpu.trainer.federation import FederationCoordinator
+
+        endpoints = [StubEndpoint(1), StubEndpoint(2)]
+        with pytest.raises(ValueError):
+            FederationCoordinator(endpoints, str(tmp_path),
+                                  _fed_config(quorum=3))
+        with pytest.raises(ValueError):
+            FederationCoordinator(endpoints, str(tmp_path),
+                                  _fed_config(quorum=0))
+
+    def test_straggler_commits_at_quorum(self, tmp_path):
+        from dragonfly2_tpu.trainer.federation import FederationCoordinator
+
+        endpoints = [StubEndpoint(1), StubEndpoint(2),
+                     StubEndpoint(3, fail_always=True)]
+        coordinator = FederationCoordinator(
+            endpoints, str(tmp_path), _fed_config(quorum=2))
+        report = coordinator.run_round()
+        assert report.committed
+        assert report.received == [1, 2]
+        assert report.stragglers == [3]
+        assert coordinator.stats["rounds_committed"] == 1
+
+    def test_transient_failure_retried(self, tmp_path):
+        from dragonfly2_tpu.trainer.federation import FederationCoordinator
+
+        flaky = StubEndpoint(2, fail_times=1)
+        coordinator = FederationCoordinator(
+            [StubEndpoint(1), flaky], str(tmp_path),
+            _fed_config(quorum=2))
+        report = coordinator.run_round()
+        assert report.committed
+        assert report.received == [1, 2]
+        assert flaky.train_calls == 2  # one failure + one retry
+
+    def test_quorum_failure_keeps_journal_then_resumes(self, tmp_path):
+        """The crash-safe contract without a SIGKILL: a round that dies
+        short of quorum keeps its journaled updates; the next coordinator
+        life resumes the SAME round, trains only the missing cluster, and
+        commits bit-identically to an uninterrupted run."""
+        from dragonfly2_tpu.trainer.federation import (
+            FederationCoordinator,
+            FederationQuorumError,
+        )
+
+        config = _fed_config(quorum=3, retry_limit=0)
+        first = [StubEndpoint(1), StubEndpoint(2),
+                 StubEndpoint(3, fail_always=True)]
+        coordinator = FederationCoordinator(first, str(tmp_path), config)
+        with pytest.raises(FederationQuorumError):
+            coordinator.run_round()
+        assert coordinator.stats["quorum_failures"] == 1
+
+        second = [StubEndpoint(1), StubEndpoint(2), StubEndpoint(3)]
+        resumed = FederationCoordinator(second, str(tmp_path), config)
+        report = resumed.run_round()
+        assert report.committed
+        assert report.round == 0
+        assert report.resumed == [1, 2]
+        assert report.received == [1, 2, 3]
+        # Journaled clusters never retrain on resume.
+        assert second[0].train_calls == 0
+        assert second[1].train_calls == 0
+        assert second[2].train_calls == 1
+
+        # Same data, same seed, no interruption => bit-identical commit.
+        import jax
+
+        clean = FederationCoordinator(
+            [StubEndpoint(1), StubEndpoint(2), StubEndpoint(3)],
+            str(tmp_path / "clean"), config)
+        clean.run_round()
+        for a, b in zip(jax.tree.leaves(resumed.global_params),
+                        jax.tree.leaves(clean.global_params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_nan_endpoint_screened_and_escalated(self, tmp_path):
+        from dragonfly2_tpu.trainer.federation import FederationCoordinator
+
+        fed = FederatedConfig(
+            local=MLPTrainConfig(hidden=(4,), epochs=1, batch_size=32,
+                                 eval_fraction=0.2),
+            screen_quarantine_rounds=2)
+        endpoints = [StubEndpoint(1), StubEndpoint(2),
+                     StubEndpoint(5, poison_nan=True)]
+        coordinator = FederationCoordinator(
+            endpoints, str(tmp_path), _fed_config(fed=fed, quorum=3))
+        first = coordinator.run_round()
+        assert first.screened == {5: "nonfinite"}
+        assert first.admitted == [1, 2]
+        assert first.escalated == []
+        second = coordinator.run_round()
+        assert second.screened == {5: "nonfinite"}
+        assert second.escalated == [5]  # strike threshold reached
+        assert coordinator.stats["updates_screened"] == 2
+
+    def test_state_survives_restart_between_rounds(self, tmp_path):
+        from dragonfly2_tpu.trainer.federation import FederationCoordinator
+
+        config = _fed_config(quorum=2)
+        coordinator = FederationCoordinator(
+            [StubEndpoint(1), StubEndpoint(2)], str(tmp_path), config)
+        coordinator.run_round()
+        import jax
+
+        committed = [np.asarray(leaf) for leaf in
+                     jax.tree.leaves(coordinator.global_params)]
+        reloaded = FederationCoordinator(
+            [StubEndpoint(1), StubEndpoint(2)], str(tmp_path), config)
+        assert reloaded.next_round == 1
+        for a, b in zip(jax.tree.leaves(reloaded.global_params), committed):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+
+@pytest.mark.slow
+@pytest.mark.fed  # full-path federation with real local MLP fits
+class TestFederationEndToEnd:
+    def test_two_runs_bit_identical(self, tmp_path):
+        """Same corpora + same seed => bit-identical global params, with
+        REAL local training through the coordinator (the determinism the
+        journal-resume contract leans on)."""
+        import jax
+
+        from dragonfly2_tpu.train.fedbench import (
+            _kill_local_config,
+            synth_cluster_corpora,
+        )
+        from dragonfly2_tpu.train.federated import (
+            cluster_datasets_from_corpora,
+        )
+        from dragonfly2_tpu.trainer.federation import (
+            FederationConfig,
+            FederationCoordinator,
+            LocalClusterEndpoint,
+        )
+
+        local = _kill_local_config(seed=0)
+        config = FederationConfig(fed=FederatedConfig(local=local),
+                                  quorum=3, round_deadline_s=120.0)
+        mesh = data_parallel_mesh()
+
+        def one_run(journal_dir):
+            corpora = synth_cluster_corpora(3, 120, seed=0)
+            endpoints = [LocalClusterEndpoint(ds, local, mesh)
+                         for ds in cluster_datasets_from_corpora(corpora)]
+            coordinator = FederationCoordinator(
+                endpoints, str(journal_dir), config)
+            reports = coordinator.run(2)
+            assert all(r.committed for r in reports)
+            return [np.asarray(leaf) for leaf in
+                    jax.tree.leaves(coordinator.global_params)]
+
+        first = one_run(tmp_path / "a")
+        second = one_run(tmp_path / "b")
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
 
 
 class TestManagerAggregation:
